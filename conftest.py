@@ -1,0 +1,30 @@
+"""Repo-level pytest bootstrap.
+
+Tests exercise the multi-chip code paths on a virtualized 8-device CPU
+"mesh" (the TPU-native answer to testing multi-node without a pod, see
+SURVEY.md §4): XLA is forced onto the host platform and told to expose 8
+devices BEFORE any backend is initialized. Set PMDT_TEST_ON_TPU=1 to run
+the suite against real chips instead (note: multi-device tests assume 8
+devices; on smaller real topologies they will skip/fail by design).
+
+Note: this environment pre-imports jax at interpreter startup (axon
+sitecustomize), so env vars alone are too late — jax.config must be
+updated directly.
+"""
+
+import os
+import sys
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+if not os.environ.get("PMDT_TEST_ON_TPU"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(__file__))

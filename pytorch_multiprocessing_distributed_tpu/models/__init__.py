@@ -1,0 +1,38 @@
+"""Model zoo.
+
+Flax re-designs of the reference's model layer (``model/resnet.py``).
+The registry is the seam where the families the reference's CLI
+advertises but never implemented (``--model dense|vgg``, reference
+``main.py:24`` — selecting them raises ``UnboundLocalError`` at
+``main.py:39-40``) and the scale-out families from BASELINE.md
+(ViT, ConvNeXt) plug in as they land.
+
+All models are NHWC (TPU-native layout), take a ``train`` flag, and carry
+their BatchNorm cross-replica axis name so the same module is correct on
+1 chip or a full pod.
+"""
+
+from .resnet import (
+    BasicBlock,
+    Bottleneck,
+    ResNet,
+    ResNet18,
+    ResNet34,
+    ResNet50,
+    ResNet101,
+    ResNet152,
+)
+from .registry import get_model, MODEL_REGISTRY
+
+__all__ = [
+    "BasicBlock",
+    "Bottleneck",
+    "ResNet",
+    "ResNet18",
+    "ResNet34",
+    "ResNet50",
+    "ResNet101",
+    "ResNet152",
+    "get_model",
+    "MODEL_REGISTRY",
+]

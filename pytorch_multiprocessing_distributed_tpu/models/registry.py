@@ -1,0 +1,46 @@
+"""Model registry — the trainer's model-selection seam.
+
+The reference selects models by string flag (``--model res`` at
+``main.py:24,39-40``) but only ever implements ``'res'`` (ResNet-18);
+``dense``/``vgg`` crash with ``UnboundLocalError``. Here unknown names
+fail loudly with the list of real constructors, and the registry is the
+extension point the wider zoo (vgg/densenet/vit/convnext modules) and
+BASELINE.md configs #4/#5 plug into via :func:`register`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict
+
+from . import resnet
+
+MODEL_REGISTRY: Dict[str, Callable[..., Any]] = {
+    # reference CLI name -> constructor ('res' is ResNet18, main.py:39-40)
+    "res": resnet.ResNet18,
+    "resnet18": resnet.ResNet18,
+    "resnet34": resnet.ResNet34,
+    "resnet50": resnet.ResNet50,
+    "resnet101": resnet.ResNet101,
+    "resnet152": resnet.ResNet152,
+}
+
+
+def register(name: str):
+    """Decorator: add a model constructor under ``name``."""
+
+    def deco(fn):
+        MODEL_REGISTRY[name] = fn
+        return fn
+
+    return deco
+
+
+def get_model(name: str, **kwargs):
+    """Instantiate a model by CLI name. Raises KeyError with the known names."""
+    try:
+        ctor = MODEL_REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"Unknown model '{name}'. Available: {sorted(MODEL_REGISTRY)}"
+        ) from None
+    return ctor(**kwargs)

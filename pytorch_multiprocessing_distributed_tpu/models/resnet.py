@@ -1,0 +1,186 @@
+"""CIFAR-style ResNet family as Flax modules.
+
+TPU-first re-design of reference ``model/resnet.py`` (NOT a translation):
+
+- NHWC layout (XLA:TPU's native conv layout) instead of torch's NCHW.
+- Cross-replica :class:`..ops.SyncBatchNorm` is built in via ``bn_axis``
+  instead of an after-the-fact ``convert_sync_batchnorm`` pass
+  (reference ``main.py:43``).
+- A ``dtype`` knob runs the conv/matmul path in bf16 on the MXU while
+  keeping params and BN statistics in f32.
+
+Architecture parity (reference ``model/resnet.py``):
+- CIFAR stem: 3x3 stride-1 conv, 64ch, no bias, no maxpool (``:79-81``).
+- Four stages 64/128/256/512, stride 2 for stages 2-4, downsample via
+  1x1-conv + BN shortcut when shape changes (``:28-33, :82-94``).
+- ``BasicBlock`` (expansion 1, ``:15-40``) / ``Bottleneck`` (expansion 4,
+  ``:43-71``) with post-add ReLU.
+- Window-4 average pool (``avg_pool2d(out, 4)``, ``:102``) then linear
+  head, ``num_classes=10`` (``:86``).
+- **``ResNet18`` keeps the reference's non-standard ``[1, 1, 1, 1]``
+  block counts** (``:108-109``); 34/50/101/152 use standard counts
+  (``:112-125``). Parameter counts are pinned by tests.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..ops.batch_norm import SyncBatchNorm
+
+# torch Conv2d's default kaiming_uniform(a=sqrt(5)) is a GPU-era historical
+# accident; he_normal fan_out is the ResNet-paper init and works as well or
+# better. Deviation documented in SURVEY.md terms: init distribution only,
+# architecture identical.
+conv_kernel_init = nn.initializers.variance_scaling(2.0, "fan_out", "normal")
+dense_init = nn.initializers.lecun_normal()
+
+
+class ConvBN(nn.Module):
+    """3x3/1x1 conv (no bias) followed by (sync) batch norm."""
+
+    features: int
+    kernel_size: int = 3
+    stride: int = 1
+    dtype: Any = jnp.float32
+    bn_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        x = nn.Conv(
+            self.features,
+            (self.kernel_size, self.kernel_size),
+            strides=(self.stride, self.stride),
+            padding=[(self.kernel_size // 2, self.kernel_size // 2)] * 2,
+            use_bias=False,
+            kernel_init=conv_kernel_init,
+            dtype=self.dtype,
+            name="conv",
+        )(x)
+        x = SyncBatchNorm(
+            use_running_average=not train,
+            axis_name=self.bn_axis,
+            dtype=self.dtype,
+            name="bn",
+        )(x)
+        return x
+
+
+class BasicBlock(nn.Module):
+    """Two 3x3 convs with identity/projection shortcut (reference ``:15-40``)."""
+
+    planes: int
+    stride: int = 1
+    dtype: Any = jnp.float32
+    bn_axis: Optional[str] = None
+    expansion: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        out_ch = self.planes * self.expansion
+        out = ConvBN(
+            self.planes, 3, self.stride, self.dtype, self.bn_axis, name="cb1"
+        )(x, train)
+        out = nn.relu(out)
+        out = ConvBN(self.planes, 3, 1, self.dtype, self.bn_axis, name="cb2")(
+            out, train
+        )
+        if self.stride != 1 or x.shape[-1] != out_ch:
+            x = ConvBN(out_ch, 1, self.stride, self.dtype, self.bn_axis,
+                       name="shortcut")(x, train)
+        return nn.relu(out + x)
+
+
+class Bottleneck(nn.Module):
+    """1x1 -> 3x3 -> 1x1 bottleneck, expansion 4 (reference ``:43-71``)."""
+
+    planes: int
+    stride: int = 1
+    dtype: Any = jnp.float32
+    bn_axis: Optional[str] = None
+    expansion: int = 4
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        out_ch = self.planes * self.expansion
+        out = ConvBN(self.planes, 1, 1, self.dtype, self.bn_axis, name="cb1")(
+            x, train
+        )
+        out = nn.relu(out)
+        out = ConvBN(
+            self.planes, 3, self.stride, self.dtype, self.bn_axis, name="cb2"
+        )(out, train)
+        out = nn.relu(out)
+        out = ConvBN(out_ch, 1, 1, self.dtype, self.bn_axis, name="cb3")(out, train)
+        if self.stride != 1 or x.shape[-1] != out_ch:
+            x = ConvBN(out_ch, 1, self.stride, self.dtype, self.bn_axis,
+                       name="shortcut")(x, train)
+        return nn.relu(out + x)
+
+
+class ResNet(nn.Module):
+    """CIFAR-stem ResNet (reference ``:74-105``).
+
+    Input ``[batch, 32, 32, 3]`` NHWC; output ``[batch, num_classes]``.
+    """
+
+    block: Callable[..., nn.Module]
+    num_blocks: Sequence[int]
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+    bn_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = ConvBN(64, 3, 1, self.dtype, self.bn_axis, name="stem")(x, train)
+        x = nn.relu(x)
+        for stage, (planes, n_blocks) in enumerate(
+            zip((64, 128, 256, 512), self.num_blocks)
+        ):
+            stride = 1 if stage == 0 else 2
+            for i in range(n_blocks):
+                x = self.block(
+                    planes,
+                    stride if i == 0 else 1,
+                    self.dtype,
+                    self.bn_axis,
+                    name=f"layer{stage + 1}_{i}",
+                )(x, train)
+        # Literal parity with `F.avg_pool2d(out, 4)` (reference :102):
+        # window-4 pool, which is global for the 32x32 stem (4x4 features).
+        x = nn.avg_pool(x, (4, 4), strides=(4, 4))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(
+            self.num_classes,
+            dtype=self.dtype,
+            kernel_init=dense_init,
+            name="linear",
+        )(x)
+        return x.astype(jnp.float32)
+
+
+def ResNet18(**kw) -> ResNet:
+    """Reference's non-standard [1,1,1,1] ResNet-18 (``:108-109``)."""
+    return ResNet(BasicBlock, (1, 1, 1, 1), **kw)
+
+
+def ResNet34(**kw) -> ResNet:
+    return ResNet(BasicBlock, (3, 4, 6, 3), **kw)
+
+
+def ResNet50(**kw) -> ResNet:
+    return ResNet(Bottleneck, (3, 4, 6, 3), **kw)
+
+
+def ResNet101(**kw) -> ResNet:
+    return ResNet(Bottleneck, (3, 4, 23, 3), **kw)
+
+
+def ResNet152(**kw) -> ResNet:
+    return ResNet(Bottleneck, (3, 8, 36, 3), **kw)

@@ -1,0 +1,110 @@
+"""Cross-replica synchronized batch normalization.
+
+TPU-native equivalent of ``torch.nn.SyncBatchNorm`` (applied to every BN
+layer of the reference model at ``main.py:43`` via
+``convert_sync_batchnorm``). Instead of a NCCL all-reduce of per-GPU
+statistics inside a CUDA kernel, the batch mean and mean-of-squares are
+``lax.pmean``-ed over the named ``data`` mesh axis — XLA lowers this to an
+ICI all-reduce fused into the surrounding computation.
+
+Semantics match torch BatchNorm2d/SyncBatchNorm exactly (gated by tests
+in ``tests/test_batch_norm.py``):
+
+- normalization uses the *biased* batch variance (``E[x^2] - E[x]^2`` over
+  the GLOBAL batch when an axis name is given);
+- running stats follow torch's convention
+  ``running = (1 - momentum) * running + momentum * stat`` with
+  ``momentum = 0.1`` (note: flax linen's ``momentum`` is the complement);
+- the running variance is updated with the *unbiased* estimate
+  (``biased * n / (n - 1)`` with ``n`` the global reduce count), as torch
+  does;
+- eval mode normalizes with the running statistics.
+
+Statistics are always computed in float32 regardless of the compute dtype
+(bf16-safe, matching torch's mixed-precision BN behavior).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class SyncBatchNorm(nn.Module):
+    """BatchNorm over ``(batch, spatial...)`` with optional cross-replica sync.
+
+    Attributes:
+      use_running_average: if True, use stored batch_stats (eval mode).
+      axis_name: mesh axis to ``pmean`` statistics over. ``None`` gives
+        plain per-replica BatchNorm (identical to torch BatchNorm2d).
+      momentum: torch-convention update fraction for running stats.
+      epsilon: numerical stability constant (torch default 1e-5).
+      dtype: compute/output dtype (e.g. bf16); stats are f32 internally.
+    """
+
+    use_running_average: Optional[bool] = None
+    axis_name: Optional[str] = None
+    momentum: float = 0.1
+    epsilon: float = 1e-5
+    dtype: Any = None
+    param_dtype: Any = jnp.float32
+    use_bias: bool = True
+    use_scale: bool = True
+
+    @nn.compact
+    def __call__(self, x: jax.Array, use_running_average: Optional[bool] = None):
+        use_running_average = nn.merge_param(
+            "use_running_average", self.use_running_average, use_running_average
+        )
+        num_features = x.shape[-1]
+        reduction_axes = tuple(range(x.ndim - 1))  # all but channel (NHWC)
+
+        ra_mean = self.variable(
+            "batch_stats", "mean", lambda s: jnp.zeros(s, jnp.float32), (num_features,)
+        )
+        ra_var = self.variable(
+            "batch_stats", "var", lambda s: jnp.ones(s, jnp.float32), (num_features,)
+        )
+
+        if use_running_average:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            xf = x.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=reduction_axes)
+            mean_sq = jnp.mean(jnp.square(xf), axis=reduction_axes)
+            # local element count per channel
+            local_n = 1
+            for ax in reduction_axes:
+                local_n *= x.shape[ax]
+            n = jnp.asarray(local_n, jnp.float32)
+            if self.axis_name is not None and not self.is_initializing():
+                # Global statistics over the data axis: one fused pmean of
+                # [mean, mean_sq] — the SyncBatchNorm stat exchange. Skipped
+                # at init time so modules can be initialized outside the
+                # mesh/pmap context (shapes are identical either way).
+                mean, mean_sq = jax.lax.pmean((mean, mean_sq), self.axis_name)
+                n = n * jax.lax.psum(1, self.axis_name)
+            var = mean_sq - jnp.square(mean)  # biased, used for normalization
+
+            if not self.is_initializing():
+                m = self.momentum
+                unbiased = var * (n / jnp.maximum(n - 1.0, 1.0))
+                ra_mean.value = (1.0 - m) * ra_mean.value + m * mean
+                ra_var.value = (1.0 - m) * ra_var.value + m * unbiased
+
+        y = (x.astype(jnp.float32) - mean) / jnp.sqrt(var + self.epsilon)
+        if self.use_scale:
+            scale = self.param(
+                "scale", nn.initializers.ones, (num_features,), self.param_dtype
+            )
+            y = y * scale
+        if self.use_bias:
+            bias = self.param(
+                "bias", nn.initializers.zeros, (num_features,), self.param_dtype
+            )
+            y = y + bias
+        out_dtype = self.dtype or x.dtype
+        return y.astype(out_dtype)

@@ -1,0 +1,25 @@
+"""Loss functions.
+
+``cross_entropy_loss`` is the TPU-native stand-in for the reference's
+``nn.CrossEntropyLoss()`` (``main.py:48``, applied at ``main.py:105``):
+softmax cross-entropy from integer labels, mean-reduced over the batch.
+Computed in float32 for bf16 stability.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def cross_entropy_loss(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """Mean softmax cross-entropy with integer targets.
+
+    Args:
+      logits: ``[batch, num_classes]``.
+      targets: ``[batch]`` int labels.
+    """
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    label_logits = jnp.take_along_axis(logits, targets[:, None], axis=-1)[:, 0]
+    return jnp.mean(logz - label_logits)

@@ -1,0 +1,13 @@
+"""Metrics, logging and plotting utilities.
+
+TPU-native re-design of the reference's ``utils.py`` and
+``plot_curves.py`` (see ``/root/reference/utils.py:1-77`` and
+``/root/reference/plot_curves.py:7-37``).
+"""
+
+from .meters import AverageMeter
+from .logger import Logger
+from .metrics import accuracy, topk_accuracy
+from .plotting import draw_plot
+
+__all__ = ["AverageMeter", "Logger", "accuracy", "topk_accuracy", "draw_plot"]

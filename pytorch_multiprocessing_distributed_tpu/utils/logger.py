@@ -1,0 +1,68 @@
+"""Append-only space-separated experiment log files.
+
+Byte-format parity target: ``Logger`` in reference ``utils.py:19-62`` —
+ints rendered ``:04d``, floats ``:.6f``, strings verbatim, single space
+separators, trailing space stripped, one row per line; ``read()`` parses
+every whitespace-separated token back to ``float`` when possible.
+
+The reference imports ``Iterable`` from ``collections`` (``utils.py:1``),
+which breaks on Python >= 3.10; this implementation uses
+``collections.abc`` (a deliberate fix, see SURVEY.md §3.5.8 — the on-disk
+byte format is unchanged).
+"""
+
+from __future__ import annotations
+
+from collections.abc import Iterable
+
+
+class Logger:
+    """Fixed-width append-only row logger, byte-compatible with the reference."""
+
+    def __init__(self, path: str, int_form: str = ":04d", float_form: str = ":.6f"):
+        self.path = path
+        self.int_form = int_form
+        self.float_form = float_form
+        self.width = 0
+
+    def __len__(self) -> int:
+        try:
+            return len(self.read())
+        except Exception:
+            return 0
+
+    def write(self, values) -> None:
+        if not isinstance(values, Iterable) or isinstance(values, (str, bytes)):
+            values = [values]
+        values = list(values)
+        if self.width == 0:
+            self.width = len(values)
+        assert self.width == len(values), "Inconsistent number of items."
+        line = ""
+        for v in values:
+            # bool is an int subclass; the reference never logs bools, so
+            # route them through the int branch for identical behavior.
+            if isinstance(v, int):
+                line += "{{{}}} ".format(self.int_form).format(v)
+            elif isinstance(v, float):
+                line += "{{{}}} ".format(self.float_form).format(v)
+            elif isinstance(v, str):
+                line += "{} ".format(v)
+            else:
+                raise Exception("Not supported type.")
+        with open(self.path, "a") as f:
+            f.write(line[:-1] + "\n")
+
+    def read(self):
+        with open(self.path, "r") as f:
+            log = []
+            for line in f:
+                values = []
+                for v in line.split(" "):
+                    try:
+                        v = float(v)
+                    except ValueError:
+                        pass
+                    values.append(v)
+                log.append(values)
+        return log

@@ -1,0 +1,36 @@
+"""Streaming scalar meters.
+
+Behavioral parity target: ``AverageMeter`` in reference ``utils.py:3-17``
+(val/sum/count/avg with weighted ``update(val, n)``).
+"""
+
+from __future__ import annotations
+
+
+class AverageMeter:
+    """Tracks the most recent value and the running (weighted) average.
+
+    Matches the reference meter exactly: ``update(v, n)`` adds ``v * n`` to
+    the running sum and ``n`` to the count; ``avg = sum / count``.
+    """
+
+    def __init__(self) -> None:
+        self.reset()
+
+    def reset(self) -> None:
+        self.val = 0
+        self.avg = 0
+        self.sum = 0
+        self.count = 0
+
+    def update(self, val, n: int = 1) -> None:
+        self.val = val
+        self.sum += val * n
+        self.count += n
+        self.avg = self.sum / self.count
+
+    def __repr__(self) -> str:  # debugging aid; not in the reference
+        return (
+            f"AverageMeter(val={self.val}, avg={self.avg}, "
+            f"sum={self.sum}, count={self.count})"
+        )

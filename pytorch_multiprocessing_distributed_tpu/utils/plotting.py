@@ -1,0 +1,45 @@
+"""End-of-run accuracy/loss curve rendering.
+
+Artifact parity target: ``draw_plot`` in reference ``plot_curves.py:7-37``
+— reads ``train.log`` / ``test.log`` via :class:`..utils.Logger`, writes
+``test_accuracy.png`` and ``loss.png`` with the same series, labels,
+legends and titles.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .logger import Logger
+
+
+def draw_plot(save_path: str) -> None:
+    """Render the two training-curve PNGs from the epoch log files."""
+    import matplotlib
+
+    matplotlib.use("Agg")  # rank-0 epilogue on a headless TPU host
+    import matplotlib.pyplot as plt
+
+    train_log = Logger(os.path.join(save_path, "train.log")).read()
+    test_log = Logger(os.path.join(save_path, "test.log")).read()
+
+    epoch, train_loss, train_acc = zip(*train_log)
+    epoch, test_loss, test_acc = zip(*test_log)
+
+    plt.plot(epoch, train_acc, "-b", label="train")
+    plt.plot(epoch, test_acc, "-r", label="test")
+    plt.xlabel("Epoch")
+    plt.ylabel("accuracy")
+    plt.legend(loc="lower right")
+    plt.title("TEST accuracy ")
+    plt.savefig(os.path.join(save_path, "test_accuracy.png"))
+    plt.close()
+
+    plt.plot(epoch, train_loss, "-b", label="train")
+    plt.plot(epoch, test_loss, "-r", label="test")
+    plt.xlabel("Epoch")
+    plt.ylabel("loss")
+    plt.legend(loc="upper right")
+    plt.title("loss")
+    plt.savefig(os.path.join(save_path, "loss.png"))
+    plt.close()

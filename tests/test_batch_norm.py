@@ -1,0 +1,149 @@
+"""SyncBatchNorm numerical parity vs torch, and cross-replica sync tests.
+
+The hard parity problem called out in SURVEY.md §7: torch BN normalizes
+with biased batch variance but updates running_var with the unbiased
+estimate, momentum 0.1 torch-convention. Cross-replica mode must make N
+replicas each holding a shard of the batch produce bitwise-identical
+statistics to one replica holding the whole batch (= SyncBatchNorm).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_multiprocessing_distributed_tpu.ops import SyncBatchNorm
+
+
+def _init_and_run(x, train, n_steps=1, axis_name=None):
+    bn = SyncBatchNorm(use_running_average=not train, axis_name=axis_name)
+    variables = bn.init(jax.random.PRNGKey(0), x)
+    outs = None
+    for _ in range(n_steps):
+        if train:
+            outs, mutated = bn.apply(variables, x, mutable=["batch_stats"])
+            variables = {**variables, "batch_stats": mutated["batch_stats"]}
+        else:
+            outs = bn.apply(variables, x)
+    return outs, variables
+
+
+class TestTorchParity:
+    def test_train_forward_and_running_stats(self):
+        torch = pytest.importorskip("torch")
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(8, 5, 5, 3)).astype(np.float32) * 2.0 + 1.0
+
+        # torch: NCHW
+        tbn = torch.nn.BatchNorm2d(3)
+        tbn.train()
+        tx = torch.tensor(x).permute(0, 3, 1, 2)
+        ty = tbn(tx).permute(0, 2, 3, 1).detach().numpy()
+
+        out, variables = _init_and_run(jnp.asarray(x), train=True)
+        np.testing.assert_allclose(np.asarray(out), ty, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(variables["batch_stats"]["mean"]),
+            tbn.running_mean.numpy(),
+            rtol=1e-5,
+            atol=1e-6,
+        )
+        np.testing.assert_allclose(
+            np.asarray(variables["batch_stats"]["var"]),
+            tbn.running_var.numpy(),
+            rtol=1e-5,
+            atol=1e-6,
+        )
+
+    def test_eval_uses_running_stats(self):
+        torch = pytest.importorskip("torch")
+        rng = np.random.default_rng(1)
+        x1 = rng.normal(size=(8, 4, 4, 3)).astype(np.float32)
+        x2 = rng.normal(size=(8, 4, 4, 3)).astype(np.float32) * 3.0
+
+        tbn = torch.nn.BatchNorm2d(3)
+        tbn.train()
+        tbn(torch.tensor(x1).permute(0, 3, 1, 2))
+        tbn.eval()
+        ty = tbn(torch.tensor(x2).permute(0, 3, 1, 2)).permute(0, 2, 3, 1)
+        ty = ty.detach().numpy()
+
+        bn_t = SyncBatchNorm(use_running_average=False)
+        variables = bn_t.init(jax.random.PRNGKey(0), jnp.asarray(x1))
+        _, mutated = bn_t.apply(variables, jnp.asarray(x1), mutable=["batch_stats"])
+        variables = {**variables, "batch_stats": mutated["batch_stats"]}
+        bn_e = SyncBatchNorm(use_running_average=True)
+        out = bn_e.apply(variables, jnp.asarray(x2))
+        np.testing.assert_allclose(np.asarray(out), ty, rtol=1e-4, atol=1e-5)
+
+
+class TestCrossReplicaSync:
+    def test_sharded_equals_global(self):
+        """pmean-synced BN over 8 shards == single BN over the full batch."""
+        rng = np.random.default_rng(2)
+        x = rng.normal(size=(16, 4, 4, 3)).astype(np.float32) * 1.7
+
+        # ground truth: unsynced BN over full batch
+        ref_out, ref_vars = _init_and_run(jnp.asarray(x), train=True)
+
+        bn = SyncBatchNorm(use_running_average=False, axis_name="data")
+        variables = bn.init(jax.random.PRNGKey(0), jnp.asarray(x[:2]))
+
+        def per_shard(xs):
+            out, mutated = bn.apply(variables, xs, mutable=["batch_stats"])
+            return out, mutated["batch_stats"]
+
+        xs = jnp.asarray(x).reshape(8, 2, 4, 4, 3)
+        outs, stats = jax.pmap(per_shard, axis_name="data")(xs)
+
+        np.testing.assert_allclose(
+            np.asarray(outs).reshape(16, 4, 4, 3),
+            np.asarray(ref_out),
+            rtol=1e-4,
+            atol=1e-5,
+        )
+        # every replica's running stats identical, and == full-batch stats
+        for k in ("mean", "var"):
+            per_replica = np.asarray(stats[k])
+            assert np.allclose(per_replica, per_replica[0:1], atol=1e-6)
+            np.testing.assert_allclose(
+                per_replica[0],
+                np.asarray(ref_vars["batch_stats"][k]),
+                rtol=1e-4,
+                atol=1e-5,
+            )
+
+    def test_matches_torch_syncbn_semantics(self):
+        """Unbiased running_var uses the GLOBAL count (8 shards x n_local)."""
+        torch = pytest.importorskip("torch")
+        rng = np.random.default_rng(3)
+        x = rng.normal(size=(16, 2, 2, 4)).astype(np.float32)
+
+        tbn = torch.nn.BatchNorm2d(4)
+        tbn.train()
+        tbn(torch.tensor(x).permute(0, 3, 1, 2))
+
+        bn = SyncBatchNorm(use_running_average=False, axis_name="data")
+        variables = bn.init(jax.random.PRNGKey(0), jnp.asarray(x[:2]))
+        xs = jnp.asarray(x).reshape(8, 2, 2, 2, 4)
+
+        def per_shard(xs):
+            _, mutated = bn.apply(variables, xs, mutable=["batch_stats"])
+            return mutated["batch_stats"]
+
+        stats = jax.pmap(per_shard, axis_name="data")(xs)
+        np.testing.assert_allclose(
+            np.asarray(stats["var"][0]), tbn.running_var.numpy(), rtol=1e-5, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(stats["mean"][0]), tbn.running_mean.numpy(), rtol=1e-5, atol=1e-6
+        )
+
+
+def test_bf16_input_f32_stats():
+    x = jnp.ones((4, 2, 2, 3), jnp.bfloat16)
+    bn = SyncBatchNorm(use_running_average=False, dtype=jnp.bfloat16)
+    variables = bn.init(jax.random.PRNGKey(0), x)
+    out, mutated = bn.apply(variables, x, mutable=["batch_stats"])
+    assert out.dtype == jnp.bfloat16
+    assert mutated["batch_stats"]["mean"].dtype == jnp.float32

@@ -1,0 +1,139 @@
+"""Unit tests for meters/logger/metrics — format parity with the reference.
+
+The Logger byte format is the contract ``plot_curves`` parses (reference
+``utils.py:30-47`` / ``plot_curves.py:15-16``): ints ``:04d``, floats
+``:.6f``, space separated, newline terminated.
+"""
+
+import math
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_multiprocessing_distributed_tpu.utils import (
+    AverageMeter,
+    Logger,
+    accuracy,
+    draw_plot,
+    topk_accuracy,
+)
+from pytorch_multiprocessing_distributed_tpu.utils.metrics import correct_count
+
+
+class TestAverageMeter:
+    def test_initial_state(self):
+        m = AverageMeter()
+        assert (m.val, m.avg, m.sum, m.count) == (0, 0, 0, 0)
+
+    def test_weighted_update(self):
+        m = AverageMeter()
+        m.update(2.0, n=4)
+        m.update(1.0, n=2)
+        assert m.val == 1.0
+        assert m.sum == 10.0
+        assert m.count == 6
+        assert m.avg == pytest.approx(10.0 / 6)
+
+    def test_reset(self):
+        m = AverageMeter()
+        m.update(5.0)
+        m.reset()
+        assert (m.val, m.avg, m.sum, m.count) == (0, 0, 0, 0)
+
+
+class TestLogger:
+    def test_exact_byte_format(self, tmp_path):
+        """Row bytes must match the reference renderer exactly."""
+        p = str(tmp_path / "train.log")
+        log = Logger(p)
+        log.write([1, 2.123456789, 91.5])
+        log.write([12, 0.5, 3.0])
+        with open(p, "rb") as f:
+            data = f.read()
+        assert data == b"0001 2.123457 91.500000\n0012 0.500000 3.000000\n"
+
+    def test_string_passthrough(self, tmp_path):
+        p = str(tmp_path / "s.log")
+        log = Logger(p)
+        log.write(["abc", 1, 0.25])
+        with open(p) as f:
+            assert f.read() == "abc 0001 0.250000\n"
+
+    def test_roundtrip_read(self, tmp_path):
+        p = str(tmp_path / "t.log")
+        log = Logger(p)
+        log.write([3, 1.25, 80.0])
+        rows = log.read()
+        assert rows == [[3.0, 1.25, 80.0]]
+
+    def test_width_assertion(self, tmp_path):
+        log = Logger(str(tmp_path / "w.log"))
+        log.write([1, 2.0])
+        with pytest.raises(AssertionError):
+            log.write([1, 2.0, 3.0])
+
+    def test_scalar_wrapped(self, tmp_path):
+        log = Logger(str(tmp_path / "x.log"))
+        log.write(7)
+        assert log.read() == [[7.0]]
+
+    def test_len(self, tmp_path):
+        log = Logger(str(tmp_path / "l.log"))
+        assert len(log) == 0
+        log.write([1, 2.0])
+        log.write([2, 3.0])
+        assert len(log) == 2
+
+    def test_unsupported_type_raises(self, tmp_path):
+        log = Logger(str(tmp_path / "u.log"))
+        with pytest.raises(Exception, match="Not supported type"):
+            log.write([object()])
+
+
+class TestAccuracy:
+    def test_prec1_simple(self):
+        logits = jnp.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7], [0.6, 0.4]])
+        targets = jnp.array([1, 0, 0, 0])
+        prec, correct = accuracy(logits, targets)
+        assert float(prec) == pytest.approx(75.0)
+        assert correct.shape == (4,)
+        assert list(np.asarray(correct)) == [True, True, False, True]
+
+    def test_topk_matches_torch(self):
+        """Numerical parity with the reference's torch implementation."""
+        torch = pytest.importorskip("torch")
+        rng = np.random.default_rng(0)
+        logits = rng.normal(size=(32, 10)).astype(np.float32)
+        targets = rng.integers(0, 10, size=(32,))
+
+        precs, _ = topk_accuracy(jnp.asarray(logits), jnp.asarray(targets), (1, 5))
+
+        t_out = torch.tensor(logits)
+        t_tgt = torch.tensor(targets)
+        maxk = 5
+        _, pred = t_out.topk(maxk, 1, True, True)
+        pred = pred.t()
+        t_correct = pred.eq(t_tgt.view(1, -1).expand_as(pred))
+        for i, k in enumerate((1, 5)):
+            ref = t_correct[:k].reshape(-1).float().sum(0).mul_(100.0 / 32)
+            assert float(precs[i]) == pytest.approx(float(ref), abs=1e-4)
+
+    def test_correct_count(self):
+        logits = jnp.array([[2.0, 1.0], [0.0, 3.0], [5.0, 1.0]])
+        targets = jnp.array([0, 1, 1])
+        assert int(correct_count(logits, targets)) == 2
+
+
+class TestDrawPlot:
+    def test_writes_both_pngs(self, tmp_path):
+        train = Logger(str(tmp_path / "train.log"))
+        test = Logger(str(tmp_path / "test.log"))
+        for e in range(1, 4):
+            train.write([e, 2.0 / e, 30.0 * e])
+            test.write([e, 2.5 / e, 25.0 * e])
+        draw_plot(str(tmp_path))
+        assert os.path.exists(tmp_path / "test_accuracy.png")
+        assert os.path.exists(tmp_path / "loss.png")
+        assert (tmp_path / "test_accuracy.png").stat().st_size > 0
