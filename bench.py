@@ -1,0 +1,108 @@
+"""Benchmark harness — prints ONE JSON line for the driver.
+
+The reference publishes no numbers (BASELINE.md), so this harness IS the
+benchmark the framework is judged on: ResNet-18/CIFAR-10 train-step
+throughput, images/sec/chip (BASELINE.json config #1 hardware-adjusted:
+whatever chips are visible — the driver runs it on one real TPU chip).
+
+Honest timing under async dispatch: warmup compiles + settles caches,
+then the timed window blocks on the final step's metrics
+(``block_until_ready``), so the measurement covers real device work —
+not dispatch (SURVEY.md §5 "Tracing").
+
+``vs_baseline`` is reported vs the recorded number in
+``benchmarks/baseline_record.json`` when present (set by earlier rounds),
+else 1.0 (the reference has no published number to compare against).
+"""
+
+import argparse
+import json
+import os
+import time
+
+
+def run_bench(dtype_name: str = "bfloat16", batch_size: int = 512,
+              steps: int = 30, warmup: int = 5) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pytorch_multiprocessing_distributed_tpu import models
+    from pytorch_multiprocessing_distributed_tpu.parallel import make_mesh
+    from pytorch_multiprocessing_distributed_tpu.train import (
+        create_train_state, make_train_step)
+    from pytorch_multiprocessing_distributed_tpu.train.optim import sgd
+    from pytorch_multiprocessing_distributed_tpu.train.step import shard_batch
+
+    n_dev = jax.device_count()
+    mesh = make_mesh(n_dev)
+    dtype = jnp.bfloat16 if dtype_name == "bfloat16" else jnp.float32
+
+    model = models.ResNet18(dtype=dtype, bn_axis="data")
+    opt = sgd(learning_rate=0.1)
+    state = create_train_state(
+        model, jax.random.PRNGKey(0), jnp.zeros((2, 32, 32, 3)), opt
+    )
+    step = make_train_step(model, opt, mesh)
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(batch_size, 32, 32, 3)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 10, (batch_size,)))
+    xb, yb = shard_batch((x, y), mesh)
+
+    for _ in range(warmup):
+        state, metrics = step(state, xb, yb)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, xb, yb)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    images_per_sec = batch_size * steps / dt
+    per_chip = images_per_sec / n_dev
+    return {
+        "metric": "resnet18_cifar10_train_images_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "extra": {
+            "dtype": dtype_name,
+            "global_batch": batch_size,
+            "devices": n_dev,
+            "steps": steps,
+            "step_ms": round(1000 * dt / steps, 3),
+            "platform": jax.devices()[0].platform,
+        },
+    }
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--dtype", default="bfloat16", choices=["float32", "bfloat16"])
+    p.add_argument("--batch_size", default=512, type=int)
+    p.add_argument("--steps", default=30, type=int)
+    args = p.parse_args()
+
+    result = run_bench(args.dtype, args.batch_size, args.steps)
+
+    record_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "benchmarks", "baseline_record.json",
+    )
+    vs = 1.0
+    if os.path.exists(record_path):
+        try:
+            with open(record_path) as f:
+                rec = json.load(f)
+            base = rec.get(result["metric"])
+            if base:
+                vs = round(result["value"] / base, 4)
+        except Exception:
+            pass
+    result["vs_baseline"] = vs
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,71 @@
+"""All-reduce bandwidth microbenchmark (BASELINE.json metric
+"DDP-vs-psum allreduce BW").
+
+Measures the bus bandwidth of ``lax.psum`` over the ``data`` mesh axis for
+a sweep of payload sizes — the number to hold against NCCL's all-reduce
+bandwidth on the reference's hardware. Bus bandwidth uses the standard
+ring formula: ``bytes * 2 * (n-1)/n / time``.
+
+Run:  python benchmarks/allreduce_bw.py [--sizes-mb 1 16 64 256]
+Emits one JSON line per payload size.
+"""
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pytorch_multiprocessing_distributed_tpu.parallel import make_mesh  # noqa: E402
+
+
+def bench_psum(mesh, size_bytes: int, iters: int = 20) -> dict:
+    n = mesh.shape["data"]
+    elems = size_bytes // 4
+    x = jnp.ones((n, elems), jnp.float32)
+
+    def body(v):  # per-shard [1, elems]
+        return jax.lax.psum(v, "data")
+
+    f = jax.jit(
+        jax.shard_map(body, mesh=mesh, in_specs=P("data"), out_specs=P(),
+                      check_vma=False)
+    )
+    out = f(x)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = f(x)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    bus_bw = size_bytes * 2 * (n - 1) / n / dt
+    return {
+        "metric": "psum_allreduce_bus_bw",
+        "payload_mb": round(size_bytes / 2**20, 2),
+        "devices": n,
+        "time_ms": round(dt * 1e3, 3),
+        "bus_gb_per_sec": round(bus_bw / 2**30, 2),
+        "platform": jax.devices()[0].platform,
+    }
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--sizes-mb", nargs="+", type=float, default=[1, 16, 64])
+    p.add_argument("--iters", type=int, default=20)
+    args = p.parse_args()
+    mesh = make_mesh(jax.device_count())
+    for mb in args.sizes_mb:
+        print(json.dumps(bench_psum(mesh, int(mb * 2**20), args.iters)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,306 @@
+// TCP key-value rendezvous store — the c10d TCPStore analogue.
+//
+// The reference rendezvouses through torch.distributed's TCPStore (spawned
+// by init_process_group behind MASTER_ADDR/MASTER_PORT, reference
+// main.py:190-193). JAX pods rendezvous through the jax.distributed
+// coordinator for the DEVICE control plane; this store provides the
+// remaining HOST control plane the framework needs outside XLA:
+// experiment-level barriers, health/heartbeat keys, rank assignment for
+// ad-hoc jobs. Exposed to Python via ctypes (runtime/store.py).
+//
+// Protocol (length-prefixed binary over TCP):
+//   request :=  u8 op | u32 klen | key bytes | u32 vlen | value bytes
+//   reply   :=  i64 status | u32 vlen | value bytes
+//   ops: 1=SET  2=GET  3=ADD(value=i64 ascii delta)  4=WAIT  5=DELETE
+// GET on a missing key returns status=-1. WAIT blocks (server side) until
+// the key exists. ADD atomically adds to an integer key (creating it),
+// returning the new value — barriers are ADD + WAIT loops client-side.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Store {
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<std::string, std::string> kv;
+  int listen_fd = -1;
+  std::thread accept_thread;
+  std::vector<std::thread> workers;
+  std::vector<int> conn_fds;  // open client connections (guarded by mu)
+  bool stopping = false;
+};
+
+bool read_full(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool send_reply(int fd, int64_t status, const std::string& value) {
+  uint32_t vlen = static_cast<uint32_t>(value.size());
+  if (!write_full(fd, &status, sizeof(status))) return false;
+  if (!write_full(fd, &vlen, sizeof(vlen))) return false;
+  if (vlen && !write_full(fd, value.data(), vlen)) return false;
+  return true;
+}
+
+void unregister_conn(Store* store, int fd) {
+  std::lock_guard<std::mutex> lock(store->mu);
+  auto& fds = store->conn_fds;
+  fds.erase(std::remove(fds.begin(), fds.end(), fd), fds.end());
+}
+
+void serve_conn(Store* store, int fd) {
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  for (;;) {
+    uint8_t op;
+    uint32_t klen, vlen;
+    if (!read_full(fd, &op, 1) || !read_full(fd, &klen, 4)) break;
+    if (klen > (1u << 20)) break;
+    std::string key(klen, '\0');
+    if (klen && !read_full(fd, key.data(), klen)) break;
+    if (!read_full(fd, &vlen, 4)) break;
+    if (vlen > (1u << 26)) break;  // 64 MiB value cap
+    std::string value(vlen, '\0');
+    if (vlen && !read_full(fd, value.data(), vlen)) break;
+
+    bool ok = true;
+    switch (op) {
+      case 1: {  // SET
+        {
+          std::lock_guard<std::mutex> lock(store->mu);
+          store->kv[key] = value;
+        }
+        store->cv.notify_all();
+        ok = send_reply(fd, 0, "");
+        break;
+      }
+      case 2: {  // GET
+        std::string out;
+        int64_t status = -1;
+        {
+          std::lock_guard<std::mutex> lock(store->mu);
+          auto it = store->kv.find(key);
+          if (it != store->kv.end()) {
+            out = it->second;
+            status = 0;
+          }
+        }
+        ok = send_reply(fd, status, out);
+        break;
+      }
+      case 3: {  // ADD — status 0, new counter value in the reply body
+        int64_t delta = std::strtoll(value.c_str(), nullptr, 10);
+        int64_t result;
+        {
+          std::lock_guard<std::mutex> lock(store->mu);
+          int64_t cur = 0;
+          auto it = store->kv.find(key);
+          if (it != store->kv.end())
+            cur = std::strtoll(it->second.c_str(), nullptr, 10);
+          result = cur + delta;
+          store->kv[key] = std::to_string(result);
+        }
+        store->cv.notify_all();
+        ok = send_reply(fd, 0, std::to_string(result));
+        break;
+      }
+      case 4: {  // WAIT (blocks until key exists or server stops)
+        std::unique_lock<std::mutex> lock(store->mu);
+        store->cv.wait(lock, [&] {
+          return store->stopping || store->kv.count(key) > 0;
+        });
+        bool aborted = store->stopping;
+        std::string out = aborted ? "" : store->kv[key];
+        lock.unlock();
+        ok = send_reply(fd, aborted ? -2 : 0, out);
+        if (aborted) ok = false;  // drop the connection on shutdown
+        break;
+      }
+      case 5: {  // DELETE — status 0, "1"/"0" (erased or not) in the body
+        int64_t erased;
+        {
+          std::lock_guard<std::mutex> lock(store->mu);
+          erased = static_cast<int64_t>(store->kv.erase(key));
+        }
+        store->cv.notify_all();
+        ok = send_reply(fd, 0, std::to_string(erased));
+        break;
+      }
+      default:
+        ok = false;
+    }
+    if (!ok) break;
+  }
+  unregister_conn(store, fd);
+  ::close(fd);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Starts a store server on port (0 = ephemeral). Returns an opaque handle,
+// or nullptr on failure. *out_port receives the bound port.
+void* pmdt_store_server_start(int port, int* out_port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      ::listen(fd, 128) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  ::getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  if (out_port) *out_port = ntohs(addr.sin_port);
+
+  auto* store = new Store();
+  store->listen_fd = fd;
+  store->accept_thread = std::thread([store] {
+    for (;;) {
+      int cfd = ::accept(store->listen_fd, nullptr, nullptr);
+      if (cfd < 0) break;  // listen fd closed -> shutdown
+      std::lock_guard<std::mutex> lock(store->mu);
+      if (store->stopping) {
+        ::close(cfd);
+        break;
+      }
+      store->conn_fds.push_back(cfd);
+      store->workers.emplace_back(serve_conn, store, cfd);
+    }
+  });
+  return store;
+}
+
+void pmdt_store_server_stop(void* handle) {
+  auto* store = static_cast<Store*>(handle);
+  if (!store) return;
+  std::vector<int> fds;
+  {
+    std::lock_guard<std::mutex> lock(store->mu);
+    store->stopping = true;
+    fds = store->conn_fds;  // snapshot; workers unregister as they exit
+  }
+  store->cv.notify_all();
+  ::shutdown(store->listen_fd, SHUT_RDWR);
+  ::close(store->listen_fd);
+  // Unblock every worker stuck in read_full on its client socket, then
+  // JOIN them all before freeing the store (no detached threads may
+  // outlive the Store they reference).
+  for (int cfd : fds) ::shutdown(cfd, SHUT_RDWR);
+  if (store->accept_thread.joinable()) store->accept_thread.join();
+  for (auto& w : store->workers)
+    if (w.joinable()) w.join();
+  delete store;
+}
+
+// Client: connect/disconnect + ops. Return fd >= 0 or -1.
+int pmdt_store_connect(const char* host, int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1 ||
+      ::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    ::close(fd);
+    return -1;
+  }
+  return fd;
+}
+
+void pmdt_store_disconnect(int fd) {
+  if (fd >= 0) ::close(fd);
+}
+
+static int64_t request(int fd, uint8_t op, const char* key, const void* val,
+                       uint32_t vlen, char* out, int64_t out_cap,
+                       int64_t* out_len) {
+  uint32_t klen = static_cast<uint32_t>(std::strlen(key));
+  if (!write_full(fd, &op, 1) || !write_full(fd, &klen, 4) ||
+      !write_full(fd, key, klen) || !write_full(fd, &vlen, 4) ||
+      (vlen && !write_full(fd, val, vlen)))
+    return -3;
+  int64_t status;
+  uint32_t rlen;
+  if (!read_full(fd, &status, 8) || !read_full(fd, &rlen, 4)) return -3;
+  std::string buf(rlen, '\0');
+  if (rlen && !read_full(fd, buf.data(), rlen)) return -3;
+  if (out && out_cap > 0) {
+    int64_t n = std::min<int64_t>(rlen, out_cap);
+    std::memcpy(out, buf.data(), static_cast<size_t>(n));
+    if (out_len) *out_len = n;
+  } else if (out_len) {
+    *out_len = rlen;
+  }
+  return status;
+}
+
+int64_t pmdt_store_set(int fd, const char* key, const void* val, int64_t len) {
+  return request(fd, 1, key, val, static_cast<uint32_t>(len), nullptr, 0,
+                 nullptr);
+}
+
+int64_t pmdt_store_get(int fd, const char* key, char* out, int64_t cap,
+                       int64_t* out_len) {
+  return request(fd, 2, key, nullptr, 0, out, cap, out_len);
+}
+
+int64_t pmdt_store_add(int fd, const char* key, int64_t delta, char* out,
+                       int64_t cap, int64_t* out_len) {
+  std::string d = std::to_string(delta);
+  return request(fd, 3, key, d.data(), static_cast<uint32_t>(d.size()), out,
+                 cap, out_len);
+}
+
+int64_t pmdt_store_wait(int fd, const char* key, char* out, int64_t cap,
+                        int64_t* out_len) {
+  return request(fd, 4, key, nullptr, 0, out, cap, out_len);
+}
+
+int64_t pmdt_store_delete(int fd, const char* key, char* out, int64_t cap,
+                          int64_t* out_len) {
+  return request(fd, 5, key, nullptr, 0, out, cap, out_len);
+}
+
+}  // extern "C"

@@ -1,0 +1,130 @@
+"""CLI entrypoint — same seven flags as the reference (``main.py:21-30``).
+
+What changes underneath (the TPU-native design, SURVEY.md §7): no
+``mp.spawn`` — ONE process per host drives all local chips via a named
+``(data, model)`` mesh; ``--world_size`` sets the data-axis (DP) degree
+the way it set the number of spawned GPU processes in the reference
+(``main.py:28,185-188``); the NCCL rendezvous on ``127.0.0.1:20080``
+(``main.py:190-193``) becomes ``jax.distributed`` pod init (multi-host)
+or nothing (single host).
+
+Extension flags (all optional, defaults reproduce the reference):
+``--data_root``, ``--synthetic``, ``--dtype``, ``--model_parallel``,
+``--seed``, ``--resume``.
+
+Testing without chips: PMDT_FORCE_CPU_DEVICES=8 virtualizes 8 CPU
+devices (same mechanism as the test suite).
+"""
+
+import argparse
+import os
+import shutil
+
+parser = argparse.ArgumentParser(description="Confidence Aware Learning")
+parser.add_argument('--batch_size', default=64, type=int, help='Batch size')
+parser.add_argument('--epochs', default=20, type=int, help='Total number of epochs to run')
+parser.add_argument('--model', default='res', type=str, help='Models name to use [res, dense, vgg]')
+parser.add_argument('--save_path', default='./test/', type=str, help='Savefiles directory')
+parser.add_argument('--gpu', default='7', type=str, help='GPU id to use')
+parser.add_argument('--print-freq', '-p', default=10, type=int, metavar='N', help='print frequency (default: 10)')
+parser.add_argument('--world_size', default=2, type=int, help='Gpu use number')
+# --- TPU-native extensions (not in the reference CLI) ---
+parser.add_argument('--data_root', default='./cifar10_data', type=str,
+                    help='CIFAR-10 root (expects cifar-10-batches-py inside)')
+parser.add_argument('--synthetic', action='store_true',
+                    help='use deterministic synthetic CIFAR (no dataset needed)')
+parser.add_argument('--dtype', default='float32', choices=['float32', 'bfloat16'],
+                    help='compute dtype for conv/matmul (params stay f32)')
+parser.add_argument('--model_parallel', default=1, type=int,
+                    help='model-axis size of the mesh (1 = pure DP, reference mode)')
+parser.add_argument('--seed', default=0, type=int, help='init/seed for params and shuffling')
+parser.add_argument('--resume', default='', type=str,
+                    help='checkpoint path to resume from (reference has no resume)')
+
+
+def main(args):
+    # Backend selection must happen before device queries.
+    if os.environ.get("PMDT_FORCE_CPU_DEVICES"):
+        n = int(os.environ["PMDT_FORCE_CPU_DEVICES"])
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_multiprocessing_distributed_tpu import data as datamod
+    from pytorch_multiprocessing_distributed_tpu import models
+    from pytorch_multiprocessing_distributed_tpu.parallel import (
+        dist, make_mesh)
+    from pytorch_multiprocessing_distributed_tpu.train import (
+        create_train_state, load_checkpoint)
+    from pytorch_multiprocessing_distributed_tpu.train.optim import (
+        multistep_lr, sgd)
+    from pytorch_multiprocessing_distributed_tpu.train.trainer import Trainer
+
+    dist.init_process()
+
+    mesh = make_mesh(args.world_size, args.model_parallel)
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+
+    # model (reference main.py:39-40 — only 'res' didn't crash there)
+    model = models.get_model(args.model, dtype=dtype, bn_axis="data")
+
+    # loaders (reference main.py:36 -> data.py:6-59)
+    train_loader, test_loader = datamod.get_loader(args, mesh)
+
+    # optimizer + schedule — the exact reference config (main.py:51-59)
+    optimizer = sgd(
+        learning_rate=multistep_lr(0.1, milestones=[60, 80], gamma=0.1),
+        momentum=0.9,
+        weight_decay=0.0001,
+        nesterov=True,
+    )
+
+    state = create_train_state(
+        model,
+        jax.random.PRNGKey(args.seed),
+        jnp.zeros((2, 32, 32, 3), jnp.float32),
+        optimizer,
+    )
+    start_epoch = 1
+    if args.resume:
+        state = load_checkpoint(args.resume, state)
+        # continue the epoch series (LR schedule + log numbering) from
+        # where the checkpoint left off
+        start_epoch = int(state.epoch) + 1
+        if dist.is_primary():
+            print(f"Resumed from {args.resume} (continuing at epoch {start_epoch})")
+
+    trainer = Trainer(
+        model=model,
+        optimizer=optimizer,
+        mesh=mesh,
+        state=state,
+        train_loader=train_loader,
+        test_loader=test_loader,
+        save_path=args.save_path,
+        epochs=args.epochs,
+        print_freq=args.print_freq,
+        start_epoch=start_epoch,
+    )
+    trainer.fit()
+
+    dist.destroy_process_group()
+
+
+def run_model(args):
+    """Experiment bring-up (reference ``run_model``, ``main.py:180-188``):
+    create the save dir, snapshot this script into it, run."""
+    if not os.path.exists(args.save_path):
+        os.makedirs(args.save_path)
+    shutil.copy(__file__, os.path.join(args.save_path, 'main.py'))
+    main(args)
+
+
+if __name__ == "__main__":
+    run_model(parser.parse_args())

@@ -1,0 +1,23 @@
+"""Input pipeline: datasets, augmentations, per-host sharded loading.
+
+TPU-native replacement for the reference's data layer (``data.py:6-59``):
+torchvision CIFAR-10 + ``DistributedSampler`` + 4-worker ``DataLoader``
+becomes a numpy-native CIFAR reader, vectorized host-side augmentations,
+a per-replica sharded loader with DistributedSampler-exact index
+assignment, and double-buffered async device prefetch (the pinned-memory
+H2D analogue, SURVEY.md §2.2).
+"""
+
+from .cifar import load_cifar10, synthetic_cifar10
+from .transforms import normalize, random_crop_flip
+from .pipeline import ShardedLoader, get_loader, prefetch_to_device
+
+__all__ = [
+    "load_cifar10",
+    "synthetic_cifar10",
+    "normalize",
+    "random_crop_flip",
+    "ShardedLoader",
+    "get_loader",
+    "prefetch_to_device",
+]

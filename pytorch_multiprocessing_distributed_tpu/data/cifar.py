@@ -1,0 +1,74 @@
+"""CIFAR-10 loading without a torchvision dependency.
+
+Reads the standard ``cifar-10-batches-py`` pickle archive (the same bytes
+torchvision's ``datasets.CIFAR10`` parses for the reference at
+``data.py:21-28``, with ``download=False`` — the reference assumes the
+data is already on disk, and so do we). When the archive is absent,
+:func:`synthetic_cifar10` provides a deterministic class-separable stand-in
+so smoke tests and benches run data-free.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Tuple
+
+import numpy as np
+
+Arrays = Tuple[np.ndarray, np.ndarray]  # images uint8 [N,32,32,3], labels int32 [N]
+
+
+def _read_batch(path: str) -> Arrays:
+    with open(path, "rb") as f:
+        d = pickle.load(f, encoding="bytes")
+    images = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)  # -> NHWC
+    labels = np.asarray(d[b"labels"], np.int32)
+    return np.ascontiguousarray(images), labels
+
+
+def load_cifar10(root: str = "./cifar10_data", train: bool = True) -> Arrays:
+    """Load a CIFAR-10 split from ``{root}/cifar-10-batches-py``.
+
+    Raises FileNotFoundError when the archive is missing (the reference
+    behavior with ``download=False``).
+    """
+    base = os.path.join(root, "cifar-10-batches-py")
+    names = [f"data_batch_{i}" for i in range(1, 6)] if train else ["test_batch"]
+    images, labels = [], []
+    for name in names:
+        x, y = _read_batch(os.path.join(base, name))
+        images.append(x)
+        labels.append(y)
+    return np.concatenate(images), np.concatenate(labels)
+
+
+def synthetic_cifar10(
+    n: int = 50000, *, seed: int = 0, num_classes: int = 10
+) -> Arrays:
+    """Deterministic learnable fake CIFAR: class-dependent colored noise.
+
+    Each class gets a fixed mean image (low-frequency pattern), so models
+    can actually fit it — loss decrease on this data is a meaningful
+    smoke signal, unlike pure noise.
+    """
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, num_classes, size=(n,)).astype(np.int32)
+    yy, xx = np.mgrid[0:32, 0:32].astype(np.float32) / 32.0
+    protos = np.stack(
+        [
+            127.5
+            + 80.0 * np.stack(
+                [
+                    np.sin(2 * np.pi * ((c + 1) * xx / 3 + c / num_classes)),
+                    np.cos(2 * np.pi * ((c + 2) * yy / 3)),
+                    np.sin(2 * np.pi * (xx + yy) * (c + 1) / 4),
+                ],
+                axis=-1,
+            )
+            for c in range(num_classes)
+        ]
+    )  # [C,32,32,3]
+    noise = rng.normal(0.0, 24.0, size=(n, 32, 32, 3)).astype(np.float32)
+    images = np.clip(protos[labels] + noise, 0, 255).astype(np.uint8)
+    return images, labels

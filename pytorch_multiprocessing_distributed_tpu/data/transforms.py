@@ -1,0 +1,60 @@
+"""Host-side image augmentations, vectorized over the batch.
+
+Parity targets (reference ``data.py:11-19``):
+- train: Resize(32) -> RandomCrop(32, padding=8) -> RandomHorizontalFlip
+  -> ToTensor -> Normalize(mean=.5, std=.5)   (Resize is a no-op at 32x32)
+- test:  Resize(32) -> ToTensor -> Normalize(mean=.5, std=.5)
+
+Implemented as batched numpy ops (one vectorized gather instead of
+per-sample PIL calls across 4 worker processes — the reference's
+``num_workers=4`` exists to hide exactly this cost, ``data.py:44``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+MEAN = 0.5
+STD = 0.5
+
+
+def normalize(images: np.ndarray) -> np.ndarray:
+    """uint8 [N,H,W,C] -> float32, scaled to [0,1] then (x-mean)/std.
+
+    ToTensor + Normalize(mean=std=0.5) == maps pixels into [-1, 1].
+    """
+    x = images.astype(np.float32) / 255.0
+    return (x - MEAN) / STD
+
+
+def random_crop_flip(
+    images: np.ndarray,
+    rng: np.random.Generator,
+    *,
+    padding: int = 8,
+    flip_prob: float = 0.5,
+) -> np.ndarray:
+    """RandomCrop(32, padding=8) + RandomHorizontalFlip, batched.
+
+    Zero-pads by ``padding`` on each side then crops a random 32x32
+    window per sample (torchvision RandomCrop default constant-0 fill),
+    then flips each sample with probability 1/2.
+    """
+    n, h, w, c = images.shape
+    padded = np.pad(
+        images,
+        ((0, 0), (padding, padding), (padding, padding), (0, 0)),
+        mode="constant",
+    )
+    ys = rng.integers(0, 2 * padding + 1, size=n)
+    xs = rng.integers(0, 2 * padding + 1, size=n)
+    # vectorized window gather
+    row_idx = ys[:, None] + np.arange(h)[None, :]  # [N,H]
+    col_idx = xs[:, None] + np.arange(w)[None, :]  # [N,W]
+    out = padded[np.arange(n)[:, None, None], row_idx[:, :, None],
+                 col_idx[:, None, :], :]
+    flips = rng.random(n) < flip_prob
+    out[flips] = out[flips, :, ::-1, :]
+    return out

@@ -23,6 +23,11 @@ from .resnet import (
     ResNet152,
 )
 from .registry import get_model, MODEL_REGISTRY
+# importing the zoo modules also registers their CLI names
+from .vgg import VGG, VGG11, VGG13, VGG16, VGG19
+from .densenet import DenseNet, DenseNet121, DenseNetBC100
+from .vit import ViT, ViT_B16, ViT_S16, ViT_Tiny
+from .convnext import ConvNeXt, ConvNeXt_T, ConvNeXt_S, ConvNeXt_B, ConvNeXt_L
 
 __all__ = [
     "BasicBlock",
@@ -35,4 +40,8 @@ __all__ = [
     "ResNet152",
     "get_model",
     "MODEL_REGISTRY",
+    "VGG", "VGG11", "VGG13", "VGG16", "VGG19",
+    "DenseNet", "DenseNet121", "DenseNetBC100",
+    "ViT", "ViT_B16", "ViT_S16", "ViT_Tiny",
+    "ConvNeXt", "ConvNeXt_T", "ConvNeXt_S", "ConvNeXt_B", "ConvNeXt_L",
 ]

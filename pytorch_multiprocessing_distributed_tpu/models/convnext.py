@@ -1,0 +1,95 @@
+"""ConvNeXt family (BASELINE.md config #5: ConvNeXt-L under the large-batch
+trainer).
+
+TPU-first notes: depthwise 7x7 via ``feature_group_count`` (XLA:TPU has a
+fused depthwise path), channels-last LayerNorm, 4x pointwise MLP on the
+MXU, per-block layer-scale gamma. Stochastic depth is omitted (inference
+-equivalent; a ``deterministic`` training-regularization knob can land
+with the ImageNet recipe).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from .registry import register
+from .resnet import dense_init
+
+
+class ConvNeXtBlock(nn.Module):
+    dim: int
+    layer_scale_init: float = 1e-6
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Conv(
+            self.dim, (7, 7), padding=[(3, 3), (3, 3)],
+            feature_group_count=self.dim, dtype=self.dtype, name="dwconv",
+        )(x)
+        h = nn.LayerNorm(dtype=jnp.float32, name="norm")(h)
+        h = nn.Dense(4 * self.dim, dtype=self.dtype, name="pw1")(h.astype(self.dtype))
+        h = nn.gelu(h)
+        h = nn.Dense(self.dim, dtype=self.dtype, name="pw2")(h)
+        gamma = self.param(
+            "gamma",
+            nn.initializers.constant(self.layer_scale_init),
+            (self.dim,),
+            jnp.float32,
+        )
+        return x + h * gamma.astype(self.dtype)
+
+
+class ConvNeXt(nn.Module):
+    depths: Sequence[int] = (3, 3, 9, 3)
+    dims: Sequence[int] = (96, 192, 384, 768)
+    num_classes: int = 10
+    patchify_stride: int = 4
+    dtype: Any = jnp.float32
+    bn_axis: Optional[str] = None  # no BN in ConvNeXt; registry parity
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        s = self.patchify_stride
+        x = nn.Conv(self.dims[0], (s, s), strides=(s, s), padding="VALID",
+                    dtype=self.dtype, name="stem")(x)
+        x = nn.LayerNorm(dtype=jnp.float32, name="stem_norm")(x).astype(self.dtype)
+        for i, (depth, dim) in enumerate(zip(self.depths, self.dims)):
+            if i > 0:
+                x = nn.LayerNorm(dtype=jnp.float32, name=f"down_norm{i}")(x)
+                x = nn.Conv(dim, (2, 2), strides=(2, 2), padding="VALID",
+                            dtype=self.dtype, name=f"down{i}")(x.astype(self.dtype))
+            for j in range(depth):
+                x = ConvNeXtBlock(dim, dtype=self.dtype,
+                                  name=f"stage{i}_block{j}")(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.LayerNorm(dtype=jnp.float32, name="head_norm")(x)
+        x = nn.Dense(self.num_classes, kernel_init=dense_init,
+                     dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+def ConvNeXt_T(**kw) -> ConvNeXt:
+    return ConvNeXt((3, 3, 9, 3), (96, 192, 384, 768), **kw)
+
+
+def ConvNeXt_S(**kw) -> ConvNeXt:
+    return ConvNeXt((3, 3, 27, 3), (96, 192, 384, 768), **kw)
+
+
+def ConvNeXt_B(**kw) -> ConvNeXt:
+    return ConvNeXt((3, 3, 27, 3), (128, 256, 512, 1024), **kw)
+
+
+def ConvNeXt_L(**kw) -> ConvNeXt:
+    return ConvNeXt((3, 3, 27, 3), (192, 384, 768, 1536), **kw)
+
+
+register("convnext_t")(ConvNeXt_T)
+register("convnext_s")(ConvNeXt_S)
+register("convnext_b")(ConvNeXt_B)
+register("convnext_l")(ConvNeXt_L)

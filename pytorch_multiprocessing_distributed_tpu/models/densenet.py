@@ -1,0 +1,109 @@
+"""CIFAR DenseNet-BC family (the reference's unimplemented ``--model dense``).
+
+Advertised at reference ``main.py:24``, crashes if selected. Standard
+DenseNet-BC construction for 32x32 inputs: bottleneck dense layers
+(BN-ReLU-1x1 -> BN-ReLU-3x3, growth-rate k new features each), transition
+layers (1x1 conv halving channels + 2x2 avg pool), global pool + linear.
+TPU-native: NHWC, channel-concat on the last axis (XLA fuses the concats),
+sync-BN over the ``data`` axis, bf16-capable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..ops.batch_norm import SyncBatchNorm
+from .registry import register
+from .resnet import conv_kernel_init, dense_init
+
+
+class DenseLayer(nn.Module):
+    growth_rate: int
+    dtype: Any = jnp.float32
+    bn_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        h = SyncBatchNorm(use_running_average=not train,
+                          axis_name=self.bn_axis, dtype=self.dtype,
+                          name="bn1")(x)
+        h = nn.relu(h)
+        h = nn.Conv(4 * self.growth_rate, (1, 1), use_bias=False,
+                    kernel_init=conv_kernel_init, dtype=self.dtype,
+                    name="conv1")(h)
+        h = SyncBatchNorm(use_running_average=not train,
+                          axis_name=self.bn_axis, dtype=self.dtype,
+                          name="bn2")(h)
+        h = nn.relu(h)
+        h = nn.Conv(self.growth_rate, (3, 3), padding=[(1, 1), (1, 1)],
+                    use_bias=False, kernel_init=conv_kernel_init,
+                    dtype=self.dtype, name="conv2")(h)
+        return jnp.concatenate([x, h], axis=-1)
+
+
+class Transition(nn.Module):
+    features: int
+    dtype: Any = jnp.float32
+    bn_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        x = SyncBatchNorm(use_running_average=not train,
+                          axis_name=self.bn_axis, dtype=self.dtype,
+                          name="bn")(x)
+        x = nn.relu(x)
+        x = nn.Conv(self.features, (1, 1), use_bias=False,
+                    kernel_init=conv_kernel_init, dtype=self.dtype,
+                    name="conv")(x)
+        return nn.avg_pool(x, (2, 2), strides=(2, 2))
+
+
+class DenseNet(nn.Module):
+    block_sizes: Sequence[int]
+    growth_rate: int = 12
+    reduction: float = 0.5
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+    bn_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        ch = 2 * self.growth_rate
+        x = nn.Conv(ch, (3, 3), padding=[(1, 1), (1, 1)], use_bias=False,
+                    kernel_init=conv_kernel_init, dtype=self.dtype,
+                    name="stem")(x)
+        for i, n_layers in enumerate(self.block_sizes):
+            for j in range(n_layers):
+                x = DenseLayer(self.growth_rate, self.dtype, self.bn_axis,
+                               name=f"block{i}_layer{j}")(x, train)
+                ch += self.growth_rate
+            if i != len(self.block_sizes) - 1:
+                ch = int(ch * self.reduction)
+                x = Transition(ch, self.dtype, self.bn_axis,
+                               name=f"transition{i}")(x, train)
+        x = SyncBatchNorm(use_running_average=not train,
+                          axis_name=self.bn_axis, dtype=self.dtype,
+                          name="bn_final")(x)
+        x = nn.relu(x)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        x = nn.Dense(self.num_classes, dtype=self.dtype,
+                     kernel_init=dense_init, name="linear")(x)
+        return x.astype(jnp.float32)
+
+
+def DenseNet121(**kw) -> DenseNet:
+    return DenseNet((6, 12, 24, 16), growth_rate=32, **kw)
+
+
+def DenseNetBC100(**kw) -> DenseNet:
+    """DenseNet-BC(L=100, k=12): 3 blocks of 16 bottleneck layers."""
+    return DenseNet((16, 16, 16), growth_rate=12, **kw)
+
+
+register("dense")(DenseNet121)  # the reference CLI name
+register("densenet121")(DenseNet121)
+register("densenet_bc100")(DenseNetBC100)

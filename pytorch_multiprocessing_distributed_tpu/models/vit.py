@@ -1,0 +1,156 @@
+"""Vision Transformer (BASELINE.md config #4: ViT-B/16 under the same
+trainer — the model-layer swap the reference's ``--model`` seam promises,
+reference ``main.py:39-40``).
+
+TPU-first choices: fused-friendly einops-free attention (plain reshapes,
+``jnp.einsum`` — XLA maps these straight onto the MXU), bf16 compute with
+f32 layernorm/softmax accumulation, learned position embeddings, token
+pooling via class token.
+
+The attention core can run sequence-parallel: pass ``seq_axis`` to shard
+the sequence over a mesh axis with ring attention
+(:mod:`..parallel.ring_attention`) — long-context support the reference
+family never had.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from .registry import register
+from .resnet import dense_init
+
+
+class MlpBlock(nn.Module):
+    mlp_dim: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        d = x.shape[-1]
+        x = nn.Dense(self.mlp_dim, dtype=self.dtype, name="fc1")(x)
+        x = nn.gelu(x)
+        x = nn.Dense(d, dtype=self.dtype, name="fc2")(x)
+        return x
+
+
+class Attention(nn.Module):
+    num_heads: int
+    dtype: Any = jnp.float32
+    seq_axis: Optional[str] = None  # mesh axis for ring attention
+
+    @nn.compact
+    def __call__(self, x):
+        b, s, d = x.shape
+        h = self.num_heads
+        qkv = nn.Dense(3 * d, dtype=self.dtype, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, s, h, d // h)
+        k = k.reshape(b, s, h, d // h)
+        v = v.reshape(b, s, h, d // h)
+        if self.seq_axis is not None:
+            from ..parallel.ring_attention import ring_attention
+
+            out = ring_attention(q, k, v, axis_name=self.seq_axis)
+        else:
+            scale = (d // h) ** -0.5
+            logits = jnp.einsum("bqhc,bkhc->bhqk", q, k) * scale
+            probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+            out = jnp.einsum(
+                "bhqk,bkhc->bqhc", probs.astype(self.dtype), v
+            )
+        out = out.reshape(b, s, d)
+        return nn.Dense(d, dtype=self.dtype, name="proj")(out)
+
+
+class EncoderBlock(nn.Module):
+    num_heads: int
+    mlp_dim: int
+    dtype: Any = jnp.float32
+    seq_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x):
+        # pre-LN transformer; LN in f32 for bf16 stability
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+        x = x + Attention(self.num_heads, self.dtype, self.seq_axis,
+                          name="attn")(h.astype(self.dtype))
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        x = x + MlpBlock(self.mlp_dim, self.dtype, name="mlp")(
+            h.astype(self.dtype)
+        )
+        return x
+
+
+class ViT(nn.Module):
+    """Patch-embed -> class token + pos embed -> N encoder blocks -> head."""
+
+    patch_size: int = 16
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+    bn_axis: Optional[str] = None  # unused (no BN); kept for registry parity
+    seq_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        b = x.shape[0]
+        x = x.astype(self.dtype)
+        x = nn.Conv(
+            self.hidden_size,
+            (self.patch_size, self.patch_size),
+            strides=(self.patch_size, self.patch_size),
+            padding="VALID",
+            dtype=self.dtype,
+            name="patch_embed",
+        )(x)
+        x = x.reshape(b, -1, self.hidden_size)  # [B, S, D]
+        cls = self.param(
+            "cls", nn.initializers.zeros, (1, 1, self.hidden_size), jnp.float32
+        )
+        x = jnp.concatenate([jnp.broadcast_to(cls, (b, 1, self.hidden_size)).astype(self.dtype), x], axis=1)
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(0.02),
+            (1, x.shape[1], self.hidden_size),
+            jnp.float32,
+        )
+        x = x + pos.astype(self.dtype)
+        for i in range(self.num_layers):
+            x = EncoderBlock(
+                self.num_heads, self.mlp_dim, self.dtype, self.seq_axis,
+                name=f"encoder_{i}",
+            )(x)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
+        x = x[:, 0]  # class token
+        x = nn.Dense(self.num_classes, kernel_init=dense_init,
+                     dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+def ViT_B16(**kw) -> ViT:
+    return ViT(patch_size=16, hidden_size=768, num_layers=12, num_heads=12,
+               mlp_dim=3072, **kw)
+
+
+def ViT_S16(**kw) -> ViT:
+    return ViT(patch_size=16, hidden_size=384, num_layers=12, num_heads=6,
+               mlp_dim=1536, **kw)
+
+
+def ViT_Tiny(**kw) -> ViT:
+    """4x4-patch tiny ViT for 32x32 smoke runs under the CIFAR trainer."""
+    return ViT(patch_size=4, hidden_size=192, num_layers=6, num_heads=3,
+               mlp_dim=768, **kw)
+
+
+register("vit_b16")(ViT_B16)
+register("vit_s16")(ViT_S16)
+register("vit_tiny")(ViT_Tiny)

@@ -12,6 +12,14 @@ import jax
 import jax.numpy as jnp
 
 
+def cross_entropy_per_sample(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """``[batch]`` per-sample softmax cross-entropy with integer targets."""
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    label_logits = jnp.take_along_axis(logits, targets[:, None], axis=-1)[:, 0]
+    return logz - label_logits
+
+
 def cross_entropy_loss(logits: jax.Array, targets: jax.Array) -> jax.Array:
     """Mean softmax cross-entropy with integer targets.
 
@@ -19,7 +27,4 @@ def cross_entropy_loss(logits: jax.Array, targets: jax.Array) -> jax.Array:
       logits: ``[batch, num_classes]``.
       targets: ``[batch]`` int labels.
     """
-    logits = logits.astype(jnp.float32)
-    logz = jax.nn.logsumexp(logits, axis=-1)
-    label_logits = jnp.take_along_axis(logits, targets[:, None], axis=-1)[:, 0]
-    return jnp.mean(logz - label_logits)
+    return jnp.mean(cross_entropy_per_sample(logits, targets))

@@ -1,0 +1,50 @@
+"""Distributed runtime: mesh, collectives, sharding, process bring-up.
+
+The TPU-native replacement for the reference's distributed stack
+(SURVEY.md §2.2): ``mp.spawn`` + ``dist.init_process_group('nccl')``
+(reference ``main.py:180-193``) becomes :func:`init_process` over a named
+:class:`jax.sharding.Mesh`; NCCL collectives become XLA collectives over
+ICI/DCN (:mod:`.collectives`); ``DistributedSampler`` (reference
+``data.py:31-37``) becomes :class:`DistributedShardSampler`.
+"""
+
+from .mesh import make_mesh, data_axis_size, DATA_AXIS, MODEL_AXIS
+from .collectives import (
+    all_gather,
+    all_reduce,
+    pmean,
+    ppermute,
+    psum,
+    reduce_scatter,
+    reduce_tensor,
+)
+from .sampler import DistributedShardSampler
+from .dist import (
+    barrier,
+    destroy_process_group,
+    get_rank,
+    get_world_size,
+    init_process,
+    is_primary,
+)
+
+__all__ = [
+    "make_mesh",
+    "data_axis_size",
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "psum",
+    "pmean",
+    "all_gather",
+    "all_reduce",
+    "reduce_scatter",
+    "ppermute",
+    "reduce_tensor",
+    "DistributedShardSampler",
+    "init_process",
+    "destroy_process_group",
+    "get_rank",
+    "get_world_size",
+    "is_primary",
+    "barrier",
+]

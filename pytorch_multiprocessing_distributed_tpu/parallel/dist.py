@@ -1,0 +1,97 @@
+"""Process bring-up and host-level rendezvous.
+
+TPU-native replacement for the reference's launcher + init
+(``main.py:180-193``): no ``mp.spawn`` — TPU runs ONE Python process per
+host controlling all local chips, and multi-host pods rendezvous through
+the JAX coordinator over DCN (``jax.distributed.initialize``), not a
+hand-rolled env-var TCP store on ``127.0.0.1:20080``.
+
+``rank`` in the reference is a per-GPU process index; here the analogous
+host-level notion is ``jax.process_index()`` and the per-shard notion is
+``lax.axis_index`` inside the step. "rank 0 does the logging" becomes
+``is_primary()``.
+
+A C++ TCP key-value store (the c10d ``TCPStore`` analogue) is provided in
+:mod:`..runtime.store` for control-plane coordination outside of JAX —
+experiment-level barriers, health keys — with the same ``set/get/wait/
+add`` surface.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+
+_initialized = False
+
+
+def init_process(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    *,
+    local_device_ids=None,
+) -> None:
+    """Join the multi-host pod (or no-op on a single host).
+
+    Mirrors ``init_process`` (reference ``main.py:190-193``) at the host
+    level. With no arguments, auto-detects: if JAX's standard cluster env
+    vars are present (``JAX_COORDINATOR_ADDRESS`` etc.) or explicit args
+    are given, calls ``jax.distributed.initialize``; otherwise single-host
+    mode. Safe to call twice (idempotent), unlike the reference which
+    would deadlock re-joining NCCL.
+    """
+    global _initialized
+    if _initialized:
+        return
+    want_distributed = (
+        coordinator_address is not None
+        or os.environ.get("JAX_COORDINATOR_ADDRESS")
+        or os.environ.get("COORDINATOR_ADDRESS")
+    )
+    if want_distributed:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            local_device_ids=local_device_ids,
+        )
+    _initialized = True
+
+
+def destroy_process_group() -> None:
+    """Leave the pod (reference ``main.py:84``). No-op on a single host."""
+    global _initialized
+    if _initialized and jax.process_count() > 1:
+        jax.distributed.shutdown()
+    _initialized = False
+
+
+def get_rank() -> int:
+    """Host-level rank: ``jax.process_index()`` (reference ``dist.get_rank()``)."""
+    return jax.process_index()
+
+
+def get_world_size() -> int:
+    """Number of participating hosts (NOT chips)."""
+    return jax.process_count()
+
+
+def is_primary() -> bool:
+    """True on the host that owns logging/checkpoint/plot side effects.
+
+    The reference gates these on ``dist.get_rank() == 0`` (``main.py:69,
+    75, 81, 119, 129, 162, 169``).
+    """
+    return jax.process_index() == 0
+
+
+def barrier(name: str = "barrier") -> None:
+    """Block until every host arrives (control-plane sync)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)

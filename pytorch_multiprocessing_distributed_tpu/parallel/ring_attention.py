@@ -1,0 +1,78 @@
+"""Ring attention: sequence-parallel exact attention over a mesh axis.
+
+Long-context support (first-class per the framework goals; the reference
+family has no attention at all — SURVEY.md §5 marks SP "absent by
+construction", this is the forward-looking half of the mesh design whose
+``sequence`` axis slot it reserves).
+
+Mechanism: Q stays resident per shard; K/V blocks rotate around the ring
+(``lax.ppermute`` — XLA lowers to ICI neighbor exchanges that overlap
+with the block matmuls). Each hop computes a partial attention block and
+folds it into a numerically-stable streaming softmax (running max ``m``,
+denominator ``l``, unnormalized output ``o`` — the flash-attention
+recurrence), so the result is EXACT full attention over the global
+sequence while no shard ever materializes more than its local block.
+
+Memory per shard: O(S_local^2) logits instead of O(S_global^2); ICI
+traffic: (ring_size - 1) K/V block transfers, fully overlapped.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Exact attention with K/V ring rotation over ``axis_name``.
+
+    Args:
+      q, k, v: per-shard ``[batch, seq_local, heads, head_dim]``; the
+        global sequence is sharded over ``axis_name``.
+      axis_name: bound mesh axis (inside ``shard_map``/``pmap``).
+      scale: logit scale; default ``head_dim ** -0.5``.
+
+    Returns:
+      ``[batch, seq_local, heads, head_dim]`` — this shard's slice of the
+      full-attention output.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    axis_size = jax.lax.psum(1, axis_name)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    # internal layout [b, h, s, c] keeps the matmuls MXU-shaped
+    qh = jnp.moveaxis(q, 2, 1).astype(jnp.float32) * scale
+    b, h, s_q, c = qh.shape
+
+    def hop(carry, _):
+        o, m, l, k_blk, v_blk = carry
+        kh = jnp.moveaxis(k_blk, 2, 1).astype(jnp.float32)  # [b,h,sk,c]
+        vh = jnp.moveaxis(v_blk, 2, 1).astype(jnp.float32)
+        logits = jnp.einsum("bhqc,bhkc->bhqk", qh, kh)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(logits - m_new[..., None])
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        o_new = o * corr[..., None] + jnp.einsum("bhqk,bhkc->bhqc", p, vh)
+        k_next = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_next = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (o_new, m_new, l_new, k_next, v_next), None
+
+    o0 = jnp.zeros((b, h, s_q, c), jnp.float32)
+    m0 = jnp.full((b, h, s_q), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, s_q), jnp.float32)
+    (o, m, l, _, _), _ = jax.lax.scan(
+        hop, (o0, m0, l0, k, v), None, length=axis_size
+    )
+    out = o / l[..., None]
+    return jnp.moveaxis(out, 1, 2).astype(q.dtype)

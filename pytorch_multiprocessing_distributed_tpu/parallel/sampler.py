@@ -1,0 +1,155 @@
+"""Deterministic per-replica dataset sharding.
+
+Semantic parity target: ``torch.utils.data.distributed.DistributedSampler``
+as the reference uses it on both splits (``data.py:31-37``, ``shuffle=True``):
+
+- epoch-seeded permutation: generator seeded with ``seed + epoch``;
+- wraparound padding so every replica gets ``ceil(N / world)`` samples
+  (eval therefore sees duplicated samples when ``N % world != 0`` — the
+  reference behavior of record, SURVEY.md §3.5.3);
+- rank r takes the strided slice ``indices[r::world]``.
+
+When torch is importable the permutation is drawn from ``torch.randperm``
+with a ``torch.Generator`` — making the shard contents **index-exact**
+with the reference sampler for the same (seed, epoch, rank, world). The
+numpy fallback keeps identical sharding semantics with a different
+permutation stream.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+try:  # torch-cpu is an optional, test/parity-time dependency only
+    import torch as _torch
+except Exception:  # pragma: no cover
+    _torch = None
+
+
+def padded_epoch_indices(
+    dataset_size: int,
+    num_replicas: int,
+    *,
+    shuffle: bool = True,
+    seed: int = 0,
+    epoch: int = 0,
+    drop_last: bool = False,
+) -> List[int]:
+    """The full padded (or truncated) epoch index list, shared by all
+    replicas — replica ``r``'s shard is the strided slice ``[r::world]``.
+
+    Computed ONCE per epoch by the loader and sliced per replica (the
+    permutation is identical across replicas by construction, so there is
+    no reason to redraw it world_size times).
+    """
+    if shuffle:
+        if _torch is not None:
+            g = _torch.Generator()
+            g.manual_seed(seed + epoch)
+            indices = _torch.randperm(dataset_size, generator=g).tolist()
+        else:
+            rng = np.random.default_rng(seed + epoch)
+            indices = rng.permutation(dataset_size).tolist()
+    else:
+        indices = list(range(dataset_size))
+
+    if drop_last and dataset_size % num_replicas:
+        num_samples = dataset_size // num_replicas
+    else:
+        num_samples = math.ceil(dataset_size / num_replicas)
+    total_size = num_samples * num_replicas
+
+    if not drop_last:
+        padding = total_size - len(indices)
+        if padding > 0:
+            if padding <= len(indices):
+                indices += indices[:padding]
+            else:  # tiny dataset: repeat whole list (torch semantics)
+                reps = math.ceil(padding / len(indices))
+                indices += (indices * reps)[:padding]
+    else:
+        indices = indices[:total_size]
+    assert len(indices) == total_size
+    return indices
+
+
+class DistributedShardSampler:
+    """Index sampler for one replica of a sharded dataset.
+
+    Args:
+      dataset_size: total number of samples.
+      rank: this replica's index on the data axis.
+      num_replicas: data-axis size (the reference's ``world_size``).
+      shuffle: epoch-seeded shuffle (the reference passes True for BOTH
+        train and test, ``data.py:31-37``).
+      seed: base seed (torch's default 0).
+      drop_last: drop the tail instead of padding (torch semantics; the
+        reference uses the default False).
+    """
+
+    def __init__(
+        self,
+        dataset_size: int,
+        rank: int,
+        num_replicas: int,
+        *,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = False,
+    ):
+        if not 0 <= rank < num_replicas:
+            raise ValueError(f"rank {rank} out of range for {num_replicas} replicas")
+        self.dataset_size = dataset_size
+        self.rank = rank
+        self.num_replicas = num_replicas
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+
+        if drop_last and dataset_size % num_replicas:
+            self.num_samples = dataset_size // num_replicas
+        else:
+            self.num_samples = math.ceil(dataset_size / num_replicas)
+        self.total_size = self.num_samples * num_replicas
+
+    def set_epoch(self, epoch: int) -> None:
+        """Reseed the shuffle for a new epoch (torch's ``set_epoch``)."""
+        self.epoch = epoch
+
+    def indices(self) -> List[int]:
+        """This replica's index list for the current epoch."""
+        padded = padded_epoch_indices(
+            self.dataset_size,
+            self.num_replicas,
+            shuffle=self.shuffle,
+            seed=self.seed,
+            epoch=self.epoch,
+            drop_last=self.drop_last,
+        )
+        shard = padded[self.rank : self.total_size : self.num_replicas]
+        assert len(shard) == self.num_samples
+        return shard
+
+    def valid_mask(self) -> np.ndarray:
+        """True where the shard position holds a REAL sample, False where
+        it holds a wraparound-padding duplicate.
+
+        Padding positions in the flat epoch list are exactly positions
+        ``>= dataset_size`` (the appended wraparound tail); shard ``r``
+        holds flat positions ``r, r+world, r+2*world, ...``. This is what
+        makes eval accuracy exact when ``N % world != 0`` — the reference
+        cannot express it (DistributedSampler hides which samples are
+        duplicates), and its eval double-counts them (SURVEY.md §3.5.3).
+        """
+        positions = self.rank + self.num_replicas * np.arange(self.num_samples)
+        return positions < self.dataset_size
+
+    def __iter__(self) -> Iterator[int]:
+        return iter(self.indices())
+
+    def __len__(self) -> int:
+        return self.num_samples

@@ -1,0 +1,14 @@
+"""Native (C++) runtime components and their Python bindings.
+
+Where the reference's capability stack is native (c10d TCPStore, NCCL —
+SURVEY.md §2.2), this package hosts the TPU-side native equivalents. The
+device data plane stays with XLA (that's the TPU-native design); the HOST
+control plane — rendezvous, barriers, health keys — is C++:
+
+- :mod:`.store` — TCP key-value store (c10d ``TCPStore`` analogue),
+  ``csrc/tcp_store.cpp`` via ctypes.
+"""
+
+from .store import TCPStore, TCPStoreServer
+
+__all__ = ["TCPStore", "TCPStoreServer"]

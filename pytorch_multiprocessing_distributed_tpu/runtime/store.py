@@ -1,0 +1,175 @@
+"""ctypes bindings for the C++ TCP rendezvous store (csrc/tcp_store.cpp).
+
+The host-control-plane analogue of the TCPStore behind the reference's
+``init_process_group`` (``main.py:190-193``): ``set``/``get``/``add``/
+``wait`` plus a counting ``barrier``. The shared library is built on
+demand with the repo Makefile (g++ only, no Python build deps).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_CSRC = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "csrc",
+)
+_SO = os.path.join(_CSRC, "build", "libpmdt_store.so")
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_SO):
+            subprocess.run(
+                ["make", "-C", _CSRC], check=True, capture_output=True
+            )
+        lib = ctypes.CDLL(_SO)
+        lib.pmdt_store_server_start.restype = ctypes.c_void_p
+        lib.pmdt_store_server_start.argtypes = [
+            ctypes.c_int, ctypes.POINTER(ctypes.c_int)]
+        lib.pmdt_store_server_stop.argtypes = [ctypes.c_void_p]
+        lib.pmdt_store_connect.restype = ctypes.c_int
+        lib.pmdt_store_connect.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.pmdt_store_disconnect.argtypes = [ctypes.c_int]
+        for name in ("set", "get", "add", "wait", "delete"):
+            getattr(lib, f"pmdt_store_{name}").restype = ctypes.c_int64
+        lib.pmdt_store_set.argtypes = [
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64]
+        lib.pmdt_store_get.argtypes = [
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64)]
+        lib.pmdt_store_add.argtypes = [
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_int64, ctypes.c_char_p,
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_int64)]
+        lib.pmdt_store_wait.argtypes = [
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64)]
+        lib.pmdt_store_delete.argtypes = [
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64)]
+        _lib = lib
+        return _lib
+
+
+class TCPStoreServer:
+    """Hosts the store (run on the coordinator host, like MASTER_ADDR)."""
+
+    def __init__(self, port: int = 0):
+        lib = _load()
+        out_port = ctypes.c_int(0)
+        self._handle = lib.pmdt_store_server_start(
+            port, ctypes.byref(out_port)
+        )
+        if not self._handle:
+            raise OSError(f"failed to start store server on port {port}")
+        self.port = out_port.value
+
+    def stop(self) -> None:
+        if self._handle:
+            _load().pmdt_store_server_stop(self._handle)
+            self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class TCPStore:
+    """Client connection to a :class:`TCPStoreServer`."""
+
+    _BUF = 1 << 20  # 1 MiB receive cap per value
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 20080):
+        self._lib = _load()
+        self._fd = self._lib.pmdt_store_connect(host.encode(), port)
+        if self._fd < 0:
+            raise ConnectionError(f"cannot connect to store at {host}:{port}")
+        # each client needs a private connection for blocking waits; guard
+        # against cross-thread interleaving on this one
+        self._mu = threading.Lock()
+
+    def close(self) -> None:
+        if self._fd >= 0:
+            self._lib.pmdt_store_disconnect(self._fd)
+            self._fd = -1
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def set(self, key: str, value: bytes) -> None:
+        with self._mu:
+            status = self._lib.pmdt_store_set(
+                self._fd, key.encode(), value, len(value)
+            )
+        if status != 0:
+            raise OSError(f"store set({key!r}) failed: {status}")
+
+    def get(self, key: str) -> Optional[bytes]:
+        buf = ctypes.create_string_buffer(self._BUF)
+        out_len = ctypes.c_int64(0)
+        with self._mu:
+            status = self._lib.pmdt_store_get(
+                self._fd, key.encode(), buf, self._BUF, ctypes.byref(out_len)
+            )
+        if status == -1:
+            return None
+        if status < 0:
+            raise OSError(f"store get({key!r}) failed: {status}")
+        return buf.raw[: out_len.value]
+
+    def add(self, key: str, delta: int = 1) -> int:
+        """Atomically add to an integer key; returns the new value (which
+        may be any integer — status and value travel separately)."""
+        buf = ctypes.create_string_buffer(32)
+        out_len = ctypes.c_int64(0)
+        with self._mu:
+            status = self._lib.pmdt_store_add(
+                self._fd, key.encode(), delta, buf, 32, ctypes.byref(out_len)
+            )
+        if status != 0:
+            raise OSError(f"store add({key!r}) failed: {status}")
+        return int(buf.raw[: out_len.value])
+
+    def wait(self, key: str) -> bytes:
+        """Block until ``key`` exists; returns its value."""
+        buf = ctypes.create_string_buffer(self._BUF)
+        out_len = ctypes.c_int64(0)
+        with self._mu:
+            status = self._lib.pmdt_store_wait(
+                self._fd, key.encode(), buf, self._BUF, ctypes.byref(out_len)
+            )
+        if status != 0:
+            raise OSError(f"store wait({key!r}) aborted: {status}")
+        return buf.raw[: out_len.value]
+
+    def delete(self, key: str) -> bool:
+        buf = ctypes.create_string_buffer(8)
+        out_len = ctypes.c_int64(0)
+        with self._mu:
+            status = self._lib.pmdt_store_delete(
+                self._fd, key.encode(), buf, 8, ctypes.byref(out_len)
+            )
+        if status != 0:
+            raise OSError(f"store delete({key!r}) failed: {status}")
+        return buf.raw[: out_len.value] == b"1"
+
+    def barrier(self, name: str, world_size: int) -> None:
+        """Counting barrier: arrive, then wait for the release key."""
+        arrived = self.add(f"__barrier__/{name}/count", 1)
+        if arrived == world_size:
+            self.set(f"__barrier__/{name}/go", b"1")
+        self.wait(f"__barrier__/{name}/go")

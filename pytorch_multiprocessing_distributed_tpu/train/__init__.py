@@ -1,0 +1,25 @@
+"""Training engine: optimizer transforms, SPMD step, loops, checkpointing.
+
+TPU-native replacement for the reference's training engine
+(``main.py:32-177``): the per-process ``main``/``train``/``validate``
+trio becomes a jitted SPMD step over the mesh plus host-side epoch loops
+that reproduce the reference's meters, stdout format and log rows.
+"""
+
+from .optim import sgd, multistep_lr, OptState, Transform
+from .state import TrainState, create_train_state
+from .step import make_train_step, make_eval_step
+from .checkpoint import save_checkpoint, load_checkpoint
+
+__all__ = [
+    "sgd",
+    "multistep_lr",
+    "OptState",
+    "Transform",
+    "TrainState",
+    "create_train_state",
+    "make_train_step",
+    "make_eval_step",
+    "save_checkpoint",
+    "load_checkpoint",
+]

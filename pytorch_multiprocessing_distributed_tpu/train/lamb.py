@@ -1,0 +1,78 @@
+"""LAMB optimizer — large-batch training (BASELINE.md config #5:
+ConvNeXt-L / ImageNet-21k large-batch stress).
+
+You, Li et al., "Large Batch Optimization for Deep Learning: Training
+BERT in 76 minutes" (layerwise adaptive moments). Pure transform with the
+same ``Transform`` interface as :func:`.optim.sgd` so the trainer and
+train step are unchanged — the extension seam the reference's optimizer
+block (``main.py:51-55``) never had.
+
+Update rule (per layer/leaf):
+  m = b1 m + (1-b1) g            v = b2 v + (1-b2) g^2
+  mhat = m / (1-b1^t)            vhat = v / (1-b2^t)
+  u = mhat / (sqrt(vhat)+eps) + wd * p
+  r = ||p|| / ||u||  (trust ratio; 1 where either norm is 0)
+  p <- p - lr * r * u
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .optim import ScalarOrSchedule, Transform
+
+
+class LambState(NamedTuple):
+    mu: Any
+    nu: Any
+    count: jax.Array
+
+
+def lamb(
+    learning_rate: ScalarOrSchedule = 1e-3,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-6,
+    weight_decay: float = 0.0,
+) -> Transform:
+    def init(params) -> LambState:
+        return LambState(
+            mu=jax.tree.map(jnp.zeros_like, params),
+            nu=jax.tree.map(jnp.zeros_like, params),
+            count=jnp.zeros((), jnp.int32),
+        )
+
+    def update(grads, state: LambState, params, lr_step=None):
+        if callable(learning_rate):
+            lr = learning_rate(lr_step)
+        else:
+            lr = jnp.asarray(learning_rate, jnp.float32)
+        count = state.count + 1
+        c1 = 1.0 - b1 ** count.astype(jnp.float32)
+        c2 = 1.0 - b2 ** count.astype(jnp.float32)
+
+        mu = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g, state.mu, grads)
+        nu = jax.tree.map(
+            lambda v, g: b2 * v + (1 - b2) * jnp.square(g), state.nu, grads
+        )
+
+        def one(p, m, v):
+            mhat = m / c1
+            vhat = v / c2
+            u = mhat / (jnp.sqrt(vhat) + eps) + weight_decay * p
+            p_norm = jnp.linalg.norm(p)
+            u_norm = jnp.linalg.norm(u)
+            # trust ratio, guarded exactly as in the paper/optax: 1 when
+            # either norm vanishes
+            r = jnp.where(
+                p_norm > 0, jnp.where(u_norm > 0, p_norm / u_norm, 1.0), 1.0
+            )
+            return -lr * r * u
+
+        updates = jax.tree.map(one, params, mu, nu)
+        return updates, LambState(mu=mu, nu=nu, count=count)
+
+    return Transform(init, update)

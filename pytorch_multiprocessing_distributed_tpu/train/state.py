@@ -1,0 +1,45 @@
+"""Train state: the one pytree threaded through the compiled step.
+
+Bundles what the reference keeps as three mutable objects (the DDP module
+buffers, ``optimizer`` state and the epoch counter, ``main.py:42-59``)
+into a single immutable pytree, so the whole update is one XLA program
+with donated inputs (no host round-trips between forward, all-reduce and
+the optimizer, unlike the reference's ``loss.backward(); optimizer.step()``
+split at ``main.py:108-110``).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+from .optim import OptState, Transform
+
+
+@flax.struct.dataclass
+class TrainState:
+    params: Any
+    batch_stats: Any
+    opt_state: OptState
+    epoch: jax.Array  # current epoch (drives the LR schedule)
+
+
+def create_train_state(model, rng, sample_input, optimizer: Transform) -> TrainState:
+    """Initialize model variables + optimizer buffers.
+
+    Weight layout note: under SPMD there is no DDP-style rank-0 broadcast
+    (reference relies on DDP's ctor broadcast, ``main.py:44``) — every
+    replica computes the same initialization from the same seed.
+    """
+    variables = model.init(rng, sample_input, train=False)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    return TrainState(
+        params=params,
+        batch_stats=batch_stats,
+        opt_state=optimizer.init(params),
+        epoch=jnp.ones((), jnp.int32),
+    )

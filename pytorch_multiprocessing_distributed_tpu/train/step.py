@@ -1,0 +1,166 @@
+"""The compiled SPMD train/eval step.
+
+This is the parity moment for the reference's hot loop (``main.py:
+101-110``): H2D copy, DDP forward (with SyncBatchNorm stat exchange),
+cross-entropy, backward with bucketed NCCL all-reduce, SGD step. Here the
+entire iteration is ONE jitted ``shard_map`` program over the mesh:
+
+- the global batch arrives sharded over the ``data`` axis (per-replica
+  slice = ``batch // world_size``, reference ``data.py:39``);
+- params/optimizer state are replicated; the model's BatchNorm binds the
+  ``data`` axis name, so batch statistics are ``pmean``-synced in-step
+  (== SyncBatchNorm, reference ``main.py:43``);
+- gradients are ``pmean``-ed over ``data`` — DDP averages gradients by
+  world size, and XLA lowers this to the same ring all-reduce NCCL would
+  run, but fused into the step and riding ICI;
+- loss / prec@1 / correct counts are reduced in-step, so the host reads
+  back three scalars instead of shipping logits (the reference pays a
+  device->host sync per batch for ``.item()`` at ``main.py:113-115``).
+
+State is donated: params are updated in place in HBM.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.losses import cross_entropy_loss, cross_entropy_per_sample
+from ..parallel.mesh import DATA_AXIS
+from .optim import Transform, apply_updates
+from .state import TrainState
+
+
+def make_train_step(
+    model,
+    optimizer: Transform,
+    mesh: Mesh,
+    *,
+    loss_fn: Callable = cross_entropy_loss,
+    axis_name: str = DATA_AXIS,
+):
+    """Build the jitted DP train step.
+
+    Returns ``step(state, images, labels) -> (state, metrics)`` where
+    ``metrics = {loss, prec1, correct, count}`` are already globally
+    reduced (scalars, replicated).
+    """
+
+    def shard_body(state: TrainState, images, labels):
+        def compute_loss(params):
+            logits, mutated = model.apply(
+                {"params": params, "batch_stats": state.batch_stats},
+                images,
+                train=True,
+                mutable=["batch_stats"],
+            )
+            return loss_fn(logits, labels), (logits, mutated["batch_stats"])
+
+        grad_fn = jax.value_and_grad(compute_loss, has_aux=True)
+        (loss, (logits, new_stats)), grads = grad_fn(state.params)
+
+        # The DDP all-reduce moment (reference main.py:109): average
+        # gradients across the data axis. BN stats were already pmean-ed
+        # inside the forward (axis bound by shard_map).
+        grads = jax.lax.pmean(grads, axis_name)
+
+        updates, new_opt = optimizer.update(
+            grads, state.opt_state, state.params, lr_step=state.epoch
+        )
+        new_params = apply_updates(state.params, updates)
+
+        pred = jnp.argmax(logits, axis=-1)
+        correct = jnp.sum((pred == labels).astype(jnp.int32))
+        count = jnp.asarray(labels.shape[0], jnp.int32)
+        metrics = {
+            "loss": jax.lax.pmean(loss, axis_name),
+            "correct": jax.lax.psum(correct, axis_name),
+            "count": jax.lax.psum(count, axis_name),
+        }
+        metrics["prec1"] = 100.0 * metrics["correct"] / metrics["count"]
+
+        new_state = state.replace(
+            params=new_params, batch_stats=new_stats, opt_state=new_opt
+        )
+        return new_state, metrics
+
+    sharded = jax.shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(P(), P(axis_name), P(axis_name)),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
+def make_eval_step(
+    model,
+    mesh: Mesh,
+    *,
+    axis_name: str = DATA_AXIS,
+):
+    """Build the jitted eval step (reference ``validate`` inner loop,
+    ``main.py:144-151``): forward in eval mode (running BN stats), loss +
+    correct-count, globally reduced.
+
+    Two fixes over the reference's eval semantics:
+    - the correct count is ``psum``-ed across the data axis (the
+      reference divides a per-rank count by the FULL dataset size,
+      ``main.py:151,168`` — wrong by ~world_size; its ``reduce_tensor``
+      fix is dead code);
+    - a per-sample validity mask excludes the sampler's wraparound-
+      padding duplicates, so accuracy is exact even when the dataset
+      size is not divisible by world_size (SURVEY.md §3.5.3).
+
+    Returns ``step(state, images, labels, valid) -> metrics`` with
+    ``metrics = {loss, correct, count, prec1}``; loss/correct/count are
+    masked sums over REAL samples only.
+    """
+
+    def shard_body(state: TrainState, images, labels, valid):
+        logits = model.apply(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            images,
+            train=False,
+        )
+        w = valid.astype(jnp.float32)
+        per_sample = cross_entropy_per_sample(logits, labels)
+        pred = jnp.argmax(logits, axis=-1)
+        correct = jnp.sum((pred == labels).astype(jnp.float32) * w)
+        metrics = {
+            "loss_sum": jax.lax.psum(jnp.sum(per_sample * w), axis_name),
+            "correct": jax.lax.psum(correct, axis_name).astype(jnp.int32),
+            "count": jax.lax.psum(jnp.sum(w), axis_name).astype(jnp.int32),
+        }
+        count = jnp.maximum(metrics["count"], 1)
+        metrics["loss"] = metrics["loss_sum"] / count
+        metrics["prec1"] = 100.0 * metrics["correct"] / count
+        return metrics
+
+    sharded = jax.shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(P(), P(axis_name), P(axis_name), P(axis_name)),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
+def shard_batch(batch, mesh: Mesh, axis_name: str = DATA_AXIS):
+    """Place a host array as a device array sharded over the data axis.
+
+    The H2D boundary (reference ``input.cuda(rank)``, ``main.py:101``) —
+    one call distributing per-replica slices across all local chips.
+    """
+    return jax.tree.map(
+        lambda x: jax.device_put(
+            x, NamedSharding(mesh, P(axis_name, *([None] * (x.ndim - 1))))
+        ),
+        batch,
+    )

@@ -1,0 +1,168 @@
+"""Epoch-level train/validate loops.
+
+Behavioral parity with the reference's ``train`` (``main.py:87-131``) and
+``validate`` (``main.py:134-171``): same meters, same stdout line formats,
+same ``[epoch, loss.avg, acc]`` log rows, primary-host gating everywhere
+the reference gates on rank 0.
+
+Two deliberate fixes of record (SURVEY.md §3.5):
+- eval accuracy uses the globally ``psum``-ed correct count (the
+  reference divides a per-rank count by the full dataset size,
+  ``main.py:151,168`` — wrong by ~world_size);
+- the LR schedule is a pure function of the epoch evaluated on every
+  replica (the reference steps it on rank 0 only, ``main.py:69-70``).
+
+Timing note: XLA dispatch is asynchronous — ``time.time()`` around the
+step call measures nothing (SURVEY.md §5 "Tracing"). The loop blocks on
+the step's scalar metrics each iteration, which both synchronizes the
+meter timings (honest ``batch_time``) and mirrors the reference's
+per-iter ``.item()`` syncs (``main.py:113-115``).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..data.pipeline import ShardedLoader, prefetch_to_device
+from ..parallel import dist
+from ..utils import AverageMeter, Logger
+from ..utils.plotting import draw_plot
+from .checkpoint import save_checkpoint
+from .state import TrainState
+from .step import make_eval_step, make_train_step
+
+
+class Trainer:
+    """Drives the compiled steps over epochs, reproducing the reference CLI
+    trainer's observable behavior (``main.py:32-84``)."""
+
+    def __init__(
+        self,
+        *,
+        model,
+        optimizer,
+        mesh,
+        state: TrainState,
+        train_loader: ShardedLoader,
+        test_loader: ShardedLoader,
+        save_path: str,
+        epochs: int,
+        print_freq: int = 10,
+        start_epoch: int = 1,
+    ):
+        self.mesh = mesh
+        self.state = state
+        self.train_loader = train_loader
+        self.test_loader = test_loader
+        self.save_path = save_path
+        self.epochs = epochs
+        self.print_freq = print_freq
+        # resume continues the epoch series (and thus the LR schedule and
+        # the log-row numbering) instead of restarting at 1 — the resume
+        # path the reference lacks entirely.
+        self.start_epoch = start_epoch
+        self.train_step = make_train_step(model, optimizer, mesh)
+        self.eval_step = make_eval_step(model, mesh)
+        self.train_logger = Logger(os.path.join(save_path, "train.log"))
+        self.test_logger = Logger(os.path.join(save_path, "test.log"))
+
+    # ------------------------------------------------------------- epochs
+
+    def fit(self) -> TrainState:
+        """The reference's epoch loop (``main.py:67-82``)."""
+        for epoch in range(self.start_epoch, self.epochs + 1):
+            # LR schedule is a function of the epoch carried in the state
+            # (uniform across replicas — fixed vs reference main.py:69-70).
+            self.state = self.state.replace(epoch=jnp.asarray(epoch, jnp.int32))
+            self.train_epoch(epoch)
+            self.validate(epoch, mode="test")
+            if dist.is_primary() and epoch == self.epochs:
+                save_checkpoint(self.save_path, self.state, epoch)
+        if dist.is_primary():
+            draw_plot(self.save_path)
+        return self.state
+
+    # -------------------------------------------------------------- train
+
+    def train_epoch(self, epoch: int) -> None:
+        batch_time = AverageMeter()
+        data_time = AverageMeter()
+        losses = AverageMeter()
+        top1 = AverageMeter()
+
+        self.train_loader.set_epoch(epoch)
+        n_batches = len(self.train_loader)
+        end = time.time()
+        for i, (images, labels) in enumerate(
+            prefetch_to_device(self.train_loader, self.mesh)
+        ):
+            data_time.update(time.time() - end)
+            self.state, metrics = self.train_step(self.state, images, labels)
+            # Block on the reduced scalars: honest batch_time under async
+            # dispatch, and the values the meters need anyway.
+            loss = float(metrics["loss"])
+            prec1 = float(metrics["prec1"])
+            count = int(metrics["count"])
+            losses.update(loss, count)
+            top1.update(prec1, count)
+            batch_time.update(time.time() - end)
+            end = time.time()
+            if dist.is_primary() and i % self.print_freq == 0:
+                print(
+                    "Epoch: [{0}][{1}/{2}]\t"
+                    "Time {batch_time.val:.3f} ({batch_time.avg:.3f})\t"
+                    "Data {data_time.val:.3f} ({data_time.avg:.3f})\t"
+                    "Loss {loss.val:.4f} ({loss.avg:.4f})\t"
+                    "Prec {top1.val:.3f}% ({top1.avg:.3f}%)".format(
+                        epoch, i, n_batches,
+                        batch_time=batch_time, data_time=data_time,
+                        loss=losses, top1=top1,
+                    )
+                )
+        if dist.is_primary():
+            self.train_logger.write([epoch, losses.avg, top1.avg])
+
+    # ---------------------------------------------------------------- eval
+
+    def validate(self, epoch: int, mode: str = "test") -> float:
+        batch_time = AverageMeter()
+        losses = AverageMeter()
+        total_correct = 0
+
+        self.test_loader.set_epoch(epoch)
+        n_batches = len(self.test_loader)
+        end = time.time()
+        for i, batch in enumerate(
+            prefetch_to_device(self.test_loader, self.mesh)
+        ):
+            if len(batch) == 3:
+                images, labels, valid = batch
+            else:  # loader without validity info: everything counts
+                images, labels = batch
+                valid = jnp.ones(labels.shape, bool)
+            metrics = self.eval_step(self.state, images, labels, valid)
+            loss = float(metrics["loss"])
+            count = int(metrics["count"])  # REAL samples only (masked)
+            total_correct += int(metrics["correct"])  # GLOBAL (psum-ed)
+            losses.update(loss, count)
+            batch_time.update(time.time() - end)
+            end = time.time()
+            if dist.is_primary() and i % self.print_freq == 0:
+                print(
+                    mode,
+                    ": [{0}/{1}]\t"
+                    "Time {batch_time.val:.3f} ({batch_time.avg:.3f})\t"
+                    "Loss {loss.val:.4f} ({loss.avg:.4f})".format(
+                        i, n_batches, batch_time=batch_time, loss=losses
+                    ),
+                )
+        total_acc = 100.0 * total_correct / self.test_loader.dataset_size
+        if dist.is_primary():
+            print("Accuracy {:.2f}".format(total_acc))
+            self.test_logger.write([epoch, losses.avg, float(total_acc)])
+        return total_acc

@@ -1,0 +1,75 @@
+"""Tracing / profiling hooks (SURVEY.md §5 "Tracing / profiling").
+
+The reference's only instrumentation is wall-clock meters
+(``main.py:88-89,94,99,117-118``) — which on an async-dispatch runtime
+measure nothing unless steps are synchronized. This module provides:
+
+- :func:`trace` — context manager around ``jax.profiler`` emitting a
+  TensorBoard-loadable trace (XLA op-level timeline, HBM usage);
+- :class:`StepTimer` — ``block_until_ready``-correct step timing with
+  warmup discard, the measurement discipline ``bench.py`` uses;
+- :func:`annotate` — named trace regions (``jax.profiler.TraceAnnotation``)
+  so host-side phases (data, H2D, step) are visible in the timeline.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import List, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(logdir: str, *, host_tracer_level: int = 2):
+    """Capture a profiler trace for the enclosed region into ``logdir``."""
+    jax.profiler.start_trace(logdir, create_perfetto_link=False)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region visible in the profiler timeline."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+class StepTimer:
+    """Measures per-step wall time honestly under async dispatch.
+
+    Call :meth:`tick` with the step's output (any pytree); it blocks on
+    the output before reading the clock. The first ``warmup`` ticks
+    (compilation, autotuning) are recorded separately.
+    """
+
+    def __init__(self, warmup: int = 2):
+        self.warmup = warmup
+        self.times: List[float] = []
+        self.warmup_times: List[float] = []
+        self._last: Optional[float] = None
+
+    def start(self) -> None:
+        self._last = time.perf_counter()
+
+    def tick(self, step_output) -> float:
+        jax.block_until_ready(step_output)
+        now = time.perf_counter()
+        if self._last is None:
+            self._last = now
+            return 0.0
+        dt = now - self._last
+        self._last = now
+        if len(self.warmup_times) < self.warmup:
+            self.warmup_times.append(dt)
+        else:
+            self.times.append(dt)
+        return dt
+
+    @property
+    def mean(self) -> float:
+        return sum(self.times) / len(self.times) if self.times else 0.0
+
+    def images_per_sec(self, batch_size: int) -> float:
+        return batch_size / self.mean if self.mean else 0.0

@@ -1,0 +1,128 @@
+"""Data pipeline tests: transforms, sharded loader, prefetch."""
+
+import numpy as np
+import pytest
+
+from pytorch_multiprocessing_distributed_tpu.data import (
+    ShardedLoader,
+    normalize,
+    prefetch_to_device,
+    random_crop_flip,
+    synthetic_cifar10,
+)
+from pytorch_multiprocessing_distributed_tpu.parallel import make_mesh
+
+
+class TestTransforms:
+    def test_normalize_range_and_dtype(self):
+        imgs = np.array([[[[0, 128, 255]]]], np.uint8)
+        out = normalize(imgs)
+        assert out.dtype == np.float32
+        np.testing.assert_allclose(
+            out[0, 0, 0], [-1.0, 128 / 255 * 2 - 1, 1.0], atol=1e-6
+        )
+
+    def test_crop_preserves_shape_and_content_domain(self):
+        rng = np.random.default_rng(0)
+        imgs, _ = synthetic_cifar10(16)
+        out = random_crop_flip(imgs, rng)
+        assert out.shape == imgs.shape
+        assert out.dtype == imgs.dtype
+
+    def test_crop_matches_torchvision_semantics(self):
+        """A crop window at offset (y,x) of the 8-padded image equals the
+        torchvision RandomCrop(32, padding=8) output for the same offset."""
+        torch = pytest.importorskip("torch")
+        torchvision = pytest.importorskip("torchvision")
+        import torchvision.transforms.functional as TF
+
+        img = (np.arange(32 * 32 * 3).reshape(32, 32, 3) % 255).astype(np.uint8)
+        padded = np.pad(img, ((8, 8), (8, 8), (0, 0)))
+        for y, x in [(0, 0), (8, 8), (16, 3)]:
+            ours = padded[y : y + 32, x : x + 32]
+            t = TF.crop(
+                TF.pad(torch.tensor(img).permute(2, 0, 1), [8, 8, 8, 8]),
+                y, x, 32, 32,
+            ).permute(1, 2, 0).numpy()
+            np.testing.assert_array_equal(ours, t)
+
+
+class TestShardedLoader:
+    def test_shapes_and_order(self):
+        imgs, lbls = synthetic_cifar10(256)
+        loader = ShardedLoader(
+            imgs, lbls, batch_size=64, world_size=8, train=False, shuffle=False
+        )
+        batches = list(loader)
+        assert len(batches) == len(loader) == 4  # 256/8 = 32 per shard / 8
+        x, y = batches[0]
+        assert x.shape == (64, 32, 32, 3) and x.dtype == np.float32
+        assert y.shape == (64,) and y.dtype == np.int32
+        # replica-ordered layout: slice i holds replica i's samples =
+        # indices i, i+8, i+16, ... (strided shard of the unshuffled range)
+        np.testing.assert_array_equal(y[:8], lbls[[0, 8, 16, 24, 32, 40, 48, 56]])
+
+    def test_epoch_reshuffles(self):
+        imgs, lbls = synthetic_cifar10(128)
+        loader = ShardedLoader(imgs, lbls, batch_size=32, world_size=4, train=False)
+        loader.set_epoch(0)
+        e0 = np.concatenate([y for _, y in loader])
+        loader.set_epoch(1)
+        e1 = np.concatenate([y for _, y in loader])
+        assert not np.array_equal(e0, e1)
+        loader.set_epoch(0)
+        e0b = np.concatenate([y for _, y in loader])
+        np.testing.assert_array_equal(e0, e0b)  # deterministic per epoch
+
+    def test_uneven_dataset_pads(self):
+        imgs, lbls = synthetic_cifar10(100)
+        loader = ShardedLoader(
+            imgs, lbls, batch_size=24, world_size=8, train=False
+        )
+        n = sum(y.shape[0] for _, y in loader)
+        # ceil(100/8)=13 per replica -> padded to 104 total, ragged last batch
+        assert n == 104
+
+    def test_with_valid_marks_padding_duplicates(self):
+        imgs, lbls = synthetic_cifar10(17)
+        loader = ShardedLoader(
+            imgs, lbls, batch_size=8, world_size=8, train=False,
+            shuffle=True, with_valid=True,
+        )
+        n_valid = 0
+        for x, y, valid in loader:
+            assert valid.shape == y.shape
+            n_valid += int(valid.sum())
+        assert n_valid == 17  # exactly the real samples, pads masked
+
+    def test_indivisible_batch_rejected(self):
+        imgs, lbls = synthetic_cifar10(64)
+        with pytest.raises(ValueError, match="not divisible"):
+            ShardedLoader(imgs, lbls, batch_size=30, world_size=8)
+
+    def test_train_aug_differs_eval_does_not(self):
+        imgs, lbls = synthetic_cifar10(64)
+        tr = ShardedLoader(imgs, lbls, batch_size=64, world_size=1,
+                           train=True, shuffle=False)
+        ev = ShardedLoader(imgs, lbls, batch_size=64, world_size=1,
+                           train=False, shuffle=False)
+        (xt, _), (xe, _) = next(iter(tr)), next(iter(ev))
+        assert not np.allclose(xt, xe)  # augmented
+        np.testing.assert_allclose(np.asarray(xe), normalize(imgs), atol=1e-6)
+
+
+class TestPrefetch:
+    def test_prefetch_yields_sharded_arrays(self):
+        import jax
+
+        mesh = make_mesh()
+        imgs, lbls = synthetic_cifar10(128)
+        loader = ShardedLoader(imgs, lbls, batch_size=32, world_size=8,
+                               train=False)
+        count = 0
+        for x, y in prefetch_to_device(loader, mesh):
+            assert isinstance(x, jax.Array)
+            assert x.shape[0] == 32
+            assert len(x.sharding.device_set) == 8
+            count += 1
+        assert count == len(loader)

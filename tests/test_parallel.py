@@ -1,0 +1,208 @@
+"""Mesh, collectives, sampler, and bring-up tests (8 virtual CPU devices)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from pytorch_multiprocessing_distributed_tpu import parallel
+from pytorch_multiprocessing_distributed_tpu.parallel import (
+    DistributedShardSampler,
+    all_reduce,
+    make_mesh,
+    reduce_tensor,
+)
+
+
+class TestMesh:
+    def test_default_full_dp(self):
+        mesh = make_mesh()
+        assert mesh.shape["data"] == 8
+        assert mesh.shape["model"] == 1
+        assert parallel.data_axis_size(mesh) == 8
+
+    def test_model_parallel_split(self):
+        mesh = make_mesh(model_parallel=2)
+        assert mesh.shape["data"] == 4
+        assert mesh.shape["model"] == 2
+
+    def test_explicit_world_size(self):
+        mesh = make_mesh(world_size=4)
+        assert mesh.shape["data"] == 4
+
+    def test_oversubscription_rejected(self):
+        with pytest.raises(ValueError, match="needs 16 devices"):
+            make_mesh(world_size=16)
+
+    def test_bad_factorization_rejected(self):
+        with pytest.raises(ValueError, match="not divisible"):
+            make_mesh(model_parallel=3)
+
+
+class TestCollectives:
+    def test_host_level_all_reduce_ops(self):
+        mesh = make_mesh()
+        x = np.arange(8, dtype=np.float32)  # member i holds value i
+        assert float(all_reduce(x, mesh, op="sum")) == 28.0
+        assert float(all_reduce(x, mesh, op="mean")) == 3.5
+        assert float(all_reduce(x, mesh, op="max")) == 7.0
+        assert float(all_reduce(x, mesh, op="min")) == 0.0
+
+    def test_all_reduce_vector_payload(self):
+        mesh = make_mesh()
+        x = np.stack([np.full((3,), i, np.float32) for i in range(8)])
+        out = np.asarray(all_reduce(x, mesh, op="sum"))
+        np.testing.assert_allclose(out, np.full((3,), 28.0))
+
+    def test_reduce_tensor_is_mean(self):
+        """The reference's dead reduce_tensor (main.py:173-177), alive."""
+        mesh = make_mesh()
+        out = reduce_tensor(np.arange(8, dtype=np.float32), mesh)
+        assert float(out) == 3.5
+
+    def test_bad_op_and_shape(self):
+        mesh = make_mesh()
+        with pytest.raises(ValueError, match="unknown reduce op"):
+            all_reduce(np.zeros(8), mesh, op="prod")
+        with pytest.raises(ValueError, match="leading dim"):
+            all_reduce(np.zeros(4), mesh)
+
+    def test_in_context_primitives(self):
+        mesh = make_mesh()
+
+        def body(x):  # x: [1, 4] shard
+            s = parallel.psum(x, "data")
+            m = parallel.pmean(x, "data")
+            g = parallel.all_gather(x, "data", axis=0, tiled=True)
+            rs = parallel.reduce_scatter(
+                jnp.ones((8, 4)) * parallel.collectives.axis_index("data"),
+                "data", scatter_axis=0, tiled=True,
+            )
+            nxt = parallel.ppermute(x, [(i, (i + 1) % 8) for i in range(8)], "data")
+            return s, m, g, rs, nxt
+
+        x = np.arange(8, dtype=np.float32)[:, None] * np.ones((8, 4), np.float32)
+        f = jax.jit(
+            jax.shard_map(
+                body, mesh=mesh,
+                in_specs=P("data"),
+                out_specs=(P(), P(), P(), P("data"), P("data")),
+                check_vma=False,
+            )
+        )
+        s, m, g, rs, nxt = f(x)
+        np.testing.assert_allclose(np.asarray(s)[0], np.full(4, 28.0))
+        np.testing.assert_allclose(np.asarray(m)[0], np.full(4, 3.5))
+        np.testing.assert_allclose(np.asarray(g), x)  # gathered == original
+        # reduce_scatter of rows all equal to axis_index: every shard gets sum 28
+        np.testing.assert_allclose(np.asarray(rs), np.full((8, 4), 28.0))
+        np.testing.assert_allclose(np.asarray(nxt)[1:], x[:-1])  # ring shift
+        np.testing.assert_allclose(np.asarray(nxt)[0], x[-1])
+
+
+class TestSamplerTorchParity:
+    """Index-exact parity with torch DistributedSampler (data.py:31-37)."""
+
+    @pytest.mark.parametrize("n,world", [(100, 4), (101, 4), (17, 8), (10000, 8)])
+    @pytest.mark.parametrize("epoch", [0, 1, 5])
+    def test_shuffle_parity(self, n, world, epoch):
+        torch = pytest.importorskip("torch")
+        from torch.utils.data.distributed import DistributedSampler
+
+        class FakeDataset:
+            def __len__(self):
+                return n
+
+        for rank in range(world):
+            ref = DistributedSampler(
+                FakeDataset(), num_replicas=world, rank=rank, shuffle=True
+            )
+            ref.set_epoch(epoch)
+            ours = DistributedShardSampler(n, rank, world, shuffle=True)
+            ours.set_epoch(epoch)
+            assert list(ours) == list(ref)
+
+    def test_no_shuffle_parity(self):
+        torch = pytest.importorskip("torch")
+        from torch.utils.data.distributed import DistributedSampler
+
+        class FakeDataset:
+            def __len__(self):
+                return 23
+
+        for rank in range(4):
+            ref = DistributedSampler(
+                FakeDataset(), num_replicas=4, rank=rank, shuffle=False
+            )
+            ours = DistributedShardSampler(23, rank, 4, shuffle=False)
+            assert list(ours) == list(ref)
+
+    def test_drop_last_parity(self):
+        torch = pytest.importorskip("torch")
+        from torch.utils.data.distributed import DistributedSampler
+
+        class FakeDataset:
+            def __len__(self):
+                return 23
+
+        for rank in range(4):
+            ref = DistributedSampler(
+                FakeDataset(), num_replicas=4, rank=rank, shuffle=True,
+                drop_last=True,
+            )
+            ref.set_epoch(3)
+            ours = DistributedShardSampler(23, rank, 4, shuffle=True, drop_last=True)
+            ours.set_epoch(3)
+            assert list(ours) == list(ref)
+
+    def test_shards_cover_dataset_with_wraparound(self):
+        world, n = 8, 10000  # CIFAR test split: 10000 % 8 == 0
+        shards = [
+            set(DistributedShardSampler(n, r, world, shuffle=True).indices())
+            for r in range(world)
+        ]
+        assert set().union(*shards) == set(range(n))
+        assert sum(len(s) for s in shards) == n  # no dup when divisible
+
+    def test_padding_duplicates_when_not_divisible(self):
+        world, n = 8, 17
+        all_idx = []
+        for r in range(world):
+            s = DistributedShardSampler(n, r, world, shuffle=True)
+            all_idx.extend(s.indices())
+            assert len(s) == 3  # ceil(17/8)
+        assert len(all_idx) == 24  # padded total
+        assert set(all_idx) == set(range(17))  # still covers everything
+
+    def test_bad_rank(self):
+        with pytest.raises(ValueError, match="out of range"):
+            DistributedShardSampler(10, 4, 4)
+
+    def test_valid_mask_marks_padding(self):
+        # n=17, world=8: ceil -> 3 per shard, padded total 24, 7 pads.
+        # Flat positions >= 17 are pads; shard r holds positions r, r+8, r+16.
+        n_real = 0
+        for r in range(8):
+            s = DistributedShardSampler(17, r, 8, shuffle=True)
+            mask = s.valid_mask()
+            assert mask.shape == (3,)
+            expected = np.array([r < 17, r + 8 < 17, r + 16 < 17])
+            np.testing.assert_array_equal(mask, expected)
+            n_real += int(mask.sum())
+        assert n_real == 17  # masks partition exactly into real samples
+
+    def test_valid_mask_all_true_when_divisible(self):
+        for r in range(8):
+            assert DistributedShardSampler(80, r, 8).valid_mask().all()
+
+
+class TestDistSingleHost:
+    def test_single_host_defaults(self):
+        parallel.init_process()
+        parallel.init_process()  # idempotent
+        assert parallel.get_rank() == 0
+        assert parallel.get_world_size() == 1
+        assert parallel.is_primary()
+        parallel.barrier()  # no-op, must not hang
+        parallel.destroy_process_group()

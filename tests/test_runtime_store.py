@@ -1,0 +1,123 @@
+"""C++ TCP store: build, serve, coordinate multiple clients."""
+
+import shutil
+import threading
+
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("g++") is None and shutil.which("make") is None,
+    reason="no C++ toolchain",
+)
+
+from pytorch_multiprocessing_distributed_tpu.runtime import (  # noqa: E402
+    TCPStore,
+    TCPStoreServer,
+)
+
+
+@pytest.fixture(scope="module")
+def server():
+    with TCPStoreServer(port=0) as srv:
+        yield srv
+
+
+def test_set_get_roundtrip(server):
+    with TCPStore(port=server.port) as c:
+        assert c.get("missing") is None
+        c.set("k", b"hello \x00 binary")
+        assert c.get("k") == b"hello \x00 binary"
+        c.set("k", b"overwritten")
+        assert c.get("k") == b"overwritten"
+
+
+def test_delete(server):
+    with TCPStore(port=server.port) as c:
+        c.set("d", b"1")
+        assert c.delete("d") is True
+        assert c.get("d") is None
+        assert c.delete("d") is False
+
+
+def test_add_negative_counter_values(server):
+    """Counter values and transport status travel separately — a counter
+    of -3 is a legal value, not an error."""
+    with TCPStore(port=server.port) as c:
+        assert c.add("neg", -3) == -3
+        assert c.add("neg", -4) == -7
+        assert c.add("neg", 10) == 3
+
+
+def test_server_stop_with_connected_clients():
+    """stop() must join workers (not leak them into freed memory) even
+    while clients are connected and one is blocked in WAIT."""
+    srv = TCPStoreServer(port=0)
+    idle = TCPStore(port=srv.port)  # connected, no traffic
+    blocked_result = {}
+
+    def waiter():
+        with TCPStore(port=srv.port) as c:
+            try:
+                c.wait("never-set")
+            except OSError as e:
+                blocked_result["err"] = str(e)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    t.join(timeout=0.3)
+    assert t.is_alive()  # blocked in WAIT
+    srv.stop()  # must unblock + join everything, no crash
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert "aborted" in blocked_result["err"]
+    idle.close()
+
+
+def test_add_atomic_across_clients(server):
+    n_clients, n_incr = 4, 50
+    def worker():
+        with TCPStore(port=server.port) as c:
+            for _ in range(n_incr):
+                c.add("ctr", 1)
+    threads = [threading.Thread(target=worker) for _ in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    with TCPStore(port=server.port) as c:
+        assert c.add("ctr", 0) == n_clients * n_incr
+
+
+def test_wait_blocks_until_set(server):
+    results = {}
+
+    def waiter():
+        with TCPStore(port=server.port) as c:
+            results["value"] = c.wait("signal")
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    t.join(timeout=0.3)
+    assert t.is_alive()  # still blocked
+    with TCPStore(port=server.port) as c:
+        c.set("signal", b"go")
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert results["value"] == b"go"
+
+
+def test_barrier_releases_all(server):
+    world = 4
+    done = []
+
+    def member(rank):
+        with TCPStore(port=server.port) as c:
+            c.barrier("epoch0", world)
+            done.append(rank)
+
+    threads = [threading.Thread(target=member, args=(r,)) for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert sorted(done) == list(range(world))

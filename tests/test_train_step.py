@@ -1,0 +1,159 @@
+"""SPMD train/eval step tests on the 8-device CPU mesh.
+
+The parity moment for the reference's hot loop (main.py:101-110): DP
+sharded batch, pmean grads, sync-BN, in-step metric reduction.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_multiprocessing_distributed_tpu import models
+from pytorch_multiprocessing_distributed_tpu.parallel import make_mesh
+from pytorch_multiprocessing_distributed_tpu.train import (
+    create_train_state,
+    load_checkpoint,
+    make_eval_step,
+    make_train_step,
+    save_checkpoint,
+)
+from pytorch_multiprocessing_distributed_tpu.train.optim import sgd
+from pytorch_multiprocessing_distributed_tpu.train.step import shard_batch
+
+
+def _tiny_model(bn_axis="data"):
+    # smallest real member of the family: the reference's [1,1,1,1] ResNet18
+    return models.ResNet18(bn_axis=bn_axis)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    mesh = make_mesh()  # 8-way data parallel
+    model = _tiny_model()
+    opt = sgd(learning_rate=0.1)
+    x = jnp.zeros((16, 32, 32, 3))
+    base_state = create_train_state(model, jax.random.PRNGKey(0), x[:2], opt)
+
+    def make_state():
+        # the train step donates its input state — hand each test a copy
+        return jax.tree.map(jnp.array, base_state)
+
+    train_step = make_train_step(model, opt, mesh)
+    eval_step = make_eval_step(model, mesh)
+    return mesh, model, opt, make_state, train_step, eval_step
+
+
+def test_train_step_runs_and_reduces(setup):
+    mesh, model, opt, make_state, train_step, eval_step = setup
+    state = make_state()
+    before = jax.device_get(state.params)  # state is donated by the step
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(16, 32, 32, 3)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 10, (16,)))
+    xb, yb = shard_batch((x, y), mesh)
+    state2, metrics = train_step(state, xb, yb)
+    assert metrics["loss"].shape == ()
+    assert int(metrics["count"]) == 16  # global, not per-shard
+    assert 0 <= int(metrics["correct"]) <= 16
+    assert float(metrics["prec1"]) == pytest.approx(
+        100.0 * int(metrics["correct"]) / 16
+    )
+    # params actually moved
+    moved = jax.tree.map(
+        lambda a, b: not np.allclose(np.asarray(a), np.asarray(b)),
+        before,
+        jax.device_get(state2.params),
+    )
+    assert any(jax.tree.leaves(moved))
+
+
+def test_dp_equals_single_device_trajectory():
+    """8-way DP on a sharded batch == single-shard run on the full batch.
+
+    This is THE DDP semantic: gradient pmean over shards must reproduce
+    the full-batch gradient (CE loss means over batch; equal shard sizes
+    make mean-of-means exact). Sync-BN makes the forwards identical too.
+    """
+    rng = np.random.default_rng(42)
+    x = jnp.asarray(rng.normal(size=(16, 32, 32, 3)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 10, (16,)))
+
+    # Low lr keeps float-reassociation noise (different reduction orders
+    # between 8 shards and 1) well below the semantic-error scale: a wrong
+    # reduction (psum vs pmean) would show up as O(lr) = 1e-2 divergence,
+    # ~20x the tolerance below.
+    lr = 0.01
+
+    # 8-way DP
+    mesh8 = make_mesh()
+    model = _tiny_model()
+    opt = sgd(learning_rate=lr)
+    state = create_train_state(model, jax.random.PRNGKey(0), x[:2], opt)
+    step8 = make_train_step(model, opt, mesh8)
+    s8 = state
+    for _ in range(2):
+        s8, m8 = step8(s8, *shard_batch((x, y), mesh8))
+
+    # "1-way DP" over a single-device mesh: full batch on one shard
+    mesh1 = make_mesh(world_size=1, devices=jax.devices()[:1])
+    state1 = create_train_state(model, jax.random.PRNGKey(0), x[:2], opt)
+    step1 = make_train_step(model, opt, mesh1)
+    s1 = state1
+    for _ in range(2):
+        s1, m1 = step1(s1, *shard_batch((x, y), mesh1))
+
+    for a, b in zip(jax.tree.leaves(s8.params), jax.tree.leaves(s1.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
+    for a, b in zip(
+        jax.tree.leaves(s8.batch_stats), jax.tree.leaves(s1.batch_stats)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
+    assert float(m8["loss"]) == pytest.approx(float(m1["loss"]), rel=1e-3)
+
+
+def test_eval_step_global_accuracy(setup):
+    mesh, model, opt, make_state, train_step, eval_step = setup
+    state = make_state()
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(16, 32, 32, 3)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 10, (16,)))
+    valid = jnp.ones((16,), bool)
+    metrics = eval_step(state, *shard_batch((x, y, valid), mesh))
+    assert int(metrics["count"]) == 16
+    # the fixed semantics: correct is the GLOBAL count (psum), so accuracy
+    # computed as correct/len(dataset) is right — unlike reference main.py:168
+    assert 0 <= int(metrics["correct"]) <= 16
+
+
+def test_eval_step_masks_padding_duplicates(setup):
+    """Padded duplicates (valid=False) must not inflate correct/count —
+    the exact-accuracy fix for N % world != 0 (SURVEY.md §3.5.3)."""
+    mesh, model, opt, make_state, train_step, eval_step = setup
+    state = make_state()
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.normal(size=(16, 32, 32, 3)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 10, (16,)))
+    all_valid = jnp.ones((16,), bool)
+    half_valid = jnp.asarray([True, False] * 8)
+    m_all = eval_step(make_state(), *shard_batch((x, y, all_valid), mesh))
+    m_half = eval_step(make_state(), *shard_batch((x, y, half_valid), mesh))
+    assert int(m_all["count"]) == 16
+    assert int(m_half["count"]) == 8
+    assert int(m_half["correct"]) <= 8
+
+
+def test_checkpoint_roundtrip(tmp_path, setup):
+    mesh, model, opt, make_state, train_step, eval_step = setup
+    state = make_state()
+    path = save_checkpoint(str(tmp_path), state, epoch=20)
+    assert path.endswith("model_20.pth")
+    fresh = create_train_state(model, jax.random.PRNGKey(1), jnp.zeros((2, 32, 32, 3)), opt)
+    # fresh(seed 1) differs from state(seed 0); after load they must match
+    restored = load_checkpoint(path, fresh)
+    for a, b in zip(jax.tree.leaves(restored.params), jax.tree.leaves(state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    from pytorch_multiprocessing_distributed_tpu.train.checkpoint import (
+        latest_checkpoint,
+    )
+    assert latest_checkpoint(str(tmp_path)) == path
