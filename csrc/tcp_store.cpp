@@ -25,6 +25,7 @@
 #include <algorithm>
 #include <condition_variable>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <map>
 #include <mutex>
@@ -269,10 +270,10 @@ static int64_t request(int fd, uint8_t op, const char* key, const void* val,
   if (out && out_cap > 0) {
     int64_t n = std::min<int64_t>(rlen, out_cap);
     std::memcpy(out, buf.data(), static_cast<size_t>(n));
-    if (out_len) *out_len = n;
-  } else if (out_len) {
-    *out_len = rlen;
   }
+  // *out_len is always the TRUE value length; a caller seeing
+  // out_len > cap got a truncated copy and should use the _dyn variant.
+  if (out_len) *out_len = rlen;
   return status;
 }
 
@@ -285,6 +286,46 @@ int64_t pmdt_store_get(int fd, const char* key, char* out, int64_t cap,
                        int64_t* out_len) {
   return request(fd, 2, key, nullptr, 0, out, cap, out_len);
 }
+
+// Dynamic-allocation variants: the reply value is malloc'd at exact size
+// so arbitrarily large values cross the socket exactly once (no probe /
+// retry). Caller frees *out with pmdt_store_free.
+static int64_t request_dyn(int fd, uint8_t op, const char* key, char** out,
+                           int64_t* out_len) {
+  uint32_t klen = static_cast<uint32_t>(std::strlen(key));
+  uint32_t vlen = 0;
+  *out = nullptr;
+  *out_len = 0;
+  if (!write_full(fd, &op, 1) || !write_full(fd, &klen, 4) ||
+      !write_full(fd, key, klen) || !write_full(fd, &vlen, 4))
+    return -3;
+  int64_t status;
+  uint32_t rlen;
+  if (!read_full(fd, &status, 8) || !read_full(fd, &rlen, 4)) return -3;
+  if (rlen) {
+    char* buf = static_cast<char*>(std::malloc(rlen));
+    if (!buf) return -4;
+    if (!read_full(fd, buf, rlen)) {
+      std::free(buf);
+      return -3;
+    }
+    *out = buf;
+    *out_len = rlen;
+  }
+  return status;
+}
+
+int64_t pmdt_store_get_dyn(int fd, const char* key, char** out,
+                           int64_t* out_len) {
+  return request_dyn(fd, 2, key, out, out_len);
+}
+
+int64_t pmdt_store_wait_dyn(int fd, const char* key, char** out,
+                            int64_t* out_len) {
+  return request_dyn(fd, 4, key, out, out_len);
+}
+
+void pmdt_store_free(char* p) { std::free(p); }
 
 int64_t pmdt_store_add(int fd, const char* key, int64_t delta, char* out,
                        int64_t cap, int64_t* out_len) {

@@ -79,7 +79,8 @@ class ShardedLoader:
         # not re-drawn per replica.
         self.samplers = [
             DistributedShardSampler(
-                len(images), r, world_size, shuffle=shuffle, seed=seed
+                len(images), r, world_size, shuffle=shuffle, seed=seed,
+                drop_last=drop_last,
             )
             for r in self.replica_ids
         ]

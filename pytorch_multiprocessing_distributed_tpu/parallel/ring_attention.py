@@ -54,8 +54,8 @@ def ring_attention(
     qh = jnp.moveaxis(q, 2, 1).astype(jnp.float32) * scale
     b, h, s_q, c = qh.shape
 
-    def hop(carry, _):
-        o, m, l, k_blk, v_blk = carry
+    def fold(o, m, l, k_blk, v_blk):
+        """Fold one K/V block into the streaming-softmax accumulators."""
         kh = jnp.moveaxis(k_blk, 2, 1).astype(jnp.float32)  # [b,h,sk,c]
         vh = jnp.moveaxis(v_blk, 2, 1).astype(jnp.float32)
         logits = jnp.einsum("bhqc,bhkc->bhqk", qh, kh)
@@ -64,15 +64,25 @@ def ring_attention(
         p = jnp.exp(logits - m_new[..., None])
         l_new = l * corr + jnp.sum(p, axis=-1)
         o_new = o * corr[..., None] + jnp.einsum("bhqk,bhkc->bhqc", p, vh)
+        return o_new, m_new, l_new
+
+    def hop(carry, _):
+        o, m, l, k_blk, v_blk = carry
+        o, m, l = fold(o, m, l, k_blk, v_blk)
         k_next = jax.lax.ppermute(k_blk, axis_name, perm)
         v_next = jax.lax.ppermute(v_blk, axis_name, perm)
-        return (o_new, m_new, l_new, k_next, v_next), None
+        return (o, m, l, k_next, v_next), None
 
     o0 = jnp.zeros((b, h, s_q, c), jnp.float32)
     m0 = jnp.full((b, h, s_q), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((b, h, s_q), jnp.float32)
-    (o, m, l, _, _), _ = jax.lax.scan(
-        hop, (o0, m0, l0, k, v), None, length=axis_size
+    # Scan the first axis_size-1 hops (each ends by rotating K/V one step
+    # around the ring), then fold the final block OUTSIDE the scan — the
+    # last rotation's result would be discarded, so issuing it is pure
+    # wasted ICI traffic. Total transfers: axis_size - 1 per K and V.
+    (o, m, l, k_last, v_last), _ = jax.lax.scan(
+        hop, (o0, m0, l0, k, v), None, length=axis_size - 1
     )
+    o, m, l = fold(o, m, l, k_last, v_last)
     out = o / l[..., None]
     return jnp.moveaxis(out, 1, 2).astype(q.dtype)

@@ -12,7 +12,7 @@ import ctypes
 import os
 import subprocess
 import threading
-from typing import Optional
+from typing import Optional, Tuple
 
 _CSRC = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
@@ -56,6 +56,15 @@ def _load() -> ctypes.CDLL:
         lib.pmdt_store_delete.argtypes = [
             ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64,
             ctypes.POINTER(ctypes.c_int64)]
+        for name in ("get_dyn", "wait_dyn"):
+            fn = getattr(lib, f"pmdt_store_{name}")
+            fn.restype = ctypes.c_int64
+            fn.argtypes = [
+                ctypes.c_int, ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_void_p),
+                ctypes.POINTER(ctypes.c_int64)]
+        lib.pmdt_store_free.restype = None
+        lib.pmdt_store_free.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
 
@@ -88,8 +97,6 @@ class TCPStoreServer:
 class TCPStore:
     """Client connection to a :class:`TCPStoreServer`."""
 
-    _BUF = 1 << 20  # 1 MiB receive cap per value
-
     def __init__(self, host: str = "127.0.0.1", port: int = 20080):
         self._lib = _load()
         self._fd = self._lib.pmdt_store_connect(host.encode(), port)
@@ -118,18 +125,32 @@ class TCPStore:
         if status != 0:
             raise OSError(f"store set({key!r}) failed: {status}")
 
-    def get(self, key: str) -> Optional[bytes]:
-        buf = ctypes.create_string_buffer(self._BUF)
+    def _fetch_dyn(self, op_name: str, key: str) -> Tuple[int, bytes]:
+        """Run a dyn-allocating fetch op; the value crosses the socket
+        exactly once at exact size (no client-side cap, no re-fetch)."""
+        ptr = ctypes.c_void_p(None)
         out_len = ctypes.c_int64(0)
+        fn = getattr(self._lib, f"pmdt_store_{op_name}")
         with self._mu:
-            status = self._lib.pmdt_store_get(
-                self._fd, key.encode(), buf, self._BUF, ctypes.byref(out_len)
+            status = fn(
+                self._fd, key.encode(), ctypes.byref(ptr), ctypes.byref(out_len)
             )
+        try:
+            value = (
+                ctypes.string_at(ptr, out_len.value) if ptr.value else b""
+            )
+        finally:
+            if ptr.value:
+                self._lib.pmdt_store_free(ptr)
+        return status, value
+
+    def get(self, key: str) -> Optional[bytes]:
+        status, value = self._fetch_dyn("get_dyn", key)
         if status == -1:
             return None
         if status < 0:
             raise OSError(f"store get({key!r}) failed: {status}")
-        return buf.raw[: out_len.value]
+        return value
 
     def add(self, key: str, delta: int = 1) -> int:
         """Atomically add to an integer key; returns the new value (which
@@ -146,15 +167,10 @@ class TCPStore:
 
     def wait(self, key: str) -> bytes:
         """Block until ``key`` exists; returns its value."""
-        buf = ctypes.create_string_buffer(self._BUF)
-        out_len = ctypes.c_int64(0)
-        with self._mu:
-            status = self._lib.pmdt_store_wait(
-                self._fd, key.encode(), buf, self._BUF, ctypes.byref(out_len)
-            )
+        status, value = self._fetch_dyn("wait_dyn", key)
         if status != 0:
             raise OSError(f"store wait({key!r}) aborted: {status}")
-        return buf.raw[: out_len.value]
+        return value
 
     def delete(self, key: str) -> bool:
         buf = ctypes.create_string_buffer(8)
@@ -168,8 +184,26 @@ class TCPStore:
         return buf.raw[: out_len.value] == b"1"
 
     def barrier(self, name: str, world_size: int) -> None:
-        """Counting barrier: arrive, then wait for the release key."""
-        arrived = self.add(f"__barrier__/{name}/count", 1)
-        if arrived == world_size:
-            self.set(f"__barrier__/{name}/go", b"1")
-        self.wait(f"__barrier__/{name}/go")
+        """Counting barrier: arrive, then wait for the release key.
+
+        Reusable with NO client-side state: a single server-side monotone
+        arrivals counter identifies rounds. Barrier semantics guarantee no
+        participant can re-enter round k+1 before all ``world_size``
+        members arrived in round k, so arrivals ``(k-1)*world+1 .. k*world``
+        belong exactly to round k — each arriver derives its round from its
+        own arrival number. Works across reconnects and fresh client
+        instances (the round lives on the server). The releaser of round k
+        garbage-collects round k-1's release key.
+
+        (Like any fixed-world counting barrier, a participant that crashes
+        MID-round and re-arrives double-counts; crash recovery needs a
+        generation-aware rendezvous above this primitive.)
+        """
+        arrival = self.add(f"__barrier__/{name}/arrivals", 1)
+        round_no = (arrival - 1) // world_size + 1
+        go_key = f"__barrier__/{name}/go/{round_no}"
+        if arrival == round_no * world_size:  # last arriver of this round
+            self.set(go_key, b"1")
+            if round_no > 1:
+                self.delete(f"__barrier__/{name}/go/{round_no - 1}")
+        self.wait(go_key)

@@ -24,7 +24,11 @@ import jax
 @contextlib.contextmanager
 def trace(logdir: str, *, host_tracer_level: int = 2):
     """Capture a profiler trace for the enclosed region into ``logdir``."""
-    jax.profiler.start_trace(logdir, create_perfetto_link=False)
+    options = jax.profiler.ProfileOptions()
+    options.host_tracer_level = host_tracer_level
+    jax.profiler.start_trace(
+        logdir, create_perfetto_link=False, profiler_options=options
+    )
     try:
         yield
     finally:

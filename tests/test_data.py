@@ -95,6 +95,24 @@ class TestShardedLoader:
             n_valid += int(valid.sum())
         assert n_valid == 17  # exactly the real samples, pads masked
 
+    def test_drop_last_consistent_lengths(self):
+        """drop_last must flow through to the samplers: __len__, the index
+        stream, and the valid masks must agree (truncated shards, no
+        ragged mismatch in the final batch)."""
+        imgs, lbls = synthetic_cifar10(101)  # 101 % 8 = 5 -> truncation
+        loader = ShardedLoader(
+            imgs, lbls, batch_size=24, world_size=8, train=False,
+            drop_last=True, with_valid=True,
+        )
+        # floor(101/8)=12 per replica; per-replica batch 3 -> 4 batches
+        assert len(loader) == 4
+        n = 0
+        for x, y, valid in loader:
+            assert x.shape[0] == y.shape[0] == valid.shape[0]
+            assert valid.all()  # truncation never pads -> all samples real
+            n += y.shape[0]
+        assert n == 8 * 12  # total = world * floor(N/world)
+
     def test_indivisible_batch_rejected(self):
         imgs, lbls = synthetic_cifar10(64)
         with pytest.raises(ValueError, match="not divisible"):

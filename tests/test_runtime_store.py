@@ -106,6 +106,70 @@ def test_wait_blocks_until_set(server):
     assert results["value"] == b"go"
 
 
+def test_get_value_larger_than_client_buffer(server):
+    """Values up to the server's 64 MiB cap must round-trip exactly:
+    get() fetches at exact size (C-side malloc), never truncating."""
+    big = bytes(range(256)) * (9 * 1 << 12)  # 9 MiB, patterned
+    with TCPStore(port=server.port) as c:
+        c.set("big", big)
+        assert c.get("big") == big
+
+
+def test_wait_value_larger_than_client_buffer(server):
+    big = b"\xab" * ((1 << 20) + 12345)
+    with TCPStore(port=server.port) as c:
+        c.set("big2", big)
+        assert c.wait("big2") == big
+
+
+def test_barrier_reusable_same_name(server):
+    """Back-to-back barriers on the SAME name must each synchronize —
+    leftover go/count keys from round k must not release round k+1."""
+    world, rounds = 3, 3
+    import contextlib
+    import time as _time
+
+    _nullctx = contextlib.nullcontext
+    _clients = {r: TCPStore(port=server.port) for r in (0, 2)}
+    trace = []  # (round, "enter"/"exit", rank)
+    lock = threading.Lock()
+
+    def member(rank):
+        for r in range(rounds):
+            # rank 1 uses a FRESH client instance per round: the round
+            # must live on the server, not in client memory.
+            with TCPStore(port=server.port) if rank == 1 else _nullctx(
+                _clients[rank]
+            ) as c:
+                if rank == 0:
+                    _time.sleep(0.15)  # straggler: others must wait for it
+                with lock:
+                    trace.append((r, "enter", rank))
+                c.barrier("reuse", world)
+                with lock:
+                    trace.append((r, "exit", rank))
+
+    threads = [threading.Thread(target=member, args=(r,)) for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    for c in _clients.values():
+        c.close()
+    assert all(not t.is_alive() for t in threads)
+    # In every round, no member may exit before ALL members entered.
+    for r in range(rounds):
+        events = [e for e in trace if e[0] == r]
+        entered = set()
+        for _, kind, rank in events:
+            if kind == "enter":
+                entered.add(rank)
+            else:
+                assert entered == set(range(world)), (
+                    f"round {r}: rank {rank} exited before all entered"
+                )
+
+
 def test_barrier_releases_all(server):
     world = 4
     done = []
