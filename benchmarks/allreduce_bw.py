@@ -27,17 +27,17 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from pytorch_multiprocessing_distributed_tpu.parallel import make_mesh  # noqa: E402
 
 
-def bench_psum(mesh, size_bytes: int, iters: int = 20) -> dict:
+def _bench(mesh, size_bytes: int, iters: int, body, metric: str,
+           out_specs) -> dict:
+    """Shared harness: same payload, warmup, timing, and bus-bandwidth
+    formula for every all-reduce implementation under comparison."""
     n = mesh.shape["data"]
     elems = size_bytes // 4
     x = jnp.ones((n, elems), jnp.float32)
 
-    def body(v):  # per-shard [1, elems]
-        return jax.lax.psum(v, "data")
-
     f = jax.jit(
-        jax.shard_map(body, mesh=mesh, in_specs=P("data"), out_specs=P(),
-                      check_vma=False)
+        jax.shard_map(body, mesh=mesh, in_specs=P("data"),
+                      out_specs=out_specs, check_vma=False)
     )
     out = f(x)
     jax.block_until_ready(out)
@@ -48,7 +48,7 @@ def bench_psum(mesh, size_bytes: int, iters: int = 20) -> dict:
     dt = (time.perf_counter() - t0) / iters
     bus_bw = size_bytes * 2 * (n - 1) / n / dt
     return {
-        "metric": "psum_allreduce_bus_bw",
+        "metric": metric,
         "payload_mb": round(size_bytes / 2**20, 2),
         "devices": n,
         "time_ms": round(dt * 1e3, 3),
@@ -57,14 +57,40 @@ def bench_psum(mesh, size_bytes: int, iters: int = 20) -> dict:
     }
 
 
+def bench_psum(mesh, size_bytes: int, iters: int = 20) -> dict:
+    return _bench(
+        mesh, size_bytes, iters,
+        lambda v: jax.lax.psum(v, "data"),  # per-shard [1, elems]
+        "psum_allreduce_bus_bw", P(),
+    )
+
+
+def bench_ring(mesh, size_bytes: int, iters: int = 20) -> dict:
+    """Same payload through the hand-built Pallas RDMA ring
+    (:func:`...ops.pallas.ring_all_reduce`) — the NCCL-analogue number."""
+    from pytorch_multiprocessing_distributed_tpu.ops.pallas import (
+        ring_all_reduce,
+    )
+
+    return _bench(
+        mesh, size_bytes, iters,
+        lambda v: ring_all_reduce(v[0], "data")[None],
+        "pallas_ring_allreduce_bus_bw", P("data"),
+    )
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--sizes-mb", nargs="+", type=float, default=[1, 16, 64])
     p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--ring", action="store_true",
+                   help="also run the Pallas RDMA ring kernel")
     args = p.parse_args()
     mesh = make_mesh(jax.device_count())
     for mb in args.sizes_mb:
         print(json.dumps(bench_psum(mesh, int(mb * 2**20), args.iters)))
+        if args.ring and mesh.shape["data"] > 1:
+            print(json.dumps(bench_ring(mesh, int(mb * 2**20), args.iters)))
 
 
 if __name__ == "__main__":

@@ -11,6 +11,13 @@ H2D analogue, SURVEY.md §2.2).
 from .cifar import load_cifar10, synthetic_cifar10
 from .transforms import normalize, random_crop_flip
 from .pipeline import ShardedLoader, get_loader, prefetch_to_device
+from .imagenet import (
+    FolderImageNet,
+    IndexedLoader,
+    SyntheticImageNet,
+    normalize_imagenet,
+    synthetic_imagenet,
+)
 
 __all__ = [
     "load_cifar10",
@@ -20,4 +27,9 @@ __all__ = [
     "ShardedLoader",
     "get_loader",
     "prefetch_to_device",
+    "FolderImageNet",
+    "IndexedLoader",
+    "SyntheticImageNet",
+    "normalize_imagenet",
+    "synthetic_imagenet",
 ]

@@ -1,0 +1,293 @@
+"""ImageNet-scale input pipeline (BASELINE.md configs #2, #3, #5).
+
+The reference pipeline is CIFAR-only (``data.py:6-59``); the framework's
+headline target is ResNet-50/ImageNet (BASELINE.json north star), so the
+data layer must scale to 224x224/1000-class traffic. Two sources:
+
+- :class:`FolderImageNet` — reads a ``train/<wnid>/*.JPEG``-style tree
+  (the torchvision ``ImageFolder`` layout) using Pillow when available.
+  Decoding is lazy per batch: only the epoch's index permutation lives in
+  memory, never the dataset.
+- :func:`synthetic_imagenet` — deterministic class-separable synthetic
+  set generated ON DEMAND per index (an ``IndexedDataset``), so
+  ImageNet-shaped benches run data-free at any nominal dataset size
+  without materializing terabytes.
+
+Both plug into the same :class:`..parallel.sampler` sharding math as
+CIFAR (DistributedSampler-parity), via :class:`IndexedLoader` — the
+lazy-source counterpart of :class:`.pipeline.ShardedLoader`.
+
+Standard ImageNet train aug = RandomResizedCrop(224) + HFlip; eval =
+Resize(256) + CenterCrop(224); normalization by the usual per-channel
+mean/std.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..parallel.sampler import padded_epoch_indices
+
+IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
+
+
+def normalize_imagenet(images: np.ndarray) -> np.ndarray:
+    """uint8 [N,H,W,C] -> float32 normalized by ImageNet mean/std."""
+    x = images.astype(np.float32) / 255.0
+    return (x - IMAGENET_MEAN) / IMAGENET_STD
+
+
+# --------------------------------------------------------------- datasets
+
+
+class IndexedDataset:
+    """Minimal lazy-dataset protocol: ``len(ds)``, ``ds.get(indices, rng,
+    train) -> (uint8 images [n,H,W,C], int32 labels [n])``."""
+
+    image_size: int = 224
+    num_classes: int = 1000
+
+    def __len__(self) -> int:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def get(self, indices, rng, train):  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class SyntheticImageNet(IndexedDataset):
+    """Class-separable synthetic images computed per index on demand.
+
+    Each class gets a fixed low-frequency pattern; per-sample noise is
+    seeded by the index, so any slice of the dataset is reproducible
+    without storing it. Default nominal size matches ImageNet-1k train.
+    """
+
+    def __init__(self, n: int = 1_281_167, *, image_size: int = 224,
+                 num_classes: int = 1000, seed: int = 0):
+        self._n = n
+        self.image_size = image_size
+        self.num_classes = num_classes
+        self.seed = seed
+        # per-class pattern basis: 8x8 low-res patterns upsampled on use
+        rng = np.random.default_rng(seed)
+        self._patterns = rng.integers(
+            64, 192, size=(num_classes, 8, 8, 3)
+        ).astype(np.uint8)
+
+    def __len__(self) -> int:
+        return self._n
+
+    def label_of(self, idx: np.ndarray) -> np.ndarray:
+        # index-determined label (golden-ratio hash for class balance)
+        return ((idx * 2654435761) % self.num_classes).astype(np.int32)
+
+    def get(self, indices, rng, train):
+        idx = np.asarray(indices, np.int64)
+        labels = self.label_of(idx)
+        s = self.image_size
+        reps = -(-s // 8)
+        base = np.repeat(
+            np.repeat(self._patterns[labels], reps, axis=1), reps, axis=2
+        )[:, :s, :s, :]
+        # per-index deterministic noise via a vectorized integer hash (no
+        # RNG state): sample i's pixels depend only on (seed, index i)
+        pix = np.arange(s * s * 3, dtype=np.uint32).reshape(1, s, s, 3)
+        h = (
+            (idx[:, None, None, None] + self.seed).astype(np.uint32)
+            * np.uint32(2654435761)
+        ) ^ (pix * np.uint32(2246822519))
+        h ^= h >> np.uint32(13)
+        noise = (h % np.uint32(49)).astype(np.int32) - 24
+        images = np.clip(base.astype(np.int32) + noise, 0, 255).astype(np.uint8)
+        return images, labels
+
+
+class FolderImageNet(IndexedDataset):
+    """``root/<split>/<wnid>/*.JPEG`` tree, decoded lazily via Pillow.
+
+    Class ids are assigned by sorted wnid (torchvision ``ImageFolder``
+    semantics), so checkpoints trained elsewhere line up.
+    """
+
+    _EXTS = (".jpeg", ".jpg", ".png", ".bmp")
+
+    def __init__(self, root: str, split: str = "train", *,
+                 image_size: int = 224):
+        self.image_size = image_size
+        base = os.path.join(root, split)
+        if not os.path.isdir(base):
+            raise FileNotFoundError(f"no ImageNet split dir at {base}")
+        self.paths: List[str] = []
+        labels: List[int] = []
+        wnids = sorted(
+            d for d in os.listdir(base)
+            if os.path.isdir(os.path.join(base, d))
+        )
+        self.wnid_to_label = {w: i for i, w in enumerate(wnids)}
+        self.num_classes = max(len(wnids), 1)
+        for w in wnids:
+            d = os.path.join(base, w)
+            for name in sorted(os.listdir(d)):
+                if name.lower().endswith(self._EXTS):
+                    self.paths.append(os.path.join(d, name))
+                    labels.append(self.wnid_to_label[w])
+        self.labels = np.asarray(labels, np.int32)
+
+    def __len__(self) -> int:
+        return len(self.paths)
+
+    def get(self, indices, rng, train):
+        from PIL import Image  # lazy: Pillow ships with torchvision stacks
+
+        s = self.image_size
+        out = np.empty((len(indices), s, s, 3), np.uint8)
+        for row, idx in enumerate(np.asarray(indices)):
+            with Image.open(self.paths[idx]) as im:
+                im = im.convert("RGB")
+                if train:
+                    out[row] = _random_resized_crop(im, s, rng)
+                else:
+                    out[row] = _center_crop(im, s)
+        return out, self.labels[np.asarray(indices)]
+
+
+def synthetic_imagenet(n: int = 4096, *, image_size: int = 224,
+                       num_classes: int = 1000, seed: int = 0
+                       ) -> SyntheticImageNet:
+    return SyntheticImageNet(n, image_size=image_size,
+                             num_classes=num_classes, seed=seed)
+
+
+# ------------------------------------------------------------ transforms
+
+
+def _random_resized_crop(im, size: int, rng: np.random.Generator):
+    """torchvision RandomResizedCrop(size): area in [0.08, 1], aspect in
+    [3/4, 4/3], 10 tries then center-crop fallback."""
+    w, h = im.size
+    area = w * h
+    for _ in range(10):
+        target_area = area * rng.uniform(0.08, 1.0)
+        aspect = np.exp(rng.uniform(np.log(3 / 4), np.log(4 / 3)))
+        cw = int(round(np.sqrt(target_area * aspect)))
+        ch = int(round(np.sqrt(target_area / aspect)))
+        if 0 < cw <= w and 0 < ch <= h:
+            x0 = int(rng.integers(0, w - cw + 1))
+            y0 = int(rng.integers(0, h - ch + 1))
+            box = (x0, y0, x0 + cw, y0 + ch)
+            out = im.resize((size, size), box=box)
+            arr = np.asarray(out, np.uint8)
+            if rng.random() < 0.5:
+                arr = arr[:, ::-1]
+            return arr
+    return _center_crop(im, size)
+
+
+def _center_crop(im, size: int):
+    """Resize(short side -> size*256/224) + CenterCrop(size)."""
+    w, h = im.size
+    scale = (size * 256 // 224) / min(w, h)
+    im = im.resize((max(1, round(w * scale)), max(1, round(h * scale))))
+    w, h = im.size
+    x0 = (w - size) // 2
+    y0 = (h - size) // 2
+    return np.asarray(im.crop((x0, y0, x0 + size, y0 + size)), np.uint8)
+
+
+def _synthetic_train_aug(images: np.ndarray, rng: np.random.Generator
+                         ) -> np.ndarray:
+    """Cheap train-time aug for already-sized (synthetic) images: random
+    flip only — crop geometry is meaningless for generated patterns."""
+    flips = rng.random(images.shape[0]) < 0.5
+    images = images.copy()
+    images[flips] = images[flips, :, ::-1, :]
+    return images
+
+
+# ----------------------------------------------------------------- loader
+
+
+class IndexedLoader:
+    """DistributedSampler-parity batch loader over a lazy
+    :class:`IndexedDataset` (the ImageNet counterpart of
+    :class:`.pipeline.ShardedLoader`, same replica-ordered superbatch
+    layout and epoch-seeded shard math — ``..parallel.sampler``)."""
+
+    def __init__(
+        self,
+        dataset: IndexedDataset,
+        *,
+        batch_size: int,
+        world_size: int,
+        replica_ids: Optional[Sequence[int]] = None,
+        train: bool = True,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = False,
+        with_valid: bool = False,
+    ):
+        if batch_size % world_size:
+            raise ValueError(
+                f"global batch {batch_size} not divisible by world {world_size}"
+            )
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.per_replica = batch_size // world_size
+        self.world_size = world_size
+        self.replica_ids = (
+            list(replica_ids) if replica_ids is not None
+            else list(range(world_size))
+        )
+        self.train = train
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.with_valid = with_valid
+        self._epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self._epoch = epoch
+
+    @property
+    def dataset_size(self) -> int:
+        return len(self.dataset)
+
+    def _shard_len(self) -> int:
+        n, w = len(self.dataset), self.world_size
+        return n // w if (self.drop_last and n % w) else -(-n // w)
+
+    def __len__(self) -> int:
+        n = self._shard_len()
+        return n // self.per_replica if self.drop_last else -(-n // self.per_replica)
+
+    def __iter__(self) -> Iterator[Tuple[np.ndarray, ...]]:
+        padded = np.asarray(padded_epoch_indices(
+            len(self.dataset), self.world_size, shuffle=self.shuffle,
+            seed=self.seed, epoch=self._epoch, drop_last=self.drop_last,
+        ))
+        shards = [padded[r :: self.world_size] for r in self.replica_ids]
+        positions = [
+            np.asarray(r) + self.world_size * np.arange(self._shard_len())
+            for r in self.replica_ids
+        ]
+        rng = np.random.default_rng(
+            np.random.SeedSequence([self.seed, self._epoch, 77])
+        )
+        for b in range(len(self)):
+            lo = b * self.per_replica
+            hi = lo + self.per_replica
+            idx = np.concatenate([np.asarray(s[lo:hi]) for s in shards])
+            images, labels = self.dataset.get(idx, rng, self.train)
+            if self.train and isinstance(self.dataset, SyntheticImageNet):
+                images = _synthetic_train_aug(images, rng)
+            out = (normalize_imagenet(images), labels.astype(np.int32))
+            if self.with_valid:
+                valid = np.concatenate(
+                    [p[lo:hi] < len(self.dataset) for p in positions]
+                )
+                out = out + (valid,)
+            yield out

@@ -42,6 +42,7 @@ class Attention(nn.Module):
     num_heads: int
     dtype: Any = jnp.float32
     seq_axis: Optional[str] = None  # mesh axis for ring attention
+    flash: bool = False  # Pallas blockwise kernel (no [S,S] logits in HBM)
 
     @nn.compact
     def __call__(self, x):
@@ -56,6 +57,10 @@ class Attention(nn.Module):
             from ..parallel.ring_attention import ring_attention
 
             out = ring_attention(q, k, v, axis_name=self.seq_axis)
+        elif self.flash:
+            from ..ops.pallas import flash_attention
+
+            out = flash_attention(q, k, v)
         else:
             scale = (d // h) ** -0.5
             logits = jnp.einsum("bqhc,bkhc->bhqk", q, k) * scale
@@ -72,13 +77,14 @@ class EncoderBlock(nn.Module):
     mlp_dim: int
     dtype: Any = jnp.float32
     seq_axis: Optional[str] = None
+    flash: bool = False
 
     @nn.compact
     def __call__(self, x):
         # pre-LN transformer; LN in f32 for bf16 stability
         h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
         x = x + Attention(self.num_heads, self.dtype, self.seq_axis,
-                          name="attn")(h.astype(self.dtype))
+                          self.flash, name="attn")(h.astype(self.dtype))
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
         x = x + MlpBlock(self.mlp_dim, self.dtype, name="mlp")(
             h.astype(self.dtype)
@@ -98,6 +104,7 @@ class ViT(nn.Module):
     dtype: Any = jnp.float32
     bn_axis: Optional[str] = None  # unused (no BN); kept for registry parity
     seq_axis: Optional[str] = None
+    flash: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -126,7 +133,7 @@ class ViT(nn.Module):
         for i in range(self.num_layers):
             x = EncoderBlock(
                 self.num_heads, self.mlp_dim, self.dtype, self.seq_axis,
-                name=f"encoder_{i}",
+                self.flash, name=f"encoder_{i}",
             )(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
         x = x[:, 0]  # class token

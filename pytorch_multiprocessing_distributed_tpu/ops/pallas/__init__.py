@@ -1,0 +1,30 @@
+"""Pallas TPU kernels for the framework's hot ops.
+
+The reference leans on cuDNN/NCCL for its fused kernels and collectives
+(SURVEY.md §2.2); XLA:TPU covers most of that surface automatically. These
+kernels cover the spots where hand scheduling buys something XLA can't:
+
+- :mod:`.flash_attention` — blockwise attention that never materializes
+  the [S, S] logits in HBM (long-context support; XLA's dot+softmax+dot
+  materializes logits).
+- :mod:`.fused_update` — single-pass SGD(momentum, nesterov, wd) update:
+  one read of (param, grad, buf), one write of (param, buf), aliased
+  in-place in HBM.
+- :mod:`.ring_allreduce` — RDMA ring collectives over ICI, the
+  educational/bench analogue of NCCL's ring all-reduce (production paths
+  use ``lax.psum``, which XLA already lowers optimally).
+
+All kernels run compiled on TPU and under ``interpret=True`` on CPU (the
+test path; auto-selected when the backend is not TPU).
+"""
+
+from .flash_attention import flash_attention  # noqa: F401
+from .fused_update import fused_sgd_apply, sgd_pallas  # noqa: F401
+from .ring_allreduce import ring_all_reduce  # noqa: F401
+
+
+def default_interpret() -> bool:
+    """Interpret mode unless running on real TPU hardware."""
+    import jax
+
+    return jax.default_backend() != "tpu"

@@ -1,0 +1,335 @@
+"""Blockwise (flash) attention as a Pallas TPU kernel.
+
+Forward: one grid cell per (batch*head, q-block); the kernel streams
+K/V blocks out of VMEM through the MXU, folding each into the running
+max / denominator / unnormalized-output recurrence, so the full [S, S]
+logit matrix never exists in HBM. This is the single-shard building
+block of the framework's long-context story (ring attention rotates K/V
+shards between chips with the same recurrence —
+:mod:`..parallel.ring_attention`... see
+``pytorch_multiprocessing_distributed_tpu/parallel/ring_attention.py``).
+
+Backward: blockwise recompute from the saved log-sum-exp (the standard
+flash-attention backward), expressed as ``lax.scan`` over K/V (for dq)
+and Q (for dk, dv) blocks in plain JAX — peak memory stays
+O(S * block) instead of O(S^2).
+
+The reference family has no attention at all (SURVEY.md §5 marks
+sequence parallelism "absent by construction"); this kernel serves the
+framework's ViT model family and the long-context mandate.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30  # large-finite: -inf breaks exp(m - m_new) when a row is all-masked
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc, m_scr, l_scr, *, scale,
+                causal, block_q, block_k, seq_len):
+    """One (batch*head, q-block, k-block) grid cell.
+
+    The k dimension is the innermost grid axis: Pallas streams (1,
+    block_k, d) K/V tiles from HBM through VMEM (auto double-buffered),
+    while the softmax accumulators persist in VMEM scratch across the
+    k iterations — VMEM residency is O(block) regardless of S.
+    """
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+    n_k = pl.num_programs(2)
+
+    @pl.when(kb == 0)
+    def _():
+        acc[:] = jnp.zeros_like(acc)
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    def fold():
+        q = q_ref[0].astype(jnp.float32) * scale  # [bq, d]
+        kblk = k_ref[0].astype(jnp.float32)  # [bk, d]
+        vblk = v_ref[0].astype(jnp.float32)
+        s = jnp.dot(q, kblk.T, preferred_element_type=jnp.float32)
+        col = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        mask = col < seq_len  # padded K columns contribute nothing
+        if causal:
+            row = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            mask = jnp.logical_and(mask, col <= row)
+        s = jnp.where(mask, s, NEG_INF)
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        m_scr[:] = m_new
+        l_scr[:] = l_scr[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc[:] = acc[:] * corr + jnp.dot(
+            p, vblk, preferred_element_type=jnp.float32
+        )
+
+    if causal:
+        # whole block strictly above the diagonal -> nothing to fold
+        @pl.when(kb * block_k < (qi + 1) * block_q)
+        def _():
+            fold()
+    else:
+        fold()
+
+    @pl.when(kb == n_k - 1)
+    def _():
+        l_safe = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0] = (acc[:] / l_safe).astype(o_ref.dtype)
+
+
+def _pad_seq(x, block):
+    s = x.shape[1]
+    pad = (-s) % block
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+    return x
+
+
+def _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret):
+    """q3/k3/v3: [bh, S, d] (already head-merged). Returns out [bh, S, d]."""
+    bh, seq_len, d = q3.shape
+    qp = _pad_seq(q3, block_q)
+    kp = _pad_seq(k3, block_k)
+    vp = _pad_seq(v3, block_k)
+    sq_pad, sk_pad = qp.shape[1], kp.shape[1]
+    grid = (bh, sq_pad // block_q, sk_pad // block_k)
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, seq_len=seq_len,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((bh, sq_pad, d), q3.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),   # output accumulator
+            pltpu.VMEM((block_q, 1), jnp.float32),   # running max
+            pltpu.VMEM((block_q, 1), jnp.float32),   # running denominator
+        ],
+        interpret=interpret,
+    )(qp, kp, vp)
+    return out[:, :seq_len]
+
+
+def _block_masks(seq_len, n_q, n_k, block_q, block_k, causal):
+    """[n_q*bq, n_k*bk] validity mask factory, evaluated lazily per pair."""
+
+    def mask(qb, kb):
+        row = qb * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        col = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        m = jnp.logical_and(row < seq_len, col < seq_len)
+        if causal:
+            m = jnp.logical_and(m, col <= row)
+        return m
+
+    return mask
+
+
+def _lse_blockwise(qb, kb_, mask_of, scale, n_k, block_q, block_k):
+    """Recompute log-sum-exp per q row via the streaming recurrence.
+    qb: [bh, n_q, bq, d], kb_: [bh, n_k, bk, d] -> lse [bh, n_q, bq]."""
+
+    def for_qblock(qi, qblk):  # qblk: [bh, bq, d]
+        def body(carry, inputs):
+            m, l = carry
+            ki, kblk = inputs
+            s = jnp.einsum("bqd,bkd->bqk", qblk, kblk) * scale
+            s = jnp.where(mask_of(qi, ki)[None], s, NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+            l = l * jnp.exp(m - m_new) + jnp.sum(
+                jnp.exp(s - m_new[..., None]), axis=-1
+            )
+            return (m_new, l), None
+
+        bh, bq = qblk.shape[0], qblk.shape[1]
+        init = (
+            jnp.full((bh, bq), NEG_INF, jnp.float32),
+            jnp.zeros((bh, bq), jnp.float32),
+        )
+        (m, l), _ = jax.lax.scan(
+            body, init, (jnp.arange(n_k), jnp.moveaxis(kb_, 1, 0))
+        )
+        return m + jnp.log(jnp.maximum(l, 1e-30))
+
+    n_q = qb.shape[1]
+    return jax.vmap(for_qblock, in_axes=(0, 1), out_axes=1)(
+        jnp.arange(n_q), qb
+    )
+
+
+def _flash_bwd_impl(q3, k3, v3, out, do, scale, causal, block_q, block_k):
+    """Blockwise flash backward (plain JAX scans; O(S*block) peak).
+
+    lse and the softmax-jacobian diagonal are recomputed blockwise from
+    (q, k) / (p, do) — nothing O(S^2) is ever materialized, and the
+    forward kernel doesn't need side outputs.
+    """
+    bh, seq_len, d = q3.shape
+    f32 = functools.partial(jnp.asarray, dtype=jnp.float32)
+    qp = _pad_seq(f32(q3), block_q)
+    dop = _pad_seq(f32(do), block_q)
+    kp = _pad_seq(f32(k3), block_k)
+    vp = _pad_seq(f32(v3), block_k)
+    sq_pad, sk_pad = qp.shape[1], kp.shape[1]
+    n_q, n_k = sq_pad // block_q, sk_pad // block_k
+    mask_of = _block_masks(seq_len, n_q, n_k, block_q, block_k, causal)
+
+    # D_i = rowsum(dO * O) — the softmax-jacobian diagonal term.
+    op_ = _pad_seq(f32(out), block_q)
+    D = jnp.sum(dop * op_, axis=-1)  # [bh, sq_pad]
+
+    qb = qp.reshape(bh, n_q, block_q, d)
+    dob = dop.reshape(bh, n_q, block_q, d)
+    Db = D.reshape(bh, n_q, block_q)
+    kb_ = kp.reshape(bh, n_k, block_k, d)
+    vb_ = vp.reshape(bh, n_k, block_k, d)
+    lseb = _lse_blockwise(qb, kb_, mask_of, scale, n_k, block_q, block_k)
+
+    def p_ds(qi, ki, qblk, kblk, vblk, lse_blk, do_blk, D_blk):
+        """Recomputed probabilities and dS for one (q-block, k-block)."""
+        s = jnp.einsum("bqd,bkd->bqk", qblk, kblk) * scale
+        s = jnp.where(mask_of(qi, ki)[None], s, NEG_INF)
+        p = jnp.exp(s - lse_blk[..., None])  # [bh, bq, bk]
+        dp = jnp.einsum("bqd,bkd->bqk", do_blk, vblk)
+        ds = p * (dp - D_blk[..., None])
+        return p, ds
+
+    # dq: scan K/V blocks for each Q block (carried over K).
+    def dq_for_qblock(qi, qblk, do_blk, lse_blk, D_blk):
+        def body(carry, inputs):
+            ki, kblk, vblk = inputs
+            _, ds = p_ds(qi, ki, qblk, kblk, vblk, lse_blk, do_blk, D_blk)
+            return carry + jnp.einsum("bqk,bkd->bqd", ds, kblk) * scale, None
+
+        init = jnp.zeros_like(qblk)
+        dq, _ = jax.lax.scan(
+            body, init,
+            (jnp.arange(n_k), jnp.moveaxis(kb_, 1, 0), jnp.moveaxis(vb_, 1, 0)),
+        )
+        return dq
+
+    dq = jax.vmap(
+        dq_for_qblock, in_axes=(0, 1, 1, 1, 1), out_axes=1
+    )(jnp.arange(n_q), qb, dob, lseb, Db)
+    dq = dq.reshape(bh, sq_pad, d)[:, :seq_len]
+
+    # dk/dv: scan Q blocks for each K/V block.
+    def dkv_for_kblock(ki, kblk, vblk):
+        def body(carry, inputs):
+            dk_acc, dv_acc = carry
+            qi, qblk, do_blk, lse_blk, D_blk = inputs
+            p, ds = p_ds(qi, ki, qblk, kblk, vblk, lse_blk, do_blk, D_blk)
+            dv_acc = dv_acc + jnp.einsum("bqk,bqd->bkd", p, do_blk)
+            dk_acc = dk_acc + jnp.einsum("bqk,bqd->bkd", ds, qblk) * scale
+            return (dk_acc, dv_acc), None
+
+        init = (jnp.zeros_like(kblk), jnp.zeros_like(vblk))
+        (dk, dv), _ = jax.lax.scan(
+            body, init,
+            (jnp.arange(n_q), jnp.moveaxis(qb, 1, 0),
+             jnp.moveaxis(dob, 1, 0), jnp.moveaxis(lseb, 1, 0),
+             jnp.moveaxis(Db, 1, 0)),
+        )
+        return dk, dv
+
+    dk, dv = jax.vmap(
+        dkv_for_kblock, in_axes=(0, 1, 1), out_axes=1
+    )(jnp.arange(n_k), kb_, vb_)
+    dk = dk.reshape(bh, sk_pad, d)[:, :seq_len]
+    dv = dv.reshape(bh, sk_pad, d)[:, :seq_len]
+    return dq.astype(q3.dtype), dk.astype(k3.dtype), dv.astype(v3.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash3(q3, k3, v3, scale, causal, block_q, block_k, interpret):
+    return _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k,
+                      interpret)
+
+
+def _flash3_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret):
+    out = _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k,
+                     interpret)
+    return out, (q3, k3, v3, out)
+
+
+def _flash3_bwd(scale, causal, block_q, block_k, interpret, res, do):
+    q3, k3, v3, out = res
+    return _flash_bwd_impl(q3, k3, v3, out, do, scale, causal,
+                           block_q, block_k)
+
+
+_flash3.defvjp(_flash3_fwd, _flash3_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    scale: Optional[float] = None,
+    causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Memory-efficient exact attention.
+
+    Args:
+      q, k, v: ``[batch, seq, heads, head_dim]`` (the layout
+        :mod:`..parallel.ring_attention` uses). Sequence lengths need not
+        be multiples of the block sizes (padded + masked internally).
+      scale: logit scale, default ``head_dim ** -0.5``.
+      causal: apply a causal mask.
+      block_q, block_k: VMEM tile sizes (128-aligned for the MXU).
+      interpret: force Pallas interpret mode; default = auto (interpret
+        everywhere except real TPU).
+
+    Returns:
+      ``[batch, seq, heads, head_dim]`` attention output in ``q.dtype``.
+    """
+    if interpret is None:
+        from . import default_interpret
+
+        interpret = default_interpret()
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    b, s, h, d = q.shape
+    block_q = min(block_q, max(s, 1))
+    block_k = min(block_k, max(s, 1))
+
+    def merge(x):
+        return jnp.moveaxis(x, 2, 1).reshape(b * h, x.shape[1], d)
+
+    out3 = _flash3(
+        merge(q), merge(k), merge(v), float(scale), bool(causal),
+        int(block_q), int(block_k), bool(interpret),
+    )
+    return jnp.moveaxis(out3.reshape(b, h, s, d), 1, 2)

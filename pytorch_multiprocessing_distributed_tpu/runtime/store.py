@@ -28,10 +28,12 @@ def _load() -> ctypes.CDLL:
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_SO):
-            subprocess.run(
-                ["make", "-C", _CSRC], check=True, capture_output=True
-            )
+        # Always invoke make: the Makefile's tcp_store.cpp dependency
+        # rebuilds a stale .so (e.g. after a source update) and is a
+        # no-op when fresh — never dlopen a library missing new symbols.
+        subprocess.run(
+            ["make", "-C", _CSRC], check=True, capture_output=True
+        )
         lib = ctypes.CDLL(_SO)
         lib.pmdt_store_server_start.restype = ctypes.c_void_p
         lib.pmdt_store_server_start.argtypes = [

@@ -44,10 +44,17 @@ class Transform(NamedTuple):
 
     ``updates`` are ADDED to params (they carry the minus sign), matching
     ``jax.tree.map(lambda p, u: p + u, params, updates)``.
+
+    ``apply`` (optional): fused whole-update path
+    ``apply(grads, state, params, lr_step) -> (new_params, new_state)``.
+    When set, the train step uses it instead of ``update`` +
+    ``apply_updates`` — the seam for single-pass Pallas updates
+    (:func:`..ops.pallas.sgd_pallas`).
     """
 
     init: Callable[[Any], OptState]
     update: Callable[..., Any]
+    apply: Any = None
 
 
 def multistep_lr(

@@ -68,10 +68,16 @@ def make_train_step(
         # inside the forward (axis bound by shard_map).
         grads = jax.lax.pmean(grads, axis_name)
 
-        updates, new_opt = optimizer.update(
-            grads, state.opt_state, state.params, lr_step=state.epoch
-        )
-        new_params = apply_updates(state.params, updates)
+        if getattr(optimizer, "apply", None) is not None:
+            # fused whole-update path (e.g. the Pallas single-pass SGD)
+            new_params, new_opt = optimizer.apply(
+                grads, state.opt_state, state.params, lr_step=state.epoch
+            )
+        else:
+            updates, new_opt = optimizer.update(
+                grads, state.opt_state, state.params, lr_step=state.epoch
+            )
+            new_params = apply_updates(state.params, updates)
 
         pred = jnp.argmax(logits, axis=-1)
         correct = jnp.sum((pred == labels).astype(jnp.int32))

@@ -1,0 +1,77 @@
+"""Debug / sanitizer mode (SURVEY.md §5 "Race detection / sanitizers").
+
+The reference has no sanitizers; its stack relies on CUDA-side tooling.
+JAX's functional purity removes data races by construction — what remains
+worth checking is numerics (NaN/Inf escaping a step) and accidental
+donation reuse. This module provides:
+
+- :func:`debug_mode` — context manager flipping ``jax_debug_nans`` /
+  ``jax_debug_infs`` (every primitive re-checked, errors point at the
+  producing op) and optionally ``jax_disable_jit`` for step-through
+  debugging;
+- :func:`assert_finite` — in-graph finiteness check usable INSIDE jitted
+  code via ``checkify``-free ``jax.debug`` printing, or as a hard error
+  outside jit.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+@contextlib.contextmanager
+def debug_mode(*, nans: bool = True, infs: bool = True,
+               disable_jit: bool = False):
+    """Run the enclosed block with JAX's numeric sanitizers enabled.
+
+    Usage::
+
+        with debug_mode():
+            state, metrics = train_step(state, x, y)  # raises at first NaN
+    """
+    prev = {
+        "jax_debug_nans": jax.config.jax_debug_nans,
+        "jax_debug_infs": jax.config.jax_debug_infs,
+        "jax_disable_jit": jax.config.jax_disable_jit,
+    }
+    try:
+        jax.config.update("jax_debug_nans", nans)
+        jax.config.update("jax_debug_infs", infs)
+        jax.config.update("jax_disable_jit", disable_jit)
+        yield
+    finally:
+        for k, v in prev.items():
+            jax.config.update(k, v)
+
+
+def assert_finite(tree: Any, name: str = "value") -> Any:
+    """Check every leaf is finite; returns the tree unchanged.
+
+    Outside jit: raises ``FloatingPointError`` immediately. Inside jit:
+    emits a ``jax.debug.print`` alarm line per offending leaf (printing
+    from compiled code can't raise), so the step keeps its performance
+    when the check is compiled in and still surfaces the first bad leaf.
+    """
+    leaves = jax.tree.leaves(tree)
+    for i, leaf in enumerate(leaves):
+        if not jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating):
+            continue
+        if isinstance(leaf, jax.core.Tracer):  # inside jit/grad tracing
+            bad = jnp.logical_not(jnp.all(jnp.isfinite(leaf)))
+            jax.lax.cond(
+                bad,
+                lambda i=i: jax.debug.print(
+                    "NaN/Inf ALARM in " + name + f" leaf {i}"
+                ),
+                lambda: None,
+            )
+        else:
+            if not bool(jnp.all(jnp.isfinite(leaf))):
+                raise FloatingPointError(
+                    f"non-finite values in {name} leaf #{i}"
+                )
+    return tree

@@ -1,0 +1,126 @@
+"""ImageNet-scale lazy pipeline + debug utilities."""
+
+import numpy as np
+import pytest
+
+from pytorch_multiprocessing_distributed_tpu.data import (
+    IndexedLoader,
+    SyntheticImageNet,
+    normalize_imagenet,
+)
+
+
+class TestSyntheticImageNet:
+    def test_deterministic_per_index(self):
+        ds = SyntheticImageNet(1000, image_size=64, num_classes=10, seed=3)
+        rng = np.random.default_rng(0)
+        a1, l1 = ds.get(np.array([5, 17, 900]), rng, train=False)
+        a2, l2 = ds.get(np.array([900, 5]), rng, train=False)
+        np.testing.assert_array_equal(a1[0], a2[1])  # index 5 reproducible
+        np.testing.assert_array_equal(a1[2], a2[0])  # index 900 too
+        assert l1[0] == l2[1]
+
+    def test_shapes_and_label_balance(self):
+        ds = SyntheticImageNet(10_000, image_size=96, num_classes=100)
+        imgs, labels = ds.get(np.arange(64), np.random.default_rng(0), True)
+        assert imgs.shape == (64, 96, 96, 3) and imgs.dtype == np.uint8
+        assert labels.shape == (64,)
+        all_labels = ds.label_of(np.arange(10_000))
+        counts = np.bincount(all_labels, minlength=100)
+        assert counts.min() > 0  # every class represented
+
+    def test_classes_distinguishable(self):
+        """Same-class images must be closer than cross-class (the
+        'learnable' property benches rely on)."""
+        ds = SyntheticImageNet(1000, image_size=64, num_classes=10)
+        labels = ds.label_of(np.arange(200))
+        c0 = np.where(labels == labels[0])[0][:2]
+        c1 = np.where(labels != labels[0])[0][:1]
+        rng = np.random.default_rng(0)
+        (a, b), _ = ds.get(c0, rng, False)
+        (c,), _ = ds.get(c1, rng, False)
+        same = np.abs(a.astype(int) - b.astype(int)).mean()
+        diff = np.abs(a.astype(int) - c.astype(int)).mean()
+        assert same < diff
+
+
+class TestIndexedLoader:
+    def _loader(self, **kw):
+        ds = SyntheticImageNet(kw.pop("n", 500), image_size=32,
+                               num_classes=10)
+        defaults = dict(batch_size=40, world_size=8, train=False,
+                        shuffle=True)
+        defaults.update(kw)
+        return IndexedLoader(ds, **defaults)
+
+    def test_epoch_coverage_and_shapes(self):
+        loader = self._loader(n=512, with_valid=True)
+        loader.set_epoch(1)
+        total = 0
+        for batch in loader:
+            x, y, valid = batch
+            assert x.shape[1:] == (32, 32, 3) and x.dtype == np.float32
+            assert x.shape[0] == y.shape[0] == valid.shape[0]
+            total += int(valid.sum())
+        assert total == 512  # every real sample exactly once
+
+    def test_padding_marked_invalid(self):
+        loader = self._loader(n=501, with_valid=True)
+        n_valid = sum(int(v.sum()) for _, _, v in loader)
+        assert n_valid == 501
+
+    def test_deterministic_epochs(self):
+        loader = self._loader(n=256)
+        loader.set_epoch(2)
+        y1 = np.concatenate([y for _, y in loader])
+        loader.set_epoch(3)
+        y2 = np.concatenate([y for _, y in loader])
+        loader.set_epoch(2)
+        y3 = np.concatenate([y for _, y in loader])
+        np.testing.assert_array_equal(y1, y3)
+        assert not np.array_equal(y1, y2)
+
+    def test_drop_last(self):
+        loader = self._loader(n=501, drop_last=True, with_valid=True)
+        counts = [len(y) for _, y, _ in loader]
+        assert all(c == 40 for c in counts)
+        assert sum(counts) == len(loader) * 40
+
+    def test_normalization_range(self):
+        x = np.zeros((2, 8, 8, 3), np.uint8)
+        out = normalize_imagenet(x)
+        # pixel 0 maps to -mean/std per channel
+        np.testing.assert_allclose(
+            out[0, 0, 0], (0 - np.array([0.485, 0.456, 0.406]))
+            / np.array([0.229, 0.224, 0.225]), rtol=1e-5,
+        )
+
+
+class TestDebugUtils:
+    def test_debug_mode_catches_nan(self):
+        import jax
+        import jax.numpy as jnp
+
+        from pytorch_multiprocessing_distributed_tpu.utils.debug import (
+            debug_mode,
+        )
+
+        def bad(x):
+            return jnp.log(x - 10.0)
+
+        with debug_mode():
+            with pytest.raises(Exception, match="(?i)nan|invalid"):
+                jax.jit(bad)(jnp.ones(()))
+        # and the flag is restored afterwards
+        assert not jax.config.jax_debug_nans
+
+    def test_assert_finite_eager(self):
+        import jax.numpy as jnp
+
+        from pytorch_multiprocessing_distributed_tpu.utils.debug import (
+            assert_finite,
+        )
+
+        assert_finite({"a": jnp.ones(3)})  # fine
+        with pytest.raises(FloatingPointError):
+            assert_finite({"a": jnp.array([1.0, jnp.nan])})
