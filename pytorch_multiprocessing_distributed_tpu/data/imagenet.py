@@ -170,6 +170,7 @@ def _random_resized_crop(im, size: int, rng: np.random.Generator):
     [3/4, 4/3], 10 tries then center-crop fallback."""
     w, h = im.size
     area = w * h
+    arr = None
     for _ in range(10):
         target_area = area * rng.uniform(0.08, 1.0)
         aspect = np.exp(rng.uniform(np.log(3 / 4), np.log(4 / 3)))
@@ -179,12 +180,15 @@ def _random_resized_crop(im, size: int, rng: np.random.Generator):
             x0 = int(rng.integers(0, w - cw + 1))
             y0 = int(rng.integers(0, h - ch + 1))
             box = (x0, y0, x0 + cw, y0 + ch)
-            out = im.resize((size, size), box=box)
-            arr = np.asarray(out, np.uint8)
-            if rng.random() < 0.5:
-                arr = arr[:, ::-1]
-            return arr
-    return _center_crop(im, size)
+            arr = np.asarray(im.resize((size, size), box=box), np.uint8)
+            break
+    if arr is None:  # extreme-aspect fallback (torchvision center-crops)
+        arr = _center_crop(im, size)
+    # HFlip is an independent transform after the crop in torchvision, so
+    # it applies on the fallback path too.
+    if rng.random() < 0.5:
+        arr = arr[:, ::-1]
+    return arr
 
 
 def _center_crop(im, size: int):

@@ -33,7 +33,7 @@ NEG_INF = -1e30  # large-finite: -inf breaks exp(m - m_new) when a row is all-ma
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc, m_scr, l_scr, *, scale,
-                causal, block_q, block_k, seq_len):
+                causal, block_q, block_k, kv_len):
     """One (batch*head, q-block, k-block) grid cell.
 
     The k dimension is the innermost grid axis: Pallas streams (1,
@@ -59,7 +59,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc, m_scr, l_scr, *, scale,
         col = kb * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1
         )
-        mask = col < seq_len  # padded K columns contribute nothing
+        mask = col < kv_len  # padded K columns contribute nothing
         if causal:
             row = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
@@ -99,8 +99,11 @@ def _pad_seq(x, block):
 
 
 def _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret):
-    """q3/k3/v3: [bh, S, d] (already head-merged). Returns out [bh, S, d]."""
-    bh, seq_len, d = q3.shape
+    """q3: [bh, S_q, d], k3/v3: [bh, S_kv, d] (already head-merged).
+    Returns out [bh, S_q, d]. The K-column validity mask is derived from
+    the KV length, NOT q's (cross-attention with S_q != S_kv is exact)."""
+    bh, q_len, d = q3.shape
+    kv_len = k3.shape[1]
     qp = _pad_seq(q3, block_q)
     kp = _pad_seq(k3, block_k)
     vp = _pad_seq(v3, block_k)
@@ -109,7 +112,7 @@ def _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret):
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, seq_len=seq_len,
+        block_q=block_q, block_k=block_k, kv_len=kv_len,
     )
     out = pl.pallas_call(
         kernel,
@@ -132,10 +135,10 @@ def _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret):
         ],
         interpret=interpret,
     )(qp, kp, vp)
-    return out[:, :seq_len]
+    return out[:, :q_len]
 
 
-def _block_masks(seq_len, n_q, n_k, block_q, block_k, causal):
+def _block_masks(q_len, kv_len, n_q, n_k, block_q, block_k, causal):
     """[n_q*bq, n_k*bk] validity mask factory, evaluated lazily per pair."""
 
     def mask(qb, kb):
@@ -145,7 +148,7 @@ def _block_masks(seq_len, n_q, n_k, block_q, block_k, causal):
         col = kb * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1
         )
-        m = jnp.logical_and(row < seq_len, col < seq_len)
+        m = jnp.logical_and(row < q_len, col < kv_len)
         if causal:
             m = jnp.logical_and(m, col <= row)
         return m
@@ -192,7 +195,8 @@ def _flash_bwd_impl(q3, k3, v3, out, do, scale, causal, block_q, block_k):
     (q, k) / (p, do) — nothing O(S^2) is ever materialized, and the
     forward kernel doesn't need side outputs.
     """
-    bh, seq_len, d = q3.shape
+    bh, q_len, d = q3.shape
+    kv_len = k3.shape[1]
     f32 = functools.partial(jnp.asarray, dtype=jnp.float32)
     qp = _pad_seq(f32(q3), block_q)
     dop = _pad_seq(f32(do), block_q)
@@ -200,7 +204,7 @@ def _flash_bwd_impl(q3, k3, v3, out, do, scale, causal, block_q, block_k):
     vp = _pad_seq(f32(v3), block_k)
     sq_pad, sk_pad = qp.shape[1], kp.shape[1]
     n_q, n_k = sq_pad // block_q, sk_pad // block_k
-    mask_of = _block_masks(seq_len, n_q, n_k, block_q, block_k, causal)
+    mask_of = _block_masks(q_len, kv_len, n_q, n_k, block_q, block_k, causal)
 
     # D_i = rowsum(dO * O) — the softmax-jacobian diagonal term.
     op_ = _pad_seq(f32(out), block_q)
@@ -239,7 +243,7 @@ def _flash_bwd_impl(q3, k3, v3, out, do, scale, causal, block_q, block_k):
     dq = jax.vmap(
         dq_for_qblock, in_axes=(0, 1, 1, 1, 1), out_axes=1
     )(jnp.arange(n_q), qb, dob, lseb, Db)
-    dq = dq.reshape(bh, sq_pad, d)[:, :seq_len]
+    dq = dq.reshape(bh, sq_pad, d)[:, :q_len]
 
     # dk/dv: scan Q blocks for each K/V block.
     def dkv_for_kblock(ki, kblk, vblk):
@@ -263,8 +267,8 @@ def _flash_bwd_impl(q3, k3, v3, out, do, scale, causal, block_q, block_k):
     dk, dv = jax.vmap(
         dkv_for_kblock, in_axes=(0, 1, 1), out_axes=1
     )(jnp.arange(n_k), kb_, vb_)
-    dk = dk.reshape(bh, sk_pad, d)[:, :seq_len]
-    dv = dv.reshape(bh, sk_pad, d)[:, :seq_len]
+    dk = dk.reshape(bh, sk_pad, d)[:, :kv_len]
+    dv = dv.reshape(bh, sk_pad, d)[:, :kv_len]
     return dq.astype(q3.dtype), dk.astype(k3.dtype), dv.astype(v3.dtype)
 
 
@@ -303,17 +307,19 @@ def flash_attention(
     """Memory-efficient exact attention.
 
     Args:
-      q, k, v: ``[batch, seq, heads, head_dim]`` (the layout
-        :mod:`..parallel.ring_attention` uses). Sequence lengths need not
-        be multiples of the block sizes (padded + masked internally).
+      q: ``[batch, seq_q, heads, head_dim]`` (the layout
+        :mod:`..parallel.ring_attention` uses).
+      k, v: ``[batch, seq_kv, heads, head_dim]`` — ``seq_kv`` may differ
+        from ``seq_q`` (cross attention); lengths need not be multiples
+        of the block sizes (padded + masked internally).
       scale: logit scale, default ``head_dim ** -0.5``.
-      causal: apply a causal mask.
+      causal: apply a causal mask (requires ``seq_q == seq_kv``).
       block_q, block_k: VMEM tile sizes (128-aligned for the MXU).
       interpret: force Pallas interpret mode; default = auto (interpret
         everywhere except real TPU).
 
     Returns:
-      ``[batch, seq, heads, head_dim]`` attention output in ``q.dtype``.
+      ``[batch, seq_q, heads, head_dim]`` attention output in ``q.dtype``.
     """
     if interpret is None:
         from . import default_interpret
@@ -322,8 +328,20 @@ def flash_attention(
     if scale is None:
         scale = q.shape[-1] ** -0.5
     b, s, h, d = q.shape
-    block_q = min(block_q, max(s, 1))
-    block_k = min(block_k, max(s, 1))
+    s_kv = k.shape[1]
+    if v.shape[1] != s_kv:
+        raise ValueError(
+            f"k and v sequence lengths differ: {s_kv} vs {v.shape[1]}"
+        )
+    if causal and s != s_kv:
+        raise ValueError(
+            f"causal flash attention needs seq_q == seq_kv, got {s} vs {s_kv}"
+        )
+    # Clamp blocks to the sequence, then 8-align the result so Mosaic
+    # lowering gets legal TPU tile shapes (for small/odd lengths AND for
+    # explicitly passed odd block sizes) — _pad_seq absorbs the rounding.
+    block_q = _round8(min(block_q, s))
+    block_k = _round8(min(block_k, s_kv))
 
     def merge(x):
         return jnp.moveaxis(x, 2, 1).reshape(b * h, x.shape[1], d)
@@ -333,3 +351,7 @@ def flash_attention(
         int(block_q), int(block_k), bool(interpret),
     )
     return jnp.moveaxis(out3.reshape(b, h, s, d), 1, 2)
+
+
+def _round8(n: int) -> int:
+    return max(8, ((n + 7) // 8) * 8)

@@ -31,9 +31,16 @@ def _load() -> ctypes.CDLL:
         # Always invoke make: the Makefile's tcp_store.cpp dependency
         # rebuilds a stale .so (e.g. after a source update) and is a
         # no-op when fresh — never dlopen a library missing new symbols.
-        subprocess.run(
-            ["make", "-C", _CSRC], check=True, capture_output=True
-        )
+        # N distributed workers may start concurrently; an fcntl lock
+        # serializes the rebuild so nobody dlopens a half-written .so.
+        import fcntl
+
+        os.makedirs(os.path.join(_CSRC, "build"), exist_ok=True)
+        with open(os.path.join(_CSRC, "build", ".make.lock"), "w") as lockf:
+            fcntl.flock(lockf, fcntl.LOCK_EX)
+            subprocess.run(
+                ["make", "-C", _CSRC], check=True, capture_output=True
+            )
         lib = ctypes.CDLL(_SO)
         lib.pmdt_store_server_start.restype = ctypes.c_void_p
         lib.pmdt_store_server_start.argtypes = [

@@ -59,6 +59,47 @@ class TestFlashAttention:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-5, rtol=2e-5)
 
+    def test_cross_attention_kv_len(self):
+        """s_q != s_kv: the K-column mask must come from KV's length
+        (ADVICE r1: q-length mask silently dropped real K columns)."""
+        rng = np.random.default_rng(7)
+        q = jnp.asarray(rng.normal(size=(2, 64, 2, 32)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(2, 128, 2, 32)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(2, 128, 2, 32)), jnp.float32)
+        out = flash_attention(q, k, v, block_q=64, block_k=64)
+        ref = reference_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(jnp.sin(flash_attention(q, k, v, block_q=64,
+                                                   block_k=64)))
+
+        def loss_ref(q, k, v):
+            return jnp.sum(jnp.sin(reference_attention(q, k, v)))
+
+        g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(gf), np.asarray(gr), atol=2e-4, rtol=2e-4,
+                err_msg=f"d{name} mismatch",
+            )
+        with pytest.raises(ValueError):
+            flash_attention(q, k, v, causal=True)
+
+    def test_odd_seq_len_blocks_are_8_aligned(self):
+        """Tiny/odd lengths must still give legal (8-aligned) block shapes."""
+        rng = np.random.default_rng(8)
+        q, k, v = (
+            jnp.asarray(rng.normal(size=(1, 13, 1, 32)), jnp.float32)
+            for _ in range(3)
+        )
+        out = flash_attention(q, k, v)
+        ref = reference_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
     @pytest.mark.parametrize("causal", [False, True])
     def test_gradients_match_reference(self, causal):
         rng = np.random.default_rng(2)
