@@ -29,10 +29,18 @@ parser.add_argument('--gpu', default='7', type=str, help='GPU id to use')
 parser.add_argument('--print-freq', '-p', default=10, type=int, metavar='N', help='print frequency (default: 10)')
 parser.add_argument('--world_size', default=2, type=int, help='Gpu use number')
 # --- TPU-native extensions (not in the reference CLI) ---
-parser.add_argument('--data_root', default='./cifar10_data', type=str,
-                    help='CIFAR-10 root (expects cifar-10-batches-py inside)')
+parser.add_argument('--dataset', default='cifar', choices=['cifar', 'imagenet'],
+                    help='dataset family: cifar (reference parity) or imagenet '
+                         '(BASELINE configs #2/#3 — ImageFolder tree or --synthetic)')
+parser.add_argument('--data_root', default='', type=str,
+                    help='dataset root (cifar: cifar-10-batches-py inside; '
+                         'imagenet: train/ + val/ ImageFolder tree)')
 parser.add_argument('--synthetic', action='store_true',
-                    help='use deterministic synthetic CIFAR (no dataset needed)')
+                    help='use a deterministic synthetic dataset (no files needed)')
+parser.add_argument('--num_classes', default=0, type=int,
+                    help='label count (0 = auto: 10 cifar / 1000 imagenet)')
+parser.add_argument('--image_size', default=0, type=int,
+                    help='square input size (0 = auto: 32 cifar / 224 imagenet)')
 parser.add_argument('--dtype', default='float32', choices=['float32', 'bfloat16'],
                     help='compute dtype for conv/matmul (params stay f32)')
 parser.add_argument('--model_parallel', default=1, type=int,
@@ -71,11 +79,35 @@ def main(args):
     mesh = make_mesh(args.world_size, args.model_parallel)
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
 
-    # model (reference main.py:39-40 — only 'res' didn't crash there)
-    model = models.get_model(args.model, dtype=dtype, bn_axis="data")
+    # dataset-derived geometry (the reference hardcodes 32x32/10-way,
+    # data.py:11 + model/resnet.py:86; here the imagenet route widens it)
+    is_imagenet = args.dataset == "imagenet"
+    image_size = args.image_size or (224 if is_imagenet else 32)
+    if not is_imagenet and image_size != 32:
+        raise ValueError(
+            "--dataset cifar is fixed at 32x32 (the reference resizes to "
+            "32, data.py:11); --image_size applies to --dataset imagenet"
+        )
+    if not args.data_root:
+        args.data_root = "./imagenet" if is_imagenet else "./cifar10_data"
+    args.image_size = image_size
 
-    # loaders (reference main.py:36 -> data.py:6-59)
+    # loaders first (reference order: main.py:36 -> data.py:6-59), so the
+    # model head can size itself from what the dataset actually contains
+    # (a FolderImageNet tree derives its own class count).
     train_loader, test_loader = datamod.get_loader(args, mesh)
+    num_classes = (
+        args.num_classes
+        or getattr(getattr(train_loader, "dataset", None), "num_classes", None)
+        or (1000 if is_imagenet else 10)
+    )
+    args.num_classes = num_classes
+
+    # model (reference main.py:39-40 — only 'res' didn't crash there)
+    model = models.get_model(
+        args.model, dtype=dtype, bn_axis="data", num_classes=num_classes,
+        stem="imagenet" if is_imagenet else "cifar",
+    )
 
     # optimizer + schedule — the exact reference config (main.py:51-59)
     optimizer = sgd(
@@ -88,7 +120,7 @@ def main(args):
     state = create_train_state(
         model,
         jax.random.PRNGKey(args.seed),
-        jnp.zeros((2, 32, 32, 3), jnp.float32),
+        jnp.zeros((2, image_size, image_size, 3), jnp.float32),
         optimizer,
     )
     start_epoch = 1

@@ -24,6 +24,13 @@ MODEL_REGISTRY: Dict[str, Callable[..., Any]] = {
     "resnet152": resnet.ResNet152,
 }
 
+# Families with a dataset-dependent stem (cifar 3x3 vs imagenet 7x7+pool).
+# Patch/stage models (ViT, ConvNeXt) adapt to input size structurally and
+# take no `stem` argument.
+STEM_MODELS = {
+    "res", "resnet18", "resnet34", "resnet50", "resnet101", "resnet152",
+}
+
 
 def register(name: str):
     """Decorator: add a model constructor under ``name``."""
@@ -35,12 +42,19 @@ def register(name: str):
     return deco
 
 
-def get_model(name: str, **kwargs):
-    """Instantiate a model by CLI name. Raises KeyError with the known names."""
+def get_model(name: str, *, stem: str = None, **kwargs):
+    """Instantiate a model by CLI name. Raises KeyError with the known names.
+
+    ``stem`` is forwarded only to families that have one (ResNets); for
+    size-agnostic models (ViT/ConvNeXt/...) it is accepted and ignored so
+    the trainer can pass it uniformly per dataset.
+    """
     try:
         ctor = MODEL_REGISTRY[name]
     except KeyError:
         raise KeyError(
             f"Unknown model '{name}'. Available: {sorted(MODEL_REGISTRY)}"
         ) from None
+    if stem is not None and name in STEM_MODELS:
+        kwargs["stem"] = stem
     return ctor(**kwargs)

@@ -124,9 +124,15 @@ class Bottleneck(nn.Module):
 
 
 class ResNet(nn.Module):
-    """CIFAR-stem ResNet (reference ``:74-105``).
+    """ResNet with a selectable stem.
 
-    Input ``[batch, 32, 32, 3]`` NHWC; output ``[batch, num_classes]``.
+    ``stem="cifar"`` (default — reference ``:74-105`` parity): 3x3/1 conv,
+    no maxpool, window-4 average pool; input ``[batch, 32, 32, 3]``.
+
+    ``stem="imagenet"`` (BASELINE.md configs #2/#3 — the torchvision
+    stem the reference family implies at ImageNet scale): 7x7/2 conv +
+    3x3/2 maxpool, GLOBAL average pool; input ``[batch, 224, 224, 3]``
+    (any spatial size works — the pool is global).
     """
 
     block: Callable[..., nn.Module]
@@ -134,12 +140,24 @@ class ResNet(nn.Module):
     num_classes: int = 10
     dtype: Any = jnp.float32
     bn_axis: Optional[str] = None
+    stem: str = "cifar"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = x.astype(self.dtype)
-        x = ConvBN(64, 3, 1, self.dtype, self.bn_axis, name="stem")(x, train)
-        x = nn.relu(x)
+        if self.stem == "imagenet":
+            x = ConvBN(64, 7, 2, self.dtype, self.bn_axis, name="stem")(
+                x, train
+            )
+            x = nn.relu(x)
+            x = nn.max_pool(
+                x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1))
+            )
+        else:
+            x = ConvBN(64, 3, 1, self.dtype, self.bn_axis, name="stem")(
+                x, train
+            )
+            x = nn.relu(x)
         for stage, (planes, n_blocks) in enumerate(
             zip((64, 128, 256, 512), self.num_blocks)
         ):
@@ -152,9 +170,12 @@ class ResNet(nn.Module):
                     self.bn_axis,
                     name=f"layer{stage + 1}_{i}",
                 )(x, train)
-        # Literal parity with `F.avg_pool2d(out, 4)` (reference :102):
-        # window-4 pool, which is global for the 32x32 stem (4x4 features).
-        x = nn.avg_pool(x, (4, 4), strides=(4, 4))
+        if self.stem == "imagenet":
+            x = jnp.mean(x, axis=(1, 2))  # global average pool
+        else:
+            # Literal parity with `F.avg_pool2d(out, 4)` (reference :102):
+            # window-4 pool, global for the 32x32 stem (4x4 features).
+            x = nn.avg_pool(x, (4, 4), strides=(4, 4))
         x = x.reshape((x.shape[0], -1))
         x = nn.Dense(
             self.num_classes,

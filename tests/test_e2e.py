@@ -66,6 +66,41 @@ def test_cli_end_to_end(tmp_path):
 
 
 @pytest.mark.slow
+def test_cli_resnet50_imagenet_synthetic(tmp_path):
+    """The north-star workload seam (BASELINE config #2): ResNet-50 +
+    --dataset imagenet trains end-to-end through the real CLI (small
+    image_size keeps the CPU-mesh run fast; geometry is size-agnostic)."""
+    save = tmp_path / "r50"
+    env = dict(
+        os.environ,
+        PMDT_FORCE_CPU_DEVICES="8",
+        PMDT_SMALL_SYNTH="1",
+    )
+    proc = subprocess.run(
+        [
+            sys.executable, "main.py",
+            "--model", "resnet50",
+            "--dataset", "imagenet",
+            "--synthetic",
+            "--batch_size", "32",
+            "--epochs", "1",
+            "--world_size", "8",
+            "--image_size", "64",
+            "--save_path", str(save),
+        ],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=900,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "Train Dataset : 1024" in proc.stdout
+    assert (save / "train.log").exists()
+    assert (save / "model_1.pth").exists()
+
+
+@pytest.mark.slow
 def test_cli_resume(tmp_path):
     """The resume path the reference lacks: train 1 epoch, resume, train 1."""
     save = tmp_path / "run"

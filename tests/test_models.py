@@ -45,6 +45,35 @@ def test_resnet18_is_nonstandard_depth():
     assert EXPECTED["ResNet18"][0] < 5_000_000
 
 
+def test_resnet50_imagenet_stem():
+    """stem='imagenet' (BASELINE config #2): 7x7/2 conv + maxpool, global
+    avg pool, 1000-way head — the torchvision ResNet-50 architecture
+    (25,557,032 weights; BN running stats live in batch_stats here)."""
+    model = models.ResNet50(stem="imagenet", num_classes=1000)
+    x = jnp.zeros((2, 64, 64, 3), jnp.float32)  # any size: pool is global
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    assert count(variables["params"]) == 25_557_032
+    y = model.apply(variables, x, train=False)
+    assert y.shape == (2, 1000)
+
+
+def test_imagenet_stem_spatial_geometry():
+    """224 input -> 112 after stem conv -> 56 after maxpool -> 7x7 final."""
+    model = models.ResNet18(stem="imagenet")
+    x = jnp.zeros((1, 224, 224, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    y = model.apply(variables, x, train=False)
+    assert y.shape == (1, 10)
+
+
+def test_registry_stem_routing():
+    """get_model forwards stem to ResNets, ignores it for patch models."""
+    m = models.get_model("resnet50", stem="imagenet", num_classes=1000)
+    assert m.stem == "imagenet"
+    v = models.get_model("vit_tiny", stem="imagenet", num_classes=1000)
+    assert v.num_classes == 1000  # constructed fine, no stem field
+
+
 def test_train_mode_updates_batch_stats():
     model = models.ResNet18()
     x = jax.random.normal(jax.random.PRNGKey(1), (4, 32, 32, 3))
