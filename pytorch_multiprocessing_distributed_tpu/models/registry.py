@@ -24,14 +24,6 @@ MODEL_REGISTRY: Dict[str, Callable[..., Any]] = {
     "resnet152": resnet.ResNet152,
 }
 
-# Families with a dataset-dependent stem (cifar 3x3 vs imagenet 7x7+pool).
-# Patch/stage models (ViT, ConvNeXt) adapt to input size structurally and
-# take no `stem` argument.
-STEM_MODELS = {
-    "res", "resnet18", "resnet34", "resnet50", "resnet101", "resnet152",
-}
-
-
 def register(name: str):
     """Decorator: add a model constructor under ``name``."""
 
@@ -45,9 +37,12 @@ def register(name: str):
 def get_model(name: str, *, stem: str = None, **kwargs):
     """Instantiate a model by CLI name. Raises KeyError with the known names.
 
-    ``stem`` is forwarded only to families that have one (ResNets); for
-    size-agnostic models (ViT/ConvNeXt/...) it is accepted and ignored so
-    the trainer can pass it uniformly per dataset.
+    ``stem`` is forwarded to any constructor that accepts it (models with
+    a dataset-dependent stem, e.g. the ResNet family); size-agnostic
+    models (ViT/ConvNeXt/...) silently ignore it so the trainer can pass
+    it uniformly per dataset. Detection is by construction, not a
+    hand-maintained name list, so ``register()``-ed additions route
+    correctly.
     """
     try:
         ctor = MODEL_REGISTRY[name]
@@ -55,6 +50,10 @@ def get_model(name: str, *, stem: str = None, **kwargs):
         raise KeyError(
             f"Unknown model '{name}'. Available: {sorted(MODEL_REGISTRY)}"
         ) from None
-    if stem is not None and name in STEM_MODELS:
-        kwargs["stem"] = stem
+    if stem is not None:
+        try:
+            return ctor(stem=stem, **kwargs)
+        except TypeError as e:
+            if "stem" not in str(e):
+                raise  # a real signature error, not a missing stem field
     return ctor(**kwargs)

@@ -96,6 +96,83 @@ class TestIndexedLoader:
         )
 
 
+def _make_jpeg_tree(root, n_classes=3, per_class=4, size=48):
+    """Tiny ImageFolder tree of real JPEGs for decode tests."""
+    from PIL import Image
+
+    rng = np.random.default_rng(0)
+    for c in range(n_classes):
+        d = root / "train" / f"n{c:08d}"
+        d.mkdir(parents=True)
+        for i in range(per_class):
+            arr = rng.integers(0, 255, (size + 7 * c, size + 3 * i, 3),
+                               dtype=np.uint8)
+            Image.fromarray(arr).save(d / f"img_{i}.jpeg", quality=90)
+
+
+class TestFolderImageNet:
+    def test_parallel_decode_matches_serial(self, tmp_path):
+        """Thread-pool decode must be bit-identical to serial decode (the
+        per-image child-seed scheme makes aug order-independent)."""
+        from pytorch_multiprocessing_distributed_tpu.data.imagenet import (
+            FolderImageNet)
+
+        _make_jpeg_tree(tmp_path)
+        serial = FolderImageNet(tmp_path, "train", image_size=32,
+                                num_workers=0)
+        parallel = FolderImageNet(tmp_path, "train", image_size=32,
+                                  num_workers=4)
+        idx = np.arange(len(serial))
+        for train in (True, False):
+            a, la = serial.get(idx, np.random.default_rng(5), train)
+            b, lb = parallel.get(idx, np.random.default_rng(5), train)
+            np.testing.assert_array_equal(a, b)
+            np.testing.assert_array_equal(la, lb)
+
+    def test_folder_layout_and_labels(self, tmp_path):
+        from pytorch_multiprocessing_distributed_tpu.data.imagenet import (
+            FolderImageNet)
+
+        _make_jpeg_tree(tmp_path, n_classes=2, per_class=3)
+        ds = FolderImageNet(tmp_path, "train", image_size=32)
+        assert len(ds) == 6 and ds.num_classes == 2
+        imgs, labels = ds.get([0, 3, 5], np.random.default_rng(0), False)
+        assert imgs.shape == (3, 32, 32, 3)
+        assert list(labels) == [0, 1, 1]
+
+
+class TestPrefetchIteration:
+    def test_prefetched_equals_inline(self):
+        """The background-assembly queue must yield the same batches in
+        the same order as inline production."""
+        from pytorch_multiprocessing_distributed_tpu.data.imagenet import (
+            IndexedLoader, SyntheticImageNet)
+
+        ds = SyntheticImageNet(64, image_size=16, num_classes=5)
+        mk = lambda pf: IndexedLoader(
+            ds, batch_size=8, world_size=2, train=True, seed=3,
+            prefetch_batches=pf,
+        )
+        a, b = mk(0), mk(2)
+        a.set_epoch(2), b.set_epoch(2)
+        batches_a, batches_b = list(a), list(b)
+        assert len(batches_a) == len(batches_b) == len(a)
+        for (xa, ya), (xb, yb) in zip(batches_a, batches_b):
+            np.testing.assert_array_equal(xa, xb)
+            np.testing.assert_array_equal(ya, yb)
+
+    def test_early_consumer_exit_does_not_hang(self):
+        from pytorch_multiprocessing_distributed_tpu.data.imagenet import (
+            IndexedLoader, SyntheticImageNet)
+
+        ds = SyntheticImageNet(256, image_size=16, num_classes=5)
+        loader = IndexedLoader(ds, batch_size=8, world_size=2,
+                               prefetch_batches=2)
+        it = iter(loader)
+        next(it)
+        it.close()  # must not deadlock the producer thread
+
+
 class TestGetLoaderRouting:
     def test_imagenet_route(self, monkeypatch):
         """get_loader(--dataset imagenet --synthetic) returns lazy
