@@ -6,8 +6,9 @@ measure nothing unless steps are synchronized. This module provides:
 
 - :func:`trace` — context manager around ``jax.profiler`` emitting a
   TensorBoard-loadable trace (XLA op-level timeline, HBM usage);
-- :class:`StepTimer` — ``block_until_ready``-correct step timing with
-  warmup discard, the measurement discipline ``bench.py`` uses;
+- :class:`StepTimer` — step timing whose tick boundary is a REAL
+  device-to-host readback, with warmup discard — the same measurement
+  discipline as ``bench.py``;
 - :func:`annotate` — named trace regions (``jax.profiler.TraceAnnotation``)
   so host-side phases (data, H2D, step) are visible in the timeline.
 """
@@ -19,6 +20,27 @@ import time
 from typing import List, Optional
 
 import jax
+import numpy as np
+
+
+def sync(step_output) -> None:
+    """Force completion of ``step_output``'s computation, for real.
+
+    ``jax.block_until_ready`` alone demonstrably returns EARLY on this
+    environment's experimental ``axon`` PJRT plugin (round 2 shipped an
+    11.6-"MFU" number because of it: a workload with a 5.6 ms/step
+    physical floor "finished" in 0.05 ms/step). A device->host transfer
+    of one leaf (``np.asarray``) does block on device completion, so
+    every timing boundary in the framework goes through here.
+    """
+    jax.block_until_ready(step_output)
+    leaves = jax.tree.leaves(step_output)
+    if leaves:
+        # Transfer the smallest leaf (a scalar metric in every trainer
+        # path): completion of one output of a program implies the whole
+        # program ran, and a scalar keeps the D2H cost ~fixed (~70 ms
+        # tunnel round-trip) instead of shipping parameters to host.
+        np.asarray(min(leaves, key=lambda l: getattr(l, "size", 1)))
 
 
 @contextlib.contextmanager
@@ -58,7 +80,7 @@ class StepTimer:
         self._last = time.perf_counter()
 
     def tick(self, step_output) -> float:
-        jax.block_until_ready(step_output)
+        sync(step_output)
         now = time.perf_counter()
         if self._last is None:
             self._last = now
