@@ -103,9 +103,15 @@ def main(args):
     )
     args.num_classes = num_classes
 
-    # model (reference main.py:39-40 — only 'res' didn't crash there)
+    # model (reference main.py:39-40 — only 'res' didn't crash there).
+    # Pure DP binds the data axis into BN for the explicit pmean stat
+    # sync; the TP path (model_parallel > 1) runs under global-semantics
+    # GSPMD jit where batch stats are global by construction, so BN must
+    # NOT carry an axis name there (train/step.py make_train_step_tp).
     model = models.get_model(
-        args.model, dtype=dtype, bn_axis="data", num_classes=num_classes,
+        args.model, dtype=dtype,
+        bn_axis=None if args.model_parallel > 1 else "data",
+        num_classes=num_classes,
         stem="imagenet" if is_imagenet else "cifar",
     )
 

@@ -8,7 +8,15 @@ that reproduce the reference's meters, stdout format and log rows.
 
 from .optim import sgd, multistep_lr, OptState, Transform
 from .state import TrainState, create_train_state
-from .step import make_train_step, make_eval_step
+from .step import (
+    make_train_step,
+    make_eval_step,
+    make_train_step_tp,
+    make_eval_step_tp,
+    shard_state,
+    state_shardings,
+    tp_param_spec,
+)
 from .checkpoint import save_checkpoint, load_checkpoint
 
 __all__ = [
@@ -20,6 +28,11 @@ __all__ = [
     "create_train_state",
     "make_train_step",
     "make_eval_step",
+    "make_train_step_tp",
+    "make_eval_step_tp",
+    "shard_state",
+    "state_shardings",
+    "tp_param_spec",
     "save_checkpoint",
     "load_checkpoint",
 ]

@@ -36,11 +36,18 @@ import jax.numpy as jnp
 
 from ..data.pipeline import ShardedLoader, prefetch_to_device
 from ..parallel import dist
+from ..parallel.mesh import MODEL_AXIS
 from ..utils import AverageMeter, Logger
 from ..utils.plotting import draw_plot
 from .checkpoint import save_checkpoint
 from .state import TrainState
-from .step import make_eval_step, make_train_step
+from .step import (
+    make_eval_step,
+    make_eval_step_tp,
+    make_train_step,
+    make_train_step_tp,
+    shard_state,
+)
 
 
 class Trainer:
@@ -72,8 +79,17 @@ class Trainer:
         # the log-row numbering) instead of restarting at 1 — the resume
         # path the reference lacks entirely.
         self.start_epoch = start_epoch
-        self.train_step = make_train_step(model, optimizer, mesh)
-        self.eval_step = make_eval_step(model, mesh)
+        if dict(mesh.shape).get(MODEL_AXIS, 1) > 1:
+            # real tensor parallelism: params sharded over the model
+            # axis via the GSPMD step (the model must carry
+            # ``bn_axis=None`` — BN stats are global by construction
+            # there; main.py builds it accordingly)
+            self.state = shard_state(state, mesh)
+            self.train_step = make_train_step_tp(model, optimizer, mesh)
+            self.eval_step = make_eval_step_tp(model, mesh)
+        else:
+            self.train_step = make_train_step(model, optimizer, mesh)
+            self.eval_step = make_eval_step(model, mesh)
         self.train_logger = Logger(os.path.join(save_path, "train.log"))
         self.test_logger = Logger(os.path.join(save_path, "test.log"))
 
