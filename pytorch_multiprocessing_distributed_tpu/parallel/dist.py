@@ -20,12 +20,174 @@ add`` surface.
 from __future__ import annotations
 
 import os
+import socket
+import threading
+import time
 from typing import Optional
 
 import jax
 
 
 _initialized = False
+_store = None         # TCPStore client kept for control-plane use
+_store_server = None  # TCPStoreServer handle when this process hosts it
+
+
+def _run_with_watchdog(fn, timeout: float, what: str, hint: str):
+    """Run ``fn`` in a daemon thread, bounded by ``timeout`` seconds.
+
+    ``jax.distributed.initialize`` (and backend bring-up generally) can
+    HANG rather than raise when a peer never shows up — the reference
+    inherits the same failure mode from NCCL and just sits there. The
+    discipline bench.py uses for backend probing applies here: complete,
+    raise, or fail fast with an ACTIONABLE error (SURVEY.md §5 failure
+    detection: "fail-fast pod init with clear coordinator-timeout
+    errors").
+    """
+    box = {}
+
+    def target():
+        try:
+            box["result"] = fn()
+        except BaseException as e:  # noqa: BLE001 — re-raised on caller
+            box["err"] = e
+
+    t = threading.Thread(target=target, daemon=True, name=f"pmdt-{what}")
+    t.start()
+    t.join(timeout)
+    if "err" in box:
+        raise box["err"]
+    if "result" not in box:
+        raise RuntimeError(
+            f"{what} did not complete within {timeout:.0f}s. {hint}"
+        )
+    return box["result"]
+
+
+def _is_local_host(host: str) -> bool:
+    if host in ("127.0.0.1", "localhost", "0.0.0.0"):
+        return True
+    try:
+        return host in (socket.gethostname(), socket.getfqdn(),
+                        socket.gethostbyname(socket.gethostname()))
+    except OSError:
+        return False
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _store_rendezvous(timeout: float):
+    """Rendezvous rank/world/coordinator through the C++ TCP store.
+
+    The TPU-native analogue of the env-var TCPStore rendezvous behind the
+    reference's ``init_process_group`` (``main.py:190-193``): the rank-0
+    process hosts the store at ``PMDT_MASTER_ADDR`` (csrc/tcp_store.cpp),
+    every process checks in, rank 0 publishes the JAX coordinator
+    address, and everyone returns ``(coordinator, world, rank)`` ready to
+    feed ``jax.distributed.initialize``. Unlike the reference's hardcoded
+    ``127.0.0.1:20080``, the address comes from the environment and every
+    wait is bounded with an error naming what was being waited for.
+
+    Env contract: ``PMDT_MASTER_ADDR=host:port`` (required),
+    ``PMDT_WORLD_SIZE=N`` (required), ``PMDT_RANK`` (optional — without
+    it ranks are assigned first-come via the store's atomic counter, and
+    only a process local to the master host will try to host the store).
+    """
+    from ..runtime.store import TCPStore, TCPStoreServer
+
+    master = os.environ["PMDT_MASTER_ADDR"]
+    try:
+        host, port_s = master.rsplit(":", 1)
+        port = int(port_s)
+    except ValueError:
+        raise RuntimeError(
+            f"PMDT_MASTER_ADDR={master!r} is not host:port"
+        ) from None
+    world_s = os.environ.get("PMDT_WORLD_SIZE")
+    if not world_s:
+        raise RuntimeError(
+            "PMDT_MASTER_ADDR is set but PMDT_WORLD_SIZE is not; "
+            "store-mediated bring-up needs the world size (export "
+            "PMDT_WORLD_SIZE=<number of processes>)"
+        )
+    world = int(world_s)
+    rank_env = os.environ.get("PMDT_RANK")
+    deadline = time.monotonic() + timeout
+
+    # Host the store when this process is (or may be) rank 0. An
+    # EXPLICIT rank 0 hosts unconditionally (like torch TCPStore's
+    # is_master flag): hostname heuristics must not be able to produce a
+    # false negative on a multi-NIC/aliased master — a failed bind just
+    # falls through to connecting. In first-come mode, only a process
+    # that looks local to the master host tries.
+    global _store_server
+    if rank_env == "0" or (rank_env is None and _is_local_host(host)):
+        try:
+            _store_server = TCPStoreServer(port)
+        except OSError:
+            _store_server = None
+
+    store = None
+    last_err = None
+    while time.monotonic() < deadline:
+        try:
+            store = TCPStore(host, port)
+            break
+        except ConnectionError as e:
+            last_err = e
+            time.sleep(0.2)
+    if store is None:
+        raise RuntimeError(
+            f"could not reach the rendezvous store at {master} within "
+            f"{timeout:.0f}s ({last_err}). Is the rank-0 process up, is "
+            "PMDT_MASTER_ADDR identical on every process, and is the "
+            "port reachable (firewall)?"
+        )
+
+    rank = int(rank_env) if rank_env is not None else store.add("rendezvous/next_rank", 1) - 1
+    if rank >= world:
+        store.close()
+        raise RuntimeError(
+            f"rank {rank} >= PMDT_WORLD_SIZE {world}: more processes "
+            "checked in than the declared world size"
+        )
+
+    coord_key = "rendezvous/jax_coordinator"
+    if rank == 0:
+        # Publish an address that resolves to THIS machine — in
+        # first-come mode rank 0 may not be on the master host, and the
+        # free port was probed here, so "master_host:port" would point
+        # at a machine where nothing will listen. The outbound IP toward
+        # the store is reachable by every peer that can reach the store.
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as probe:
+            probe.connect((host, port))  # no traffic; just routes
+            my_ip = probe.getsockname()[0]
+        coordinator = f"{my_ip}:{_free_port()}"
+        store.set(coord_key, coordinator.encode())
+    else:
+        # bounded poll (store.wait blocks unboundedly by design)
+        coordinator = None
+        while time.monotonic() < deadline:
+            v = store.get(coord_key)
+            if v:
+                coordinator = v.decode()
+                break
+            time.sleep(0.1)
+        if coordinator is None:
+            store.close()
+            raise RuntimeError(
+                f"rank {rank}: rank 0 did not publish the JAX coordinator "
+                f"address at the store within {timeout:.0f}s — it likely "
+                "crashed before or during bring-up; check its logs first"
+            )
+
+    global _store
+    _store = store
+    return coordinator, world, rank
 
 
 def init_process(
@@ -34,39 +196,85 @@ def init_process(
     process_id: Optional[int] = None,
     *,
     local_device_ids=None,
+    timeout: Optional[float] = None,
 ) -> None:
     """Join the multi-host pod (or no-op on a single host).
 
     Mirrors ``init_process`` (reference ``main.py:190-193``) at the host
-    level. With no arguments, auto-detects: if JAX's standard cluster env
-    vars are present (``JAX_COORDINATOR_ADDRESS`` etc.) or explicit args
-    are given, calls ``jax.distributed.initialize``; otherwise single-host
-    mode. Safe to call twice (idempotent), unlike the reference which
-    would deadlock re-joining NCCL.
+    level. Resolution order:
+
+    1. explicit args or JAX's standard cluster env vars
+       (``JAX_COORDINATOR_ADDRESS`` etc.) -> ``jax.distributed.initialize``;
+    2. ``PMDT_MASTER_ADDR`` (+ ``PMDT_WORLD_SIZE``) -> rendezvous through
+       the C++ TCP store first (:func:`_store_rendezvous`), then
+       ``jax.distributed.initialize`` with the agreed coordinates;
+    3. neither -> single-host mode, no-op.
+
+    Every distributed path runs under a bounded watchdog
+    (``PMDT_INIT_TIMEOUT`` seconds, default 180) that fails fast with an
+    actionable message instead of hanging forever on a missing peer.
+    Safe to call twice (idempotent), unlike the reference which would
+    deadlock re-joining NCCL.
     """
     global _initialized
     if _initialized:
         return
+    if timeout is None:
+        timeout = float(os.environ.get("PMDT_INIT_TIMEOUT", 180))
+
     want_distributed = (
         coordinator_address is not None
         or os.environ.get("JAX_COORDINATOR_ADDRESS")
         or os.environ.get("COORDINATOR_ADDRESS")
     )
+    use_store = (
+        not want_distributed
+        and os.environ.get("PMDT_MASTER_ADDR")
+        and not os.environ.get("JAX_COORDINATOR_ADDRESS")
+    )
+    if use_store:
+        t0 = time.monotonic()
+        coordinator_address, num_processes, process_id = _store_rendezvous(
+            timeout
+        )
+        timeout = max(10.0, timeout - (time.monotonic() - t0))
+        want_distributed = True
+
     if want_distributed:
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id,
-            local_device_ids=local_device_ids,
+        where = coordinator_address or os.environ.get(
+            "JAX_COORDINATOR_ADDRESS", "<env-provided>"
+        )
+        _run_with_watchdog(
+            lambda: jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+                local_device_ids=local_device_ids,
+            ),
+            timeout,
+            what=f"jax.distributed.initialize (coordinator {where})",
+            hint=(
+                "Not all processes reached the coordinator. Check that "
+                "every process was started with the same world size and "
+                "coordinator address, that none crashed earlier (inspect "
+                "their logs), and that the port is reachable. Set "
+                "PMDT_INIT_TIMEOUT to adjust this deadline."
+            ),
         )
     _initialized = True
 
 
 def destroy_process_group() -> None:
     """Leave the pod (reference ``main.py:84``). No-op on a single host."""
-    global _initialized
+    global _initialized, _store, _store_server
     if _initialized and jax.process_count() > 1:
         jax.distributed.shutdown()
+    if _store is not None:
+        _store.close()
+        _store = None
+    if _store_server is not None:
+        _store_server.stop()
+        _store_server = None
     _initialized = False
 
 

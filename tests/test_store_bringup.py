@@ -1,0 +1,129 @@
+"""Store-mediated multi-process bring-up (round-2 VERDICT next #4).
+
+The C++ TCP store (csrc/tcp_store.cpp) is no longer an island: with
+``PMDT_MASTER_ADDR``/``PMDT_WORLD_SIZE`` set, ``dist.init_process``
+rendezvouses rank/world/coordinator through it and feeds
+``jax.distributed.initialize``. These tests spawn REAL separate Python
+processes (the reference's ``mp.spawn`` moment, ``main.py:185-193``) on
+the CPU backend and drive the whole path end to end — plus the fail-fast
+behaviors: missing peer -> bounded, actionable error, not a hang.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = textwrap.dedent(
+    """
+    import os, sys
+    sys.path.insert(0, {repo!r})
+    import jax
+    jax.config.update("jax_platforms", "cpu")  # env vars are too late here
+    from pytorch_multiprocessing_distributed_tpu.parallel import dist
+
+    dist.init_process()
+    assert jax.process_count() == int(os.environ["PMDT_WORLD_SIZE"]), \\
+        f"process_count={{jax.process_count()}}"
+    rank = jax.process_index()
+    assert rank == int(os.environ["PMDT_RANK"])
+    n_global = len(jax.devices())
+    n_local = len(jax.local_devices())
+    assert n_global == n_local * jax.process_count()
+    print(f"BRINGUP_OK rank={{rank}} global_devices={{n_global}}", flush=True)
+    dist.destroy_process_group()
+    """
+).format(repo=REPO)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _spawn(rank: int, world: int, port: int, extra_env=None,
+           script: str = WORKER):
+    env = dict(
+        os.environ,
+        PMDT_MASTER_ADDR=f"127.0.0.1:{port}",
+        PMDT_WORLD_SIZE=str(world),
+        PMDT_RANK=str(rank),
+        JAX_PLATFORMS="cpu",
+    )
+    # the parent test process may carry the virtual-device flag; children
+    # should be plain 1-device CPU hosts
+    env.pop("XLA_FLAGS", None)
+    env.update(extra_env or {})
+    return subprocess.Popen(
+        [sys.executable, "-c", script],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+
+
+@pytest.mark.slow
+def test_two_process_store_bringup():
+    """Two real processes: store hosted by rank 0, coordinator published
+    through it, jax.distributed across both — the reference's
+    mp.spawn+NCCL bring-up, store-mediated and TPU-native."""
+    port = _free_port()
+    procs = [_spawn(r, 2, port) for r in range(2)]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=240)
+        outs.append(out)
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r} failed:\n{out}"
+        assert f"BRINGUP_OK rank={r} global_devices=2" in out, out
+
+
+@pytest.mark.slow
+def test_missing_peer_fails_fast_with_actionable_error():
+    """Rank 1 alone, nobody hosting the store: bounded error naming the
+    store address and what to check — never a silent hang (the
+    reference's failure mode)."""
+    port = _free_port()  # nothing listens here
+    p = _spawn(1, 2, port, extra_env={"PMDT_INIT_TIMEOUT": "4"})
+    out, _ = p.communicate(timeout=120)
+    assert p.returncode != 0
+    assert "could not reach the rendezvous store" in out, out
+    assert f"127.0.0.1:{port}" in out, out
+    assert "rank-0 process" in out, out
+
+
+@pytest.mark.slow
+def test_rank0_crash_before_publish_fails_fast():
+    """Rank 1 reaches the store but rank 0 never publishes the
+    coordinator (simulated by an external server with no rank 0):
+    bounded, actionable error."""
+    server_script = textwrap.dedent(
+        f"""
+        import sys, time
+        sys.path.insert(0, {REPO!r})
+        from pytorch_multiprocessing_distributed_tpu.runtime.store import (
+            TCPStoreServer)
+        s = TCPStoreServer(int(sys.argv[1]))
+        print("SERVER_UP", flush=True)
+        time.sleep(60)
+        """
+    )
+    port = _free_port()
+    server = subprocess.Popen(
+        [sys.executable, "-c", server_script, str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        assert "SERVER_UP" in server.stdout.readline()
+        p = _spawn(1, 2, port, extra_env={"PMDT_INIT_TIMEOUT": "4"})
+        out, _ = p.communicate(timeout=120)
+        assert p.returncode != 0
+        assert "did not publish the JAX coordinator" in out, out
+    finally:
+        server.kill()
+        server.wait()
