@@ -48,6 +48,15 @@ parser.add_argument('--model_parallel', default=1, type=int,
 parser.add_argument('--seed', default=0, type=int, help='init/seed for params and shuffling')
 parser.add_argument('--resume', default='', type=str,
                     help='checkpoint path to resume from (reference has no resume)')
+parser.add_argument('--optimizer', default='sgd',
+                    choices=['sgd', 'lamb', 'sgd_fused'],
+                    help='sgd = reference config (main.py:51-55); lamb = '
+                         'large-batch layerwise-adaptive (BASELINE #5); '
+                         'sgd_fused = same SGD trajectory via the fused '
+                         'single-pass Pallas update kernel')
+parser.add_argument('--profile', default='', type=str, metavar='LOGDIR',
+                    help='capture a jax.profiler trace of the run into '
+                         'LOGDIR (TensorBoard-loadable; off when empty)')
 
 
 def main(args):
@@ -115,13 +124,33 @@ def main(args):
         stem="imagenet" if is_imagenet else "cifar",
     )
 
-    # optimizer + schedule — the exact reference config (main.py:51-59)
-    optimizer = sgd(
-        learning_rate=multistep_lr(0.1, milestones=[60, 80], gamma=0.1),
-        momentum=0.9,
-        weight_decay=0.0001,
-        nesterov=True,
-    )
+    # optimizer + schedule — default is the exact reference config
+    # (main.py:51-59); the alternatives are the model-layer extension
+    # seam BASELINE configs #4/#5 train through
+    if args.optimizer == "lamb":
+        from pytorch_multiprocessing_distributed_tpu.train.lamb import lamb
+
+        optimizer = lamb(
+            learning_rate=multistep_lr(1e-3, milestones=[60, 80], gamma=0.1),
+            weight_decay=0.0001,
+        )
+    elif args.optimizer == "sgd_fused":
+        from pytorch_multiprocessing_distributed_tpu.ops.pallas.fused_update import (
+            sgd_pallas)
+
+        optimizer = sgd_pallas(
+            learning_rate=multistep_lr(0.1, milestones=[60, 80], gamma=0.1),
+            momentum=0.9,
+            weight_decay=0.0001,
+            nesterov=True,
+        )
+    else:
+        optimizer = sgd(
+            learning_rate=multistep_lr(0.1, milestones=[60, 80], gamma=0.1),
+            momentum=0.9,
+            weight_decay=0.0001,
+            nesterov=True,
+        )
 
     state = create_train_state(
         model,
@@ -150,7 +179,13 @@ def main(args):
         print_freq=args.print_freq,
         start_epoch=start_epoch,
     )
-    trainer.fit()
+    if args.profile:
+        from pytorch_multiprocessing_distributed_tpu.utils.profiler import trace
+
+        with trace(args.profile):
+            trainer.fit()
+    else:
+        trainer.fit()
 
     dist.destroy_process_group()
 

@@ -123,3 +123,76 @@ def test_cli_resume(tmp_path):
     )
     assert p2.returncode == 0, p2.stderr[-3000:]
     assert "Resumed from" in p2.stdout
+
+
+@pytest.mark.slow
+def test_cli_vit_lamb_profile(tmp_path):
+    """BASELINE configs #4/#5 seam: a ViT trains under LAMB through the
+    unchanged trainer (the reference's model-swap seam, main.py:39-40),
+    and --profile writes a TensorBoard-loadable trace directory."""
+    save = tmp_path / "vit"
+    prof = tmp_path / "trace"
+    env = dict(os.environ, PMDT_FORCE_CPU_DEVICES="8", PMDT_SMALL_SYNTH="1")
+    proc = subprocess.run(
+        [
+            sys.executable, "main.py",
+            "--model", "vit_tiny",
+            "--optimizer", "lamb",
+            "--batch_size", "64",
+            "--epochs", "1",
+            "--world_size", "8",
+            "--synthetic",
+            "--save_path", str(save),
+            "--print-freq", "100",
+            "--profile", str(prof),
+        ],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=900,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert (save / "train.log").exists()
+    assert (save / "model_1.pth").exists()
+    # profiler trace appeared (plugins/profile/<ts>/*.xplane.pb layout)
+    traces = list(prof.rglob("*.xplane.pb")) + list(prof.rglob("*.trace.json*"))
+    assert traces, f"no trace files under {prof}"
+
+
+@pytest.mark.slow
+def test_cli_sgd_fused_matches_sgd(tmp_path):
+    """--optimizer sgd_fused (single-pass Pallas update) follows the same
+    trajectory as plain sgd: identical train-log rows after 1 epoch on
+    the same synthetic data."""
+    env = dict(os.environ, PMDT_FORCE_CPU_DEVICES="8", PMDT_SMALL_SYNTH="1")
+    logs = {}
+    for opt in ("sgd", "sgd_fused"):
+        save = tmp_path / opt
+        proc = subprocess.run(
+            [
+                sys.executable, "main.py",
+                "--optimizer", opt,
+                "--batch_size", "64",
+                "--epochs", "1",
+                "--world_size", "8",
+                "--synthetic",
+                "--save_path", str(save),
+                "--print-freq", "100",
+            ],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=900,
+        )
+        assert proc.returncode == 0, proc.stderr[-3000:]
+        logs[opt] = (save / "train.log").read_text()
+    sgd_loss = float(logs["sgd"].split()[1])
+    fused_loss = float(logs["sgd_fused"].split()[1])
+    # Per-step parity is pinned tightly by tests/test_pallas_kernels.py
+    # (identical to ~1e-5/step); over a 32-step epoch those f32 rounding
+    # differences amplify chaotically, so the e2e gate is family-level:
+    # the fused run trains (loss well below init ~2.3) and lands near
+    # the reference-SGD epoch average.
+    # init loss ~2.3 (ln 10); an epoch average below 2.0 means it trained
+    assert fused_loss < 2.0, f"fused SGD did not train: {logs}"
+    assert sgd_loss == pytest.approx(fused_loss, rel=0.25), (
+        f"fused SGD diverged from reference SGD: {logs}"
+    )
