@@ -164,7 +164,16 @@ class FolderImageNet(IndexedDataset):
         return self._pool
 
     def get(self, indices, rng, train):
-        from PIL import Image  # lazy: Pillow ships with torchvision stacks
+        from PIL import Image, ImageFile  # lazy: ships with torchvision stacks
+
+        # Real ImageNet shards contain truncated JPEGs (and CMYK,
+        # grayscale, palette images — ``convert("RGB")`` below absorbs
+        # those). DECISION OF RECORD: tolerate truncation the way
+        # torchvision-based pipelines conventionally do (the cut-off
+        # region decodes gray) rather than letting one bad file kill an
+        # epoch hours in; a file that cannot be decoded AT ALL still
+        # fails fast with its path in the error (below).
+        ImageFile.LOAD_TRUNCATED_IMAGES = True
 
         idx = np.asarray(indices)
         s = self.image_size
@@ -176,12 +185,20 @@ class FolderImageNet(IndexedDataset):
 
         def work(row: int) -> None:
             r = np.random.default_rng(seeds[row])
-            with Image.open(self.paths[idx[row]]) as im:
-                im = im.convert("RGB")
-                if train:
-                    out[row] = _random_resized_crop(im, s, r)
-                else:
-                    out[row] = _center_crop(im, s)
+            path = self.paths[idx[row]]
+            try:
+                with Image.open(path) as im:
+                    im = im.convert("RGB")
+                    if train:
+                        out[row] = _random_resized_crop(im, s, r)
+                    else:
+                        out[row] = _center_crop(im, s)
+            except Exception as e:
+                # name the file: "UnidentifiedImageError" alone is
+                # useless against a 1.2M-file tree
+                raise RuntimeError(
+                    f"cannot decode image {path!r}: {type(e).__name__}: {e}"
+                ) from e
 
         pool = self._ensure_pool()
         if pool is None:

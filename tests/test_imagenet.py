@@ -230,3 +230,92 @@ class TestDebugUtils:
         assert_finite({"a": jnp.ones(3)})  # fine
         with pytest.raises(FloatingPointError):
             assert_finite({"a": jnp.array([1.0, jnp.nan])})
+
+
+class TestOddJpegs:
+    """Real ImageNet shards contain grayscale, CMYK and truncated JPEGs
+    (the reference absorbs them implicitly via torchvision,
+    data.py:21-28). Round-2 VERDICT missing #4: pin all three, plus the
+    fail-fast path for an undecodable file."""
+
+    def _ds(self, tmp_path, **kw):
+        from pytorch_multiprocessing_distributed_tpu.data.imagenet import (
+            FolderImageNet)
+
+        return FolderImageNet(tmp_path, "train", image_size=32, **kw)
+
+    def _tree_with(self, tmp_path, save_fn, name="odd.jpeg"):
+        """One normal RGB jpeg + one odd file produced by save_fn."""
+        from PIL import Image
+
+        d = tmp_path / "train" / "n00000000"
+        d.mkdir(parents=True)
+        rng = np.random.default_rng(0)
+        arr = rng.integers(0, 255, (48, 40, 3), dtype=np.uint8)
+        Image.fromarray(arr).save(d / "a_normal.jpeg", quality=90)
+        save_fn(d / name)
+        return d / name
+
+    def test_grayscale_jpeg_decodes(self, tmp_path):
+        from PIL import Image
+
+        def save(p):
+            arr = np.random.default_rng(1).integers(
+                0, 255, (40, 40), dtype=np.uint8)
+            Image.fromarray(arr, mode="L").save(p, quality=90)
+
+        self._tree_with(tmp_path, save)
+        ds = self._ds(tmp_path)
+        imgs, _ = ds.get(np.arange(2), np.random.default_rng(0), False)
+        assert imgs.shape == (2, 32, 32, 3)
+        # grayscale -> RGB replication: channels identical
+        gray = imgs[list(ds.paths).index(
+            next(p for p in ds.paths if "odd" in str(p)))]
+        np.testing.assert_array_equal(gray[..., 0], gray[..., 1])
+        np.testing.assert_array_equal(gray[..., 1], gray[..., 2])
+
+    def test_cmyk_jpeg_decodes(self, tmp_path):
+        from PIL import Image
+
+        def save(p):
+            arr = np.random.default_rng(2).integers(
+                0, 255, (40, 40, 3), dtype=np.uint8)
+            Image.fromarray(arr).convert("CMYK").save(p, quality=90)
+
+        self._tree_with(tmp_path, save)
+        ds = self._ds(tmp_path)
+        imgs, _ = ds.get(np.arange(2), np.random.default_rng(0), False)
+        assert imgs.shape == (2, 32, 32, 3)
+        assert imgs.dtype == np.uint8
+
+    def test_truncated_jpeg_decodes(self, tmp_path):
+        """DECISION OF RECORD (imagenet.py get): truncated files decode
+        (missing region gray) instead of killing the epoch."""
+        from PIL import Image
+
+        def save(p):
+            arr = np.random.default_rng(3).integers(
+                0, 255, (64, 64, 3), dtype=np.uint8)
+            Image.fromarray(arr).save(p, quality=90)
+            data = p.read_bytes()
+            p.write_bytes(data[: len(data) // 2])  # cut the tail off
+
+        self._tree_with(tmp_path, save)
+        ds = self._ds(tmp_path)
+        for workers in (0, 2):
+            ds2 = self._ds(tmp_path, num_workers=workers)
+            imgs, _ = ds2.get(np.arange(2), np.random.default_rng(0), True)
+            assert imgs.shape == (2, 32, 32, 3)
+
+    def test_undecodable_file_fails_fast_with_path(self, tmp_path):
+        def save(p):
+            p.write_bytes(b"this is not a jpeg at all")
+
+        bad = self._tree_with(tmp_path, save)
+        ds = self._ds(tmp_path)
+        with pytest.raises(RuntimeError, match="cannot decode image"):
+            ds.get(np.arange(2), np.random.default_rng(0), False)
+        try:
+            ds.get(np.arange(2), np.random.default_rng(0), False)
+        except RuntimeError as e:
+            assert str(bad) in str(e)  # the path is in the error
