@@ -41,6 +41,7 @@ def test_lamb_matches_optax(wd):
             )
 
 
+@pytest.mark.slow  # full train-step compile on the CPU mesh
 def test_lamb_trains_under_step_builder():
     """LAMB slots into make_train_step unchanged (the optimizer seam)."""
     from pytorch_multiprocessing_distributed_tpu import models

@@ -15,6 +15,7 @@ import pytorch_multiprocessing_distributed_tpu.models.vit  # noqa: F401
 import pytorch_multiprocessing_distributed_tpu.models.convnext  # noqa: F401
 
 
+@pytest.mark.slow  # whole-model compiles on the CPU mesh, ~40-90s each
 @pytest.mark.parametrize(
     "name",
     ["vgg", "vgg11", "dense", "densenet_bc100", "vit_tiny", "convnext_t"],

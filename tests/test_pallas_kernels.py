@@ -223,6 +223,7 @@ class TestIntegration:
             np.asarray(y_flash), np.asarray(y_ref), atol=1e-4, rtol=1e-4
         )
 
+    @pytest.mark.slow  # two full train-step compiles on the CPU mesh
     def test_train_step_uses_fused_apply(self):
         """A full DP train step with the Pallas optimizer matches the
         unfused step's trajectory."""
