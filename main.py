@@ -45,6 +45,10 @@ parser.add_argument('--dtype', default='float32', choices=['float32', 'bfloat16'
                     help='compute dtype for conv/matmul (params stay f32)')
 parser.add_argument('--model_parallel', default=1, type=int,
                     help='model-axis size of the mesh (1 = pure DP, reference mode)')
+parser.add_argument('--zero1', action='store_true',
+                    help='ZeRO-1: shard optimizer moments over the data '
+                         'axis (each replica stores 1/world of them; '
+                         'GSPMD inserts the reduce-scatter/all-gather)')
 parser.add_argument('--seed', default=0, type=int, help='init/seed for params and shuffling')
 parser.add_argument('--resume', default='', type=str,
                     help='checkpoint path to resume from (reference has no resume)')
@@ -117,9 +121,10 @@ def main(args):
     # sync; the TP path (model_parallel > 1) runs under global-semantics
     # GSPMD jit where batch stats are global by construction, so BN must
     # NOT carry an axis name there (train/step.py make_train_step_tp).
+    use_gspmd = args.model_parallel > 1 or args.zero1
     model = models.get_model(
         args.model, dtype=dtype,
-        bn_axis=None if args.model_parallel > 1 else "data",
+        bn_axis=None if use_gspmd else "data",
         num_classes=num_classes,
         stem="imagenet" if is_imagenet else "cifar",
     )
@@ -135,6 +140,14 @@ def main(args):
             weight_decay=0.0001,
         )
     elif args.optimizer == "sgd_fused":
+        if args.zero1 or args.model_parallel > 1:
+            raise ValueError(
+                "--optimizer sgd_fused is the explicit shard_map-DP "
+                "path's fused kernel; under --zero1/--model_parallel "
+                "the GSPMD partitioner cannot shard through the opaque "
+                "Pallas call (it would replicate the moment buffers, "
+                "defeating the sharding). Use --optimizer sgd there."
+            )
         from pytorch_multiprocessing_distributed_tpu.ops.pallas.fused_update import (
             sgd_pallas)
 
@@ -178,6 +191,7 @@ def main(args):
         epochs=args.epochs,
         print_freq=args.print_freq,
         start_epoch=start_epoch,
+        zero1=args.zero1,
     )
     if args.profile:
         from pytorch_multiprocessing_distributed_tpu.utils.profiler import trace

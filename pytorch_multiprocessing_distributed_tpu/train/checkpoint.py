@@ -21,9 +21,33 @@ from typing import Optional
 
 import jax
 from flax import serialization
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..parallel import dist
 from .state import TrainState
+
+
+def _gather_for_host(tree):
+    """Make every leaf fully host-addressable before serialization.
+
+    Under ``--zero1`` (and multi-host TP) state leaves are sharded
+    across hosts, so a bare ``jax.device_get`` would raise
+    "spans non-addressable devices". A jitted identity with replicated
+    ``out_shardings`` all-gathers such a leaf onto every device of its
+    mesh. This is a COLLECTIVE: every host must call it, so it runs
+    BEFORE any primary-host gating. Single-host states pass through
+    untouched (everything is already addressable).
+    """
+
+    def fix(leaf):
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+            mesh = leaf.sharding.mesh
+            return jax.jit(
+                lambda x: x, out_shardings=NamedSharding(mesh, P())
+            )(leaf)
+        return leaf
+
+    return jax.tree.map(fix, tree)
 
 
 def checkpoint_path(save_path: str, epoch: int) -> str:
@@ -35,9 +59,12 @@ def save_checkpoint(save_path: str, state: TrainState, epoch: int) -> Optional[s
     """Write the state on the primary host; returns the path (None on
     non-primary hosts, which mirror the reference's rank-gating at
     ``main.py:75``)."""
+    # Collective leaf replication first — ALL hosts participate even
+    # though only the primary writes (see _gather_for_host).
+    state = _gather_for_host(state)
     if not dist.is_primary():
         return None
-    # Pull fully-addressable host copies off the devices first.
+    # Pull fully-addressable host copies off the devices.
     host_state = jax.device_get(state)
     payload = serialization.to_bytes(host_state)
     path = checkpoint_path(save_path, epoch)

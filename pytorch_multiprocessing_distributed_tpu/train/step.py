@@ -218,27 +218,67 @@ def tp_param_spec(leaf, tp: int) -> P:
     inserts the (all-gather / reduce-scatter) collectives.
     """
     shape = getattr(leaf, "shape", ())
-    if len(shape) >= 1 and shape[-1] % tp == 0 and shape[-1] >= tp:
+    if tp > 1 and len(shape) >= 1 and shape[-1] % tp == 0 and shape[-1] >= tp:
         return P(*([None] * (len(shape) - 1)), MODEL_AXIS)
     return P()
 
 
-def state_shardings(state, mesh: Mesh):
-    """NamedSharding pytree for a :class:`TrainState` under TP.
+def zero1_opt_spec(leaf, dp: int, tp: int) -> P:
+    """Partition rule for ZeRO-1 optimizer-state sharding.
 
-    Optimizer moments mirror parameter shapes, so one trailing-dim rule
-    covers params, batch_stats and opt_state uniformly.
+    Starts from the TP trailing-dim rule (moments must line up with
+    their params on the ``model`` axis), then additionally shards the
+    LARGEST remaining divisible dimension over ``data`` — each DP
+    replica then stores only 1/dp of every moment buffer, and GSPMD
+    turns the weight update into reduce-scatter(grads) -> sharded
+    update -> all-gather(params), the ZeRO-1 schedule (cf. SURVEY §2.3
+    "sharded optimizer: optional optimization").
+    """
+    spec = list(tp_param_spec(leaf, tp))
+    shape = getattr(leaf, "shape", ())
+    spec += [None] * (len(shape) - len(spec))
+    best, best_size = None, 0
+    for i, n in enumerate(shape):
+        if spec[i] is None and n % dp == 0 and n >= dp and n > best_size:
+            best, best_size = i, n
+    if best is not None:
+        spec[best] = DATA_AXIS
+    return P(*spec)
+
+
+def state_shardings(state, mesh: Mesh, *, zero1: bool = False):
+    """NamedSharding pytree for a :class:`TrainState` under TP (and,
+    optionally, ZeRO-1 sharding of the optimizer state over ``data``).
+
+    Optimizer moments mirror parameter shapes, so the trailing-dim TP
+    rule covers params, batch_stats and opt_state uniformly; ``zero1``
+    additionally spreads each moment buffer across the data axis.
     """
     tp = mesh.shape[MODEL_AXIS]
-    return jax.tree.map(
-        lambda l: NamedSharding(mesh, tp_param_spec(l, tp)), state
+    dp = mesh.shape[DATA_AXIS]
+
+    def tp_sh(l):
+        return NamedSharding(mesh, tp_param_spec(l, tp))
+
+    def opt_sh(l):
+        return NamedSharding(
+            mesh, zero1_opt_spec(l, dp, tp) if zero1 else tp_param_spec(l, tp)
+        )
+
+    return state.replace(
+        params=jax.tree.map(tp_sh, state.params),
+        batch_stats=jax.tree.map(tp_sh, state.batch_stats),
+        opt_state=jax.tree.map(opt_sh, state.opt_state),
+        epoch=NamedSharding(mesh, P()),
     )
 
 
-def shard_state(state, mesh: Mesh):
-    """Place a replicated state onto the mesh with TP shardings."""
+def shard_state(state, mesh: Mesh, *, zero1: bool = False):
+    """Place a replicated state onto the mesh with TP/ZeRO shardings."""
     return jax.tree.map(
-        lambda l, s: jax.device_put(l, s), state, state_shardings(state, mesh)
+        lambda l, s: jax.device_put(l, s),
+        state,
+        state_shardings(state, mesh, zero1=zero1),
     )
 
 
@@ -248,6 +288,7 @@ def make_train_step_tp(
     mesh: Mesh,
     *,
     loss_fn: Callable = cross_entropy_loss,
+    zero1: bool = False,
 ):
     """Build the jitted DP x TP train step (GSPMD path).
 
@@ -293,15 +334,20 @@ def make_train_step_tp(
         # on first call (and on structure change, e.g. after resume).
         key = jax.tree.structure(state)
         if key not in compiled:
-            compiled[key] = _build(state_shardings(state, mesh))
+            compiled[key] = _build(
+                state_shardings(state, mesh, zero1=zero1)
+            )
         return compiled[key](state, images, labels)
 
     return step
 
 
-def make_eval_step_tp(model, mesh: Mesh):
+def make_eval_step_tp(model, mesh: Mesh, *, zero1: bool = False):
     """Eval twin of :func:`make_train_step_tp` (global semantics; same
-    masked-validity accounting as :func:`make_eval_step`)."""
+    masked-validity accounting as :func:`make_eval_step`). ``zero1``
+    must match the train step's so in_shardings agree with where the
+    state actually lives (a mismatch would silently reshard per call).
+    """
     _check_tp_model(model)
     body = _eval_body(model, axis_name=None)
 
@@ -310,7 +356,7 @@ def make_eval_step_tp(model, mesh: Mesh):
     def step(state, images, labels, valid):
         key = jax.tree.structure(state)
         if key not in compiled:
-            state_sh = state_shardings(state, mesh)
+            state_sh = state_shardings(state, mesh, zero1=zero1)
             img_sh = NamedSharding(mesh, P(DATA_AXIS, None, None, None))
             vec_sh = NamedSharding(mesh, P(DATA_AXIS))
             repl = NamedSharding(mesh, P())

@@ -67,6 +67,7 @@ class Trainer:
         epochs: int,
         print_freq: int = 10,
         start_epoch: int = 1,
+        zero1: bool = False,
     ):
         self.mesh = mesh
         self.state = state
@@ -79,14 +80,17 @@ class Trainer:
         # the log-row numbering) instead of restarting at 1 — the resume
         # path the reference lacks entirely.
         self.start_epoch = start_epoch
-        if dict(mesh.shape).get(MODEL_AXIS, 1) > 1:
-            # real tensor parallelism: params sharded over the model
-            # axis via the GSPMD step (the model must carry
+        if dict(mesh.shape).get(MODEL_AXIS, 1) > 1 or zero1:
+            # the GSPMD step: real tensor parallelism (params sharded
+            # over the model axis) and/or ZeRO-1 (optimizer moments
+            # sharded over the data axis). The model must carry
             # ``bn_axis=None`` — BN stats are global by construction
-            # there; main.py builds it accordingly)
-            self.state = shard_state(state, mesh)
-            self.train_step = make_train_step_tp(model, optimizer, mesh)
-            self.eval_step = make_eval_step_tp(model, mesh)
+            # there; main.py builds it accordingly.
+            self.state = shard_state(state, mesh, zero1=zero1)
+            self.train_step = make_train_step_tp(
+                model, optimizer, mesh, zero1=zero1
+            )
+            self.eval_step = make_eval_step_tp(model, mesh, zero1=zero1)
         else:
             self.train_step = make_train_step(model, optimizer, mesh)
             self.eval_step = make_eval_step(model, mesh)

@@ -156,3 +156,106 @@ def test_tp_eval_matches_dp_eval():
     assert float(m_tp["loss"]) == pytest.approx(float(m_dp["loss"]), rel=1e-5)
     assert int(m_tp["correct"]) == int(m_dp["correct"])
     assert int(m_tp["count"]) == 16
+
+
+def test_zero1_spec_rule():
+    from pytorch_multiprocessing_distributed_tpu.train.step import (
+        zero1_opt_spec)
+
+    dp, tp = 8, 2
+    conv = jnp.zeros((3, 3, 64, 128))
+    stem = jnp.zeros((7, 7, 3, 64))
+    bias = jnp.zeros((64,))
+    scalar = jnp.zeros(())
+    # TP takes the trailing dim; ZeRO takes the largest remaining one
+    assert zero1_opt_spec(conv, dp, tp) == P(None, None, "data", MODEL_AXIS)
+    assert zero1_opt_spec(stem, dp, tp) == P(None, None, None, MODEL_AXIS)
+    # without TP the trailing dim is free for ZeRO
+    assert zero1_opt_spec(conv, dp, 1) == P(None, None, None, "data")
+    assert zero1_opt_spec(bias, dp, 1) == P("data")
+    assert zero1_opt_spec(scalar, dp, 1) == P()
+
+
+def test_zero1_shards_moments_and_matches_dp():
+    """ZeRO-1 on an 8x1 mesh: optimizer moments live 1/8-per-replica
+    (addressable-shard proof) and the loss trajectory matches plain DP."""
+    from pytorch_multiprocessing_distributed_tpu.parallel.mesh import (
+        DATA_AXIS)
+
+    opt = sgd(learning_rate=0.1, momentum=0.9, weight_decay=1e-4,
+              nesterov=True)
+
+    mesh_dp = make_mesh(8, 1)
+    model_dp = models.ResNet18(bn_axis="data")
+    state_dp = _fresh(model_dp, opt)
+    step_dp = make_train_step(model_dp, opt, mesh_dp)
+
+    model_z = models.ResNet18(bn_axis=None)
+    state_z = shard_state(_fresh(model_z, opt), mesh_dp, zero1=True)
+    step_z = make_train_step_tp(model_z, opt, mesh_dp, zero1=True)
+
+    # a large moment buffer is really spread over the data axis
+    mom = next(
+        l for l in jax.tree.leaves(state_z.opt_state)
+        if getattr(l, "ndim", 0) == 4 and l.shape[-1] % 8 == 0
+    )
+    assert DATA_AXIS in jax.tree.leaves(
+        [mom.sharding.spec]
+    )[0] or DATA_AXIS in tuple(mom.sharding.spec), mom.sharding.spec
+    assert mom.addressable_shards[0].data.size == mom.size // 8
+    # params stay replicated (ZeRO-1, not ZeRO-3)
+    kernel = next(l for l in jax.tree.leaves(state_z.params) if l.ndim == 4)
+    assert kernel.addressable_shards[0].data.size == kernel.size
+
+    for i in range(3):
+        x, y = _batch(seed=100 + i)
+        xb, yb = shard_batch((x, y), mesh_dp)
+        state_dp, m_dp = step_dp(state_dp, xb, yb)
+        xz, yz = shard_batch((x, y), mesh_dp)
+        state_z, m_z = step_z(state_z, xz, yz)
+        assert float(m_z["loss"]) == pytest.approx(
+            float(m_dp["loss"]), rel=1e-4
+        ), f"step {i}: ZeRO-1 loss diverged from DP"
+
+
+def test_zero1_composes_with_tp():
+    """4x2 mesh with BOTH model-axis param sharding and data-axis
+    optimizer sharding compiles and runs one step."""
+    opt = sgd(learning_rate=0.1)
+    mesh = make_mesh(4, 2)
+    model = models.ResNet18(bn_axis=None)
+    state = shard_state(_fresh(model, opt), mesh, zero1=True)
+    step = make_train_step_tp(model, opt, mesh, zero1=True)
+    x, y = _batch(seed=3)
+    state, metrics = step(state, *shard_batch((x, y), mesh))
+    assert int(metrics["count"]) == 16
+    import math
+    assert math.isfinite(float(metrics["loss"]))
+
+
+def test_zero1_checkpoint_roundtrip(tmp_path):
+    """Save/resume works with a ZeRO-sharded state (single-host: leaves
+    are addressable; the multi-host all-gather path is exercised
+    structurally by _gather_for_host passing sharded leaves through)."""
+    from pytorch_multiprocessing_distributed_tpu.train import (
+        load_checkpoint, save_checkpoint)
+
+    opt = sgd(learning_rate=0.1, momentum=0.9)
+    mesh = make_mesh(8, 1)
+    model = models.ResNet18(bn_axis=None)
+    state = shard_state(_fresh(model, opt), mesh, zero1=True)
+    step = make_train_step_tp(model, opt, mesh, zero1=True)
+    x, y = _batch(seed=11)
+    state, _ = step(state, *shard_batch((x, y), mesh))
+
+    path = save_checkpoint(str(tmp_path), state, 1)
+    assert path is not None
+
+    template = shard_state(_fresh(model, opt), mesh, zero1=True)
+    restored = load_checkpoint(path, template)
+    for a, b in zip(jax.tree.leaves(state.params),
+                    jax.tree.leaves(restored.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree.leaves(state.opt_state),
+                    jax.tree.leaves(restored.opt_state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
