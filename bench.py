@@ -199,7 +199,8 @@ def compile_step(step, *args):
 
 
 def run_bench(config: str, dtype_name: str, batch_size: int,
-              min_window: float, warmup: int, devices, note) -> dict:
+              min_window: float, warmup: int, devices, note,
+              remat: bool = False) -> dict:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -237,7 +238,7 @@ def run_bench(config: str, dtype_name: str, batch_size: int,
     state = create_train_state(
         model, jax.random.PRNGKey(0), jnp.zeros((2, s, s, 3)), opt
     )
-    step = make_train_step(model, opt, mesh)
+    step = make_train_step(model, opt, mesh, remat=remat)
 
     rng = np.random.default_rng(0)
     x = jnp.asarray(rng.normal(size=(batch, s, s, 3)), jnp.float32)
@@ -337,7 +338,8 @@ def run_bench(config: str, dtype_name: str, batch_size: int,
             # canonical = the config's own batch/dtype (what the baseline
             # record may be written from; ad-hoc flag runs never claim it)
             "canonical": (batch == cfg["batch"] and dtype_name == "bfloat16"
-                          and is_tpu),
+                          and is_tpu and not remat),
+            "remat": remat,
             "flops_per_step_per_chip": flops,
             "peak_flops_per_chip": peak,
         },
@@ -389,6 +391,9 @@ def main():
     p.add_argument("--warmup", default=5, type=int)
     p.add_argument("--platform", default="auto", choices=["auto", "cpu"],
                    help="cpu = skip the TPU probe and force the host platform")
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialize activations (jax.checkpoint) — "
+                        "trades ~1.3x step time for the activation HBM")
     args = p.parse_args()
 
     result = None
@@ -405,7 +410,8 @@ def main():
         _log(f"devices: {len(devices)} x "
              f"{getattr(devices[0], 'device_kind', devices[0].platform)}")
         result = run_bench(args.config, args.dtype, args.batch_size,
-                           args.min_window, args.warmup, devices, note)
+                           args.min_window, args.warmup, devices, note,
+                           remat=args.remat)
     except BaseException as e:  # noqa: BLE001 — the JSON line must appear
         _log(traceback.format_exc())
         result = {
@@ -448,6 +454,8 @@ def main():
                 base.get(k) is None or base.get(k) == extra.get(k)
                 for k in ("global_batch", "dtype", "device_kind")
             )
+            # legacy records lack the remat key; treat them as non-remat
+            and bool(base.get("remat", False)) == bool(extra.get("remat"))
         )
         if comparable:
             vs = round(result["value"] / base["value"], 4)
@@ -473,6 +481,7 @@ def main():
                 "device_kind": extra["device_kind"],
                 "global_batch": extra["global_batch"],
                 "dtype": extra["dtype"],
+                "remat": bool(extra.get("remat")),
             }
             os.makedirs(os.path.dirname(record_path), exist_ok=True)
             with open(record_path, "w") as f:

@@ -68,6 +68,7 @@ class Trainer:
         print_freq: int = 10,
         start_epoch: int = 1,
         zero1: bool = False,
+        remat: bool = False,
     ):
         self.mesh = mesh
         self.state = state
@@ -88,11 +89,13 @@ class Trainer:
             # there; main.py builds it accordingly.
             self.state = shard_state(state, mesh, zero1=zero1)
             self.train_step = make_train_step_tp(
-                model, optimizer, mesh, zero1=zero1
+                model, optimizer, mesh, zero1=zero1, remat=remat
             )
             self.eval_step = make_eval_step_tp(model, mesh, zero1=zero1)
         else:
-            self.train_step = make_train_step(model, optimizer, mesh)
+            self.train_step = make_train_step(
+                model, optimizer, mesh, remat=remat
+            )
             self.eval_step = make_eval_step(model, mesh)
         self.train_logger = Logger(os.path.join(save_path, "train.log"))
         self.test_logger = Logger(os.path.join(save_path, "test.log"))
@@ -107,7 +110,10 @@ class Trainer:
             self.state = self.state.replace(epoch=jnp.asarray(epoch, jnp.int32))
             self.train_epoch(epoch)
             self.validate(epoch, mode="test")
-            if dist.is_primary() and epoch == self.epochs:
+            if epoch == self.epochs:
+                # EVERY host calls this: the sharded-state gather inside
+                # is a collective; save_checkpoint itself gates the
+                # actual write on the primary (checkpoint.py).
                 save_checkpoint(self.save_path, self.state, epoch)
         if dist.is_primary():
             draw_plot(self.save_path)

@@ -157,3 +157,26 @@ def test_checkpoint_roundtrip(tmp_path, setup):
         latest_checkpoint,
     )
     assert latest_checkpoint(str(tmp_path)) == path
+
+
+def test_remat_matches_plain_trajectory():
+    """jax.checkpoint rematerialization changes memory, not math: 2 steps
+    with remat=True match the plain step bit-for-bit-ish."""
+    rng = np.random.default_rng(9)
+    x = jnp.asarray(rng.normal(size=(16, 32, 32, 3)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 10, (16,)))
+    mesh = make_mesh()
+    from pytorch_multiprocessing_distributed_tpu.train.optim import sgd as _sgd
+
+    losses = {}
+    for remat in (False, True):
+        model = _tiny_model()
+        opt = _sgd(learning_rate=0.1, momentum=0.9)
+        state = create_train_state(model, jax.random.PRNGKey(0), x[:2], opt)
+        step = make_train_step(model, opt, mesh, remat=remat)
+        ls = []
+        for _ in range(2):
+            state, m = step(state, *shard_batch((x, y), mesh))
+            ls.append(float(m["loss"]))
+        losses[remat] = ls
+    np.testing.assert_allclose(losses[False], losses[True], rtol=1e-6)
