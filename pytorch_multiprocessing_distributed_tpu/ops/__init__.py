@@ -8,5 +8,7 @@ torch/cuDNN (see SURVEY.md §2.2): cross-replica batch norm replaces
 
 from .batch_norm import SyncBatchNorm
 from .losses import cross_entropy_loss
+from .moe import MoEMlp, shard_expert_params
 
-__all__ = ["SyncBatchNorm", "cross_entropy_loss"]
+__all__ = ["SyncBatchNorm", "cross_entropy_loss", "MoEMlp",
+           "shard_expert_params"]

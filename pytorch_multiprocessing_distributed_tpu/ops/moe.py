@@ -1,0 +1,138 @@
+"""Mixture-of-Experts MLP with expert parallelism (EP).
+
+The reference has no MoE (SURVEY.md §2.3 marks expert parallelism
+absent); this is part of the framework's scale-out surface, built the
+idiomatic TPU way: the layer is written with GLOBAL semantics
+(Switch-style top-1 routing with a fixed per-expert capacity so every
+shape is static), the expert-indexed weight tensors carry a mesh-axis
+annotation, and GSPMD partitions the dispatch/combine einsums —
+lowering them to the all-to-all exchanges an NCCL MoE implementation
+would hand-write.
+
+Routing (Switch Transformer, top-1):
+  gates  = softmax(x @ Wg)                      [B, S, E]
+  expert = argmax(gates)                        [B, S]
+  slot   = position of each token within its expert's capacity C
+           (C = ceil(S * capacity_factor / E)); tokens past capacity
+           are DROPPED (their output is 0 — the residual carries them)
+  dispatch[b, s, e, c] = 1 iff token (b, s) is slot c of expert e
+  h = expert_mlp_e(dispatch^T x)                [E, B, C, D] (vmapped)
+  y[b, s] = gate[b, s, expert] * h[expert, b, slot]
+
+Under ``shard_expert_params`` + a mesh, each device stores E/ep of the
+expert weights and computes only its experts' FLOPs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+
+class MoEMlp(nn.Module):
+    """Switch-style top-1 MoE feed-forward block.
+
+    Attributes:
+      n_experts: number of expert MLPs (E).
+      d_hidden: expert hidden width.
+      capacity_factor: per-expert capacity = ceil(S * factor / E).
+      expert_axis: optional mesh axis name baked into a
+        ``with_sharding_constraint`` on the expert-indexed activations
+        (use together with :func:`shard_expert_params`); ``None`` runs
+        unconstrained (single device / tests).
+      dtype: compute dtype (params stay f32).
+    """
+
+    n_experts: int
+    d_hidden: int
+    capacity_factor: float = 1.0
+    expert_axis: Optional[str] = None
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        b, s, d = x.shape
+        e = self.n_experts
+        cap = max(1, int(-(-s * self.capacity_factor // e)))
+        dtype = self.dtype or x.dtype
+
+        wg = self.param("gate", nn.initializers.lecun_normal(), (d, e),
+                        jnp.float32)
+        w1 = self.param(
+            "w1", nn.initializers.lecun_normal(), (e, d, self.d_hidden),
+            jnp.float32)
+        b1 = self.param("b1", nn.initializers.zeros, (e, self.d_hidden),
+                        jnp.float32)
+        w2 = self.param(
+            "w2", nn.initializers.lecun_normal(), (e, self.d_hidden, d),
+            jnp.float32)
+        b2 = self.param("b2", nn.initializers.zeros, (e, d), jnp.float32)
+
+        gates = jax.nn.softmax(
+            (x.astype(jnp.float32) @ wg), axis=-1
+        )  # [B, S, E] — routing math in f32 always
+        expert = jnp.argmax(gates, axis=-1)  # [B, S]
+        gate = jnp.max(gates, axis=-1)  # [B, S]
+
+        onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)  # [B, S, E]
+        # slot of each token within its expert (0-based), per batch row
+        pos = jnp.cumsum(onehot, axis=1) * onehot  # [B, S, E], 1-based
+        slot = (jnp.sum(pos, axis=-1) - 1.0).astype(jnp.int32)  # [B, S]
+        kept = (slot < cap)[..., None]  # tokens past capacity drop
+        dispatch = (
+            onehot[..., None]
+            * jax.nn.one_hot(jnp.clip(slot, 0, cap - 1), cap)[:, :, None, :]
+            * kept[..., None]
+        )  # [B, S, E, C]
+
+        xin = x.astype(dtype)
+        expert_in = jnp.einsum(
+            "bsec,bsd->ebcd", dispatch.astype(dtype), xin
+        )  # [E, B, C, D] — GSPMD lowers this to the all-to-all dispatch
+        expert_in = self._constrain(expert_in)
+
+        def one_expert(inp, w1e, b1e, w2e, b2e):
+            h = jax.nn.relu(inp @ w1e.astype(dtype) + b1e.astype(dtype))
+            return h @ w2e.astype(dtype) + b2e.astype(dtype)
+
+        h = jax.vmap(one_expert)(expert_in, w1, b1, w2, b2)  # [E, B, C, D]
+        h = self._constrain(h)
+
+        combine = dispatch * gate[..., None, None]  # [B, S, E, C]
+        y = jnp.einsum(
+            "bsec,ebcd->bsd", combine.astype(dtype), h
+        )  # the all-to-all return + weighted combine
+        return y.astype(x.dtype)
+
+    def _constrain(self, t):
+        if self.expert_axis is None or self.is_initializing():
+            return t
+        mesh = jax.sharding.get_abstract_mesh()
+        if mesh is None or self.expert_axis not in getattr(
+            mesh, "axis_names", ()
+        ):
+            # no mesh context (e.g. plain CPU apply in tests): the
+            # constraint is a layout hint, not semantics — skip it
+            return t
+        return jax.lax.with_sharding_constraint(
+            t, P(self.expert_axis, *([None] * (t.ndim - 1)))
+        )
+
+
+def shard_expert_params(params, mesh, axis: str):
+    """Place a MoEMlp param tree with expert dims sharded over ``axis``."""
+    from jax.sharding import NamedSharding
+
+    def place(path, leaf):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        if name in ("w1", "b1", "w2", "b2"):
+            sh = NamedSharding(mesh, P(axis, *([None] * (leaf.ndim - 1))))
+        else:
+            sh = NamedSharding(mesh, P())
+        return jax.device_put(leaf, sh)
+
+    return jax.tree_util.tree_map_with_path(place, params)

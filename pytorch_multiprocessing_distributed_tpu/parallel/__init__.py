@@ -19,6 +19,8 @@ from .collectives import (
     reduce_tensor,
 )
 from .sampler import DistributedShardSampler
+from .ring_attention import ring_attention
+from .pipeline import pipeline_apply
 from .dist import (
     barrier,
     destroy_process_group,
@@ -41,6 +43,8 @@ __all__ = [
     "ppermute",
     "reduce_tensor",
     "DistributedShardSampler",
+    "ring_attention",
+    "pipeline_apply",
     "init_process",
     "destroy_process_group",
     "get_rank",

@@ -1,0 +1,105 @@
+"""Pipeline parallelism: GPipe-style microbatched execution over a mesh
+axis, expressed as a shard_map collective pipeline.
+
+The reference is single-stage (SURVEY.md §2.3 marks PP absent); this is
+the framework's PP primitive. Stage s of a homogeneous S-stage network
+lives on mesh shard s of the ``pipe`` axis. Microbatches enter stage 0,
+activations hop to the next stage each tick via ``lax.ppermute`` (ICI
+neighbor exchange, overlapped with the current tick's compute by XLA),
+and after ``M + S - 1`` ticks every microbatch has flowed through every
+stage — the classic GPipe schedule with its (S-1)-tick bubble.
+
+Differentiable by construction: the schedule is a ``lax.scan`` over
+ticks and autodiff reverses it (backward microbatches flow the ring the
+other way), so ``jax.grad`` of a loss on the pipeline output yields
+per-stage parameter gradients on the shard that owns the stage — a
+pipelined training step with no hand-written backward schedule.
+
+Use INSIDE ``shard_map`` with the stage-stacked params sharded over the
+pipe axis (leading dim S -> per-shard 1, see tests):
+
+    jax.shard_map(
+        lambda p, x: pipeline_apply(stage_fn, p, x, axis_name="pipe"),
+        mesh=mesh,
+        in_specs=(P("pipe"), P()),
+        out_specs=P(),
+    )
+
+Keep ``check_vma`` at its default (True): the replication checker is
+what makes the AD transpose of the final ``psum`` correct — under
+``check_vma=False`` gradients through the pipeline silently come back
+scaled by the number of stages.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    stage_params,
+    microbatches: jax.Array,
+    *,
+    axis_name: str,
+):
+    """Run the S-stage pipeline on ``M`` microbatches.
+
+    Args:
+      stage_fn: ``stage_fn(params, x) -> y`` with ``y.shape == x.shape``
+        (homogeneous stages — the standard PP regime).
+      stage_params: THIS shard's stage parameters (pytree; leaves carry
+        a leading stage dim of 1 from the ``P(axis_name)`` in_spec,
+        squeezed here).
+      microbatches: ``[M, mb, ...]`` replicated input microbatches.
+      axis_name: the bound pipe mesh axis.
+
+    Returns:
+      ``[M, mb, ...]`` pipeline outputs, replicated across the axis.
+    """
+    n = jax.lax.psum(1, axis_name)  # static python int under shard_map
+    i = jax.lax.axis_index(axis_name)
+    m = microbatches.shape[0]
+    params = jax.tree.map(lambda l: jnp.squeeze(l, axis=0), stage_params)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    # Run under check_vma=True (shard_map's default): correct psum/
+    # ppermute AD transposes REQUIRE the replication checker — with
+    # check_vma=False the transpose of the final psum over-counts
+    # gradients by the axis size. Mark the device-varying values
+    # explicitly so the checker accepts the scan carries.
+    def vary(x):
+        if axis_name in getattr(jax.typeof(x), "vma", frozenset()):
+            return x  # caller already passed a varying value
+        return jax.lax.pcast(x, axis_name, to="varying")
+
+    microbatches = vary(microbatches)
+
+    def tick(carry, t):
+        act, out = carry
+        # stage 0 injects microbatch t (clipped reads feed the bubble
+        # ticks; their results are masked out of `out` below)
+        inj = microbatches[jnp.clip(t, 0, m - 1)]
+        x = jnp.where(i == 0, inj, act)
+        y = stage_fn(params, x)
+        # the last stage banks finished microbatch t - (n - 1)
+        slot = t - (n - 1)
+        valid = jnp.logical_and(
+            i == n - 1, jnp.logical_and(slot >= 0, slot < m)
+        )
+        sc = jnp.clip(slot, 0, m - 1)
+        out = out.at[sc].set(jnp.where(valid, y, out[sc]))
+        # rotate activations one stage forward around the ring
+        act = jax.lax.ppermute(y, axis_name, perm)
+        return (act, out), None
+
+    act0 = jnp.zeros_like(microbatches[0])  # inherits varying-ness
+    out0 = jnp.zeros_like(microbatches)
+    (act, out), _ = jax.lax.scan(
+        tick, (act0, out0), jnp.arange(m + n - 1)
+    )
+    # `out` is populated only on the last shard; replicate it
+    mask = (i == n - 1).astype(out.dtype)
+    return jax.lax.psum(out * mask, axis_name)

@@ -1,0 +1,101 @@
+"""MoE layer: routing math vs a per-token reference, EP sharding proof,
+gradient flow, and parity between sharded and unsharded execution."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_multiprocessing_distributed_tpu.ops.moe import (
+    MoEMlp,
+    shard_expert_params,
+)
+from pytorch_multiprocessing_distributed_tpu.parallel import make_mesh
+from pytorch_multiprocessing_distributed_tpu.parallel.mesh import MODEL_AXIS
+
+B, S, D, E, H = 2, 16, 8, 4, 32
+
+
+def _init(capacity_factor=2.0, expert_axis=None):
+    model = MoEMlp(n_experts=E, d_hidden=H,
+                   capacity_factor=capacity_factor, expert_axis=expert_axis)
+    x = jnp.asarray(
+        np.random.default_rng(0).normal(size=(B, S, D)), jnp.float32
+    )
+    params = model.init(jax.random.PRNGKey(1), x)["params"]
+    return model, params, x
+
+
+def _reference(params, x, capacity_factor):
+    """Per-token numpy recompute of Switch top-1 with capacity drops."""
+    wg = np.asarray(params["gate"])
+    w1, b1 = np.asarray(params["w1"]), np.asarray(params["b1"])
+    w2, b2 = np.asarray(params["w2"]), np.asarray(params["b2"])
+    xs = np.asarray(x)
+    cap = max(1, int(np.ceil(S * capacity_factor / E)))
+    out = np.zeros_like(xs)
+    for b in range(B):
+        logits = xs[b] @ wg
+        gates = np.exp(logits - logits.max(-1, keepdims=True))
+        gates /= gates.sum(-1, keepdims=True)
+        counts = np.zeros(E, int)
+        for s in range(S):
+            e = int(np.argmax(gates[s]))
+            if counts[e] < cap:
+                counts[e] += 1
+                h = np.maximum(xs[b, s] @ w1[e] + b1[e], 0.0)
+                out[b, s] = gates[s, e] * (h @ w2[e] + b2[e])
+            # dropped tokens contribute 0
+    return out
+
+
+@pytest.mark.parametrize("capacity_factor", [2.0, 0.5])
+def test_moe_matches_per_token_reference(capacity_factor):
+    """capacity 2.0 = nothing drops; 0.5 = forced drops exercise the
+    capacity mask."""
+    model, params, x = _init(capacity_factor)
+    y = model.apply({"params": params}, x)
+    ref = _reference(params, x, capacity_factor)
+    np.testing.assert_allclose(np.asarray(y), ref, atol=1e-5)
+
+
+def test_moe_gradients_flow_to_all_param_kinds():
+    model, params, x = _init()
+
+    def loss(p):
+        return jnp.sum(jnp.square(model.apply({"params": p}, x)))
+
+    grads = jax.grad(loss)(params)
+    for name in ("gate", "w1", "w2", "b1", "b2"):
+        g = np.asarray(grads[name])
+        assert np.all(np.isfinite(g)), name
+        assert np.abs(g).max() > 0, f"no gradient reached {name}"
+
+
+def test_expert_parallel_sharding_and_parity():
+    """Experts spread over an 8-way mesh axis: each device stores E/8=...
+    here E=8 experts over 8 devices -> 1 expert each; sharded output
+    equals unsharded."""
+    mesh = make_mesh(1, 8)  # model axis = 8
+    model = MoEMlp(n_experts=8, d_hidden=H, capacity_factor=2.0,
+                   expert_axis=MODEL_AXIS)
+    x = jnp.asarray(
+        np.random.default_rng(3).normal(size=(B, S, D)), jnp.float32
+    )
+    params = model.init(jax.random.PRNGKey(2), x)["params"]
+
+    dense_model = MoEMlp(n_experts=8, d_hidden=H, capacity_factor=2.0)
+    y_ref = dense_model.apply({"params": params}, x)
+
+    sharded = shard_expert_params(params, mesh, MODEL_AXIS)
+    w1 = sharded["w1"]
+    assert w1.sharding.spec[0] == MODEL_AXIS
+    assert w1.addressable_shards[0].data.shape[0] == 1  # 1 expert/device
+
+    with jax.set_mesh(mesh):
+        y = jax.jit(
+            lambda p, x: model.apply({"params": p}, x)
+        )(sharded, x)
+    np.testing.assert_allclose(
+        np.asarray(y), np.asarray(y_ref), atol=1e-5
+    )

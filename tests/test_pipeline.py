@@ -1,0 +1,125 @@
+"""Pipeline parallelism: GPipe schedule over a mesh axis.
+
+Pins (a) pipelined forward == sequential stage application, (b) a
+pipelined TRAINING step — grads through the scan/ppermute schedule —
+matches sequential training step for step, with each shard holding only
+its own stage's params.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from pytorch_multiprocessing_distributed_tpu.parallel.pipeline import (
+    pipeline_apply,
+)
+
+STAGES, M, MB, DIM = 4, 8, 4, 16  # stages, microbatches, microbatch, width
+
+
+def _stage_fn(params, x):
+    """One homogeneous stage: Dense + residual tanh."""
+    return x + jnp.tanh(x @ params["w"] + params["b"])
+
+
+def _init_stacked(rng):
+    k1, k2 = jax.random.split(rng)
+    return {
+        "w": jax.random.normal(k1, (STAGES, DIM, DIM)) * 0.3,
+        "b": jax.random.normal(k2, (STAGES, DIM)) * 0.1,
+    }
+
+
+def _sequential(stacked, xs):
+    """Reference: apply the S stages in order to all microbatches."""
+    y = xs.reshape(M * MB, DIM)
+    for s in range(STAGES):
+        y = _stage_fn(jax.tree.map(lambda l: l[s], stacked), y)
+    return y.reshape(M, MB, DIM)
+
+
+def _mesh():
+    return Mesh(np.asarray(jax.devices()[:STAGES]), ("pipe",))
+
+
+def test_pipeline_forward_matches_sequential():
+    stacked = _init_stacked(jax.random.PRNGKey(0))
+    xs = jnp.asarray(
+        np.random.default_rng(1).normal(size=(M, MB, DIM)), jnp.float32
+    )
+    piped = jax.jit(
+        jax.shard_map(
+            lambda p, x: pipeline_apply(_stage_fn, p, x, axis_name="pipe"),
+            mesh=_mesh(),
+            in_specs=(P("pipe"), P()),
+            out_specs=P(),
+        )
+    )
+    np.testing.assert_allclose(
+        np.asarray(piped(stacked, xs)),
+        np.asarray(_sequential(stacked, xs)),
+        atol=1e-5,
+    )
+
+
+def test_pipelined_training_matches_sequential():
+    """Autodiff straight through the pipeline schedule: grads land on
+    the shard that owns each stage; the loss trajectory matches
+    sequential training."""
+    mesh = _mesh()
+    lr = 0.1
+
+    targets = jnp.asarray(
+        np.random.default_rng(2).normal(size=(M, MB, DIM)), jnp.float32
+    )
+
+    def piped_loss(stacked, xs):
+        y = pipeline_apply(_stage_fn, stacked, xs, axis_name="pipe")
+        return jnp.mean(jnp.square(y - targets))
+
+    def piped_step(stacked, xs):
+        loss, grads = jax.value_and_grad(piped_loss)(stacked, xs)
+        # per-stage grads already live on the owning shard (leading dim
+        # 1 per shard under P("pipe")); the update is shard-local
+        new = jax.tree.map(lambda p, g: p - lr * g, stacked, grads)
+        return new, loss
+
+    piped = jax.jit(
+        jax.shard_map(
+            piped_step,
+            mesh=mesh,
+            in_specs=(P("pipe"), P()),
+            out_specs=(P("pipe"), P()),
+        )
+    )
+
+    def seq_step(stacked, xs):
+        def loss_fn(p):
+            return jnp.mean(jnp.square(_sequential(p, xs) - targets))
+
+        loss, grads = jax.value_and_grad(loss_fn)(stacked)
+        return jax.tree.map(lambda p, g: p - lr * g, stacked, grads), loss
+
+    seq_step = jax.jit(seq_step)
+
+    stacked_p = _init_stacked(jax.random.PRNGKey(0))
+    stacked_s = jax.tree.map(jnp.array, stacked_p)
+    xs = jnp.asarray(
+        np.random.default_rng(3).normal(size=(M, MB, DIM)), jnp.float32
+    )
+
+    losses_p, losses_s = [], []
+    for _ in range(5):
+        stacked_p, lp = piped(stacked_p, xs)
+        stacked_s, ls = seq_step(stacked_s, xs)
+        losses_p.append(float(lp))
+        losses_s.append(float(ls))
+
+    np.testing.assert_allclose(losses_p, losses_s, rtol=1e-5)
+    assert losses_p[-1] < losses_p[0]  # it trains
+    for key in stacked_p:
+        np.testing.assert_allclose(
+            np.asarray(stacked_p[key]), np.asarray(stacked_s[key]),
+            rtol=1e-4, atol=1e-6, err_msg=key,
+        )
