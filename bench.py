@@ -26,9 +26,17 @@ readback). The timed protocol is therefore:
 3. the window is grown until it spans >= ``--min_window`` seconds
    (default 1.0 s) of real wall time — never a 9 ms blip;
 4. a linearity self-check times N steps and 2N steps; if t(2N)/t(N) is
-   not ~2 (within [1.6, 2.6], tolerance for the ~70 ms fixed per-window
+   not ~2 (within [1.6, 2.6], tolerance for the fixed per-window
    readback latency over the tunnel), the run FAILS with an ``error``
    field instead of emitting a number;
+4b. the reported step time is the two-window SLOPE
+   ``(t(2N) - t(N)) / N``: each window is ``fixed_readback + n * step``,
+   so the difference cancels the fixed device->host readback latency
+   (measured ~100-200 ms per window over this environment's tunnel)
+   exactly, leaving the steady-state step time the chip actually
+   sustains. The conservative whole-window quotient ``t(2N) / 2N``
+   (which charges the tunnel round-trip to the workload) is kept in
+   ``extra.step_ms_conservative``; both are linearity- and MFU-gated;
 5. hard physical sanity gates: computed MFU must be <= 1.0 and the loss
    finite, else ``error`` — this harness can no longer print a number
    that exceeds the hardware's peak.
@@ -307,7 +315,22 @@ def run_bench(config: str, dtype_name: str, batch_size: int,
     ratio = t2 / t1
     _log(f"window n={2 * n1}: {t2 * 1000:.1f} ms (linearity ratio {ratio:.3f})")
 
-    step_s = t2 / (2 * n1)  # conservative: includes readback overhead
+    # Two-window slope: t(n) = fixed_readback + n*step, so the difference
+    # cancels the fixed D2H/tunnel latency exactly. Guarded below: the
+    # linearity gate already bounds ratio in [1.6, 2.6], which bounds the
+    # slope within a sane band of the conservative quotient; the MFU gate
+    # applies to the slope (the number actually reported).
+    step_s_conservative = t2 / (2 * n1)
+    step_s = (t2 - t1) / n1
+    if step_s <= 0:
+        # second window faster than the first in total: the linear model
+        # collapsed (and the linearity gate below will reject the run);
+        # fall back to the conservative whole-window quotient
+        step_s = step_s_conservative
+    # NOTE: when slope > conservative (steps DEcelerating, e.g. thermal
+    # throttling — fixed_readback would be negative) the slope is the
+    # PESSIMISTIC estimate and is kept; the fallback never swaps in the
+    # smaller number.
     images_per_sec = batch / step_s
     per_chip = images_per_sec / n_dev
     peak = chip_peak_flops(devices[0])
@@ -329,6 +352,7 @@ def run_bench(config: str, dtype_name: str, batch_size: int,
             "device_kind": getattr(devices[0], "device_kind", "unknown"),
             "steps_timed": 2 * n1,
             "step_ms": round(1000 * step_s, 3),
+            "step_ms_conservative": round(1000 * step_s_conservative, 3),
             "window1_s": round(t1, 4),
             "window2_s": round(t2, 4),
             "linearity_ratio": round(ratio, 4),
@@ -456,6 +480,11 @@ def main():
             )
             # legacy records lack the remat key; treat them as non-remat
             and bool(base.get("remat", False)) == bool(extra.get("remat"))
+            # a record written under a different step-time estimator is a
+            # different measurement, not a baseline (the slope estimator
+            # reads 10-30% faster than the whole-window quotient purely
+            # because it cancels the fixed tunnel-readback latency)
+            and base.get("estimator", "whole_window") == "two_window_slope"
         )
         if comparable:
             vs = round(result["value"] / base["value"], 4)
@@ -473,7 +502,12 @@ def main():
             # pin the baseline forever
             and extra.get("canonical")
         )
-        if valid_tpu and result["metric"] not in rec:
+        prior = rec.get(result["metric"])
+        prior_legacy = (
+            isinstance(prior, dict)
+            and prior.get("estimator", "whole_window") != "two_window_slope"
+        )
+        if valid_tpu and (result["metric"] not in rec or prior_legacy):
             rec[result["metric"]] = {
                 "value": result["value"],
                 "unit": result["unit"],
@@ -482,6 +516,7 @@ def main():
                 "global_batch": extra["global_batch"],
                 "dtype": extra["dtype"],
                 "remat": bool(extra.get("remat")),
+                "estimator": "two_window_slope",
             }
             os.makedirs(os.path.dirname(record_path), exist_ok=True)
             with open(record_path, "w") as f:
