@@ -49,6 +49,11 @@ parser.add_argument('--zero1', action='store_true',
                     help='ZeRO-1: shard optimizer moments over the data '
                          'axis (each replica stores 1/world of them; '
                          'GSPMD inserts the reduce-scatter/all-gather)')
+parser.add_argument('--grad_accum', default=1, type=int,
+                    help='accumulate gradients over N sequential '
+                         'microbatches per optimizer step (activation '
+                         'memory of one microbatch, one weight update) — '
+                         'the per-device batch must divide by N')
 parser.add_argument('--remat', action='store_true',
                     help='rematerialize activations in the backward '
                          '(jax.checkpoint): ~1.3x step time for a much '
@@ -198,6 +203,7 @@ def main(args):
         start_epoch=start_epoch,
         zero1=args.zero1,
         remat=args.remat,
+        grad_accum=args.grad_accum,
     )
     if args.profile:
         from pytorch_multiprocessing_distributed_tpu.utils.profiler import trace

@@ -220,10 +220,18 @@ def get_loader(args, mesh: Mesh, *, data=None):
     elif getattr(args, "synthetic", False):
         import os as _os
 
-        # PMDT_SMALL_SYNTH shrinks the synthetic set for smoke tests/CI.
-        n_tr, n_te = (
-            (2048, 512) if _os.environ.get("PMDT_SMALL_SYNTH") else (50000, 10000)
-        )
+        # PMDT_SMALL_SYNTH shrinks the synthetic set for smoke tests/CI:
+        # "1" (or any non-int) = 2048/512; an integer > 1 = that many
+        # training samples (test set = 1/4 of it).
+        small = _os.environ.get("PMDT_SMALL_SYNTH")
+        if small:
+            try:
+                n = int(small)
+            except ValueError:
+                n = 1
+            n_tr, n_te = (n, max(1, n // 4)) if n > 1 else (2048, 512)
+        else:
+            n_tr, n_te = (50000, 10000)
         tr_x, tr_y = synthetic_cifar10(n_tr, seed=0)
         te_x, te_y = synthetic_cifar10(n_te, seed=1)
     else:

@@ -36,7 +36,8 @@ from .state import TrainState
 
 
 def _train_body(model, optimizer: Transform, loss_fn: Callable,
-                axis_name: Optional[str], remat: bool = False):
+                axis_name: Optional[str], remat: bool = False,
+                grad_accum: int = 1):
     """The one train-step body both parallelism paths share.
 
     ``axis_name`` set: per-shard view under ``shard_map`` — grads/metrics
@@ -50,12 +51,30 @@ def _train_body(model, optimizer: Transform, loss_fn: Callable,
     the standard TPU memory/FLOPs trade that buys batch sizes the chip
     could not otherwise hold (~1.3x step time for ~the forward's
     activation footprint back).
+
+    ``grad_accum``: split the batch into this many microbatches and run
+    them sequentially under ``lax.scan``, summing gradients, before the
+    ONE optimizer step — the standard large-global-batch trade (activation
+    memory of one microbatch, one all-reduce, one weight update). The
+    microbatch split is STRIDED (sample ``i`` goes to microbatch
+    ``i % grad_accum``) so that under GSPMD the batch dimension's
+    data-axis sharding stays device-local through the reshape — a
+    contiguous split would gather each microbatch from a subset of
+    devices (an all-to-all). BatchNorm statistics are computed per
+    microbatch and the running stats see ``grad_accum`` momentum updates
+    per step (torch grad-accumulation semantics: N small forwards).
     """
 
-    def body(state: TrainState, images, labels):
-        def compute_loss(params):
+    if grad_accum < 1:
+        raise ValueError(
+            f"grad_accum must be >= 1, got {grad_accum} (1 = no "
+            "accumulation; 0/negative would silently disable it)"
+        )
+
+    def grad_of(params, stats, images, labels):
+        def compute_loss(p):
             logits, mutated = model.apply(
-                {"params": params, "batch_stats": state.batch_stats},
+                {"params": p, "batch_stats": stats},
                 images,
                 train=True,
                 mutable=["batch_stats"],
@@ -64,8 +83,52 @@ def _train_body(model, optimizer: Transform, loss_fn: Callable,
 
         if remat:
             compute_loss = jax.checkpoint(compute_loss)
-        grad_fn = jax.value_and_grad(compute_loss, has_aux=True)
-        (loss, (logits, new_stats)), grads = grad_fn(state.params)
+        return jax.value_and_grad(compute_loss, has_aux=True)(params)
+
+    def body(state: TrainState, images, labels):
+        if grad_accum > 1:
+            b = images.shape[0]
+            if b % grad_accum:
+                raise ValueError(
+                    f"per-{'device' if axis_name else 'global'} batch {b} "
+                    f"is not divisible by grad_accum={grad_accum}"
+                )
+
+            def to_micro(x):
+                # [b, ...] -> [accum, b//accum, ...], strided assignment
+                return x.reshape(
+                    b // grad_accum, grad_accum, *x.shape[1:]
+                ).swapaxes(0, 1)
+
+            def micro(carry, mb):
+                stats, gsum, lsum, csum = carry
+                imgs, labs = mb
+                (loss, (logits, new_stats)), grads = grad_of(
+                    state.params, stats, imgs, labs
+                )
+                pred = jnp.argmax(logits, axis=-1)
+                corr = jnp.sum((pred == labs).astype(jnp.int32))
+                gsum = jax.tree.map(jnp.add, gsum, grads)
+                return (new_stats, gsum, lsum + loss, csum + corr), None
+
+            carry0 = (
+                state.batch_stats,
+                jax.tree.map(jnp.zeros_like, state.params),
+                jnp.zeros((), jnp.float32),
+                jnp.zeros((), jnp.int32),
+            )
+            (new_stats, gsum, lsum, correct), _ = jax.lax.scan(
+                micro, carry0, (to_micro(images), to_micro(labels))
+            )
+            # equal-sized microbatches: mean of means == global mean
+            grads = jax.tree.map(lambda g: g / grad_accum, gsum)
+            loss = lsum / grad_accum
+        else:
+            (loss, (logits, new_stats)), grads = grad_of(
+                state.params, state.batch_stats, images, labels
+            )
+            pred = jnp.argmax(logits, axis=-1)
+            correct = jnp.sum((pred == labels).astype(jnp.int32))
 
         if axis_name is not None:
             # The DDP all-reduce moment (reference main.py:109): average
@@ -84,8 +147,6 @@ def _train_body(model, optimizer: Transform, loss_fn: Callable,
             )
             new_params = apply_updates(state.params, updates)
 
-        pred = jnp.argmax(logits, axis=-1)
-        correct = jnp.sum((pred == labels).astype(jnp.int32))
         count = jnp.asarray(labels.shape[0], jnp.int32)
         if axis_name is not None:
             loss = jax.lax.pmean(loss, axis_name)
@@ -110,6 +171,7 @@ def make_train_step(
     loss_fn: Callable = cross_entropy_loss,
     axis_name: str = DATA_AXIS,
     remat: bool = False,
+    grad_accum: int = 1,
 ):
     """Build the jitted DP train step.
 
@@ -118,7 +180,8 @@ def make_train_step(
     reduced (scalars, replicated).
     """
     sharded = jax.shard_map(
-        _train_body(model, optimizer, loss_fn, axis_name, remat=remat),
+        _train_body(model, optimizer, loss_fn, axis_name, remat=remat,
+                    grad_accum=grad_accum),
         mesh=mesh,
         in_specs=(P(), P(axis_name), P(axis_name)),
         out_specs=(P(), P()),
@@ -299,6 +362,7 @@ def make_train_step_tp(
     loss_fn: Callable = cross_entropy_loss,
     zero1: bool = False,
     remat: bool = False,
+    grad_accum: int = 1,
 ):
     """Build the jitted DP x TP train step (GSPMD path).
 
@@ -325,7 +389,7 @@ def make_train_step_tp(
     """
     _check_tp_model(model)
     body = _train_body(model, optimizer, loss_fn, axis_name=None,
-                       remat=remat)
+                       remat=remat, grad_accum=grad_accum)
 
     def _build(state_sh):
         batch_sh = NamedSharding(mesh, P(DATA_AXIS))

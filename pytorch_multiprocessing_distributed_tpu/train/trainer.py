@@ -69,6 +69,7 @@ class Trainer:
         start_epoch: int = 1,
         zero1: bool = False,
         remat: bool = False,
+        grad_accum: int = 1,
     ):
         self.mesh = mesh
         self.state = state
@@ -89,12 +90,13 @@ class Trainer:
             # there; main.py builds it accordingly.
             self.state = shard_state(state, mesh, zero1=zero1)
             self.train_step = make_train_step_tp(
-                model, optimizer, mesh, zero1=zero1, remat=remat
+                model, optimizer, mesh, zero1=zero1, remat=remat,
+                grad_accum=grad_accum,
             )
             self.eval_step = make_eval_step_tp(model, mesh, zero1=zero1)
         else:
             self.train_step = make_train_step(
-                model, optimizer, mesh, remat=remat
+                model, optimizer, mesh, remat=remat, grad_accum=grad_accum
             )
             self.eval_step = make_eval_step(model, mesh)
         self.train_logger = Logger(os.path.join(save_path, "train.log"))

@@ -1,0 +1,132 @@
+"""Gradient accumulation (``--grad_accum``) on the 8-device CPU mesh.
+
+The contract: N sequential microbatches, summed grads, ONE optimizer
+step. For a batchnorm-free model (ViT — LayerNorm is per-sample) the
+accumulated step must be numerically equivalent to the single-shot step;
+for BN models the running stats legitimately see N momentum updates
+(torch grad-accumulation semantics) so we assert training works rather
+than bit-equality.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_multiprocessing_distributed_tpu import models
+from pytorch_multiprocessing_distributed_tpu.parallel import make_mesh
+from pytorch_multiprocessing_distributed_tpu.train import (
+    create_train_state,
+    make_train_step,
+)
+from pytorch_multiprocessing_distributed_tpu.train.optim import sgd
+from pytorch_multiprocessing_distributed_tpu.train.step import (
+    make_train_step_tp,
+    shard_batch,
+    shard_state,
+)
+
+
+def _batch(rng, n=32, size=32, classes=10):
+    x = jnp.asarray(rng.normal(size=(n, size, size, 3)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, classes, (n,)))
+    return x, y
+
+
+@pytest.fixture(scope="module")
+def vit_setup():
+    mesh = make_mesh()
+    model = models.get_model("vit_tiny", num_classes=10)
+    opt = sgd(learning_rate=0.1)
+    x = jnp.zeros((2, 32, 32, 3))
+    state = create_train_state(model, jax.random.PRNGKey(0), x, opt)
+    return mesh, model, opt, state
+
+
+def test_accum_matches_single_shot_on_ln_model(vit_setup):
+    """ViT (no BN): grad_accum=4 must reproduce the exact single-step
+    update — the mean over equal microbatches IS the global batch mean."""
+    mesh, model, opt, state0 = vit_setup
+    rng = np.random.default_rng(0)
+    xb, yb = shard_batch(_batch(rng), mesh)
+
+    one = make_train_step(model, opt, mesh)
+    acc = make_train_step(model, opt, mesh, grad_accum=4)
+
+    s_one, m_one = one(jax.tree.map(jnp.array, state0), xb, yb)
+    s_acc, m_acc = acc(jax.tree.map(jnp.array, state0), xb, yb)
+
+    np.testing.assert_allclose(
+        float(m_one["loss"]), float(m_acc["loss"]), rtol=1e-5
+    )
+    assert int(m_one["correct"]) == int(m_acc["correct"])
+    assert int(m_one["count"]) == int(m_acc["count"]) == 32
+    flat_one = jax.tree.leaves(jax.device_get(s_one.params))
+    flat_acc = jax.tree.leaves(jax.device_get(s_acc.params))
+    for a, b in zip(flat_one, flat_acc):
+        np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-6)
+
+
+def test_accum_trains_bn_model():
+    """ResNet (sync-BN): the accumulated step runs and learns; BN running
+    stats move (they see one momentum update per microbatch)."""
+    mesh = make_mesh()
+    model = models.ResNet18(bn_axis="data")
+    opt = sgd(learning_rate=0.05)
+    state = create_train_state(
+        model, jax.random.PRNGKey(0), jnp.zeros((2, 32, 32, 3)), opt
+    )
+    step = make_train_step(model, opt, mesh, grad_accum=2)
+    rng = np.random.default_rng(1)
+    xb, yb = shard_batch(_batch(rng, n=16), mesh)
+    stats_before = jax.device_get(state.batch_stats)
+    losses = []
+    for _ in range(4):
+        state, metrics = step(state, xb, yb)
+        losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+    stats_after = jax.device_get(state.batch_stats)
+    moved = any(
+        not np.allclose(a, b)
+        for a, b in zip(
+            jax.tree.leaves(stats_before), jax.tree.leaves(stats_after)
+        )
+    )
+    assert moved
+
+
+def test_accum_indivisible_batch_raises(vit_setup):
+    mesh, model, opt, state0 = vit_setup
+    rng = np.random.default_rng(2)
+    # 32 global / 8 devices = 4 per device, not divisible by 3
+    xb, yb = shard_batch(_batch(rng), mesh)
+    step = make_train_step(model, opt, mesh, grad_accum=3)
+    with pytest.raises(ValueError, match="not divisible by grad_accum"):
+        step(jax.tree.map(jnp.array, state0), xb, yb)
+
+
+def test_accum_composes_with_gspmd_tp(vit_setup):
+    """grad_accum under the GSPMD (tensor-parallel) step: same update as
+    the GSPMD step without accumulation."""
+    _, model, opt, state0 = vit_setup
+    mesh = make_mesh(4, 2)  # 4-way DP x 2-way TP
+    rng = np.random.default_rng(3)
+    x, y = _batch(rng)
+
+    one = make_train_step_tp(model, opt, mesh)
+    acc = make_train_step_tp(model, opt, mesh, grad_accum=4)
+
+    s1 = shard_state(jax.tree.map(jnp.array, state0), mesh)
+    s2 = shard_state(jax.tree.map(jnp.array, state0), mesh)
+    s_one, m_one = one(s1, x, y)
+    s_acc, m_acc = acc(s2, x, y)
+
+    np.testing.assert_allclose(
+        float(m_one["loss"]), float(m_acc["loss"]), rtol=1e-5
+    )
+    for a, b in zip(
+        jax.tree.leaves(jax.device_get(s_one.params)),
+        jax.tree.leaves(jax.device_get(s_acc.params)),
+    ):
+        np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-6)
