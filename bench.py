@@ -378,13 +378,16 @@ def run_bench(config: str, dtype_name: str, batch_size: int,
     errors = []
     if not math.isfinite(loss2):
         errors.append(f"non-finite loss {loss2}")
-    if flops and peak and flops / min(step_s, step_s_conservative) > peak:
+    fastest = min(step_s, step_s_conservative)
+    if flops and peak and flops / fastest > peak:
         # BOTH estimators must be physically possible (equivalently:
         # per-chip images/sec above the ceiling peak*(batch/n_dev)/flops)
         errors.append(
-            f"implied {flops / min(step_s, step_s_conservative) / 1e12:.1f} "
-            f"TFLOP/s exceeds the chip's {peak / 1e12:.0f} TFLOP/s peak "
-            f"(mfu {mfu}) — measurement invalid"
+            f"implied {flops / fastest / 1e12:.1f} TFLOP/s "
+            f"({'conservative' if fastest < step_s else 'slope'} estimator)"
+            f" exceeds the chip's {peak / 1e12:.0f} TFLOP/s peak "
+            f"(worst-case mfu {flops / fastest / peak:.3f}) — "
+            "measurement invalid"
         )
     if is_tpu:
         if t2 < min_window:

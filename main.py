@@ -49,6 +49,13 @@ parser.add_argument('--zero1', action='store_true',
                     help='ZeRO-1: shard optimizer moments over the data '
                          'axis (each replica stores 1/world of them; '
                          'GSPMD inserts the reduce-scatter/all-gather)')
+parser.add_argument('--fsdp', action='store_true',
+                    help='FSDP/ZeRO-3: shard params, BN stats AND '
+                         'optimizer moments over the data axis (each '
+                         'replica stores ~1/world of the model; GSPMD '
+                         'all-gathers params per layer and reduce-'
+                         'scatters grads). For models bigger than chip '
+                         'HBM; pure DP is faster when the model fits')
 parser.add_argument('--grad_accum', default=1, type=int,
                     help='accumulate gradients over N sequential '
                          'microbatches per optimizer step (activation '
@@ -131,7 +138,7 @@ def main(args):
     # sync; the TP path (model_parallel > 1) runs under global-semantics
     # GSPMD jit where batch stats are global by construction, so BN must
     # NOT carry an axis name there (train/step.py make_train_step_tp).
-    use_gspmd = args.model_parallel > 1 or args.zero1
+    use_gspmd = args.model_parallel > 1 or args.zero1 or args.fsdp
     model = models.get_model(
         args.model, dtype=dtype,
         bn_axis=None if use_gspmd else "data",
@@ -150,10 +157,10 @@ def main(args):
             weight_decay=0.0001,
         )
     elif args.optimizer == "sgd_fused":
-        if args.zero1 or args.model_parallel > 1:
+        if args.zero1 or args.fsdp or args.model_parallel > 1:
             raise ValueError(
                 "--optimizer sgd_fused is the explicit shard_map-DP "
-                "path's fused kernel; under --zero1/--model_parallel "
+                "path's fused kernel; under --zero1/--fsdp/--model_parallel "
                 "the GSPMD partitioner cannot shard through the opaque "
                 "Pallas call (it would replicate the moment buffers, "
                 "defeating the sharding). Use --optimizer sgd there."
@@ -202,6 +209,7 @@ def main(args):
         print_freq=args.print_freq,
         start_epoch=start_epoch,
         zero1=args.zero1,
+        fsdp=args.fsdp,
         remat=args.remat,
         grad_accum=args.grad_accum,
     )

@@ -37,7 +37,7 @@ from .state import TrainState
 
 def _train_body(model, optimizer: Transform, loss_fn: Callable,
                 axis_name: Optional[str], remat: bool = False,
-                grad_accum: int = 1):
+                grad_accum: int = 1, dp_size: int = 1):
     """The one train-step body both parallelism paths share.
 
     ``axis_name`` set: per-shard view under ``shard_map`` — grads/metrics
@@ -88,10 +88,17 @@ def _train_body(model, optimizer: Transform, loss_fn: Callable,
     def body(state: TrainState, images, labels):
         if grad_accum > 1:
             b = images.shape[0]
-            if b % grad_accum:
+            # Under shard_map ``b`` IS the per-device batch; under GSPMD
+            # it is global, and the PER-DEVICE batch (b / dp) must still
+            # divide by grad_accum or the strided microbatch reshape
+            # loses its device-locality (GSPMD would silently insert an
+            # all-to-all per microbatch — the cost this split avoids).
+            if b % (grad_accum * dp_size):
+                per_dev = b // dp_size if axis_name is None else b
                 raise ValueError(
-                    f"per-{'device' if axis_name else 'global'} batch {b} "
-                    f"is not divisible by grad_accum={grad_accum}"
+                    f"per-device batch {per_dev} is not divisible by "
+                    f"grad_accum={grad_accum} (global batch {b}, "
+                    f"data-parallel degree {dp_size})"
                 )
 
             def to_micro(x):
@@ -318,13 +325,25 @@ def zero1_opt_spec(leaf, dp: int, tp: int) -> P:
     return P(*spec)
 
 
-def state_shardings(state, mesh: Mesh, *, zero1: bool = False):
+def state_shardings(state, mesh: Mesh, *, zero1: bool = False,
+                    fsdp: bool = False):
     """NamedSharding pytree for a :class:`TrainState` under TP (and,
-    optionally, ZeRO-1 sharding of the optimizer state over ``data``).
+    optionally, ZeRO sharding over ``data``).
 
     Optimizer moments mirror parameter shapes, so the trailing-dim TP
-    rule covers params, batch_stats and opt_state uniformly; ``zero1``
-    additionally spreads each moment buffer across the data axis.
+    rule covers params, batch_stats and opt_state uniformly.
+
+    ``zero1`` spreads each optimizer moment buffer across the data axis
+    (params stay replicated per DP rank — the ZeRO-1 memory point).
+
+    ``fsdp`` is the ZeRO-3 point: params, batch_stats AND moments are
+    all sharded over ``data`` (largest divisible dim,
+    :func:`zero1_opt_spec`), so each replica stores ~1/dp of the whole
+    model. GSPMD then materializes full params layer-by-layer at use
+    (all-gather in the forward/backward) and reduce-scatters gradients —
+    the FSDP schedule — instead of keeping a resident replica. This is
+    the trade that fits models bigger than chip HBM; for HBM-resident
+    models pure DP is faster (no per-layer gathers).
     """
     tp = mesh.shape[MODEL_AXIS]
     dp = mesh.shape[DATA_AXIS]
@@ -332,25 +351,27 @@ def state_shardings(state, mesh: Mesh, *, zero1: bool = False):
     def tp_sh(l):
         return NamedSharding(mesh, tp_param_spec(l, tp))
 
-    def opt_sh(l):
-        return NamedSharding(
-            mesh, zero1_opt_spec(l, dp, tp) if zero1 else tp_param_spec(l, tp)
-        )
+    def dp_sh(l):
+        return NamedSharding(mesh, zero1_opt_spec(l, dp, tp))
+
+    param_sh = dp_sh if fsdp else tp_sh
+    opt_sh = dp_sh if (zero1 or fsdp) else tp_sh
 
     return state.replace(
-        params=jax.tree.map(tp_sh, state.params),
-        batch_stats=jax.tree.map(tp_sh, state.batch_stats),
+        params=jax.tree.map(param_sh, state.params),
+        batch_stats=jax.tree.map(param_sh, state.batch_stats),
         opt_state=jax.tree.map(opt_sh, state.opt_state),
         epoch=NamedSharding(mesh, P()),
     )
 
 
-def shard_state(state, mesh: Mesh, *, zero1: bool = False):
+def shard_state(state, mesh: Mesh, *, zero1: bool = False,
+                fsdp: bool = False):
     """Place a replicated state onto the mesh with TP/ZeRO shardings."""
     return jax.tree.map(
         lambda l, s: jax.device_put(l, s),
         state,
-        state_shardings(state, mesh, zero1=zero1),
+        state_shardings(state, mesh, zero1=zero1, fsdp=fsdp),
     )
 
 
@@ -361,6 +382,7 @@ def make_train_step_tp(
     *,
     loss_fn: Callable = cross_entropy_loss,
     zero1: bool = False,
+    fsdp: bool = False,
     remat: bool = False,
     grad_accum: int = 1,
 ):
@@ -389,7 +411,8 @@ def make_train_step_tp(
     """
     _check_tp_model(model)
     body = _train_body(model, optimizer, loss_fn, axis_name=None,
-                       remat=remat, grad_accum=grad_accum)
+                       remat=remat, grad_accum=grad_accum,
+                       dp_size=mesh.shape[DATA_AXIS])
 
     def _build(state_sh):
         batch_sh = NamedSharding(mesh, P(DATA_AXIS))
@@ -410,14 +433,15 @@ def make_train_step_tp(
         key = jax.tree.structure(state)
         if key not in compiled:
             compiled[key] = _build(
-                state_shardings(state, mesh, zero1=zero1)
+                state_shardings(state, mesh, zero1=zero1, fsdp=fsdp)
             )
         return compiled[key](state, images, labels)
 
     return step
 
 
-def make_eval_step_tp(model, mesh: Mesh, *, zero1: bool = False):
+def make_eval_step_tp(model, mesh: Mesh, *, zero1: bool = False,
+                      fsdp: bool = False):
     """Eval twin of :func:`make_train_step_tp` (global semantics; same
     masked-validity accounting as :func:`make_eval_step`). ``zero1``
     must match the train step's so in_shardings agree with where the
@@ -431,7 +455,7 @@ def make_eval_step_tp(model, mesh: Mesh, *, zero1: bool = False):
     def step(state, images, labels, valid):
         key = jax.tree.structure(state)
         if key not in compiled:
-            state_sh = state_shardings(state, mesh, zero1=zero1)
+            state_sh = state_shardings(state, mesh, zero1=zero1, fsdp=fsdp)
             img_sh = NamedSharding(mesh, P(DATA_AXIS, None, None, None))
             vec_sh = NamedSharding(mesh, P(DATA_AXIS))
             repl = NamedSharding(mesh, P())

@@ -68,6 +68,7 @@ class Trainer:
         print_freq: int = 10,
         start_epoch: int = 1,
         zero1: bool = False,
+        fsdp: bool = False,
         remat: bool = False,
         grad_accum: int = 1,
     ):
@@ -82,18 +83,21 @@ class Trainer:
         # the log-row numbering) instead of restarting at 1 — the resume
         # path the reference lacks entirely.
         self.start_epoch = start_epoch
-        if dict(mesh.shape).get(MODEL_AXIS, 1) > 1 or zero1:
+        if dict(mesh.shape).get(MODEL_AXIS, 1) > 1 or zero1 or fsdp:
             # the GSPMD step: real tensor parallelism (params sharded
-            # over the model axis) and/or ZeRO-1 (optimizer moments
-            # sharded over the data axis). The model must carry
+            # over the model axis), ZeRO-1 (optimizer moments sharded
+            # over the data axis) and/or FSDP/ZeRO-3 (params + stats +
+            # moments all sharded over data). The model must carry
             # ``bn_axis=None`` — BN stats are global by construction
             # there; main.py builds it accordingly.
-            self.state = shard_state(state, mesh, zero1=zero1)
+            self.state = shard_state(state, mesh, zero1=zero1, fsdp=fsdp)
             self.train_step = make_train_step_tp(
-                model, optimizer, mesh, zero1=zero1, remat=remat,
-                grad_accum=grad_accum,
+                model, optimizer, mesh, zero1=zero1, fsdp=fsdp,
+                remat=remat, grad_accum=grad_accum,
             )
-            self.eval_step = make_eval_step_tp(model, mesh, zero1=zero1)
+            self.eval_step = make_eval_step_tp(
+                model, mesh, zero1=zero1, fsdp=fsdp
+            )
         else:
             self.train_step = make_train_step(
                 model, optimizer, mesh, remat=remat, grad_accum=grad_accum
