@@ -61,6 +61,18 @@ parser.add_argument('--grad_accum', default=1, type=int,
                          'microbatches per optimizer step (activation '
                          'memory of one microbatch, one weight update) — '
                          'the per-device batch must divide by N')
+parser.add_argument('--clip_grad_norm', default=0.0, type=float,
+                    help='clip the global gradient norm to this bound '
+                         'before the update (0 = off); applied to the '
+                         'already-averaged gradients, torch '
+                         'clip_grad_norm_ semantics')
+parser.add_argument('--label_smoothing', default=0.0, type=float,
+                    help='cross-entropy label smoothing epsilon '
+                         '(torch CrossEntropyLoss(label_smoothing=e))')
+parser.add_argument('--ema', default=0.0, type=float, metavar='DECAY',
+                    help='track an exponential moving average of the '
+                         'params with this decay (e.g. 0.999) and use '
+                         'it for evaluation; 0 = off')
 parser.add_argument('--remat', action='store_true',
                     help='rematerialize activations in the backward '
                          '(jax.checkpoint): ~1.3x step time for a much '
@@ -187,6 +199,7 @@ def main(args):
         jax.random.PRNGKey(args.seed),
         jnp.zeros((2, image_size, image_size, 3), jnp.float32),
         optimizer,
+        ema=args.ema > 0,
     )
     start_epoch = 1
     if args.resume:
@@ -197,6 +210,10 @@ def main(args):
         if dist.is_primary():
             print(f"Resumed from {args.resume} (continuing at epoch {start_epoch})")
 
+    from pytorch_multiprocessing_distributed_tpu.ops.losses import (
+        smooth_cross_entropy_loss)
+
+    loss_fn = smooth_cross_entropy_loss(args.label_smoothing)
     trainer = Trainer(
         model=model,
         optimizer=optimizer,
@@ -212,6 +229,9 @@ def main(args):
         fsdp=args.fsdp,
         remat=args.remat,
         grad_accum=args.grad_accum,
+        loss_fn=loss_fn,
+        clip_grad_norm=args.clip_grad_norm or None,
+        ema_decay=args.ema or None,
     )
     if args.profile:
         from pytorch_multiprocessing_distributed_tpu.utils.profiler import trace

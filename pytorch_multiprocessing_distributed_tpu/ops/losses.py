@@ -28,3 +28,40 @@ def cross_entropy_loss(logits: jax.Array, targets: jax.Array) -> jax.Array:
       targets: ``[batch]`` int labels.
     """
     return jnp.mean(cross_entropy_per_sample(logits, targets))
+
+
+# eval loops need the UN-reduced form of the same criterion (for the
+# validity-masked sums in train/step.py _eval_body); every mean loss in
+# this module carries its per-sample companion as an attribute.
+cross_entropy_loss.per_sample = cross_entropy_per_sample
+
+
+def smooth_cross_entropy_loss(label_smoothing: float):
+    """Factory: mean cross-entropy with label smoothing ``eps``.
+
+    ``torch.nn.CrossEntropyLoss(label_smoothing=eps)`` semantics: the
+    target distribution is ``(1-eps)`` on the label plus ``eps/C``
+    uniform, so ``loss = (1-eps)*CE(label) + eps * mean_c(-log p_c)``.
+    ``eps=0`` reduces exactly to :func:`cross_entropy_loss`.
+    """
+    eps = float(label_smoothing)
+    if not 0.0 <= eps < 1.0:
+        raise ValueError(f"label_smoothing must be in [0, 1), got {eps}")
+    if eps == 0.0:
+        return cross_entropy_loss
+
+    def per_sample_fn(logits: jax.Array, targets: jax.Array) -> jax.Array:
+        logits = logits.astype(jnp.float32)
+        logz = jax.nn.logsumexp(logits, axis=-1)  # [batch]
+        label_logits = jnp.take_along_axis(
+            logits, targets[:, None], axis=-1
+        )[:, 0]
+        # mean over classes of -log p_c  ==  logz - mean_c(logit_c)
+        uniform_term = logz - jnp.mean(logits, axis=-1)
+        return (1.0 - eps) * (logz - label_logits) + eps * uniform_term
+
+    def loss_fn(logits: jax.Array, targets: jax.Array) -> jax.Array:
+        return jnp.mean(per_sample_fn(logits, targets))
+
+    loss_fn.per_sample = per_sample_fn
+    return loss_fn

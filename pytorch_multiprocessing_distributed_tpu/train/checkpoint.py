@@ -77,10 +77,27 @@ def save_checkpoint(save_path: str, state: TrainState, epoch: int) -> Optional[s
 
 def load_checkpoint(path: str, template: TrainState) -> TrainState:
     """Restore a checkpoint into the structure of ``template``
-    (a freshly-initialized state with the same model/optimizer)."""
+    (a freshly-initialized state with the same model/optimizer).
+
+    Forward-compatible with checkpoints written before a TrainState
+    field existed (e.g. ``ema_params``): missing top-level fields keep
+    the template's value instead of failing the restore.
+
+    EMA resume semantics: when the template tracks EMA (``--ema``) but
+    the checkpoint has none (missing key OR the empty ``{}`` every
+    non-EMA checkpoint serializes), the EMA is seeded from the
+    checkpoint's TRAINED params — never from the template's fresh
+    random init, which would poison every eval for ~1/(1-decay) steps.
+    """
     with open(path, "rb") as f:
         payload = f.read()
-    return serialization.from_bytes(template, payload)
+    state_dict = serialization.msgpack_restore(payload)
+    template_dict = serialization.to_state_dict(template)
+    if template_dict.get("ema_params") and not state_dict.get("ema_params"):
+        state_dict["ema_params"] = state_dict["params"]
+    for key, value in template_dict.items():
+        state_dict.setdefault(key, value)
+    return serialization.from_state_dict(template, state_dict)
 
 
 def latest_checkpoint(save_path: str) -> Optional[str]:

@@ -25,14 +25,22 @@ class TrainState:
     batch_stats: Any
     opt_state: OptState
     epoch: jax.Array  # current epoch (drives the LR schedule)
+    # Exponential moving average of params ({} = EMA off). A dict rather
+    # than Optional so the pytree STRUCTURE is stable for jit caching and
+    # msgpack round-trips; populated by create_train_state(ema=True).
+    ema_params: Any = flax.struct.field(default_factory=dict)
 
 
-def create_train_state(model, rng, sample_input, optimizer: Transform) -> TrainState:
+def create_train_state(model, rng, sample_input, optimizer: Transform,
+                       ema: bool = False) -> TrainState:
     """Initialize model variables + optimizer buffers.
 
     Weight layout note: under SPMD there is no DDP-style rank-0 broadcast
     (reference relies on DDP's ctor broadcast, ``main.py:44``) — every
     replica computes the same initialization from the same seed.
+
+    ``ema=True`` seeds an EMA copy of the params (tracked in-step by
+    the trainer's ``ema_decay``; used for evaluation/checkpointing).
     """
     variables = model.init(rng, sample_input, train=False)
     params = variables["params"]
@@ -42,4 +50,5 @@ def create_train_state(model, rng, sample_input, optimizer: Transform) -> TrainS
         batch_stats=batch_stats,
         opt_state=optimizer.init(params),
         epoch=jnp.ones((), jnp.int32),
+        ema_params=jax.tree.map(jnp.array, params) if ema else {},
     )

@@ -37,7 +37,9 @@ from .state import TrainState
 
 def _train_body(model, optimizer: Transform, loss_fn: Callable,
                 axis_name: Optional[str], remat: bool = False,
-                grad_accum: int = 1, dp_size: int = 1):
+                grad_accum: int = 1, dp_size: int = 1,
+                clip_grad_norm: Optional[float] = None,
+                ema_decay: Optional[float] = None):
     """The one train-step body both parallelism paths share.
 
     ``axis_name`` set: per-shard view under ``shard_map`` — grads/metrics
@@ -69,6 +71,16 @@ def _train_body(model, optimizer: Transform, loss_fn: Callable,
         raise ValueError(
             f"grad_accum must be >= 1, got {grad_accum} (1 = no "
             "accumulation; 0/negative would silently disable it)"
+        )
+    if clip_grad_norm is not None and not clip_grad_norm > 0:
+        raise ValueError(
+            f"clip_grad_norm must be > 0, got {clip_grad_norm} (a "
+            "negative bound would NEGATE gradients; pass None to disable)"
+        )
+    if ema_decay is not None and not 0.0 < ema_decay < 1.0:
+        raise ValueError(
+            f"ema_decay must be in (0, 1), got {ema_decay} (>= 1 "
+            "diverges exponentially; pass None to disable)"
         )
 
     def grad_of(params, stats, images, labels):
@@ -143,6 +155,17 @@ def _train_body(model, optimizer: Transform, loss_fn: Callable,
             # pmean-ed inside the forward (axis bound by shard_map).
             grads = jax.lax.pmean(grads, axis_name)
 
+        if clip_grad_norm is not None:
+            # Global-norm clipping of the ALREADY-averaged gradients
+            # (torch.nn.utils.clip_grad_norm_ semantics: one norm over
+            # every leaf; scale only when the norm exceeds the bound).
+            gnorm = jnp.sqrt(sum(
+                jnp.sum(jnp.square(g.astype(jnp.float32)))
+                for g in jax.tree.leaves(grads)
+            ))
+            scale = jnp.minimum(1.0, clip_grad_norm / (gnorm + 1e-6))
+            grads = jax.tree.map(lambda g: g * scale, grads)
+
         if getattr(optimizer, "apply", None) is not None:
             # fused whole-update path (e.g. the Pallas single-pass SGD)
             new_params, new_opt = optimizer.apply(
@@ -165,6 +188,13 @@ def _train_body(model, optimizer: Transform, loss_fn: Callable,
         new_state = state.replace(
             params=new_params, batch_stats=new_stats, opt_state=new_opt
         )
+        if ema_decay is not None and state.ema_params:
+            new_state = new_state.replace(
+                ema_params=jax.tree.map(
+                    lambda e, p: ema_decay * e + (1.0 - ema_decay) * p,
+                    state.ema_params, new_params,
+                )
+            )
         return new_state, metrics
 
     return body
@@ -179,6 +209,8 @@ def make_train_step(
     axis_name: str = DATA_AXIS,
     remat: bool = False,
     grad_accum: int = 1,
+    clip_grad_norm=None,
+    ema_decay=None,
 ):
     """Build the jitted DP train step.
 
@@ -188,7 +220,8 @@ def make_train_step(
     """
     sharded = jax.shard_map(
         _train_body(model, optimizer, loss_fn, axis_name, remat=remat,
-                    grad_accum=grad_accum),
+                    grad_accum=grad_accum, clip_grad_norm=clip_grad_norm,
+                    ema_decay=ema_decay),
         mesh=mesh,
         in_specs=(P(), P(axis_name), P(axis_name)),
         out_specs=(P(), P()),
@@ -202,6 +235,7 @@ def make_eval_step(
     mesh: Mesh,
     *,
     axis_name: str = DATA_AXIS,
+    loss_fn: Callable = cross_entropy_loss,
 ):
     """Build the jitted eval step (reference ``validate`` inner loop,
     ``main.py:144-151``): forward in eval mode (running BN stats), loss +
@@ -222,7 +256,7 @@ def make_eval_step(
     """
 
     sharded = jax.shard_map(
-        _eval_body(model, axis_name),
+        _eval_body(model, axis_name, loss_fn=loss_fn),
         mesh=mesh,
         in_specs=(P(), P(axis_name), P(axis_name), P(axis_name)),
         out_specs=P(),
@@ -231,10 +265,18 @@ def make_eval_step(
     return jax.jit(sharded)
 
 
-def _eval_body(model, axis_name: Optional[str]):
+def _eval_body(model, axis_name: Optional[str],
+               loss_fn: Callable = cross_entropy_loss):
     """Shared eval body (masked-validity accounting) for both paths —
     explicit ``psum`` under ``shard_map`` when ``axis_name`` is set,
-    global sums under GSPMD jit when it is ``None``."""
+    global sums under GSPMD jit when it is ``None``.
+
+    The per-sample criterion mirrors the TRAIN loss (``loss_fn``'s
+    ``.per_sample`` companion when it has one — e.g. label smoothing —
+    plain cross-entropy otherwise), so train/test losses stay
+    comparable, like the reference's shared ``criterion`` (main.py:48).
+    """
+    per_sample = getattr(loss_fn, "per_sample", cross_entropy_per_sample)
 
     def body(state: TrainState, images, labels, valid):
         logits = model.apply(
@@ -243,10 +285,10 @@ def _eval_body(model, axis_name: Optional[str]):
             train=False,
         )
         w = valid.astype(jnp.float32)
-        per_sample = cross_entropy_per_sample(logits, labels)
+        per_sample_loss = per_sample(logits, labels)
         pred = jnp.argmax(logits, axis=-1)
         correct = jnp.sum((pred == labels).astype(jnp.float32) * w)
-        loss_sum = jnp.sum(per_sample * w)
+        loss_sum = jnp.sum(per_sample_loss * w)
         count = jnp.sum(w)
         if axis_name is not None:
             loss_sum, correct, count = jax.lax.psum(
@@ -362,6 +404,7 @@ def state_shardings(state, mesh: Mesh, *, zero1: bool = False,
         batch_stats=jax.tree.map(param_sh, state.batch_stats),
         opt_state=jax.tree.map(opt_sh, state.opt_state),
         epoch=NamedSharding(mesh, P()),
+        ema_params=jax.tree.map(param_sh, state.ema_params),
     )
 
 
@@ -385,6 +428,8 @@ def make_train_step_tp(
     fsdp: bool = False,
     remat: bool = False,
     grad_accum: int = 1,
+    clip_grad_norm=None,
+    ema_decay=None,
 ):
     """Build the jitted DP x TP train step (GSPMD path).
 
@@ -412,7 +457,8 @@ def make_train_step_tp(
     _check_tp_model(model)
     body = _train_body(model, optimizer, loss_fn, axis_name=None,
                        remat=remat, grad_accum=grad_accum,
-                       dp_size=mesh.shape[DATA_AXIS])
+                       dp_size=mesh.shape[DATA_AXIS],
+                       clip_grad_norm=clip_grad_norm, ema_decay=ema_decay)
 
     def _build(state_sh):
         batch_sh = NamedSharding(mesh, P(DATA_AXIS))
@@ -441,14 +487,15 @@ def make_train_step_tp(
 
 
 def make_eval_step_tp(model, mesh: Mesh, *, zero1: bool = False,
-                      fsdp: bool = False):
+                      fsdp: bool = False,
+                      loss_fn: Callable = cross_entropy_loss):
     """Eval twin of :func:`make_train_step_tp` (global semantics; same
     masked-validity accounting as :func:`make_eval_step`). ``zero1``
     must match the train step's so in_shardings agree with where the
     state actually lives (a mismatch would silently reshard per call).
     """
     _check_tp_model(model)
-    body = _eval_body(model, axis_name=None)
+    body = _eval_body(model, axis_name=None, loss_fn=loss_fn)
 
     compiled = {}
 

@@ -71,6 +71,9 @@ class Trainer:
         fsdp: bool = False,
         remat: bool = False,
         grad_accum: int = 1,
+        loss_fn=None,
+        clip_grad_norm=None,
+        ema_decay=None,
     ):
         self.mesh = mesh
         self.state = state
@@ -83,6 +86,11 @@ class Trainer:
         # the log-row numbering) instead of restarting at 1 — the resume
         # path the reference lacks entirely.
         self.start_epoch = start_epoch
+        # evaluate/checkpoint with EMA weights when tracking is on
+        self.ema_decay = ema_decay
+        from ..ops.losses import cross_entropy_loss
+
+        loss_fn = loss_fn or cross_entropy_loss
         if dict(mesh.shape).get(MODEL_AXIS, 1) > 1 or zero1 or fsdp:
             # the GSPMD step: real tensor parallelism (params sharded
             # over the model axis), ZeRO-1 (optimizer moments sharded
@@ -93,16 +101,19 @@ class Trainer:
             self.state = shard_state(state, mesh, zero1=zero1, fsdp=fsdp)
             self.train_step = make_train_step_tp(
                 model, optimizer, mesh, zero1=zero1, fsdp=fsdp,
-                remat=remat, grad_accum=grad_accum,
+                remat=remat, grad_accum=grad_accum, loss_fn=loss_fn,
+                clip_grad_norm=clip_grad_norm, ema_decay=ema_decay,
             )
             self.eval_step = make_eval_step_tp(
-                model, mesh, zero1=zero1, fsdp=fsdp
+                model, mesh, zero1=zero1, fsdp=fsdp, loss_fn=loss_fn
             )
         else:
             self.train_step = make_train_step(
-                model, optimizer, mesh, remat=remat, grad_accum=grad_accum
+                model, optimizer, mesh, remat=remat, grad_accum=grad_accum,
+                loss_fn=loss_fn, clip_grad_norm=clip_grad_norm,
+                ema_decay=ema_decay,
             )
-            self.eval_step = make_eval_step(model, mesh)
+            self.eval_step = make_eval_step(model, mesh, loss_fn=loss_fn)
         self.train_logger = Logger(os.path.join(save_path, "train.log"))
         self.test_logger = Logger(os.path.join(save_path, "test.log"))
 
@@ -181,6 +192,11 @@ class Trainer:
         total_correct = 0
 
         self.test_loader.set_epoch(epoch)
+        # EMA evaluation: swap the averaged weights in (standard EMA
+        # practice; BN running stats are already their own EMA).
+        eval_state = self.state
+        if self.ema_decay and getattr(self.state, "ema_params", None):
+            eval_state = self.state.replace(params=self.state.ema_params)
         n_batches = len(self.test_loader)
         pending = []
         window_start = time.time()
@@ -192,7 +208,7 @@ class Trainer:
             else:  # loader without validity info: everything counts
                 images, labels = batch
                 valid = jnp.ones(labels.shape, bool)
-            pending.append(self.eval_step(self.state, images, labels, valid))
+            pending.append(self.eval_step(eval_state, images, labels, valid))
             if i % self.print_freq == 0 or i == n_batches - 1:
                 for m in jax.device_get(pending):
                     losses.update(float(m["loss"]), int(m["count"]))

@@ -1,0 +1,239 @@
+"""Trainer extras: label smoothing, gradient clipping, EMA.
+
+Label smoothing is pinned against ``torch.nn.CrossEntropyLoss`` (the
+reference's loss, ``main.py:48``, with the smoothing knob the reference
+never used); clipping against the closed-form SGD update; EMA against
+the recurrence by hand.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from pytorch_multiprocessing_distributed_tpu import models
+from pytorch_multiprocessing_distributed_tpu.ops.losses import (
+    cross_entropy_loss,
+    smooth_cross_entropy_loss,
+)
+from pytorch_multiprocessing_distributed_tpu.parallel import make_mesh
+from pytorch_multiprocessing_distributed_tpu.train import (
+    create_train_state,
+    load_checkpoint,
+    make_train_step,
+    save_checkpoint,
+)
+from pytorch_multiprocessing_distributed_tpu.train.optim import sgd
+from pytorch_multiprocessing_distributed_tpu.train.step import shard_batch
+
+
+class TestLabelSmoothing:
+    def test_eps_zero_is_plain_ce(self):
+        assert smooth_cross_entropy_loss(0.0) is cross_entropy_loss
+
+    @pytest.mark.parametrize("eps", [0.05, 0.1, 0.3])
+    def test_matches_torch(self, eps):
+        rng = np.random.default_rng(0)
+        logits = rng.normal(size=(16, 10)).astype(np.float32)
+        labels = rng.integers(0, 10, (16,))
+        ours = float(
+            smooth_cross_entropy_loss(eps)(
+                jnp.asarray(logits), jnp.asarray(labels)
+            )
+        )
+        theirs = float(
+            torch.nn.CrossEntropyLoss(label_smoothing=eps)(
+                torch.from_numpy(logits), torch.from_numpy(labels)
+            )
+        )
+        np.testing.assert_allclose(ours, theirs, rtol=1e-5)
+
+    def test_invalid_eps_raises(self):
+        with pytest.raises(ValueError, match="label_smoothing"):
+            smooth_cross_entropy_loss(1.0)
+
+
+class TestClipAndEma:
+    @pytest.fixture(scope="class")
+    def setup(self):
+        mesh = make_mesh()
+        model = models.get_model("vit_tiny", num_classes=10)
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(16, 32, 32, 3)), jnp.float32)
+        y = jnp.asarray(rng.integers(0, 10, (16,)))
+        return mesh, model, shard_batch((x, y), mesh)
+
+    def test_clip_bounds_update_norm(self, setup):
+        """Plain SGD (no momentum/wd): update = -lr * clipped_grad, so
+        the total parameter delta norm is exactly lr * min(clip, |g|)."""
+        mesh, model, batch = setup
+        lr, clip = 0.5, 1e-3  # clip far below the real grad norm
+        opt = sgd(learning_rate=lr, momentum=0.0, weight_decay=0.0,
+                  nesterov=False)
+        state = create_train_state(
+            model, jax.random.PRNGKey(0), jnp.zeros((2, 32, 32, 3)), opt
+        )
+        before = jax.device_get(state.params)
+        step = make_train_step(model, opt, mesh, clip_grad_norm=clip)
+        state, _ = step(state, *batch)
+        after = jax.device_get(state.params)
+        delta_sq = sum(
+            float(np.sum((a - b) ** 2))
+            for a, b in zip(jax.tree.leaves(after), jax.tree.leaves(before))
+        )
+        np.testing.assert_allclose(
+            np.sqrt(delta_sq), lr * clip, rtol=1e-3
+        )
+
+    def test_ema_tracks_recurrence(self, setup):
+        mesh, model, batch = setup
+        decay = 0.5
+        opt = sgd(learning_rate=0.1)
+        state = create_train_state(
+            model, jax.random.PRNGKey(0), jnp.zeros((2, 32, 32, 3)), opt,
+            ema=True,
+        )
+        p0 = jax.device_get(state.params)
+        assert jax.tree.structure(state.ema_params) == jax.tree.structure(
+            state.params
+        )
+        step = make_train_step(model, opt, mesh, ema_decay=decay)
+        state, _ = step(state, *batch)
+        p1 = jax.device_get(state.params)
+        ema1 = jax.device_get(state.ema_params)
+        for e, a, b in zip(
+            jax.tree.leaves(ema1), jax.tree.leaves(p0), jax.tree.leaves(p1)
+        ):
+            np.testing.assert_allclose(
+                e, decay * a + (1 - decay) * b, rtol=1e-5, atol=1e-7
+            )
+
+    def test_ema_off_state_untouched(self, setup):
+        mesh, model, batch = setup
+        opt = sgd(learning_rate=0.1)
+        state = create_train_state(
+            model, jax.random.PRNGKey(0), jnp.zeros((2, 32, 32, 3)), opt
+        )
+        step = make_train_step(model, opt, mesh)
+        state, _ = step(state, *batch)
+        assert state.ema_params == {}
+
+
+class TestKnobValidation:
+    def test_bad_clip_raises(self):
+        mesh = make_mesh()
+        model = models.get_model("vit_tiny", num_classes=10)
+        with pytest.raises(ValueError, match="clip_grad_norm"):
+            make_train_step(model, sgd(), mesh, clip_grad_norm=-1.0)
+
+    def test_bad_ema_raises(self):
+        mesh = make_mesh()
+        model = models.get_model("vit_tiny", num_classes=10)
+        with pytest.raises(ValueError, match="ema_decay"):
+            make_train_step(model, sgd(), mesh, ema_decay=1.5)
+
+
+class TestEvalSmoothingParity:
+    def test_eval_loss_uses_train_criterion(self):
+        """With label smoothing on, test loss must include the smoothing
+        term (the reference shares ONE criterion between train and
+        validate, main.py:48)."""
+        from pytorch_multiprocessing_distributed_tpu.train import (
+            make_eval_step)
+
+        mesh = make_mesh()
+        model = models.get_model("vit_tiny", num_classes=10)
+        opt = sgd(learning_rate=0.1)
+        state = create_train_state(
+            model, jax.random.PRNGKey(0), jnp.zeros((2, 32, 32, 3)), opt
+        )
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(16, 32, 32, 3)), jnp.float32)
+        y = jnp.asarray(rng.integers(0, 10, (16,)))
+        xb, yb = shard_batch((x, y), mesh)
+        valid = shard_batch(jnp.ones(y.shape, bool), mesh)
+
+        smooth = smooth_cross_entropy_loss(0.3)
+        m_plain = make_eval_step(model, mesh)(state, xb, yb, valid)
+        m_smooth = make_eval_step(model, mesh, loss_fn=smooth)(
+            state, xb, yb, valid
+        )
+        # the two criteria genuinely differ on random logits...
+        assert abs(float(m_plain["loss"]) - float(m_smooth["loss"])) > 1e-4
+        # ...and the smoothed eval loss equals the smoothed train loss
+        # applied to the same logits
+        logits = model.apply(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            x, train=False,
+        )
+        np.testing.assert_allclose(
+            float(m_smooth["loss"]), float(smooth(logits, y)), rtol=1e-5
+        )
+
+
+class TestCheckpointCompat:
+    def test_resume_with_ema_from_non_ema_checkpoint(self, tmp_path):
+        """--ema resume from a non-EMA checkpoint: EMA must seed from the
+        TRAINED params in the file, not the template's random init."""
+        mesh = make_mesh()
+        model = models.get_model("vit_tiny", num_classes=10)
+        opt = sgd(learning_rate=0.1)
+        trained = create_train_state(
+            model, jax.random.PRNGKey(7), jnp.zeros((2, 32, 32, 3)), opt
+        )
+        path = save_checkpoint(str(tmp_path), trained, 5)  # ema_params={}
+        template = create_train_state(
+            model, jax.random.PRNGKey(1), jnp.zeros((2, 32, 32, 3)), opt,
+            ema=True,  # different seed: fresh init != trained weights
+        )
+        restored = load_checkpoint(path, template)
+        for e, p in zip(
+            jax.tree.leaves(jax.device_get(restored.ema_params)),
+            jax.tree.leaves(jax.device_get(trained.params)),
+        ):
+            np.testing.assert_allclose(e, p)
+
+    def test_pre_ema_checkpoint_loads(self, tmp_path):
+        """A checkpoint written WITHOUT the ema_params field (older
+        layout) must restore into today's TrainState."""
+        from flax import serialization
+
+        mesh = make_mesh()
+        model = models.ResNet18(bn_axis="data")
+        opt = sgd(learning_rate=0.1)
+        state = create_train_state(
+            model, jax.random.PRNGKey(0), jnp.zeros((2, 32, 32, 3)), opt
+        )
+        old_dict = serialization.to_state_dict(state)
+        old_dict.pop("ema_params")  # simulate the pre-EMA layout
+        path = tmp_path / "model_1.pth"
+        path.write_bytes(serialization.msgpack_serialize(
+            jax.device_get(old_dict)
+        ))
+        restored = load_checkpoint(str(path), state)
+        assert restored.ema_params == {}
+        np.testing.assert_allclose(
+            jax.tree.leaves(jax.device_get(restored.params))[0],
+            jax.tree.leaves(jax.device_get(state.params))[0],
+        )
+
+    def test_ema_checkpoint_roundtrip(self, tmp_path):
+        mesh = make_mesh()
+        model = models.get_model("vit_tiny", num_classes=10)
+        opt = sgd(learning_rate=0.1)
+        state = create_train_state(
+            model, jax.random.PRNGKey(0), jnp.zeros((2, 32, 32, 3)), opt,
+            ema=True,
+        )
+        path = save_checkpoint(str(tmp_path), state, 3)
+        template = create_train_state(
+            model, jax.random.PRNGKey(1), jnp.zeros((2, 32, 32, 3)), opt,
+            ema=True,
+        )
+        restored = load_checkpoint(path, template)
+        for a, b in zip(
+            jax.tree.leaves(jax.device_get(restored.ema_params)),
+            jax.tree.leaves(jax.device_get(state.ema_params)),
+        ):
+            np.testing.assert_allclose(a, b)
