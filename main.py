@@ -150,6 +150,13 @@ def main(args):
     # sync; the TP path (model_parallel > 1) runs under global-semantics
     # GSPMD jit where batch stats are global by construction, so BN must
     # NOT carry an axis name there (train/step.py make_train_step_tp).
+    if args.model in models.LM_MODELS:
+        raise ValueError(
+            f"--model {args.model} is a language model: it trains on "
+            "token sequences via pytorch_multiprocessing_distributed_tpu"
+            ".train.lm (make_lm_train_step), not through this image-"
+            "classification CLI. See MIGRATION.md."
+        )
     use_gspmd = args.model_parallel > 1 or args.zero1 or args.fsdp
     model = models.get_model(
         args.model, dtype=dtype,

@@ -28,6 +28,7 @@ from .vgg import VGG, VGG11, VGG13, VGG16, VGG19
 from .densenet import DenseNet, DenseNet121, DenseNetBC100
 from .vit import ViT, ViT_B16, ViT_S16, ViT_Tiny
 from .convnext import ConvNeXt, ConvNeXt_T, ConvNeXt_S, ConvNeXt_B, ConvNeXt_L
+from .gpt import GPT, GPT_Small, GPT_Medium, GPT_Tiny
 
 __all__ = [
     "BasicBlock",
@@ -44,4 +45,10 @@ __all__ = [
     "DenseNet", "DenseNet121", "DenseNetBC100",
     "ViT", "ViT_B16", "ViT_S16", "ViT_Tiny",
     "ConvNeXt", "ConvNeXt_T", "ConvNeXt_S", "ConvNeXt_B", "ConvNeXt_L",
+    "GPT", "GPT_Small", "GPT_Medium", "GPT_Tiny", "LM_MODELS",
 ]
+
+# LM families train through train/lm.py (next-token loss over [B, S]
+# tokens), not the image CLI trainer; main.py uses this set to fail
+# loudly instead of crashing downstream on image-shaped inputs.
+LM_MODELS = frozenset({"gpt_small", "gpt_medium", "gpt_tiny"})

@@ -1,0 +1,178 @@
+"""Decoder-only transformer language models (GPT family).
+
+The reference repo is a vision trainer with no attention anywhere
+(SURVEY.md §5 marks long-context "absent by construction"); this family
+is the framework's long-context flagship — the model-level consumer of
+the two attention paths the kernel layer provides:
+
+- single shard: the Pallas causal flash kernel
+  (:func:`..ops.pallas.flash_attention` — [S, S] logits never touch
+  HBM);
+- sequence parallel: pass ``seq_axis`` and the SAME model runs with its
+  sequence dimension sharded over a mesh axis via causal ring attention
+  (:func:`..parallel.ring_attention` — K/V rotate by ``ppermute``,
+  flash kernel per hop, custom VJP). Per-position ops (projections,
+  LayerNorm, MLP) stay shard-local; only attention communicates.
+
+Architecture: pre-LN GPT-2 style — learned positional embeddings, N
+blocks of (LN -> causal MHA -> residual, LN -> GELU MLP -> residual),
+final LN, untied linear head. Compute in ``dtype`` (bf16 on the MXU),
+params/LayerNorm/softmax in f32 — the same mixed-precision policy as
+the rest of the zoo.
+
+Train with :func:`..train.lm.make_lm_train_step` (next-token loss; the
+image trainer's [B, C] loss shape does not apply).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..ops.pallas.flash_attention import flash_attention
+from ..parallel.ring_attention import ring_attention
+from .registry import register
+
+dense_init = nn.initializers.normal(stddev=0.02)
+
+
+class CausalSelfAttention(nn.Module):
+    num_heads: int
+    dtype: Any = jnp.float32
+    seq_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x):
+        b, s, d_model = x.shape
+        assert d_model % self.num_heads == 0
+        head_dim = d_model // self.num_heads
+        qkv = nn.Dense(3 * d_model, dtype=self.dtype,
+                       kernel_init=dense_init, name="wqkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(b, s, self.num_heads, head_dim)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        if self.seq_axis is not None:
+            # sequence sharded over the mesh: exact causal attention
+            # over GLOBAL positions via the K/V ring
+            out = ring_attention(q, k, v, axis_name=self.seq_axis,
+                                 causal=True)
+        else:
+            out = flash_attention(q, k, v, causal=True)
+        out = out.reshape(b, s, d_model)
+        return nn.Dense(d_model, dtype=self.dtype,
+                        kernel_init=dense_init, name="wo")(out)
+
+
+class Block(nn.Module):
+    num_heads: int
+    mlp_dim: int
+    dtype: Any = jnp.float32
+    seq_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+        x = x + CausalSelfAttention(
+            self.num_heads, self.dtype, self.seq_axis, name="attn"
+        )(h)
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        h = nn.Dense(self.mlp_dim, dtype=self.dtype,
+                     kernel_init=dense_init, name="fc1")(h)
+        h = nn.gelu(h)
+        h = nn.Dense(x.shape[-1], dtype=self.dtype,
+                     kernel_init=dense_init, name="fc2")(h)
+        return x + h
+
+
+class GPT(nn.Module):
+    """Decoder-only LM. Input ``[batch, seq]`` int tokens (per-shard
+    slice of the global sequence when ``seq_axis`` is set); output
+    ``[batch, seq, vocab]`` f32 logits."""
+
+    vocab_size: int = 50257
+    max_seq_len: int = 1024
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    dtype: Any = jnp.float32
+    seq_axis: Optional[str] = None
+    bn_axis: Optional[str] = None  # unused (no BN); registry parity
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        b, s = tokens.shape
+        embed = self.param(
+            "embed", dense_init, (self.vocab_size, self.hidden_size),
+            jnp.float32,
+        )
+        pos = self.param(
+            "pos_embed", dense_init, (self.max_seq_len, self.hidden_size),
+            jnp.float32,
+        )
+        if self.seq_axis is not None:
+            axis_size = jax.lax.psum(1, self.seq_axis)
+            if s * axis_size > self.max_seq_len:
+                # dynamic_slice CLAMPS out-of-range starts, which would
+                # silently duplicate position encodings across shards —
+                # fail at trace time instead (mirrors the loud shape
+                # error the unsharded path produces)
+                raise ValueError(
+                    f"global sequence {s} x {axis_size} shards = "
+                    f"{s * axis_size} exceeds max_seq_len="
+                    f"{self.max_seq_len}"
+                )
+            # this shard holds global positions [idx*s, (idx+1)*s)
+            idx = jax.lax.axis_index(self.seq_axis)
+            pos_slice = jax.lax.dynamic_slice_in_dim(
+                pos, idx * s, s, axis=0
+            )
+        else:
+            if s > self.max_seq_len:
+                raise ValueError(
+                    f"sequence {s} exceeds max_seq_len={self.max_seq_len}"
+                )
+            pos_slice = pos[:s]
+        x = embed[tokens].astype(self.dtype) + pos_slice.astype(self.dtype)
+        for i in range(self.num_layers):
+            x = Block(self.num_heads, self.mlp_dim, self.dtype,
+                      self.seq_axis, name=f"block_{i}")(x)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
+        logits = nn.Dense(self.vocab_size, dtype=jnp.float32,
+                          kernel_init=dense_init, name="head")(x)
+        return logits.astype(jnp.float32)
+
+
+def _family(kw, **defaults):
+    for key, value in defaults.items():
+        kw.setdefault(key, value)
+    return GPT(**kw)
+
+
+def GPT_Small(**kw) -> GPT:
+    """GPT-2 small geometry (124M at the 50257 vocab)."""
+    return _family(kw, hidden_size=768, num_layers=12, num_heads=12,
+                   mlp_dim=3072)
+
+
+def GPT_Medium(**kw) -> GPT:
+    """GPT-2 medium geometry (350M)."""
+    return _family(kw, hidden_size=1024, num_layers=24, num_heads=16,
+                   mlp_dim=4096)
+
+
+def GPT_Tiny(**kw) -> GPT:
+    """4-layer/128-wide smoke model for tests and CPU-mesh runs."""
+    return _family(kw, vocab_size=257, max_seq_len=256, hidden_size=128,
+                   num_layers=4, num_heads=4, mlp_dim=512)
+
+
+register("gpt_small")(GPT_Small)
+register("gpt_medium")(GPT_Medium)
+register("gpt_tiny")(GPT_Tiny)
