@@ -81,6 +81,16 @@ parser.add_argument('--remat', action='store_true',
 parser.add_argument('--seed', default=0, type=int, help='init/seed for params and shuffling')
 parser.add_argument('--resume', default='', type=str,
                     help='checkpoint path to resume from (reference has no resume)')
+parser.add_argument('--lr', default=0.0, type=float,
+                    help='base learning rate (0 = optimizer default: '
+                         '0.1 sgd / 1e-3 lamb, the reference values)')
+parser.add_argument('--lr_schedule', default='multistep',
+                    choices=['multistep', 'cosine'],
+                    help='multistep = reference MultiStepLR([60,80], 0.1); '
+                         'cosine = cosine decay to 0 over --epochs with '
+                         '--warmup_epochs linear warmup')
+parser.add_argument('--warmup_epochs', default=0, type=int,
+                    help='linear LR warmup epochs (cosine schedule only)')
 parser.add_argument('--optimizer', default='sgd',
                     choices=['sgd', 'lamb', 'sgd_fused'],
                     help='sgd = reference config (main.py:51-55); lamb = '
@@ -113,7 +123,7 @@ def main(args):
     from pytorch_multiprocessing_distributed_tpu.train import (
         create_train_state, load_checkpoint)
     from pytorch_multiprocessing_distributed_tpu.train.optim import (
-        multistep_lr, sgd)
+        cosine_lr, multistep_lr, sgd)
     from pytorch_multiprocessing_distributed_tpu.train.trainer import Trainer
 
     dist.init_process()
@@ -168,11 +178,23 @@ def main(args):
     # optimizer + schedule — default is the exact reference config
     # (main.py:51-59); the alternatives are the model-layer extension
     # seam BASELINE configs #4/#5 train through
+    def make_schedule(base_default):
+        base = args.lr or base_default
+        if args.lr_schedule == "cosine":
+            return cosine_lr(base, args.epochs,
+                             warmup_epochs=args.warmup_epochs)
+        if args.warmup_epochs:
+            raise ValueError(
+                "--warmup_epochs applies to --lr_schedule cosine (the "
+                "reference's MultiStepLR has no warmup)"
+            )
+        return multistep_lr(base, milestones=[60, 80], gamma=0.1)
+
     if args.optimizer == "lamb":
         from pytorch_multiprocessing_distributed_tpu.train.lamb import lamb
 
         optimizer = lamb(
-            learning_rate=multistep_lr(1e-3, milestones=[60, 80], gamma=0.1),
+            learning_rate=make_schedule(1e-3),
             weight_decay=0.0001,
         )
     elif args.optimizer == "sgd_fused":
@@ -188,14 +210,14 @@ def main(args):
             sgd_pallas)
 
         optimizer = sgd_pallas(
-            learning_rate=multistep_lr(0.1, milestones=[60, 80], gamma=0.1),
+            learning_rate=make_schedule(0.1),
             momentum=0.9,
             weight_decay=0.0001,
             nesterov=True,
         )
     else:
         optimizer = sgd(
-            learning_rate=multistep_lr(0.1, milestones=[60, 80], gamma=0.1),
+            learning_rate=make_schedule(0.1),
             momentum=0.9,
             weight_decay=0.0001,
             nesterov=True,

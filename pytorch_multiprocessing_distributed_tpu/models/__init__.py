@@ -22,7 +22,7 @@ from .resnet import (
     ResNet101,
     ResNet152,
 )
-from .registry import get_model, MODEL_REGISTRY
+from .registry import get_model, LM_MODELS, MODEL_REGISTRY
 # importing the zoo modules also registers their CLI names
 from .vgg import VGG, VGG11, VGG13, VGG16, VGG19
 from .densenet import DenseNet, DenseNet121, DenseNetBC100
@@ -48,7 +48,3 @@ __all__ = [
     "GPT", "GPT_Small", "GPT_Medium", "GPT_Tiny", "LM_MODELS",
 ]
 
-# LM families train through train/lm.py (next-token loss over [B, S]
-# tokens), not the image CLI trainer; main.py uses this set to fail
-# loudly instead of crashing downstream on image-shaped inputs.
-LM_MODELS = frozenset({"gpt_small", "gpt_medium", "gpt_tiny"})

@@ -24,11 +24,24 @@ MODEL_REGISTRY: Dict[str, Callable[..., Any]] = {
     "resnet152": resnet.ResNet152,
 }
 
-def register(name: str):
-    """Decorator: add a model constructor under ``name``."""
+# Names registered with ``lm=True`` — language models that train on
+# token sequences through train/lm.py, not the image CLI. Kept HERE, at
+# the registration site, so a new LM family cannot forget to mark
+# itself (main.py consults this set to fail loudly).
+LM_MODELS: set = set()
+
+
+def register(name: str, lm: bool = False):
+    """Decorator: add a model constructor under ``name``.
+
+    ``lm=True`` marks the name as a language model (token-sequence
+    input); the image CLI rejects those with a pointer to train/lm.py.
+    """
 
     def deco(fn):
         MODEL_REGISTRY[name] = fn
+        if lm:
+            LM_MODELS.add(name)
         return fn
 
     return deco

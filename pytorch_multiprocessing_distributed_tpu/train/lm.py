@@ -136,7 +136,16 @@ def make_lm_train_step(
 
 def create_lm_train_state(model, rng, sample_tokens,
                           optimizer: Transform) -> TrainState:
-    """LM twin of :func:`..train.create_train_state` (no batch stats)."""
+    """LM twin of :func:`..train.create_train_state` (no batch stats).
+
+    Accepts a sequence-parallel model directly: ``seq_axis`` changes no
+    parameter shapes but DOES make the forward call collectives
+    (``axis_index``/``psum``) that have no bound axis at init time, so
+    initialization runs on an axis-free clone. ``sample_tokens`` is the
+    GLOBAL ``[B, S]`` batch either way.
+    """
+    if getattr(model, "seq_axis", None) is not None:
+        model = model.clone(seq_axis=None)
     variables = model.init(rng, sample_tokens, train=False)
     params = variables["params"]
     return TrainState(

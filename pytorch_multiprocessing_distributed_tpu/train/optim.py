@@ -75,6 +75,41 @@ def multistep_lr(
     return schedule
 
 
+def cosine_lr(
+    base_lr: float, total_epochs: int, warmup_epochs: int = 0,
+    min_lr: float = 0.0,
+) -> Schedule:
+    """Cosine decay with optional linear warmup (epoch-indexed, like the
+    reference's per-epoch MultiStepLR; epochs count from 1).
+
+    ``lr(e) = min_lr + (base - min_lr) * (1 + cos(pi * t)) / 2`` with
+    ``t = (e - warmup - 1) / (total - warmup)`` — torch
+    ``CosineAnnealingLR`` indexing: the FIRST post-warmup epoch trains
+    at ``base`` and the LAST trains just above ``min_lr`` (never AT it —
+    the trainer sets the epoch before training, so mapping the final
+    epoch to t=1 would spend a whole epoch at lr=min, doing nothing at
+    the default min_lr=0). During warmup the LR ramps linearly from
+    ``base/warmup`` to ``base``.
+    """
+    if total_epochs < 1:
+        raise ValueError(f"total_epochs must be >= 1, got {total_epochs}")
+    if not 0 <= warmup_epochs < total_epochs:
+        raise ValueError(
+            f"warmup_epochs must be in [0, total_epochs), got "
+            f"{warmup_epochs} of {total_epochs}"
+        )
+
+    def schedule(epoch) -> jax.Array:
+        e = jnp.asarray(epoch, jnp.float32)
+        warm = base_lr * e / jnp.maximum(warmup_epochs, 1)
+        span = jnp.maximum(total_epochs - warmup_epochs, 1)
+        t = jnp.clip((e - warmup_epochs - 1) / span, 0.0, 1.0)
+        cos = min_lr + (base_lr - min_lr) * 0.5 * (1 + jnp.cos(jnp.pi * t))
+        return jnp.where(e <= warmup_epochs, warm, cos)
+
+    return schedule
+
+
 def sgd(
     learning_rate: ScalarOrSchedule = 0.1,
     momentum: float = 0.9,

@@ -107,3 +107,47 @@ def test_sgd_jittable():
     params2, state2 = step(params, state, {"w": jnp.ones((3,))})
     assert params2["w"].shape == (3,)
     assert int(state2.count) == 1
+
+
+def test_cosine_lr_matches_torch():
+    """cosine_lr(warmup=0) at epoch e == torch CosineAnnealingLR at step
+    e (same closed form); warmup ramps linearly and joins continuously."""
+    import torch
+
+    from pytorch_multiprocessing_distributed_tpu.train.optim import cosine_lr
+
+    base, total, eta_min = 0.4, 90, 0.004
+    sched = cosine_lr(base, total, warmup_epochs=0, min_lr=eta_min)
+    m = torch.nn.Linear(1, 1)
+    opt = torch.optim.SGD(m.parameters(), lr=base)
+    tsched = torch.optim.lr_scheduler.CosineAnnealingLR(
+        opt, T_max=total, eta_min=eta_min
+    )
+    for e in range(1, total + 1):
+        # epoch e trains at torch's lr after e-1 scheduler steps (the
+        # final epoch is ABOVE eta_min — a full epoch at lr=min would
+        # do no useful work)
+        assert float(sched(e)) == pytest.approx(
+            tsched.get_last_lr()[0], rel=1e-5, abs=1e-7  # f32 cos
+        ), e
+        opt.step()
+        tsched.step()
+    assert float(sched(total)) > eta_min
+    assert float(sched(total + 1)) == pytest.approx(eta_min, rel=1e-5)
+
+
+def test_cosine_lr_warmup():
+    from pytorch_multiprocessing_distributed_tpu.train.optim import cosine_lr
+
+    sched = cosine_lr(0.8, 100, warmup_epochs=5)
+    # linear ramp: base * e / warmup
+    for e in range(1, 6):
+        assert float(sched(e)) == pytest.approx(0.8 * e / 5, rel=1e-6)
+    # continuous at the joint (first cosine epoch trains at base),
+    # decays after, final epoch small but nonzero
+    assert float(sched(5)) == pytest.approx(0.8, rel=1e-6)
+    assert float(sched(6)) == pytest.approx(0.8, rel=1e-6)
+    assert float(sched(7)) < 0.8
+    assert 0.0 < float(sched(100)) < 0.001
+    with pytest.raises(ValueError, match="warmup_epochs"):
+        cosine_lr(0.1, 10, warmup_epochs=10)
