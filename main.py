@@ -80,7 +80,15 @@ parser.add_argument('--remat', action='store_true',
                          'chip could not otherwise hold')
 parser.add_argument('--seed', default=0, type=int, help='init/seed for params and shuffling')
 parser.add_argument('--resume', default='', type=str,
-                    help='checkpoint path to resume from (reference has no resume)')
+                    help="checkpoint path to resume from, or 'auto' = "
+                         "latest model_*.pth in --save_path (reference "
+                         "has no resume)")
+parser.add_argument('--save_every', default=0, type=int,
+                    help='checkpoint every N epochs (0 = reference '
+                         'behavior: final epoch only)')
+parser.add_argument('--keep_checkpoints', default=0, type=int,
+                    help='retain only the K newest periodic checkpoints '
+                         '(0 = keep all)')
 parser.add_argument('--lr', default=0.0, type=float,
                     help='base learning rate (0 = optimizer default: '
                          '0.1 sgd / 1e-3 lamb, the reference values)')
@@ -231,6 +239,14 @@ def main(args):
         ema=args.ema > 0,
     )
     start_epoch = 1
+    if args.resume == "auto":
+        from pytorch_multiprocessing_distributed_tpu.train.checkpoint import (
+            resolve_auto_resume)
+
+        args.resume = resolve_auto_resume(args.save_path) or ""
+        if not args.resume and dist.is_primary():
+            print(f"--resume auto: no checkpoint under {args.save_path}; "
+                  "starting fresh")
     if args.resume:
         state = load_checkpoint(args.resume, state)
         # continue the epoch series (LR schedule + log numbering) from
@@ -261,6 +277,8 @@ def main(args):
         loss_fn=loss_fn,
         clip_grad_norm=args.clip_grad_norm or None,
         ema_decay=args.ema or None,
+        save_every=args.save_every,
+        keep_checkpoints=args.keep_checkpoints,
     )
     if args.profile:
         from pytorch_multiprocessing_distributed_tpu.utils.profiler import trace

@@ -100,17 +100,69 @@ def load_checkpoint(path: str, template: TrainState) -> TrainState:
     return serialization.from_state_dict(template, state_dict)
 
 
-def latest_checkpoint(save_path: str) -> Optional[str]:
-    """Highest-epoch ``model_*.pth`` under ``save_path``, if any."""
-    best, best_epoch = None, -1
+def _checkpoint_epochs(save_path: str):
+    """``[(epoch, filename), ...]`` for every parseable ``model_*.pth``
+    under ``save_path`` — the ONE place the naming scheme is decoded
+    (prune/latest/auto-resume all consume this)."""
+    found = []
     if not os.path.isdir(save_path):
-        return None
+        return found
     for name in os.listdir(save_path):
         if name.startswith("model_") and name.endswith(".pth"):
             try:
-                epoch = int(name[len("model_") : -len(".pth")])
+                found.append((int(name[len("model_"):-len(".pth")]), name))
             except ValueError:
                 continue
-            if epoch > best_epoch:
-                best, best_epoch = name, epoch
-    return os.path.join(save_path, best) if best else None
+    return found
+
+
+def prune_checkpoints(save_path: str, keep: int) -> None:
+    """Delete all but the ``keep`` highest-epoch ``model_*.pth`` files.
+
+    Primary-host-only callers (the trainer gates this like the writes);
+    ``keep <= 0`` disables pruning. Removes the LISTED filename (never a
+    reconstructed one — ``model_007.pth`` parses to epoch 7 but is not
+    named ``model_7.pth``).
+    """
+    if keep <= 0:
+        return
+    for _, name in sorted(_checkpoint_epochs(save_path))[:-keep]:
+        os.remove(os.path.join(save_path, name))
+
+
+def latest_checkpoint(save_path: str) -> Optional[str]:
+    """Highest-epoch ``model_*.pth`` under ``save_path``, if any."""
+    found = _checkpoint_epochs(save_path)
+    return os.path.join(save_path, max(found)[1]) if found else None
+
+
+def resolve_auto_resume(save_path: str) -> Optional[str]:
+    """Multi-host-safe ``--resume auto``: the PRIMARY host's latest
+    checkpoint decides for everyone.
+
+    Resolving independently per host can silently disagree (workers with
+    a host-local save_path see no files, start at epoch 1, and the
+    per-epoch save collectives then deadlock against the primary's
+    shifted epoch range). The primary's epoch is broadcast; every other
+    host must find the same file locally or fail loudly — ``--resume
+    auto`` across hosts requires a shared filesystem.
+    """
+    found = _checkpoint_epochs(save_path)
+    my_epoch = max(found)[0] if found else 0
+    if jax.process_count() == 1:
+        return latest_checkpoint(save_path) if found else None
+    from jax.experimental import multihost_utils
+
+    epoch = int(multihost_utils.broadcast_one_to_all(my_epoch))
+    if epoch == 0:
+        return None
+    match = [name for e, name in found if e == epoch]
+    if not match:
+        raise FileNotFoundError(
+            f"--resume auto: primary host resolved epoch {epoch} but "
+            f"this host (rank {dist.get_rank()}) has no matching "
+            f"model_*.pth under {save_path} — auto-resume across hosts "
+            "requires save_path on a SHARED filesystem (or pass an "
+            "explicit --resume path)"
+        )
+    return os.path.join(save_path, match[0])

@@ -39,7 +39,7 @@ from ..parallel import dist
 from ..parallel.mesh import MODEL_AXIS
 from ..utils import AverageMeter, Logger
 from ..utils.plotting import draw_plot
-from .checkpoint import save_checkpoint
+from .checkpoint import prune_checkpoints, save_checkpoint
 from .state import TrainState
 from .step import (
     make_eval_step,
@@ -74,6 +74,8 @@ class Trainer:
         loss_fn=None,
         clip_grad_norm=None,
         ema_decay=None,
+        save_every: int = 0,
+        keep_checkpoints: int = 0,
     ):
         self.mesh = mesh
         self.state = state
@@ -86,6 +88,10 @@ class Trainer:
         # the log-row numbering) instead of restarting at 1 — the resume
         # path the reference lacks entirely.
         self.start_epoch = start_epoch
+        # periodic checkpointing (0 = reference behavior: final epoch
+        # only, main.py:75-77) with optional keep-K retention
+        self.save_every = save_every
+        self.keep_checkpoints = keep_checkpoints
         # evaluate/checkpoint with EMA weights when tracking is on
         self.ema_decay = ema_decay
         from ..ops.losses import cross_entropy_loss
@@ -127,11 +133,14 @@ class Trainer:
             self.state = self.state.replace(epoch=jnp.asarray(epoch, jnp.int32))
             self.train_epoch(epoch)
             self.validate(epoch, mode="test")
-            if epoch == self.epochs:
+            periodic = self.save_every and epoch % self.save_every == 0
+            if epoch == self.epochs or periodic:
                 # EVERY host calls this: the sharded-state gather inside
                 # is a collective; save_checkpoint itself gates the
                 # actual write on the primary (checkpoint.py).
                 save_checkpoint(self.save_path, self.state, epoch)
+                if dist.is_primary():
+                    prune_checkpoints(self.save_path, self.keep_checkpoints)
         if dist.is_primary():
             draw_plot(self.save_path)
         return self.state

@@ -237,3 +237,43 @@ class TestCheckpointCompat:
             jax.tree.leaves(jax.device_get(state.ema_params)),
         ):
             np.testing.assert_allclose(a, b)
+
+
+class TestCheckpointRetention:
+    def test_prune_keeps_newest(self, tmp_path):
+        from pytorch_multiprocessing_distributed_tpu.train.checkpoint import (
+            checkpoint_path,
+            prune_checkpoints,
+        )
+
+        for e in (1, 2, 3, 7, 10):
+            open(checkpoint_path(str(tmp_path), e), "wb").write(b"x")
+        (tmp_path / "model_bad.pth").write_bytes(b"x")  # ignored
+        prune_checkpoints(str(tmp_path), keep=2)
+        left = sorted(p.name for p in tmp_path.glob("model_*.pth"))
+        assert left == ["model_10.pth", "model_7.pth", "model_bad.pth"]
+        prune_checkpoints(str(tmp_path), keep=0)  # 0 = keep everything
+        assert len(list(tmp_path.glob("model_*.pth"))) == 3
+
+    def test_prune_removes_listed_names(self, tmp_path):
+        """Zero-padded names parse but must be removed by their ACTUAL
+        filename, not a reconstructed one."""
+        from pytorch_multiprocessing_distributed_tpu.train.checkpoint import (
+            prune_checkpoints,
+        )
+
+        for name in ("model_007.pth", "model_8.pth", "model_9.pth"):
+            (tmp_path / name).write_bytes(b"x")
+        prune_checkpoints(str(tmp_path), keep=2)
+        left = sorted(p.name for p in tmp_path.glob("model_*.pth"))
+        assert left == ["model_8.pth", "model_9.pth"]
+
+    def test_resolve_auto_resume_single_host(self, tmp_path):
+        from pytorch_multiprocessing_distributed_tpu.train.checkpoint import (
+            resolve_auto_resume,
+        )
+
+        assert resolve_auto_resume(str(tmp_path)) is None
+        (tmp_path / "model_3.pth").write_bytes(b"x")
+        (tmp_path / "model_11.pth").write_bytes(b"x")
+        assert resolve_auto_resume(str(tmp_path)).endswith("model_11.pth")
