@@ -5,7 +5,9 @@ benchmark the framework is judged on. Configs mirror BASELINE.json:
 ``resnet50_imagenet`` (config #2, THE NORTH STAR and the default: global
 batch 256, 224x224, bf16), ``resnet18_cifar`` (config #1),
 ``resnet152_imagenet`` (config #3), ``vit_b16_imagenet`` (config #4) and
-``convnext_lamb`` (config #5, large-batch LAMB stress).
+``convnext_lamb`` (config #5, large-batch LAMB stress); ``gpt_lm``
+(beyond BASELINE's five) measures the GPT/flash-attention LM path in
+tokens/sec/chip.
 
 Robustness contract (round-1 failure was an ``UNAVAILABLE`` at backend
 bring-up with rc=1 and no output): backend init is retried with backoff,
@@ -93,7 +95,21 @@ CONFIGS = {
         model="convnext_t", image_size=224, batch=256, num_classes=21841,
         stem=None, optimizer="lamb",
     ),
+    # LM / long-context flagship (beyond BASELINE's five): GPT-2 small
+    # through the Pallas causal flash kernel; tokens/sec/chip.
+    "gpt_lm": dict(
+        lm=True, model="gpt_small", seq_len=1024, batch=8,
+    ),
 }
+
+
+def metric_for(config: str):
+    """(metric_name, unit) for a config — the ONE place the naming
+    lives; the success and error paths must emit the same strings (the
+    baseline record is keyed by them)."""
+    if CONFIGS.get(config, {}).get("lm"):
+        return f"{config}_train_tokens_per_sec_per_chip", "tokens/sec/chip"
+    return f"{config}_train_images_per_sec_per_chip", "images/sec/chip"
 
 
 def _log(msg: str) -> None:
@@ -228,32 +244,53 @@ def run_bench(config: str, dtype_name: str, batch_size: int,
     mesh = make_mesh(n_dev, devices=devices)
     dtype = jnp.bfloat16 if dtype_name == "bfloat16" else jnp.float32
     batch = batch_size or cfg["batch"]
+    is_lm = bool(cfg.get("lm"))
     if not is_tpu:
         # CPU fallback is a liveness signal, not a perf number — shrink
         # so the line still appears in bounded time.
-        batch = min(batch, 8 * n_dev)
+        batch = min(batch, (1 if is_lm else 8) * n_dev)
         min_window, warmup = min(min_window, 0.2), min(warmup, 2)
     if batch % n_dev:
         batch += n_dev - batch % n_dev  # keep the data axis even
-    s = cfg["image_size"]
-
-    model = models.get_model(
-        cfg["model"], dtype=dtype, bn_axis="data",
-        num_classes=cfg["num_classes"], stem=cfg["stem"],
-    )
-    opt = (lamb(learning_rate=1e-3) if cfg.get("optimizer") == "lamb"
-           else sgd(learning_rate=0.1))
-    state = create_train_state(
-        model, jax.random.PRNGKey(0), jnp.zeros((2, s, s, 3)), opt
-    )
-    step = make_train_step(model, opt, mesh, remat=remat)
-
     rng = np.random.default_rng(0)
-    x = jnp.asarray(rng.normal(size=(batch, s, s, 3)), jnp.float32)
-    y = jnp.asarray(rng.integers(0, cfg["num_classes"], (batch,)))
-    xb, yb = shard_batch((x, y), mesh)
 
-    step, flops = compile_step(step, state, xb, yb)
+    if is_lm:
+        from pytorch_multiprocessing_distributed_tpu.train.lm import (
+            create_lm_train_state, make_lm_train_step)
+
+        s = cfg["seq_len"]
+        if not is_tpu:
+            s = min(s, 64)  # interpret-mode flash kernel: liveness only
+        model = models.get_model(cfg["model"], dtype=dtype,
+                                 max_seq_len=max(s, 1024))
+        opt = sgd(learning_rate=0.1)
+        tokens = jnp.asarray(
+            rng.integers(0, model.vocab_size, (batch, s))
+        )
+        state = create_lm_train_state(
+            model, jax.random.PRNGKey(0), tokens[:2], opt
+        )
+        step = make_lm_train_step(model, opt, mesh, remat=remat)
+        batch_args = shard_batch((tokens,), mesh)
+        items_per_step = batch * s  # tokens
+    else:
+        s = cfg["image_size"]
+        model = models.get_model(
+            cfg["model"], dtype=dtype, bn_axis="data",
+            num_classes=cfg["num_classes"], stem=cfg["stem"],
+        )
+        opt = (lamb(learning_rate=1e-3) if cfg.get("optimizer") == "lamb"
+               else sgd(learning_rate=0.1))
+        state = create_train_state(
+            model, jax.random.PRNGKey(0), jnp.zeros((2, s, s, 3)), opt
+        )
+        step = make_train_step(model, opt, mesh, remat=remat)
+        x = jnp.asarray(rng.normal(size=(batch, s, s, 3)), jnp.float32)
+        y = jnp.asarray(rng.integers(0, cfg["num_classes"], (batch,)))
+        batch_args = shard_batch((x, y), mesh)
+        items_per_step = batch  # images
+
+    step, flops = compile_step(step, state, *batch_args)
 
     from pytorch_multiprocessing_distributed_tpu.utils.profiler import sync
 
@@ -266,17 +303,17 @@ def run_bench(config: str, dtype_name: str, batch_size: int,
 
     def window(state, n: int):
         """Drain the queue, then time n steps ending in a D2H readback."""
-        state, m = step(state, xb, yb)
+        state, m = step(state, *batch_args)
         readback(m)  # queue now empty: the clock can't absorb old work
         t0 = time.perf_counter()
         for _ in range(n):
-            state, m = step(state, xb, yb)
+            state, m = step(state, *batch_args)
         loss = readback(m)
         return time.perf_counter() - t0, state, loss
 
     _log(f"warmup x{warmup}")
     for _ in range(max(1, warmup)):
-        state, metrics = step(state, xb, yb)
+        state, metrics = step(state, *batch_args)
     readback(metrics)
 
     # Grow the window until it spans >= min_window seconds of real wall
@@ -334,17 +371,16 @@ def run_bench(config: str, dtype_name: str, batch_size: int,
     # throttling — fixed_readback would be negative) the slope is the
     # PESSIMISTIC estimate and is kept; the fallback never swaps in the
     # smaller number.
-    images_per_sec = batch / step_s
-    per_chip = images_per_sec / n_dev
+    per_chip = items_per_step / step_s / n_dev
     peak = chip_peak_flops(devices[0])
     mfu = None
     if flops and peak:
         mfu = round(flops / step_s / peak, 4)
 
     result = {
-        "metric": f"{config}_train_images_per_sec_per_chip",
+        "metric": metric_for(config)[0],
         "value": round(per_chip, 2),
-        "unit": "images/sec/chip",
+        "unit": metric_for(config)[1],
         "mfu": mfu,
         "extra": {
             "config": config,
@@ -363,8 +399,11 @@ def run_bench(config: str, dtype_name: str, batch_size: int,
             # always parses even when training diverged
             "final_loss": loss2 if math.isfinite(loss2) else repr(loss2),
             # canonical = the config's own batch/dtype (what the baseline
-            # record may be written from; ad-hoc flag runs never claim it)
-            "canonical": (batch == cfg["batch"] and dtype_name == "bfloat16"
+            # record may be written from; ad-hoc flag runs never claim
+            # it). Keyed on the REQUEST (batch_size==0), not the final
+            # batch: mesh-alignment rounding of the config's own batch
+            # must not bar a config from ever recording a baseline.
+            "canonical": (batch_size == 0 and dtype_name == "bfloat16"
                           and is_tpu and not remat),
             "remat": remat,
             "flops_per_step_per_chip": flops,
@@ -445,9 +484,9 @@ def main():
     except BaseException as e:  # noqa: BLE001 — the JSON line must appear
         _log(traceback.format_exc())
         result = {
-            "metric": f"{args.config}_train_images_per_sec_per_chip",
+            "metric": metric_for(args.config)[0],
             "value": 0.0,
-            "unit": "images/sec/chip",
+            "unit": metric_for(args.config)[1],
             "mfu": None,
             "error": f"{type(e).__name__}: {e}",
         }
