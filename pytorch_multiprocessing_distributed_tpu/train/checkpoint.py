@@ -148,13 +148,15 @@ def resolve_auto_resume(save_path: str) -> Optional[str]:
     auto`` across hosts requires a shared filesystem.
     """
     found = _checkpoint_epochs(save_path)
-    my_epoch = max(found)[0] if found else 0
+    # -1 = no checkpoint: epoch 0 is LEGAL (the preemption handler saves
+    # model_0.pth when interrupted during epoch 1)
+    my_epoch = max(found)[0] if found else -1
     if jax.process_count() == 1:
         return latest_checkpoint(save_path) if found else None
     from jax.experimental import multihost_utils
 
     epoch = int(multihost_utils.broadcast_one_to_all(my_epoch))
-    if epoch == 0:
+    if epoch < 0:
         return None
     match = [name for e, name in found if e == epoch]
     if not match:

@@ -125,8 +125,81 @@ class Trainer:
 
     # ------------------------------------------------------------- epochs
 
+    def _install_preemption_handler(self):
+        """SIGTERM -> checkpoint-and-exit at the next metrics window.
+
+        TPU preemptions/maintenance deliver SIGTERM to every host of the
+        slice; instead of dying mid-step, the hot loop notices the flag
+        at its next fetch boundary, saves a checkpoint that resumes at
+        the INTERRUPTED epoch (the partial epoch is redone — its steps
+        are not individually recoverable), and exits cleanly. Installed
+        only in the main thread of the main interpreter; a prior handler
+        is chained so external supervisors still see the signal.
+        """
+        import signal
+        import threading
+
+        if threading.current_thread() is not threading.main_thread():
+            return
+        self._preempted = False
+        prev = signal.getsignal(signal.SIGTERM)
+
+        def handler(signum, frame):
+            self._preempted = True
+            if callable(prev) and prev not in (
+                signal.SIG_IGN, signal.SIG_DFL, handler
+            ):
+                prev(signum, frame)
+
+        signal.signal(signal.SIGTERM, handler)
+
+    def _checkpoint_if_preempted(self, epoch: int) -> None:
+        """Called at metrics-window boundaries inside the hot loop.
+
+        Multi-host: the local SIGTERM flag is AGREED across hosts first
+        (signal delivery skews by milliseconds; a host branching into
+        the save collectives while another dispatches the next train
+        step would deadlock the slice — the exact failure this feature
+        exists to avoid). Any host's flag preempts everyone.
+        """
+        preempted = bool(getattr(self, "_preempted", False))
+        if jax.process_count() > 1:
+            import numpy as _np
+            from jax.experimental import multihost_utils
+
+            flags = multihost_utils.process_allgather(
+                _np.int32(preempted)
+            )
+            preempted = bool(flags.max())
+        if not preempted:
+            return
+        if dist.is_primary():
+            print(
+                f"SIGTERM received: checkpointing at epoch {epoch} "
+                f"(resume redoes the interrupted epoch) and exiting"
+            )
+        # resume continues AT `epoch`: load_checkpoint restores
+        # state.epoch and main.py starts from state.epoch + 1. If a
+        # REAL end-of-epoch checkpoint for epoch-1 already exists
+        # (--save_every), keep it: overwriting it with mid-epoch state
+        # would destroy a clean artifact for zero resume benefit.
+        from .checkpoint import checkpoint_path
+
+        target = checkpoint_path(self.save_path, epoch - 1)
+        if os.path.exists(target):
+            if dist.is_primary():
+                print(f"keeping existing {target} (same resume point)")
+        else:
+            save_checkpoint(
+                self.save_path,
+                self.state.replace(epoch=jnp.asarray(epoch - 1, jnp.int32)),
+                epoch - 1,
+            )
+        raise SystemExit(0)
+
     def fit(self) -> TrainState:
         """The reference's epoch loop (``main.py:67-82``)."""
+        self._install_preemption_handler()
         for epoch in range(self.start_epoch, self.epochs + 1):
             # LR schedule is a function of the epoch carried in the state
             # (uniform across replicas — fixed vs reference main.py:69-70).
@@ -167,6 +240,7 @@ class Trainer:
             # step's dispatch overlaps this one's execution.
             pending.append(metrics)
             if i % self.print_freq == 0 or i == n_batches - 1:
+                self._checkpoint_if_preempted(epoch)
                 fetched = jax.device_get(pending)  # the sync point
                 for m in fetched:
                     losses.update(float(m["loss"]), int(m["count"]))

@@ -196,3 +196,66 @@ def test_cli_sgd_fused_matches_sgd(tmp_path):
     assert sgd_loss == pytest.approx(fused_loss, rel=0.25), (
         f"fused SGD diverged from reference SGD: {logs}"
     )
+
+
+@pytest.mark.slow
+def test_cli_sigterm_checkpoints_and_resumes(tmp_path):
+    """Preemption drill: SIGTERM mid-training must produce a clean exit
+    with a resumable checkpoint (trainer._checkpoint_if_preempted), and
+    --resume auto must pick it up and finish the run."""
+    import signal
+    import time as _time
+
+    save = tmp_path / "run"
+    env = dict(
+        os.environ,
+        PMDT_FORCE_CPU_DEVICES="8",
+        PMDT_SMALL_SYNTH="512",
+    )
+    cmd = [
+        sys.executable, "main.py",
+        "--batch_size", "64",
+        "--epochs", "3",
+        "--world_size", "8",
+        "--synthetic",
+        "--print-freq", "1",
+        "--save_path", str(save),
+    ]
+    # stderr merged into stdout: a separate undrained stderr pipe can
+    # fill and deadlock the child before "Epoch: [2]" ever prints
+    proc = subprocess.Popen(
+        cmd, cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    # wait for epoch 2 to start (epoch 1 completed), then preempt
+    deadline = _time.time() + 600
+    seen_epoch2 = False
+    lines = []
+    while _time.time() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        lines.append(line)
+        if "Epoch: [2]" in line:
+            seen_epoch2 = True
+            proc.send_signal(signal.SIGTERM)
+            break
+    assert seen_epoch2, "".join(lines)[-3000:]
+    out, _ = proc.communicate(timeout=600)
+    assert proc.returncode == 0, ("".join(lines) + out)[-3000:]
+    assert "SIGTERM received: checkpointing at epoch 2" in (
+        "".join(lines) + out
+    )
+    # epoch 1 is the last COMPLETED epoch -> model_1.pth
+    assert (save / "model_1.pth").exists()
+
+    # resume auto finishes epochs 2..3
+    done = subprocess.run(
+        cmd + ["--resume", "auto"], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=900,
+    )
+    assert done.returncode == 0, done.stderr[-3000:]
+    assert "Resumed from" in done.stdout
+    assert (save / "model_3.pth").exists()
+    rows = (save / "train.log").read_text().splitlines()
+    assert [r.split()[0] for r in rows] == ["0001", "0002", "0003"]
