@@ -34,6 +34,7 @@ from flax import linen as nn
 
 from ..ops.pallas.flash_attention import flash_attention
 from ..parallel.ring_attention import ring_attention
+from ..parallel.ulysses import ulysses_attention
 from .registry import register
 
 dense_init = nn.initializers.normal(stddev=0.02)
@@ -43,6 +44,7 @@ class CausalSelfAttention(nn.Module):
     num_heads: int
     dtype: Any = jnp.float32
     seq_axis: Optional[str] = None
+    sp_mode: str = "ring"  # "ring" (K/V rotation) | "ulysses" (all-to-all)
 
     @nn.compact
     def __call__(self, x):
@@ -59,9 +61,17 @@ class CausalSelfAttention(nn.Module):
         q, k, v = heads(q), heads(k), heads(v)
         if self.seq_axis is not None:
             # sequence sharded over the mesh: exact causal attention
-            # over GLOBAL positions via the K/V ring
-            out = ring_attention(q, k, v, axis_name=self.seq_axis,
-                                 causal=True)
+            # over GLOBAL positions — K/V ring, or Ulysses all-to-all
+            # head re-partition (needs heads % axis_size == 0)
+            if self.sp_mode not in ("ring", "ulysses"):
+                raise ValueError(
+                    f"sp_mode must be 'ring' or 'ulysses', got "
+                    f"{self.sp_mode!r} (a typo would otherwise silently "
+                    "benchmark the wrong strategy)"
+                )
+            attn = (ulysses_attention if self.sp_mode == "ulysses"
+                    else ring_attention)
+            out = attn(q, k, v, axis_name=self.seq_axis, causal=True)
         else:
             out = flash_attention(q, k, v, causal=True)
         out = out.reshape(b, s, d_model)
@@ -74,12 +84,14 @@ class Block(nn.Module):
     mlp_dim: int
     dtype: Any = jnp.float32
     seq_axis: Optional[str] = None
+    sp_mode: str = "ring"
 
     @nn.compact
     def __call__(self, x):
         h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
         x = x + CausalSelfAttention(
-            self.num_heads, self.dtype, self.seq_axis, name="attn"
+            self.num_heads, self.dtype, self.seq_axis, self.sp_mode,
+            name="attn"
         )(h)
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
         h = nn.Dense(self.mlp_dim, dtype=self.dtype,
@@ -103,6 +115,7 @@ class GPT(nn.Module):
     mlp_dim: int = 3072
     dtype: Any = jnp.float32
     seq_axis: Optional[str] = None
+    sp_mode: str = "ring"  # "ring" | "ulysses" (used when seq_axis set)
     bn_axis: Optional[str] = None  # unused (no BN); registry parity
 
     @nn.compact
@@ -142,7 +155,7 @@ class GPT(nn.Module):
         x = embed[tokens].astype(self.dtype) + pos_slice.astype(self.dtype)
         for i in range(self.num_layers):
             x = Block(self.num_heads, self.mlp_dim, self.dtype,
-                      self.seq_axis, name=f"block_{i}")(x)
+                      self.seq_axis, self.sp_mode, name=f"block_{i}")(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
         logits = nn.Dense(self.vocab_size, dtype=jnp.float32,
                           kernel_init=dense_init, name="head")(x)

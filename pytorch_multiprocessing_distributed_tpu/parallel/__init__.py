@@ -20,6 +20,7 @@ from .collectives import (
 )
 from .sampler import DistributedShardSampler
 from .ring_attention import ring_attention
+from .ulysses import ulysses_attention
 from .pipeline import pipeline_apply
 from .dist import (
     barrier,
@@ -44,6 +45,7 @@ __all__ = [
     "reduce_tensor",
     "DistributedShardSampler",
     "ring_attention",
+    "ulysses_attention",
     "pipeline_apply",
     "init_process",
     "destroy_process_group",

@@ -140,7 +140,7 @@ class Trainer:
         import threading
 
         if threading.current_thread() is not threading.main_thread():
-            return
+            return None
         self._preempted = False
         prev = signal.getsignal(signal.SIGTERM)
 
@@ -152,6 +152,7 @@ class Trainer:
                 prev(signum, frame)
 
         signal.signal(signal.SIGTERM, handler)
+        return prev
 
     def _checkpoint_if_preempted(self, epoch: int) -> None:
         """Called at metrics-window boundaries inside the hot loop.
@@ -199,21 +200,34 @@ class Trainer:
 
     def fit(self) -> TrainState:
         """The reference's epoch loop (``main.py:67-82``)."""
-        self._install_preemption_handler()
-        for epoch in range(self.start_epoch, self.epochs + 1):
-            # LR schedule is a function of the epoch carried in the state
-            # (uniform across replicas — fixed vs reference main.py:69-70).
-            self.state = self.state.replace(epoch=jnp.asarray(epoch, jnp.int32))
-            self.train_epoch(epoch)
-            self.validate(epoch, mode="test")
-            periodic = self.save_every and epoch % self.save_every == 0
-            if epoch == self.epochs or periodic:
-                # EVERY host calls this: the sharded-state gather inside
-                # is a collective; save_checkpoint itself gates the
-                # actual write on the primary (checkpoint.py).
-                save_checkpoint(self.save_path, self.state, epoch)
-                if dist.is_primary():
-                    prune_checkpoints(self.save_path, self.keep_checkpoints)
+        prev_handler = self._install_preemption_handler()
+        try:
+            for epoch in range(self.start_epoch, self.epochs + 1):
+                # LR schedule is a function of the epoch carried in the
+                # state (uniform across replicas — fixed vs reference
+                # main.py:69-70).
+                self.state = self.state.replace(
+                    epoch=jnp.asarray(epoch, jnp.int32)
+                )
+                self.train_epoch(epoch)
+                self.validate(epoch, mode="test")
+                periodic = self.save_every and epoch % self.save_every == 0
+                if epoch == self.epochs or periodic:
+                    # EVERY host calls this: the sharded-state gather
+                    # inside is a collective; save_checkpoint itself
+                    # gates the actual write on the primary.
+                    save_checkpoint(self.save_path, self.state, epoch)
+                    if dist.is_primary():
+                        prune_checkpoints(
+                            self.save_path, self.keep_checkpoints
+                        )
+        finally:
+            # a caller's process must not permanently swallow SIGTERM
+            # after training ends
+            if prev_handler is not None:
+                import signal
+
+                signal.signal(signal.SIGTERM, prev_handler)
         if dist.is_primary():
             draw_plot(self.save_path)
         return self.state

@@ -211,6 +211,10 @@ def test_cli_sigterm_checkpoints_and_resumes(tmp_path):
         os.environ,
         PMDT_FORCE_CPU_DEVICES="8",
         PMDT_SMALL_SYNTH="512",
+        # the polling loop below reads lines in real time; piped stdout
+        # is otherwise block-buffered and "Epoch: [2]" could sit in the
+        # child's buffer past the SIGTERM window
+        PYTHONUNBUFFERED="1",
     )
     cmd = [
         sys.executable, "main.py",
