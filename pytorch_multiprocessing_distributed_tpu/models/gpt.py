@@ -3,7 +3,9 @@
 The reference repo is a vision trainer with no attention anywhere
 (SURVEY.md §5 marks long-context "absent by construction"); this family
 is the framework's long-context flagship — the model-level consumer of
-the two attention paths the kernel layer provides:
+the two attention paths the kernel layer provides (and, with
+``n_experts > 0``, of the Switch-style MoE feed-forward — the
+expert-parallel seam):
 
 - single shard: the Pallas causal flash kernel
   (:func:`..ops.pallas.flash_attention` — [S, S] logits never touch
@@ -32,6 +34,7 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
+from ..ops.moe import MoEMlp
 from ..ops.pallas.flash_attention import flash_attention
 from ..parallel.ring_attention import ring_attention
 from ..parallel.ulysses import ulysses_attention
@@ -85,6 +88,8 @@ class Block(nn.Module):
     dtype: Any = jnp.float32
     seq_axis: Optional[str] = None
     sp_mode: str = "ring"
+    n_experts: int = 0  # > 0: Switch-style MoE feed-forward (EP seam)
+    expert_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, x):
@@ -94,11 +99,21 @@ class Block(nn.Module):
             name="attn"
         )(h)
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
-        h = nn.Dense(self.mlp_dim, dtype=self.dtype,
-                     kernel_init=dense_init, name="fc1")(h)
-        h = nn.gelu(h)
-        h = nn.Dense(x.shape[-1], dtype=self.dtype,
-                     kernel_init=dense_init, name="fc2")(h)
+        if self.n_experts > 0:
+            # sparse feed-forward: top-1 routed experts (ops.MoEMlp —
+            # expert weights shard over ``expert_axis`` under GSPMD via
+            # shard_expert_params; replicated under plain shard_map DP)
+            h = MoEMlp(
+                n_experts=self.n_experts, d_hidden=self.mlp_dim,
+                expert_axis=self.expert_axis, dtype=self.dtype,
+                name="moe",
+            )(h)
+        else:
+            h = nn.Dense(self.mlp_dim, dtype=self.dtype,
+                         kernel_init=dense_init, name="fc1")(h)
+            h = nn.gelu(h)
+            h = nn.Dense(x.shape[-1], dtype=self.dtype,
+                         kernel_init=dense_init, name="fc2")(h)
         return x + h
 
 
@@ -116,6 +131,8 @@ class GPT(nn.Module):
     dtype: Any = jnp.float32
     seq_axis: Optional[str] = None
     sp_mode: str = "ring"  # "ring" | "ulysses" (used when seq_axis set)
+    n_experts: int = 0  # > 0: MoE feed-forward in every block
+    expert_axis: Optional[str] = None
     bn_axis: Optional[str] = None  # unused (no BN); registry parity
 
     @nn.compact
@@ -155,7 +172,8 @@ class GPT(nn.Module):
         x = embed[tokens].astype(self.dtype) + pos_slice.astype(self.dtype)
         for i in range(self.num_layers):
             x = Block(self.num_heads, self.mlp_dim, self.dtype,
-                      self.seq_axis, self.sp_mode, name=f"block_{i}")(x)
+                      self.seq_axis, self.sp_mode, self.n_experts,
+                      self.expert_axis, name=f"block_{i}")(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
         logits = nn.Dense(self.vocab_size, dtype=jnp.float32,
                           kernel_init=dense_init, name="head")(x)

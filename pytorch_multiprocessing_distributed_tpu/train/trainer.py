@@ -50,6 +50,9 @@ from .step import (
 )
 
 
+_HANDLER_NOT_INSTALLED = object()  # signal handler sentinel (see fit)
+
+
 class Trainer:
     """Drives the compiled steps over epochs, reproducing the reference CLI
     trainer's observable behavior (``main.py:32-84``)."""
@@ -140,8 +143,11 @@ class Trainer:
         import threading
 
         if threading.current_thread() is not threading.main_thread():
-            return None
+            return _HANDLER_NOT_INSTALLED
         self._preempted = False
+        # NB getsignal() returns None for a handler installed from C —
+        # still a value we must RESTORE (hence the distinct sentinel
+        # for the not-installed case above)
         prev = signal.getsignal(signal.SIGTERM)
 
         def handler(signum, frame):
@@ -224,10 +230,15 @@ class Trainer:
         finally:
             # a caller's process must not permanently swallow SIGTERM
             # after training ends
-            if prev_handler is not None:
+            if prev_handler is not _HANDLER_NOT_INSTALLED:
                 import signal
 
-                signal.signal(signal.SIGTERM, prev_handler)
+                # None = prior handler lives in C and is invisible to
+                # Python; SIG_DFL at least lets TERM terminate again
+                signal.signal(
+                    signal.SIGTERM,
+                    signal.SIG_DFL if prev_handler is None else prev_handler,
+                )
         if dist.is_primary():
             draw_plot(self.save_path)
         return self.state

@@ -117,3 +117,24 @@ def test_sp_matches_dp():
         jax.tree.leaves(jax.device_get(s_sp.params)),
     ):
         np.testing.assert_allclose(a, b, rtol=3e-4, atol=3e-6)
+
+
+def test_gpt_moe_trains():
+    """n_experts > 0 swaps every block's MLP for the Switch MoE; the
+    routed model must train (DP) and expose per-expert weights."""
+    mesh = make_mesh(4, devices=jax.devices()[:4])
+    model = models.GPT_Tiny(num_layers=2, n_experts=4)
+    opt = sgd(learning_rate=0.05, momentum=0.9, weight_decay=0.0,
+              nesterov=False)
+    tok = _tokens(2)
+    state = create_lm_train_state(model, jax.random.PRNGKey(0), tok, opt)
+    # expert-indexed weights exist: [E, d, hidden]
+    w1 = state.params["block_0"]["moe"]["w1"]
+    assert w1.shape[0] == 4
+    step = make_lm_train_step(model, opt, mesh)
+    losses = []
+    for _ in range(10):
+        state, m = step(state, tok)
+        losses.append(float(m["loss"]))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < 0.9 * losses[0], losses
