@@ -10,6 +10,7 @@ H2D analogue, SURVEY.md §2.2).
 
 from .cifar import load_cifar10, synthetic_cifar10
 from .transforms import normalize, random_crop_flip
+from .lm import TokenLoader, synthetic_tokens
 from .pipeline import ShardedLoader, get_loader, prefetch_to_device
 from .imagenet import (
     FolderImageNet,
@@ -20,6 +21,8 @@ from .imagenet import (
 )
 
 __all__ = [
+    "TokenLoader",
+    "synthetic_tokens",
     "load_cifar10",
     "synthetic_cifar10",
     "normalize",

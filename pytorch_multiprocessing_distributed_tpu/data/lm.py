@@ -1,0 +1,118 @@
+"""Token-stream data pipeline for language-model training.
+
+The LM counterpart of the image ``ShardedLoader`` (``pipeline.py``): a
+flat token stream is cut into fixed ``[batch, seq_len]`` windows and
+epoch-seed shuffled. Unlike ``ShardedLoader`` this loader yields the
+FULL global batch — the train step's ``P("data")`` in_spec does the
+replica sharding (single-host; multi-host per-host assembly would need
+``replica_ids`` parity with the image loader). No reference
+counterpart (the reference is vision-only); built for
+:func:`..train.lm.make_lm_train_step` /
+:class:`..models.gpt.GPT`.
+
+``synthetic_tokens`` generates a deterministic Zipf-ish stream so LM
+training is runnable data-free, mirroring ``--synthetic`` for images.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import numpy as np
+
+
+def synthetic_tokens(n: int, vocab_size: int = 257, seed: int = 0) -> np.ndarray:
+    """Deterministic pseudo-text: Zipf-distributed token stream.
+
+    Zipf rather than uniform so models exhibit realistic early loss
+    drops (frequent-token mass is learnable) — uniform streams plateau
+    at ``log(V)`` and make smoke-test learnability assertions flaky.
+    """
+    rng = np.random.default_rng(seed)
+    ranks = np.arange(1, vocab_size + 1, dtype=np.float64)
+    probs = (1.0 / ranks) / np.sum(1.0 / ranks)
+    return rng.choice(vocab_size, size=n, p=probs).astype(np.int32)
+
+
+class TokenLoader:
+    """Epoch iterator of ``[global_batch, seq_len]`` windows.
+
+    Windows are NON-overlapping contiguous slices of the stream
+    (window ``i`` = tokens ``[i*seq_len, (i+1)*seq_len + 1)`` is NOT
+    used — the next-token shift happens inside the train step, so plain
+    ``seq_len`` windows suffice). The final partial window is dropped
+    (an LM step needs full static shapes).
+
+    Args:
+      tokens: 1-D int array, the corpus.
+      batch_size: GLOBAL batch (split over ``world_size`` by the step's
+        sharding, like the image loader).
+      seq_len: tokens per sample.
+      world_size: data-axis size; ``batch_size`` must divide by it.
+      shuffle: epoch-seeded shuffle of window order.
+      drop_last: drop the ragged final batch (default True: static
+        shapes are what jit wants; False pads by wraparound like the
+        sampler so every batch is full).
+    """
+
+    def __init__(
+        self,
+        tokens: np.ndarray,
+        *,
+        batch_size: int,
+        seq_len: int,
+        world_size: int = 1,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = True,
+    ):
+        tokens = np.asarray(tokens)
+        if tokens.ndim != 1:
+            raise ValueError(f"tokens must be 1-D, got shape {tokens.shape}")
+        if batch_size % world_size:
+            raise ValueError(
+                f"global batch {batch_size} must divide by "
+                f"world_size {world_size}"
+            )
+        n_windows = len(tokens) // seq_len
+        if n_windows < batch_size:
+            raise ValueError(
+                f"corpus of {len(tokens)} tokens yields {n_windows} "
+                f"windows of {seq_len} — fewer than one global batch "
+                f"({batch_size})"
+            )
+        self.windows = tokens[: n_windows * seq_len].reshape(
+            n_windows, seq_len
+        )
+        self.batch_size = batch_size
+        self.seq_len = seq_len
+        self.world_size = world_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        """Reseed the shuffle (same contract as the image loader)."""
+        self.epoch = epoch
+
+    def __len__(self) -> int:
+        n = len(self.windows)
+        if self.drop_last:
+            return n // self.batch_size
+        return -(-n // self.batch_size)
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        order = np.arange(len(self.windows))
+        if self.shuffle:
+            np.random.default_rng(self.seed + self.epoch).shuffle(order)
+        n_batches = len(self)
+        for b in range(n_batches):
+            idx = order[b * self.batch_size : (b + 1) * self.batch_size]
+            if len(idx) < self.batch_size:
+                # wraparound padding (sampler semantics) for the ragged
+                # final batch when drop_last=False
+                idx = np.concatenate(
+                    [idx, order[: self.batch_size - len(idx)]]
+                )
+            yield self.windows[idx]

@@ -138,3 +138,71 @@ def test_gpt_moe_trains():
         losses.append(float(m["loss"]))
     assert all(np.isfinite(losses))
     assert losses[-1] < 0.9 * losses[0], losses
+
+
+class TestTokenLoader:
+    def test_windows_shuffle_and_len(self):
+        from pytorch_multiprocessing_distributed_tpu.data.lm import (
+            TokenLoader,
+            synthetic_tokens,
+        )
+
+        toks = synthetic_tokens(1000, vocab_size=50, seed=0)
+        assert toks.shape == (1000,) and toks.max() < 50
+        loader = TokenLoader(toks, batch_size=4, seq_len=16, world_size=4)
+        # 1000 // 16 = 62 windows; 62 // 4 = 15 full batches
+        assert len(loader) == 15
+        loader.set_epoch(1)
+        b1 = list(loader)
+        loader.set_epoch(2)
+        b2 = list(loader)
+        assert all(b.shape == (4, 16) for b in b1)
+        assert not np.array_equal(b1[0], b2[0])  # epoch reseeds
+        loader.set_epoch(1)
+        again = list(loader)
+        assert np.array_equal(b1[0], again[0])  # deterministic per epoch
+
+    def test_wraparound_padding_and_guards(self):
+        from pytorch_multiprocessing_distributed_tpu.data.lm import (
+            TokenLoader,
+            synthetic_tokens,
+        )
+
+        toks = synthetic_tokens(330, vocab_size=50)  # 20 windows of 16
+        padded = TokenLoader(toks, batch_size=8, seq_len=16,
+                             drop_last=False, shuffle=False)
+        batches = list(padded)
+        assert len(batches) == 3 and batches[-1].shape == (8, 16)
+        with pytest.raises(ValueError, match="divide"):
+            TokenLoader(toks, batch_size=6, seq_len=16, world_size=4)
+        with pytest.raises(ValueError, match="fewer than one"):
+            TokenLoader(toks[:40], batch_size=8, seq_len=16)
+
+    def test_trains_gpt_end_to_end(self):
+        """The full LM triad: synthetic corpus -> TokenLoader -> GPT ->
+        LM train step; loss must drop over two epochs."""
+        from pytorch_multiprocessing_distributed_tpu.data.lm import (
+            TokenLoader,
+            synthetic_tokens,
+        )
+
+        mesh = make_mesh(4, devices=jax.devices()[:4])
+        toks = synthetic_tokens(4096, vocab_size=257, seed=1)
+        loader = TokenLoader(toks, batch_size=8, seq_len=32, world_size=4)
+        model = models.GPT_Tiny(num_layers=2)
+        opt = sgd(learning_rate=0.05, momentum=0.9, weight_decay=0.0,
+                  nesterov=False)
+        state = create_lm_train_state(
+            model, jax.random.PRNGKey(0), jnp.zeros((2, 32), jnp.int32), opt
+        )
+        step = make_lm_train_step(model, opt, mesh)
+        losses = []
+        for epoch in (1, 2):
+            loader.set_epoch(epoch)
+            for batch in loader:
+                state, m = step(state, jnp.asarray(batch))
+                losses.append(float(m["loss"]))
+        assert all(np.isfinite(losses))
+        # Zipf-257's conditional entropy floor is ~4.3 nats; from ~5.1 the
+        # model closes most of the available gap in two epochs
+        assert np.mean(losses[-4:]) < 0.9 * np.mean(losses[:4]), losses
