@@ -1,0 +1,26 @@
+"""Shared helpers for the standalone benchmark scripts."""
+
+import os
+
+
+def apply_platform_env() -> None:
+    """Force ``JAX_PLATFORMS`` through ``jax.config`` before the first
+    device query.
+
+    In a fresh interpreter JAX honors the env var natively and this is
+    a no-op. It exists because some PJRT plugin environments initialize
+    their platform regardless of ``JAX_PLATFORMS`` once the backend
+    comes up (bench.py's ``init_devices`` documents the same behavior),
+    and a sick accelerator then hangs the whole script at the first
+    ``jax.devices()``. Setting the config before any backend init is
+    the reliable selector either way.
+
+    (``decode_bench.py`` deliberately does not call this: it never
+    imports jax — decode is pure PIL/numpy — so no backend can
+    initialize.)
+    """
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)

@@ -24,6 +24,7 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+import benchmarks._common as _common  # noqa: E402
 from pytorch_multiprocessing_distributed_tpu.parallel import make_mesh  # noqa: E402
 
 
@@ -79,7 +80,9 @@ def bench_ring(mesh, size_bytes: int, iters: int = 20) -> dict:
     )
 
 
+
 def main():
+    _common.apply_platform_env()
     p = argparse.ArgumentParser()
     p.add_argument("--sizes-mb", nargs="+", type=float, default=[1, 16, 64])
     p.add_argument("--iters", type=int, default=20)

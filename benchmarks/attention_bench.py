@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+import benchmarks._common as _common  # noqa: E402
 from pytorch_multiprocessing_distributed_tpu.ops.pallas.flash_attention import (
     flash_attention)
 from pytorch_multiprocessing_distributed_tpu.utils.profiler import sync
@@ -55,7 +56,9 @@ def timeit(fn, args, min_window=0.5):
         n = min(10_000, max(n + 1, int(n * 1.3 * min_window / dt)))
 
 
+
 def main():
+    _common.apply_platform_env()
     p = argparse.ArgumentParser()
     p.add_argument("--causal", action="store_true")
     p.add_argument("--dtype", default="bfloat16",
