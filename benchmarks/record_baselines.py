@@ -1,0 +1,78 @@
+"""Re-record every bench config's TPU baseline in one command.
+
+Runs ``bench.py`` once per config (canonical settings), sequentially,
+and stops early if the TPU backend is unavailable — the per-config
+JSON lines stream to stdout and ``benchmarks/baseline_record.json``
+updates via bench.py's own record logic (first valid canonical run per
+metric writes it; a slope-estimator run replaces a legacy whole-window
+record).
+
+Use after a measurement-methodology change or on new hardware:
+
+    python benchmarks/record_baselines.py [--configs a b c]
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    sys.path.insert(0, REPO)
+    from bench import CONFIGS  # noqa: E402
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--configs", nargs="+", default=sorted(CONFIGS),
+                   choices=sorted(CONFIGS))
+    args = p.parse_args()
+
+    rc = 0
+    for config in args.configs:
+        print(f"=== {config}", file=sys.stderr, flush=True)
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.join(REPO, "bench.py"),
+                 "--config", config],
+                cwd=REPO, capture_output=True, text=True, timeout=1800,
+            )
+        except subprocess.TimeoutExpired as e:
+            tail = (e.stderr or "")[-2000:] if isinstance(e.stderr, str) else ""
+            print(f"!! {config}: bench.py hung past 1800s — stopping "
+                  f"(sick backend?)", file=sys.stderr)
+            if tail:
+                print(tail, file=sys.stderr)
+            return 2
+        lines = proc.stdout.strip().splitlines() if proc.stdout else []
+        line = lines[-1] if lines else ""
+        print(line, flush=True)
+        try:
+            result = json.loads(line)
+        except json.JSONDecodeError:
+            print(f"!! {config}: no JSON line (rc={proc.returncode})",
+                  file=sys.stderr)
+            print(proc.stderr[-2000:], file=sys.stderr)
+            return 1
+        # an ERROR line (fenced {metric, value, error} with no extra)
+        # is a per-config failure: record it and keep going
+        if "error" in result:
+            print(f"!! {config}: {result['error']}", file=sys.stderr)
+            rc = 3
+            continue
+        extra = result.get("extra", {})
+        if extra.get("platform") != "tpu":
+            print(
+                f"!! {config} fell back to {extra.get('platform')} "
+                f"({extra.get('backend_note')}) — stopping: baselines "
+                "must be TPU numbers",
+                file=sys.stderr,
+            )
+            return 2
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
