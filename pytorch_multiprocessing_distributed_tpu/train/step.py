@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.losses import cross_entropy_loss, cross_entropy_per_sample
+from ..utils.metrics import topk_accuracy
 from ..parallel.mesh import DATA_AXIS, MODEL_AXIS
 from .optim import Transform, apply_updates
 from .state import TrainState
@@ -251,8 +252,11 @@ def make_eval_step(
       size is not divisible by world_size (SURVEY.md §3.5.3).
 
     Returns ``step(state, images, labels, valid) -> metrics`` with
-    ``metrics = {loss, correct, count, prec1}``; loss/correct/count are
-    masked sums over REAL samples only.
+    ``metrics = {loss, loss_sum, correct, correct5, count, prec1,
+    prec5}``; the sums/counts are masked sums over REAL samples only
+    (``correct5``/``prec5`` = top-5, the metric the reference's README
+    quotes but never computes — the trainer's stdout/log formats ignore
+    it for reference parity; library callers read it from the dict).
     """
 
     sharded = jax.shard_map(
@@ -288,20 +292,31 @@ def _eval_body(model, axis_name: Optional[str],
         per_sample_loss = per_sample(logits, labels)
         pred = jnp.argmax(logits, axis=-1)
         correct = jnp.sum((pred == labels).astype(jnp.float32) * w)
+        # top-5: the metric the reference's README quotes but its code
+        # never computes (README.md:13-17 vs main.py:129-130); provided
+        # at the metrics level, stdout/log formats stay reference-exact.
+        # The [maxk, batch] correctness matrix comes from the SAME
+        # jittable helper the meters use (utils/metrics.topk_accuracy).
+        k = min(5, logits.shape[-1])
+        _, correct_mat = topk_accuracy(logits, labels, topk=(k,))
+        in_top5 = jnp.any(correct_mat, axis=0)
+        correct5 = jnp.sum(in_top5.astype(jnp.float32) * w)
         loss_sum = jnp.sum(per_sample_loss * w)
         count = jnp.sum(w)
         if axis_name is not None:
-            loss_sum, correct, count = jax.lax.psum(
-                (loss_sum, correct, count), axis_name
+            loss_sum, correct, correct5, count = jax.lax.psum(
+                (loss_sum, correct, correct5, count), axis_name
             )
         metrics = {
             "loss_sum": loss_sum,
             "correct": correct.astype(jnp.int32),
+            "correct5": correct5.astype(jnp.int32),
             "count": count.astype(jnp.int32),
         }
         safe = jnp.maximum(metrics["count"], 1)
         metrics["loss"] = loss_sum / safe
         metrics["prec1"] = 100.0 * metrics["correct"] / safe
+        metrics["prec5"] = 100.0 * metrics["correct5"] / safe
         return metrics
 
     return body

@@ -180,3 +180,29 @@ def test_remat_matches_plain_trajectory():
             ls.append(float(m["loss"]))
         losses[remat] = ls
     np.testing.assert_allclose(losses[False], losses[True], rtol=1e-6)
+
+
+def test_eval_top5_metric(setup):
+    """correct5 counts labels inside the top-5 logits, masked and
+    psum-ed like correct; pinned against a numpy reference."""
+    mesh, model, opt, make_state, train_step, eval_step = setup
+    state = make_state()
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(16, 32, 32, 3)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 10, (16,)))
+    valid = jnp.ones(y.shape, bool)
+    xb, yb = shard_batch((x, y), mesh)
+    vb = shard_batch(valid, mesh)
+    m = eval_step(state, xb, yb, vb)
+
+    logits = np.asarray(model.apply(
+        {"params": state.params, "batch_stats": state.batch_stats},
+        x, train=False,
+    ))
+    top5 = np.argsort(logits, axis=-1)[:, -5:]
+    want5 = int(np.sum([y_i in t for y_i, t in zip(np.asarray(y), top5)]))
+    want1 = int(np.sum(np.argmax(logits, -1) == np.asarray(y)))
+    assert int(m["correct"]) == want1
+    assert int(m["correct5"]) == want5
+    assert int(m["correct5"]) >= int(m["correct"])
+    assert float(m["prec5"]) == pytest.approx(100.0 * want5 / 16)
