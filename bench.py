@@ -49,7 +49,8 @@ table (bf16 MXU numbers). Null on CPU or unknown hardware.
 
 ``vs_baseline``: the first VALID TPU run of each metric writes
 ``benchmarks/baseline_record.json``; later runs report against it.
-Before a record exists (or on error) it is 1.0.
+Before a record exists (or on error / CPU fallback / mismatched
+config) it is null — a non-comparison must never read as "on par".
 """
 
 import argparse
@@ -117,21 +118,58 @@ def _log(msg: str) -> None:
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
 
+def probe_backend(timeout: float):
+    """Probe backend bring-up in a SHORT-LIVED SUBPROCESS.
+
+    The round-3 failure mode: ``jax.devices()`` HANGS in-process
+    (observed: hours, after a killed bring-up wedges the axon tunnel),
+    and a hung init thread holds jax's global backend lock forever — one
+    wedged probe cost the whole round its TPU evidence. A subprocess
+    probe can neither wedge nor poison the parent: the parent only
+    initializes a backend the probe just proved healthy.
+
+    Returns (platform_or_None, err_note_or_None).
+    """
+    import subprocess
+
+    code = ("import jax, sys; ds = jax.devices(); "
+            "sys.stdout.write(ds[0].platform)")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"probe hung past {timeout:.0f}s"
+    except Exception as e:  # noqa: BLE001
+        return None, f"probe failed to launch: {e}"
+    if proc.returncode == 0 and proc.stdout.strip():
+        return proc.stdout.strip().splitlines()[-1], None
+    tail = (proc.stderr or "").strip().splitlines()
+    return None, (f"probe rc={proc.returncode}: "
+                  f"{tail[-1] if tail else 'no output'}")
+
+
 def init_devices(retries: int = 3, delay: float = 5.0):
     """Bring up the backend, surviving transient TPU-plugin failures AND
-    hangs (the round-1 bench died here with rc=1 and no JSON).
+    hangs (the round-1 bench died here with rc=1 and no JSON; round 3
+    lost its TPU evidence to a single in-process hang).
 
-    ``jax.devices()`` does not just raise on a sick TPU plugin — it can
-    HANG (observed: >500s inside axon bring-up). The init runs in a
-    watchdog thread so the healthy path pays exactly one bring-up:
+    Protocol:
 
-    - completes -> done;
-    - raises (e.g. UNAVAILABLE) -> retry with backoff, then in-process
-      CPU fallback via ``jax.config.update`` (env vars are too late —
-      the plugin initializes even under ``JAX_PLATFORMS=cpu``);
-    - times out -> the hung thread holds jax's global backend lock, so
-      NOTHING in this process can initialize any platform anymore:
-      re-exec ourselves once with ``--platform cpu``.
+    1. Probe bring-up in a subprocess (``probe_backend``) over a
+       multi-attempt budget — default 3 probes x 180 s each, spaced
+       60 s apart (env knobs: ``PMDT_BENCH_PROBE_TIMEOUT``,
+       ``PMDT_BENCH_PROBE_ATTEMPTS``, ``PMDT_BENCH_PROBE_DELAY``).
+       A transiently wedged tunnel gets minutes to recover instead of
+       one strike; a wedged probe dies with its subprocess.
+    2. Only after a probe reports a healthy non-CPU platform does the
+       PARENT initialize it — still under a watchdog thread with the
+       re-exec escape hatch, in case the backend wedges between probe
+       and init.
+    3. If every probe fails, fall back to CPU in-process via
+       ``jax.config.update`` — the parent never touched the sick
+       plugin, so this is safe and instant.
 
     Returns (devices, note) where note is None or a fallback explanation.
     """
@@ -140,6 +178,30 @@ def init_devices(retries: int = 3, delay: float = 5.0):
     import jax
 
     timeout = float(os.environ.get("PMDT_BENCH_PROBE_TIMEOUT", 180))
+    attempts = int(os.environ.get("PMDT_BENCH_PROBE_ATTEMPTS", retries))
+    probe_delay = float(os.environ.get("PMDT_BENCH_PROBE_DELAY", 60))
+    platform = None
+    probe_note = None
+    for attempt in range(max(1, attempts)):
+        platform, probe_note = probe_backend(timeout)
+        if platform is not None:
+            _log(f"backend probe ok (attempt {attempt + 1}): {platform}")
+            break
+        _log(f"backend probe attempt {attempt + 1}/{attempts} failed: "
+             f"{probe_note}")
+        if attempt + 1 < attempts:
+            _log(f"retrying probe in {probe_delay:.0f}s")
+            time.sleep(probe_delay)
+    if platform is None:
+        note = (f"TPU backend unavailable after {attempts} subprocess "
+                f"probes x {timeout:.0f}s ({probe_note}); CPU fallback")
+        _log(note)
+        jax.config.update("jax_platforms", "cpu")
+        return jax.devices(), note
+    if platform == "cpu":
+        # Probe came back healthy but CPU-only: no accelerator attached.
+        return jax.devices(), None
+
     last_err = None
     for attempt in range(retries):
         box = {}
@@ -507,7 +569,9 @@ def main():
                     rec = loaded
             except Exception:
                 rec = {}
-        vs = 1.0
+        # null (not 1.0) when no valid comparison happened: an error or
+        # CPU-fallback line must never read as "on par with baseline".
+        vs = None
         base = rec.get(result["metric"])
         if isinstance(base, (int, float)):  # legacy scalar format
             base = {"value": base}
@@ -569,7 +633,7 @@ def main():
             _log(f"recorded baseline for {result['metric']} -> {record_path}")
     except Exception as e:
         _log(f"baseline record handling failed (non-fatal): {e}")
-        result.setdefault("vs_baseline", 1.0)
+        result.setdefault("vs_baseline", None)
 
     print(json.dumps(result))
 

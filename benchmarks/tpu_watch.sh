@@ -1,0 +1,24 @@
+#!/bin/bash
+# Background TPU liveness watcher. Probes the backend in short-lived
+# subprocesses (a wedged probe cannot poison anything) and records the first
+# success to .tpu_alive so long-running work can react.
+# Usage: bash benchmarks/tpu_watch.sh [interval_seconds] [probe_timeout]
+INTERVAL=${1:-120}
+PROBE_TIMEOUT=${2:-150}
+cd "$(dirname "$0")/.." || exit 1
+rm -f .tpu_alive
+while true; do
+  if timeout "$PROBE_TIMEOUT" python -c "
+import jax
+ds = jax.devices()
+assert ds and ds[0].platform != 'cpu', ds
+print(len(ds), ds[0].device_kind)
+" > .tpu_probe_out 2> .tpu_probe_err; then
+    date -u +%FT%TZ > .tpu_alive
+    cat .tpu_probe_out >> .tpu_alive
+    echo "[tpu_watch] TPU alive: $(cat .tpu_probe_out)"
+    exit 0
+  fi
+  echo "[tpu_watch] $(date -u +%FT%TZ) probe failed/hung; retrying in ${INTERVAL}s"
+  sleep "$INTERVAL"
+done

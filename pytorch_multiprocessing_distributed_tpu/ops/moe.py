@@ -72,13 +72,33 @@ class MoEMlp(nn.Module):
             jnp.float32)
         b2 = self.param("b2", nn.initializers.zeros, (e, d), jnp.float32)
 
+        router_logits = x.astype(jnp.float32) @ wg  # [B, S, E]
         gates = jax.nn.softmax(
-            (x.astype(jnp.float32) @ wg), axis=-1
+            router_logits, axis=-1
         )  # [B, S, E] — routing math in f32 always
         expert = jnp.argmax(gates, axis=-1)  # [B, S]
         gate = jnp.max(gates, axis=-1)  # [B, S]
 
         onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)  # [B, S, E]
+
+        # Load-balancing auxiliary loss (Switch Transformer): E * <f, p>
+        # where f_e = fraction of tokens dispatched to expert e (hard,
+        # pre-capacity) and p_e = mean router probability of expert e.
+        # Minimized (= 1.0) at uniform routing; without it top-1 routing
+        # collapses onto a few experts in real training. Differentiable
+        # through p only (f is argmax-hard), which is exactly the Switch
+        # formulation. Sown under the "losses" collection — training
+        # steps read it via ``mutable=["losses"]`` and add
+        # ``weight * aux``; eval/apply without mutable discards it.
+        f = jnp.mean(onehot.reshape(-1, e), axis=0)  # [E]
+        p = jnp.mean(gates.reshape(-1, e), axis=0)  # [E]
+        self.sow("losses", "moe_aux", e * jnp.sum(f * p))
+        # Router z-loss (ST-MoE): mean logsumexp(logits)^2 keeps router
+        # logits small/stable in bf16 training.
+        self.sow(
+            "losses", "moe_z",
+            jnp.mean(jax.nn.logsumexp(router_logits, axis=-1) ** 2),
+        )
         # slot of each token within its expert (0-based), per batch row
         pos = jnp.cumsum(onehot, axis=1) * onehot  # [B, S, E], 1-based
         slot = (jnp.sum(pos, axis=-1) - 1.0).astype(jnp.int32)  # [B, S]

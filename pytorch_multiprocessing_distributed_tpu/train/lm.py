@@ -24,6 +24,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from flax.traverse_util import flatten_dict
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.losses import cross_entropy_per_sample
@@ -71,6 +72,8 @@ def make_lm_train_step(
     axis_name: str = DATA_AXIS,
     seq_axis: Optional[str] = None,
     remat: bool = False,
+    moe_aux_weight: float = 0.01,
+    moe_z_weight: float = 1e-3,
 ):
     """Build the jitted LM train step.
 
@@ -82,43 +85,75 @@ def make_lm_train_step(
 
     Returns ``step(state, tokens) -> (state, metrics)``; ``tokens`` is
     the global ``[B, S]`` int array, ``metrics = {loss, count}`` (loss =
-    exact mean next-token CE over all predictable positions).
+    exact mean next-token CE over all predictable positions). MoE models
+    (``n_experts > 0``) additionally train against the Switch
+    load-balancing aux loss and the ST-MoE router z-loss the layer sows
+    into its ``losses`` collection (``moe_aux_weight`` /
+    ``moe_z_weight``; metrics gain ``moe_aux``).
     """
     axes = (axis_name,) if seq_axis is None else (axis_name, seq_axis)
+    is_moe = getattr(model, "n_experts", 0) > 0
 
     def body(state: TrainState, tokens):
         targets, valid = _next_token_targets(tokens, seq_axis)
         w = valid.astype(jnp.float32)
+        # Constants wrt params, computed before differentiation: global
+        # predictable-position count and shard count (for layer-mean
+        # normalization of the per-shard aux losses).
+        count = jax.lax.psum(jnp.sum(w), axes)
+        world = jax.lax.psum(1, axes)
 
-        # Differentiate the LOCAL masked loss-SUM — deliberately no
-        # collective inside the differentiated function (transposing
-        # through psum under shard_map is a notorious factor-of-N trap;
-        # ring attention's own custom VJP handles its internal comms).
-        # Each shard's grad is then exactly its local contribution to
-        # d(global sum)/d(params); one explicit psum + one divide by the
-        # global count yields the exact global-mean gradient.
-        def local_loss_sum(params):
-            logits = model.apply({"params": params}, tokens, train=True)
+        # Differentiate a LOCAL objective — deliberately no collective
+        # inside the differentiated function (transposing through psum
+        # under shard_map is a notorious factor-of-N trap; ring
+        # attention's own custom VJP handles its internal comms). The
+        # local objective is pre-normalized (CE by the global count, aux
+        # by the shard count) so ONE psum of the local grads outside is
+        # exactly the global-mean gradient.
+        def local_obj(params):
+            logits, mut = model.apply(
+                {"params": params}, tokens, train=True, mutable=["losses"]
+            )
             flat_ce = cross_entropy_per_sample(
                 logits.reshape(-1, logits.shape[-1]), targets.reshape(-1)
             ).reshape(targets.shape)
-            return jnp.sum(flat_ce * w)
+            ce_sum = jnp.sum(flat_ce * w)
+            # sow appends (scalar,) tuples keyed moe_aux/moe_z, one path
+            # per MoE layer; mean over layers keeps the weight
+            # geometry-independent
+            flat = flatten_dict(mut.get("losses", {}))
+            aux_terms = [v for path, vals in flat.items()
+                         if path[-1] == "moe_aux"
+                         for v in jax.tree_util.tree_leaves(vals)]
+            z_terms = [v for path, vals in flat.items()
+                       if path[-1] == "moe_z"
+                       for v in jax.tree_util.tree_leaves(vals)]
+            aux = (sum(aux_terms) / len(aux_terms)
+                   if aux_terms else jnp.zeros((), jnp.float32))
+            z = (sum(z_terms) / len(z_terms)
+                 if z_terms else jnp.zeros((), jnp.float32))
+            obj = ce_sum / count + (
+                moe_aux_weight * aux + moe_z_weight * z
+            ) / world
+            return obj, (ce_sum, aux)
 
         if remat:
-            local_loss_sum = jax.checkpoint(local_loss_sum)
-        loss_sum, grads = jax.value_and_grad(local_loss_sum)(state.params)
-        count = jax.lax.psum(jnp.sum(w), axes)
+            local_obj = jax.checkpoint(local_obj)
+        (_, (loss_sum, aux)), grads = jax.value_and_grad(
+            local_obj, has_aux=True
+        )(state.params)
         loss = jax.lax.psum(loss_sum, axes) / count
-        grads = jax.tree.map(
-            lambda g: jax.lax.psum(g, axes) / count, grads
-        )
+        grads = jax.tree.map(lambda g: jax.lax.psum(g, axes), grads)
 
         updates, new_opt = optimizer.update(
             grads, state.opt_state, state.params, lr_step=state.epoch
         )
         new_params = apply_updates(state.params, updates)
         new_state = state.replace(params=new_params, opt_state=new_opt)
-        return new_state, {"loss": loss, "count": count}
+        metrics = {"loss": loss, "count": count}
+        if is_moe:
+            metrics["moe_aux"] = jax.lax.psum(aux, axes) / world
+        return new_state, metrics
 
     if seq_axis is None:
         in_specs = (P(), P(axis_name))
@@ -131,7 +166,28 @@ def make_lm_train_step(
         out_specs=(P(), P()),
         check_vma=False,
     )
-    return jax.jit(sharded, donate_argnums=(0,))
+    dp = int(mesh.shape[axis_name])
+    sp = int(mesh.shape[seq_axis]) if seq_axis is not None else 1
+
+    def checked(state, tokens):
+        # Trace-time shape validation (shapes are static under jit): a
+        # mismatched global batch must raise a framework-style error,
+        # not an opaque shard_map sharding failure — mirrors the image
+        # path's and TokenLoader's checks.
+        b, s = tokens.shape
+        if b % dp:
+            raise ValueError(
+                f"global batch {b} is not divisible by the data-axis "
+                f"size {dp} (mesh axis {axis_name!r})"
+            )
+        if seq_axis is not None and s % sp:
+            raise ValueError(
+                f"seq_len {s} is not divisible by the sequence-axis "
+                f"size {sp} (mesh axis {seq_axis!r})"
+            )
+        return sharded(state, tokens)
+
+    return jax.jit(checked, donate_argnums=(0,))
 
 
 def create_lm_train_state(model, rng, sample_tokens,

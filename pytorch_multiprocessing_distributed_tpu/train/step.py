@@ -107,11 +107,19 @@ def _train_body(model, optimizer: Transform, loss_fn: Callable,
             # loses its device-locality (GSPMD would silently insert an
             # all-to-all per microbatch — the cost this split avoids).
             if b % (grad_accum * dp_size):
-                per_dev = b // dp_size if axis_name is None else b
+                if axis_name is None:
+                    detail = (f"global batch {b}, data-parallel degree "
+                              f"{dp_size}")
+                    per_dev = b // dp_size
+                else:
+                    # shard_map body: b is already the PER-DEVICE batch
+                    detail = (f"per-device batch {b} as seen inside "
+                              f"shard_map; the global batch is b x "
+                              f"world_size")
+                    per_dev = b
                 raise ValueError(
                     f"per-device batch {per_dev} is not divisible by "
-                    f"grad_accum={grad_accum} (global batch {b}, "
-                    f"data-parallel degree {dp_size})"
+                    f"grad_accum={grad_accum} ({detail})"
                 )
 
             def to_micro(x):

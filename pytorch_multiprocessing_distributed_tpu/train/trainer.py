@@ -193,7 +193,22 @@ class Trainer:
         from .checkpoint import checkpoint_path
 
         target = checkpoint_path(self.save_path, epoch - 1)
-        if os.path.exists(target):
+        # The skip-vs-save decision must be UNIFORM across hosts: only
+        # the primary writes checkpoints, so with a non-shared save_path
+        # the file exists only there — a per-host os.path.exists would
+        # send the primary down the skip branch while workers enter
+        # save_checkpoint's gather collective, deadlocking the slice.
+        # The primary's verdict is broadcast (same pattern as
+        # resolve_auto_resume).
+        exists = os.path.exists(target)
+        if jax.process_count() > 1:
+            import numpy as _np
+            from jax.experimental import multihost_utils
+
+            exists = bool(
+                multihost_utils.broadcast_one_to_all(_np.int32(exists))
+            )
+        if exists:
             if dist.is_primary():
                 print(f"keeping existing {target} (same resume point)")
         else:
