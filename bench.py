@@ -199,7 +199,12 @@ def init_devices(retries: int = 3, delay: float = 5.0):
         jax.config.update("jax_platforms", "cpu")
         return jax.devices(), note
     if platform == "cpu":
-        # Probe came back healthy but CPU-only: no accelerator attached.
+        # Probe came back healthy but CPU-only (e.g. the plugin errored
+        # in the subprocess and jax fell back). PIN cpu before touching
+        # the backend: a bare jax.devices() here would re-initialize the
+        # possibly-sick accelerator plugin in the parent, unprotected —
+        # the exact hang this probe design exists to avoid.
+        jax.config.update("jax_platforms", "cpu")
         return jax.devices(), None
 
     last_err = None

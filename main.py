@@ -108,9 +108,23 @@ parser.add_argument('--optimizer', default='sgd',
 parser.add_argument('--profile', default='', type=str, metavar='LOGDIR',
                     help='capture a jax.profiler trace of the run into '
                          'LOGDIR (TensorBoard-loadable; off when empty)')
+parser.add_argument('--torch_export', action='store_true',
+                    help='additionally export the final weights as a '
+                         'torch-loadable state_dict '
+                         '(model_{epoch}.torch.pth, reference model '
+                         'naming; ResNet family only)')
 
 
 def main(args):
+    if args.torch_export and not (
+        args.model == "res" or args.model.startswith("resnet")
+    ):
+        # Fail BEFORE the training run, not after hours of work: the
+        # torch state_dict mapping covers the ResNet family only.
+        raise SystemExit(
+            f"--torch_export supports the ResNet family only "
+            f"(got --model {args.model})"
+        )
     # Backend selection must happen before device queries.
     if os.environ.get("PMDT_FORCE_CPU_DEVICES"):
         n = int(os.environ["PMDT_FORCE_CPU_DEVICES"])
@@ -287,6 +301,25 @@ def main(args):
             trainer.fit()
     else:
         trainer.fit()
+
+    if args.torch_export:
+        from pytorch_multiprocessing_distributed_tpu.train.checkpoint import (
+            _gather_for_host)
+        from pytorch_multiprocessing_distributed_tpu.utils.torch_interop import (
+            save_torch_checkpoint)
+
+        # COLLECTIVE gather first — under --zero1/--fsdp/--model_parallel
+        # the state is sharded across hosts, so every host must
+        # participate before the primary-only write (same contract as
+        # save_checkpoint).
+        params, batch_stats = _gather_for_host(
+            (trainer.state.params, trainer.state.batch_stats))
+        if dist.is_primary():
+            out = os.path.join(
+                args.save_path, f"model_{args.epochs}.torch.pth")
+            save_torch_checkpoint(
+                out, jax.device_get(params), jax.device_get(batch_stats))
+            print(f"Exported torch state_dict -> {out}")
 
     dist.destroy_process_group()
 

@@ -88,7 +88,25 @@ def load_checkpoint(path: str, template: TrainState) -> TrainState:
     non-EMA checkpoint serializes), the EMA is seeded from the
     checkpoint's TRAINED params — never from the template's fresh
     random init, which would poison every eval for ~1/(1-decay) steps.
+
+    Torch interop: a reference-trained ``model_{epoch}.pth`` is a torch
+    zip archive, not msgpack. Detected by magic and routed through
+    :mod:`..utils.torch_interop` — params + BN stats load, the
+    optimizer starts fresh (torch SGD momentum buffers don't map onto
+    this optimizer's tree), and the epoch keeps the template's value.
     """
+    import zipfile
+
+    if zipfile.is_zipfile(path):
+        from ..utils.torch_interop import load_torch_checkpoint
+
+        params, stats = load_torch_checkpoint(
+            path, template.params, template.batch_stats
+        )
+        state = template.replace(params=params, batch_stats=stats)
+        if getattr(template, "ema_params", None):
+            state = state.replace(ema_params=params)
+        return state
     with open(path, "rb") as f:
         payload = f.read()
     state_dict = serialization.msgpack_restore(payload)

@@ -1,0 +1,202 @@
+"""Torch ``state_dict`` interop for the ResNet family.
+
+The reference's artifact of record is a torch ``state_dict`` saved as
+``model_{epoch}.pth`` (reference ``main.py:75-77``) with the module
+naming of reference ``model/resnet.py``: ``conv1``/``bn1`` stem,
+``layer{1-4}.{i}.conv{1-3}`` / ``.bn{1-3}`` / ``.shortcut.{0,1}``
+blocks, ``linear`` head. This module maps that naming, layout and BN
+convention onto the framework's Flax trees in both directions, so
+
+- reference-trained torch weights load into this framework
+  (:func:`from_torch_state_dict` / :func:`load_torch_checkpoint`), and
+- framework-trained weights export to a torch-loadable ``.pth``
+  (:func:`to_torch_state_dict` / :func:`save_torch_checkpoint`) that a
+  user's existing torch tooling can read.
+
+Layout mapping (the TPU-native model is NHWC, torch is NCHW):
+
+====================  =======================  =====================
+framework (Flax)      torch                    transform
+====================  =======================  =====================
+conv ``kernel`` HWIO  ``*.weight`` OIHW        transpose (3, 2, 0, 1)
+dense ``kernel`` IO   ``linear.weight`` OI     transpose (1, 0)
+bn ``scale``          ``*.weight``             identity
+bn ``bias``           ``*.bias``               identity
+bn stats mean/var     ``running_mean``/``_var`` identity (f32)
+(none)                ``num_batches_tracked``  0 on export, ignored
+====================  =======================  =====================
+
+``torch`` itself is only required by the ``.pth`` save/load helpers
+(imported lazily); the pure-dict converters run anywhere.
+"""
+
+from __future__ import annotations
+
+import re
+from collections import OrderedDict
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# flax ConvBN child -> (torch conv prefix, torch bn prefix) inside a block
+_CB_TO_TORCH = {
+    "cb1": ("conv1", "bn1"),
+    "cb2": ("conv2", "bn2"),
+    "cb3": ("conv3", "bn3"),
+    "shortcut": ("shortcut.0", "shortcut.1"),
+}
+_LAYER_RE = re.compile(r"^layer(\d+)_(\d+)$")
+
+
+def _iter_convbn(params) -> Tuple[Tuple[Tuple[str, ...], str, str], ...]:
+    """Ordered ((flax path), torch conv prefix, torch bn prefix) triples.
+
+    Order follows the torch module's registration order (stem, then
+    layers by (stage, index), cb1/cb2[/cb3]/shortcut within a block) so
+    the exported ``state_dict`` iterates the way a torch user expects.
+    """
+    out = [(("stem",), "conv1", "bn1")]
+    layers = sorted(
+        (tuple(int(g) for g in m.groups()), name)
+        for name, m in ((n, _LAYER_RE.match(n)) for n in params)
+        if m
+    )
+    for (stage, idx), name in layers:
+        for cb in ("cb1", "cb2", "cb3", "shortcut"):
+            if cb in params[name]:
+                conv, bn = _CB_TO_TORCH[cb]
+                out.append(
+                    ((name, cb), f"layer{stage}.{idx}.{conv}",
+                     f"layer{stage}.{idx}.{bn}")
+                )
+    return tuple(out)
+
+
+def _get(tree, path):
+    for key in path:
+        tree = tree[key]
+    return tree
+
+
+def to_torch_state_dict(params, batch_stats) -> "OrderedDict[str, np.ndarray]":
+    """Flax (params, batch_stats) -> reference-convention state_dict.
+
+    Values are numpy f32 (int64 for ``num_batches_tracked``); pass the
+    result to ``torch.save`` directly or via :func:`save_torch_checkpoint`.
+    """
+    sd: "OrderedDict[str, np.ndarray]" = OrderedDict()
+    for path, conv, bn in _iter_convbn(params):
+        node = _get(params, path)
+        stats = _get(batch_stats, path)
+        sd[f"{conv}.weight"] = np.transpose(
+            np.asarray(node["conv"]["kernel"], np.float32), (3, 2, 0, 1)
+        )
+        sd[f"{bn}.weight"] = np.asarray(node["bn"]["scale"], np.float32)
+        sd[f"{bn}.bias"] = np.asarray(node["bn"]["bias"], np.float32)
+        sd[f"{bn}.running_mean"] = np.asarray(stats["bn"]["mean"], np.float32)
+        sd[f"{bn}.running_var"] = np.asarray(stats["bn"]["var"], np.float32)
+        sd[f"{bn}.num_batches_tracked"] = np.asarray(0, np.int64)
+    sd["linear.weight"] = np.transpose(
+        np.asarray(params["linear"]["kernel"], np.float32), (1, 0)
+    )
+    sd["linear.bias"] = np.asarray(params["linear"]["bias"], np.float32)
+    return sd
+
+
+def from_torch_state_dict(state_dict, params, batch_stats):
+    """Reference-convention state_dict -> (params, batch_stats).
+
+    ``params``/``batch_stats`` are templates (e.g. a fresh ``init``)
+    providing structure, shapes and dtypes; every template leaf must be
+    covered and every state_dict entry consumed (except
+    ``num_batches_tracked``) or a ``ValueError`` names the offenders —
+    a half-loaded model is worse than a loud failure.
+
+    Accepts torch tensors or numpy arrays as values (a raw
+    ``torch.load`` result works; DDP's ``module.`` prefix is stripped).
+    """
+    sd = {}
+    for key, value in state_dict.items():
+        if key.startswith("module."):  # DDP-wrapped save (reference's)
+            key = key[len("module."):]
+        if key.endswith("num_batches_tracked"):
+            continue
+        if hasattr(value, "detach"):  # torch tensor without importing torch
+            value = value.detach().cpu().numpy()
+        sd[key] = np.asarray(value)
+
+    used = set()
+
+    def take(key, like, transform=None):
+        if key not in sd:
+            raise ValueError(f"state_dict is missing {key!r}")
+        arr = sd[key]
+        if transform:
+            arr = transform(arr)
+        like = jnp.asarray(like)
+        if arr.shape != like.shape:
+            raise ValueError(
+                f"{key!r}: shape {arr.shape} does not match the model's "
+                f"{like.shape}"
+            )
+        used.add(key)
+        return jnp.asarray(arr, like.dtype)
+
+    new_params = jax.tree.map(lambda x: x, params)
+    new_stats = jax.tree.map(lambda x: x, batch_stats)
+
+    def set_(tree, path, value):
+        node = _get(tree, path[:-1])
+        node[path[-1]] = value
+
+    for path, conv, bn in _iter_convbn(params):
+        node = _get(params, path)
+        stats = _get(batch_stats, path)
+        set_(new_params, path + ("conv", "kernel"), take(
+            f"{conv}.weight", node["conv"]["kernel"],
+            lambda a: np.transpose(a, (2, 3, 1, 0)),
+        ))
+        set_(new_params, path + ("bn", "scale"),
+             take(f"{bn}.weight", node["bn"]["scale"]))
+        set_(new_params, path + ("bn", "bias"),
+             take(f"{bn}.bias", node["bn"]["bias"]))
+        set_(new_stats, path + ("bn", "mean"),
+             take(f"{bn}.running_mean", stats["bn"]["mean"]))
+        set_(new_stats, path + ("bn", "var"),
+             take(f"{bn}.running_var", stats["bn"]["var"]))
+    set_(new_params, ("linear", "kernel"), take(
+        "linear.weight", params["linear"]["kernel"],
+        lambda a: np.transpose(a, (1, 0)),
+    ))
+    set_(new_params, ("linear", "bias"),
+         take("linear.bias", params["linear"]["bias"]))
+
+    unused = sorted(set(sd) - used)
+    if unused:
+        raise ValueError(
+            f"state_dict entries not consumed by the model: {unused[:8]}"
+            + ("..." if len(unused) > 8 else "")
+        )
+    return new_params, new_stats
+
+
+def save_torch_checkpoint(path: str, params, batch_stats) -> str:
+    """Write a torch-loadable ``.pth`` (requires torch)."""
+    import torch
+
+    sd = OrderedDict(
+        (k, torch.from_numpy(np.ascontiguousarray(v)))
+        for k, v in to_torch_state_dict(params, batch_stats).items()
+    )
+    torch.save(sd, path)
+    return path
+
+
+def load_torch_checkpoint(path: str, params, batch_stats):
+    """Load a torch ``.pth`` state_dict into Flax trees (requires torch)."""
+    import torch
+
+    sd = torch.load(path, map_location="cpu", weights_only=True)
+    return from_torch_state_dict(sd, params, batch_stats)
