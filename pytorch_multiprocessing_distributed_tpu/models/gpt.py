@@ -48,6 +48,10 @@ class CausalSelfAttention(nn.Module):
     dtype: Any = jnp.float32
     seq_axis: Optional[str] = None
     sp_mode: str = "ring"  # "ring" (K/V rotation) | "ulysses" (all-to-all)
+    # "flash" = Pallas kernel (the TPU fast path); "xla" = plain masked
+    # softmax attention — same exact math, needed where Pallas can't run
+    # (e.g. inside a check_vma=True shard_map: the pipelined trainer)
+    attn_impl: str = "flash"
 
     @nn.compact
     def __call__(self, x):
@@ -75,6 +79,23 @@ class CausalSelfAttention(nn.Module):
             attn = (ulysses_attention if self.sp_mode == "ulysses"
                     else ring_attention)
             out = attn(q, k, v, axis_name=self.seq_axis, causal=True)
+        elif self.attn_impl not in ("flash", "xla"):
+            raise ValueError(
+                f"attn_impl must be 'flash' or 'xla', got "
+                f"{self.attn_impl!r} (a typo would otherwise silently "
+                "run the wrong kernel)"
+            )
+        elif self.attn_impl == "xla":
+            scale = head_dim ** -0.5
+            logits = jnp.einsum(
+                "bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                k.astype(jnp.float32)) * scale
+            mask = jnp.tril(jnp.ones((s, s), bool))
+            logits = jnp.where(mask, logits, -jnp.inf)
+            probs = jax.nn.softmax(logits, axis=-1)
+            out = jnp.einsum(
+                "bhqk,bkhd->bqhd", probs, v.astype(jnp.float32)
+            ).astype(q.dtype)
         else:
             out = flash_attention(q, k, v, causal=True)
         out = out.reshape(b, s, d_model)
@@ -90,13 +111,14 @@ class Block(nn.Module):
     sp_mode: str = "ring"
     n_experts: int = 0  # > 0: Switch-style MoE feed-forward (EP seam)
     expert_axis: Optional[str] = None
+    attn_impl: str = "flash"
 
     @nn.compact
     def __call__(self, x):
         h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
         x = x + CausalSelfAttention(
             self.num_heads, self.dtype, self.seq_axis, self.sp_mode,
-            name="attn"
+            attn_impl=self.attn_impl, name="attn"
         )(h)
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
         if self.n_experts > 0:

@@ -22,6 +22,13 @@ from .sampler import DistributedShardSampler
 from .ring_attention import ring_attention
 from .ulysses import ulysses_attention
 from .pipeline import pipeline_apply
+from .gpt_pipeline import (
+    PIPE_AXIS,
+    create_pipelined_lm_state,
+    make_pipelined_lm_train_step,
+    stack_pipeline_params,
+    unstack_pipeline_params,
+)
 from .dist import (
     barrier,
     destroy_process_group,

@@ -1,0 +1,334 @@
+"""Pipelined GPT training: heterogeneous stages over a ``pipe`` mesh axis.
+
+:mod:`.pipeline` provides the homogeneous GPipe primitive; a real LM is
+NOT homogeneous — it is embed -> N blocks -> head, and the embedding /
+head tables are among the largest tensors in the model. The torch way
+to pipeline this is per-stage ``nn.Module``\\ s with different code on
+different ranks. The TPU-native way, used here, keeps ONE SPMD program
+and makes every stage-heterogeneous tensor *sharded* over the pipe axis
+instead:
+
+- **embedding**: the vocab dimension is sharded over ``pipe``
+  (Megatron-style vocab-parallel lookup: each shard gathers the rows it
+  owns, one ``psum`` materializes the activation);
+- **blocks**: stacked ``[n_stages, layers_per_stage, ...]`` and sharded
+  over ``pipe`` — stage *s* holds only its own layers; microbatches flow
+  through :func:`.pipeline.pipeline_apply` (``ppermute`` ring, GPipe
+  schedule, differentiable scan);
+- **head**: output-vocab sharded over ``pipe``; the next-token loss is
+  computed vocab-parallel (local partial logits, ``pmax``/``psum``
+  log-sum-exp) so the full ``[B, S, V]`` logits tensor never
+  materializes anywhere.
+
+Every parameter therefore has exactly one resident shard per pipe
+stage (embed/head rows live where their slice lives), composing with
+data parallelism over the ``data`` axis — all in one jitted
+``shard_map`` with ``check_vma=True`` (required for correct collective
+AD transposes, see :mod:`.pipeline`).
+
+No reference counterpart (the reference is single-stage DDP,
+SURVEY.md §2.3); geometry validation mirrors :func:`.mesh.make_mesh`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .mesh import DATA_AXIS
+
+# NB: ..train imports stay function-local — parallel/__init__ re-exports
+# this module and ..train imports ..parallel, so a top-level import here
+# would cycle.
+
+PIPE_AXIS = "pipe"
+_LN_EPS = 1e-6  # flax nn.LayerNorm default, as used by the GPT family
+
+
+def _num_layers(params) -> int:
+    n = 0
+    while f"block_{n}" in params:
+        n += 1
+    return n
+
+
+def stack_pipeline_params(params, n_stages: int):
+    """GPT ``init`` params -> the pipe-shardable tree.
+
+    Returns a dict whose pipe-sharded leaves carry a leading
+    ``n_stages`` dim: ``embed`` ``[S, ceil(V/S), D]`` (vocab
+    row-sharded, zero-padded), ``blocks`` ``[S, L/S, ...]``, ``head_k``
+    ``[S, D, ceil(V/S)]`` / ``head_b`` ``[S, ceil(V/S)]`` (vocab
+    col-sharded; padded bias slots get ``-1e9`` so their softmax mass
+    is exactly zero). ``pos`` and ``ln_f`` are small and replicated.
+    """
+    num_layers = _num_layers(params)
+    if num_layers == 0:
+        raise ValueError("params has no block_<i> entries — not a GPT tree")
+    if num_layers % n_stages:
+        raise ValueError(
+            f"{num_layers} layers not divisible by n_stages={n_stages}"
+        )
+    per = num_layers // n_stages
+    blocks = jax.tree.map(
+        lambda *ls: jnp.stack(ls),
+        *[params[f"block_{i}"] for i in range(num_layers)],
+    )
+    blocks = jax.tree.map(
+        lambda l: l.reshape(n_stages, per, *l.shape[1:]), blocks
+    )
+
+    embed = params["embed"]  # [V, D]
+    vocab, d = embed.shape
+    vs = -(-vocab // n_stages)  # ceil
+    pad = n_stages * vs - vocab
+    embed = jnp.pad(embed, ((0, pad), (0, 0))).reshape(n_stages, vs, d)
+    head_k = params["head"]["kernel"]  # [D, V]
+    head_k = jnp.pad(head_k, ((0, 0), (0, pad)))
+    head_k = head_k.reshape(d, n_stages, vs).transpose(1, 0, 2)
+    head_b = jnp.pad(
+        params["head"]["bias"], (0, pad), constant_values=-1e9
+    ).reshape(n_stages, vs)
+
+    return {
+        "embed": embed,
+        # copy pass-through leaves: sharing buffers with the source tree
+        # would let a donating step on the SOURCE state delete them
+        "pos": jnp.array(params["pos_embed"], copy=True),
+        "blocks": blocks,
+        "ln_f": jax.tree.map(lambda l: jnp.array(l, copy=True),
+                             params["ln_final"]),
+        "head_k": head_k,
+        "head_b": head_b,
+    }
+
+
+def unstack_pipeline_params(pipe_params, vocab_size: int):
+    """Inverse of :func:`stack_pipeline_params` (checkpoint interop)."""
+    n_stages, vs, d = pipe_params["embed"].shape
+    blocks = pipe_params["blocks"]
+    any_leaf = jax.tree_util.tree_leaves(blocks)[0]
+    per = any_leaf.shape[1]
+    out = {
+        "embed": pipe_params["embed"].reshape(n_stages * vs, d)[:vocab_size],
+        "pos_embed": pipe_params["pos"],
+        "ln_final": pipe_params["ln_f"],
+        "head": {
+            "kernel": pipe_params["head_k"].transpose(1, 0, 2).reshape(
+                d, n_stages * vs)[:, :vocab_size],
+            "bias": pipe_params["head_b"].reshape(n_stages * vs)[:vocab_size],
+        },
+    }
+    for s in range(n_stages):
+        for j in range(per):
+            out[f"block_{s * per + j}"] = jax.tree.map(
+                lambda l: l[s, j], blocks
+            )
+    return out
+
+
+def pipeline_specs(pipe_params, pipe_axis: str = PIPE_AXIS):
+    """PartitionSpec tree matching :func:`stack_pipeline_params` output."""
+    return {
+        "embed": P(pipe_axis),
+        "pos": P(),
+        "blocks": jax.tree.map(lambda _: P(pipe_axis),
+                               pipe_params["blocks"]),
+        "ln_f": jax.tree.map(lambda _: P(), pipe_params["ln_f"]),
+        "head_k": P(pipe_axis),
+        "head_b": P(pipe_axis),
+    }
+
+
+def create_pipelined_lm_state(model, rng, sample_tokens,
+                              optimizer: "Transform",
+                              n_stages: int) -> "TrainState":
+    """Init the GPT normally, restack for the pipe axis, init optimizer
+    buffers on the stacked tree (so they shard identically)."""
+    from ..train.state import TrainState
+
+    if getattr(model, "n_experts", 0) > 0:
+        raise NotImplementedError(
+            "pipeline parallelism currently covers dense GPT blocks "
+            "(MoE routing state does not stack across stages)"
+        )
+    if getattr(model, "seq_axis", None) is not None:
+        model = model.clone(seq_axis=None)
+    variables = model.init(rng, sample_tokens, train=False)
+    params = stack_pipeline_params(variables["params"], n_stages)
+    return TrainState(
+        params=params,
+        batch_stats={},
+        opt_state=optimizer.init(params),
+        epoch=jnp.ones((), jnp.int32),
+    )
+
+
+def make_pipelined_lm_train_step(
+    model,
+    optimizer: "Transform",
+    mesh: Mesh,
+    *,
+    axis_name: str = DATA_AXIS,
+    pipe_axis: str = PIPE_AXIS,
+    n_microbatches: Optional[int] = None,
+):
+    """Build the jitted DP x PP LM train step.
+
+    Args:
+      model: a dense ``GPT`` (provides block geometry and dtype).
+      mesh: 2-D ``(data, pipe)`` mesh (either axis may be 1).
+      n_microbatches: GPipe microbatches per step (default: the pipe
+        axis size — the minimum that keeps every stage busy; more
+        shrinks the bubble fraction ``(S-1)/(M+S-1)`` further).
+
+    Returns ``step(state, tokens) -> (state, metrics)`` with ``state``
+    from :func:`create_pipelined_lm_state`; ``tokens`` is the global
+    ``[B, S]`` int array and ``metrics = {loss, count}`` matches
+    :func:`..train.lm.make_lm_train_step` (exact mean next-token CE).
+    """
+    from ..models.gpt import Block
+    from ..train.lm import _next_token_targets
+    from ..train.optim import OptState, apply_updates
+    from ..train.state import TrainState
+    from .pipeline import pipeline_apply
+
+    n_stages = int(mesh.shape[pipe_axis])
+    dp = int(mesh.shape[axis_name])
+    m = n_microbatches or n_stages
+    # attn_impl="xla": the Pallas flash kernel cannot declare vma for
+    # the check_vma=True shard_map this step REQUIRES (collective AD
+    # correctness, see .pipeline); plain masked attention is the same
+    # exact math.
+    block = Block(model.num_heads, model.mlp_dim, model.dtype,
+                  attn_impl="xla")
+
+    def body(state: TrainState, tokens):
+        targets, valid = _next_token_targets(tokens, None)
+        w = valid.astype(jnp.float32)
+        count = jax.lax.psum(jnp.sum(w), axis_name)
+        b, s = tokens.shape
+        if b % m:
+            raise ValueError(
+                f"per-replica batch {b} is not divisible by "
+                f"n_microbatches={m}"
+            )
+        i = jax.lax.axis_index(pipe_axis)
+
+        def local_obj(p):
+            # ---- vocab-parallel embedding (rows live on their stage)
+            emb = p["embed"][0]  # [Vs, D]
+            vs = emb.shape[0]
+            start = i * vs
+            idx = tokens - start
+            mine = jnp.logical_and(idx >= 0, idx < vs)
+            h = emb[jnp.clip(idx, 0, vs - 1)] * mine[..., None]
+            h = jax.lax.psum(h, pipe_axis)  # [B, S, D] on every stage
+            h = (h + p["pos"][:s]).astype(model.dtype)
+
+            # ---- GPipe over the block stages
+            micro = h.reshape(m, b // m, s, h.shape[-1])
+
+            def stage_fn(stage_params, x):
+                # stage_params leaves [L/S, ...]: scan this stage's layers
+                def layer(carry, lp):
+                    return block.apply({"params": lp}, carry), None
+
+                y, _ = jax.lax.scan(layer, x, stage_params)
+                return y
+
+            out = pipeline_apply(
+                stage_fn, p["blocks"], micro, axis_name=pipe_axis
+            )
+            h = out.reshape(b, s, -1).astype(jnp.float32)
+
+            # ---- final LN (replicated; flax LayerNorm convention)
+            mu = jnp.mean(h, -1, keepdims=True)
+            var = jnp.var(h, -1, keepdims=True)
+            h = (h - mu) * jax.lax.rsqrt(var + _LN_EPS)
+            h = h * p["ln_f"]["scale"] + p["ln_f"]["bias"]
+
+            # ---- vocab-parallel head + log-sum-exp CE: the [B, S, V]
+            # logits never materialize; each stage scores its vocab
+            # slice (padded slots carry bias -1e9 => zero mass). The
+            # matmul stays f32: the plain GPT head is f32-pinned
+            # (models/gpt.py nn.Dense(dtype=f32)) and trajectory parity
+            # must hold for bf16 models too.
+            logits = h @ p["head_k"][0] + p["head_b"][0]
+            # stop_gradient BEFORE pmax: the max-shift is numerical
+            # stabilization only (lse is shift-invariant) and pmax has
+            # no AD rule — its input must already carry a zero tangent
+            gmax = jax.lax.pmax(
+                jax.lax.stop_gradient(jnp.max(logits, -1)), pipe_axis
+            )
+            lse = jnp.log(jax.lax.psum(
+                jnp.sum(jnp.exp(logits - gmax[..., None]), -1), pipe_axis
+            )) + gmax
+            tidx = targets - start
+            tmine = jnp.logical_and(tidx >= 0, tidx < vs)
+            tlogit = jnp.take_along_axis(
+                logits, jnp.clip(tidx, 0, vs - 1)[..., None], -1
+            )[..., 0] * tmine
+            tlogit = jax.lax.psum(tlogit, pipe_axis)
+            ce_sum = jnp.sum((lse - tlogit) * w)
+            return ce_sum / count, ce_sum
+
+        (_, ce_sum), grads = jax.value_and_grad(
+            local_obj, has_aux=True
+        )(state.params)
+        # NO explicit grad psums here. Under check_vma=True the vma-aware
+        # AD transposes already reduce each cotangent over every mesh
+        # axis its parameter is INVARIANT along: pipe-sharded leaves come
+        # back data-summed, replicated leaves (pos, ln_f) come back
+        # summed over BOTH axes. An explicit psum on top multiplies the
+        # gradient by the axis size (verified empirically: 2x/8x updates
+        # on a (2, 4) mesh). This is the opposite convention from the
+        # check_vma=False steps elsewhere in train/, which must psum
+        # their local grads themselves.
+
+        updates, new_opt = optimizer.update(
+            grads, state.opt_state, state.params, lr_step=state.epoch
+        )
+        new_state = state.replace(
+            params=apply_updates(state.params, updates), opt_state=new_opt
+        )
+        loss = jax.lax.psum(ce_sum, axis_name) / count
+        return new_state, {"loss": loss, "count": count}
+
+    def specs_for(state):
+        # ONE source of truth for the param layout (pipeline_specs),
+        # mirrored onto the full TrainState pytree
+        ps = pipeline_specs(state.params, pipe_axis)
+        return TrainState(
+            params=ps,
+            batch_stats={},
+            opt_state=OptState(momentum=ps, count=P(), initialized=P()),
+            epoch=P(),
+        )
+
+    def step(state, tokens):
+        if state.params["embed"].shape[0] != n_stages:
+            raise ValueError(
+                f"state was stacked for "
+                f"{state.params['embed'].shape[0]} stages but the mesh "
+                f"{pipe_axis!r} axis has {n_stages} — create the state "
+                f"with n_stages matching the mesh"
+            )
+        if tokens.shape[0] % (dp * m):
+            raise ValueError(
+                f"global batch {tokens.shape[0]} must divide by "
+                f"data axis x n_microbatches = {dp} x {m}"
+            )
+        sspec = specs_for(state)
+        sharded = jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(sspec, P(axis_name)),
+            out_specs=(sspec, {"loss": P(), "count": P()}),
+        )
+        return sharded(state, tokens)
+
+    return jax.jit(step, donate_argnums=(0,))

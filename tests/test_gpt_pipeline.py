@@ -1,0 +1,142 @@
+"""Pipelined GPT training (DP x PP over a (data, pipe) mesh).
+
+The gold test: the pipelined step and the plain LM step produce the
+SAME loss trajectory from identical initial weights — pipelining is an
+execution strategy, not a different model. Plus: per-stage parameter
+residency (each device holds only its stage's slice), round-trip
+restacking, and geometry validation.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_multiprocessing_distributed_tpu import models
+from pytorch_multiprocessing_distributed_tpu.parallel import make_mesh
+from pytorch_multiprocessing_distributed_tpu.parallel.gpt_pipeline import (
+    create_pipelined_lm_state,
+    make_pipelined_lm_train_step,
+    stack_pipeline_params,
+    unstack_pipeline_params,
+)
+from pytorch_multiprocessing_distributed_tpu.train.lm import (
+    create_lm_train_state,
+    make_lm_train_step,
+)
+from pytorch_multiprocessing_distributed_tpu.train.optim import sgd
+from pytorch_multiprocessing_distributed_tpu.train.state import TrainState
+
+
+def _tokens(batch=16, seq=32):
+    model = models.get_model("gpt_tiny")
+    return model, jnp.asarray(
+        np.random.default_rng(0).integers(0, model.vocab_size, (batch, seq))
+    )
+
+
+def test_stack_round_trip():
+    model, tokens = _tokens()
+    params = model.init(jax.random.PRNGKey(0), tokens[:2])["params"]
+    stacked = stack_pipeline_params(params, 4)
+    assert stacked["embed"].shape[0] == 4
+    restored = unstack_pipeline_params(stacked, model.vocab_size)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)),
+        params, restored,
+    )
+
+
+def test_pipelined_loss_matches_plain_step():
+    """Same weights, same tokens: DP2 x PP4 pipelined trajectory ==
+    plain DP trajectory, step by step (forward AND gradients)."""
+    model, tokens = _tokens()
+    opt = sgd(learning_rate=0.1)
+
+    plain_mesh = make_mesh(8)
+    plain_state = create_lm_train_state(
+        model, jax.random.PRNGKey(0), tokens[:2], opt)
+    plain_step = make_lm_train_step(model, opt, plain_mesh)
+
+    pipe_mesh = make_mesh(2, 4, axis_names=("data", "pipe"))
+    pipe_params = stack_pipeline_params(plain_state.params, 4)
+    pipe_state = TrainState(
+        params=pipe_params, batch_stats={},
+        opt_state=opt.init(pipe_params), epoch=jnp.ones((), jnp.int32))
+    pipe_step = make_pipelined_lm_train_step(model, opt, pipe_mesh)
+
+    for step_i in range(3):
+        plain_state, mp = plain_step(plain_state, tokens)
+        pipe_state, mq = pipe_step(pipe_state, tokens)
+        lp = float(np.asarray(mp["loss"]))
+        lq = float(np.asarray(mq["loss"]))
+        # identical counts, near-identical losses (vocab-parallel LSE vs
+        # dense CE reorder f32 sums; divergence would compound by step 3
+        # if grads differed)
+        assert float(mp["count"]) == float(mq["count"])
+        assert abs(lp - lq) < 5e-4 * max(1.0, abs(lp)), (
+            f"step {step_i}: plain {lp} vs pipelined {lq}")
+
+
+def test_pipelined_params_resident_per_stage():
+    """Each device holds 1/n_stages of blocks, embed rows, head cols —
+    the memory win that makes PP real, not a replicated emulation."""
+    model, tokens = _tokens()
+    opt = sgd(learning_rate=0.1)
+    mesh = make_mesh(2, 4, axis_names=("data", "pipe"))
+    state = create_pipelined_lm_state(
+        model, jax.random.PRNGKey(0), tokens[:2], opt, n_stages=4)
+    step = make_pipelined_lm_train_step(model, opt, mesh)
+    state, _ = step(state, tokens)
+
+    embed = state.params["embed"]
+    assert embed.shape[0] == 4
+    assert embed.sharding.spec[0] == "pipe"
+    assert embed.addressable_shards[0].data.shape[0] == 1  # 1 stage/device
+    blk = jax.tree_util.tree_leaves(state.params["blocks"])[0]
+    assert blk.sharding.spec[0] == "pipe"
+    assert blk.addressable_shards[0].data.shape[0] == 1
+    head = state.params["head_k"]
+    assert head.sharding.spec[0] == "pipe"
+    # momentum buffers shard with their params
+    mom = state.opt_state.momentum["embed"]
+    assert mom.sharding.spec[0] == "pipe"
+
+
+def test_pipelined_training_reduces_loss():
+    model, tokens = _tokens()
+    opt = sgd(learning_rate=0.3)
+    mesh = make_mesh(2, 4, axis_names=("data", "pipe"))
+    state = create_pipelined_lm_state(
+        model, jax.random.PRNGKey(0), tokens[:2], opt, n_stages=4)
+    step = make_pipelined_lm_train_step(model, opt, mesh)
+    state, m0 = step(state, tokens)
+    first = float(np.asarray(m0["loss"]))
+    for _ in range(7):
+        state, m = step(state, tokens)
+    last = float(np.asarray(m["loss"]))
+    assert np.isfinite(last)
+    assert last < first - 0.2, f"no learning: {first:.3f} -> {last:.3f}"
+
+
+def test_geometry_validation():
+    model, tokens = _tokens()
+    opt = sgd(learning_rate=0.1)
+    params = model.init(jax.random.PRNGKey(0), tokens[:2])["params"]
+    with pytest.raises(ValueError, match="not divisible"):
+        stack_pipeline_params(params, 3)  # 4 layers / 3 stages
+    mesh = make_mesh(2, 4, axis_names=("data", "pipe"))
+    step = make_pipelined_lm_train_step(model, opt, mesh)
+    state = create_pipelined_lm_state(
+        model, jax.random.PRNGKey(0), tokens[:2], opt, n_stages=4)
+    with pytest.raises(ValueError, match="batch"):
+        step(state, tokens[:6])  # 6 % (2 dp * 4 micro) != 0
+    mesh2 = make_mesh(4, 2, axis_names=("data", "pipe"))
+    step2 = make_pipelined_lm_train_step(model, opt, mesh2)
+    with pytest.raises(ValueError, match="stages"):
+        step2(state, tokens)  # state stacked for 4 stages, mesh has 2
+    moe = models.get_model("gpt_tiny", n_experts=2)
+    with pytest.raises(NotImplementedError):
+        create_pipelined_lm_state(
+            moe, jax.random.PRNGKey(0), tokens[:2], opt, n_stages=4)
