@@ -1,6 +1,28 @@
 """Shared helpers for the standalone benchmark scripts."""
 
 import os
+import time
+
+
+def timeit(fn, args, min_window=0.5):
+    """ms-accurate adaptive timing: drain the queue, grow the window to
+    >= ``min_window`` seconds, end every window on a real D2H readback
+    (``utils.profiler.sync`` — same discipline as bench.py)."""
+    from pytorch_multiprocessing_distributed_tpu.utils.profiler import sync
+
+    out = fn(*args)
+    sync(out)  # compile + drain
+    n = 2
+    while True:
+        sync(fn(*args))  # drain boundary
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = fn(*args)
+        sync(out)
+        dt = time.perf_counter() - t0
+        if dt >= min_window or n >= 10_000:
+            return dt / n
+        n = min(10_000, max(n + 1, int(n * 1.3 * min_window / dt)))
 
 
 def apply_platform_env() -> None:

@@ -12,7 +12,6 @@ Prints one line per (impl, seq_len) with ms/iter and the speedup.
 import argparse
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -21,9 +20,9 @@ import jax.numpy as jnp
 import numpy as np
 
 import benchmarks._common as _common  # noqa: E402
+from benchmarks._common import timeit  # noqa: E402
 from pytorch_multiprocessing_distributed_tpu.ops.pallas.flash_attention import (
     flash_attention)
-from pytorch_multiprocessing_distributed_tpu.utils.profiler import sync
 
 
 def dense_attention(q, k, v, causal=False):
@@ -38,23 +37,6 @@ def dense_attention(q, k, v, causal=False):
     return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(
         q.dtype
     )
-
-
-def timeit(fn, args, min_window=0.5):
-    out = fn(*args)
-    sync(out)  # compile + drain
-    n = 2
-    while True:
-        sync(fn(*args))  # drain boundary
-        t0 = time.perf_counter()
-        for _ in range(n):
-            out = fn(*args)
-        sync(out)
-        dt = time.perf_counter() - t0
-        if dt >= min_window or n >= 10_000:
-            return dt / n
-        n = min(10_000, max(n + 1, int(n * 1.3 * min_window / dt)))
-
 
 
 def main():

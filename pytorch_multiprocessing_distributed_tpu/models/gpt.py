@@ -47,7 +47,9 @@ class CausalSelfAttention(nn.Module):
     num_heads: int
     dtype: Any = jnp.float32
     seq_axis: Optional[str] = None
-    sp_mode: str = "ring"  # "ring" (K/V rotation) | "ulysses" (all-to-all)
+    # "ring" (K/V rotation) | "zigzag" (ring, balanced causal layout) |
+    # "ulysses" (all-to-all head re-partition)
+    sp_mode: str = "ring"
     # "flash" = Pallas kernel (the TPU fast path); "xla" = plain masked
     # softmax attention — same exact math, needed where Pallas can't run
     # (e.g. inside a check_vma=True shard_map: the pipelined trainer)
@@ -55,6 +57,13 @@ class CausalSelfAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x):
+        if self.attn_impl not in ("flash", "xla"):
+            # validated BEFORE the seq_axis branch: a typo must fail on
+            # SP models too, not silently run the wrong kernel
+            raise ValueError(
+                f"attn_impl must be 'flash' or 'xla', got "
+                f"{self.attn_impl!r}"
+            )
         b, s, d_model = x.shape
         assert d_model % self.num_heads == 0
         head_dim = d_model // self.num_heads
@@ -70,21 +79,21 @@ class CausalSelfAttention(nn.Module):
             # sequence sharded over the mesh: exact causal attention
             # over GLOBAL positions — K/V ring, or Ulysses all-to-all
             # head re-partition (needs heads % axis_size == 0)
-            if self.sp_mode not in ("ring", "ulysses"):
+            if self.sp_mode not in ("ring", "zigzag", "ulysses"):
                 raise ValueError(
-                    f"sp_mode must be 'ring' or 'ulysses', got "
+                    f"sp_mode must be 'ring', 'zigzag' or 'ulysses', got "
                     f"{self.sp_mode!r} (a typo would otherwise silently "
                     "benchmark the wrong strategy)"
                 )
-            attn = (ulysses_attention if self.sp_mode == "ulysses"
-                    else ring_attention)
-            out = attn(q, k, v, axis_name=self.seq_axis, causal=True)
-        elif self.attn_impl not in ("flash", "xla"):
-            raise ValueError(
-                f"attn_impl must be 'flash' or 'xla', got "
-                f"{self.attn_impl!r} (a typo would otherwise silently "
-                "run the wrong kernel)"
-            )
+            if self.sp_mode == "ulysses":
+                out = ulysses_attention(q, k, v, axis_name=self.seq_axis,
+                                        causal=True)
+            else:
+                # "zigzag" = same ring, balanced causal layout (shard i
+                # holds chunks i and 2N-1-i; kills the idle tail)
+                out = ring_attention(q, k, v, axis_name=self.seq_axis,
+                                     causal=True,
+                                     zigzag=self.sp_mode == "zigzag")
         elif self.attn_impl == "xla":
             scale = head_dim ** -0.5
             logits = jnp.einsum(
@@ -152,7 +161,8 @@ class GPT(nn.Module):
     mlp_dim: int = 3072
     dtype: Any = jnp.float32
     seq_axis: Optional[str] = None
-    sp_mode: str = "ring"  # "ring" | "ulysses" (used when seq_axis set)
+    # "ring" | "zigzag" | "ulysses" (used when seq_axis is set)
+    sp_mode: str = "ring"
     n_experts: int = 0  # > 0: MoE feed-forward in every block
     expert_axis: Optional[str] = None
     bn_axis: Optional[str] = None  # unused (no BN); registry parity
@@ -180,11 +190,21 @@ class GPT(nn.Module):
                     f"{s * axis_size} exceeds max_seq_len="
                     f"{self.max_seq_len}"
                 )
-            # this shard holds global positions [idx*s, (idx+1)*s)
             idx = jax.lax.axis_index(self.seq_axis)
-            pos_slice = jax.lax.dynamic_slice_in_dim(
-                pos, idx * s, s, axis=0
-            )
+            if self.sp_mode == "zigzag":
+                # zigzag layout: this shard holds chunks idx and
+                # 2N-1-idx of the 2N-chunked global sequence
+                c = s // 2
+                pos_slice = jnp.concatenate([
+                    jax.lax.dynamic_slice_in_dim(pos, idx * c, c, axis=0),
+                    jax.lax.dynamic_slice_in_dim(
+                        pos, (2 * axis_size - 1 - idx) * c, c, axis=0),
+                ])
+            else:
+                # this shard holds global positions [idx*s, (idx+1)*s)
+                pos_slice = jax.lax.dynamic_slice_in_dim(
+                    pos, idx * s, s, axis=0
+                )
         else:
             if s > self.max_seq_len:
                 raise ValueError(

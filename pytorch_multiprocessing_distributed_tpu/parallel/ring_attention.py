@@ -17,8 +17,17 @@ Causal mode: with contiguous sequence sharding, a visiting block from
 shard ``src`` relates to resident rows of shard ``i`` as: fully visible
 (``src < i``), diagonal (``src == i`` — local causal mask), or fully
 masked (``src > i`` — skipped). The skip makes later shards idle part of
-each rotation (the classic ring-causal load imbalance; zigzag ordering
-would fix it and is out of scope).
+each rotation — the classic ring-causal load imbalance: shard 0 folds 1
+block while shard N-1 folds N, so utilization averages ~(N+1)/2N.
+
+``zigzag=True`` kills that tail: the global sequence is cut into ``2N``
+chunks and shard ``i`` holds chunks ``(i, 2N-1-i)`` (layout from
+:func:`zigzag_indices`; the llama3-style context-parallel ordering).
+Per visiting block, each shard now folds exactly two half-quadrants —
+(early rows x visiting early cols) on the ``src <= i`` triangle,
+(late rows x visiting early cols) always, (late rows x visiting late
+cols) on the mirrored triangle — constant work every hop on every
+shard, same exact-attention total.
 
 Backward (custom VJP): per-hop residuals are never saved — only this
 shard's (q, k, v, out, GLOBAL lse). The backward re-rotates K/V around
@@ -51,6 +60,39 @@ from ..ops.pallas.flash_attention import (
 )
 
 
+def zigzag_indices(seq_len: int, n_shards: int):
+    """``[n_shards, seq_len // n_shards]`` global positions per shard.
+
+    Shard ``i`` holds chunks ``i`` and ``2 * n_shards - 1 - i`` of the
+    ``2 * n_shards``-chunked sequence, concatenated. Callers permute
+    tokens (and positional state) into this layout before a
+    ``zigzag=True`` ring; ``indices.reshape(-1)`` is the permutation and
+    ``argsort`` of it the inverse.
+    """
+    import numpy as np
+
+    if seq_len % (2 * n_shards):
+        raise ValueError(
+            f"zigzag needs seq_len divisible by 2 x n_shards "
+            f"({seq_len} vs 2 x {n_shards})"
+        )
+    c = seq_len // (2 * n_shards)
+    idx = np.arange(seq_len).reshape(2 * n_shards, c)
+    return np.stack([
+        np.concatenate([idx[i], idx[2 * n_shards - 1 - i]])
+        for i in range(n_shards)
+    ])
+
+
+def _lse_fold(o, m, z, out_j, lse_j):
+    """Streaming log-sum-exp combine of one partial (out, lse)."""
+    m_new = jnp.maximum(m, lse_j)
+    corr = jnp.exp(m - m_new)
+    w = jnp.exp(lse_j - m_new)
+    o_new = o * corr[..., None] + out_j.astype(jnp.float32) * w[..., None]
+    return o_new, m_new, z * corr + w
+
+
 def _merge_heads(x):
     """[b, s, h, d] -> [b*h, s, d] (the flash kernels' layout)."""
     b, s, h, d = x.shape
@@ -69,11 +111,12 @@ def _hop_cases(src, my, causal):
     return src <= my, src == my
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def _ring(q3, k3, v3, scale, causal, block_q, block_k, interpret,
-          axis_name):
-    out, _ = _ring_fwd_impl(q3, k3, v3, scale, causal, block_q, block_k,
-                            interpret, axis_name)
+          axis_name, zigzag):
+    impl = _zig_fwd_impl if zigzag else _ring_fwd_impl
+    out, _ = impl(q3, k3, v3, scale, causal, block_q, block_k,
+                  interpret, axis_name)
     return out
 
 
@@ -112,12 +155,7 @@ def _ring_fwd_impl(q3, k3, v3, scale, causal, block_q, block_k, interpret,
         def do_fold():
             out_j, lse_j = _pair_fwd(q3, k_blk, v_blk, diag, scale,
                                      causal, block_q, block_k, interpret)
-            m_new = jnp.maximum(m, lse_j)
-            corr = jnp.exp(m - m_new)
-            w = jnp.exp(lse_j - m_new)
-            o_new = o * corr[..., None] + out_j.astype(jnp.float32) * w[..., None]
-            z_new = z * corr + w
-            return o_new, m_new, z_new
+            return _lse_fold(o, m, z, out_j, lse_j)
 
         if not causal:
             return do_fold()
@@ -142,15 +180,175 @@ def _ring_fwd_impl(q3, k3, v3, scale, causal, block_q, block_k, interpret,
     return out, lse
 
 
-def _ring_vjp_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret,
+def _zig_fwd_impl(q3, k3, v3, scale, causal, block_q, block_k, interpret,
                   axis_name):
-    out, lse = _ring_fwd_impl(q3, k3, v3, scale, causal, block_q, block_k,
-                              interpret, axis_name)
+    """Zigzag forward: per-shard rows are (chunk my, chunk 2N-1-my).
+
+    Per visiting block from ``src`` (its cols = chunks ``src`` /
+    ``2N-1-src``) the three live quadrants are:
+
+    - A: early rows x early cols — triangle (``src < my`` full,
+      ``== my`` diagonal, ``> my`` skip);
+    - B: late rows x early cols — ALWAYS fully visible
+      (``src < N <= 2N-1-my``);
+    - C: late rows x late cols — mirrored triangle (``src > my`` full,
+      ``== my`` diagonal, ``< my`` skip).
+
+    (Early rows x late cols is never visible.) Every shard folds ~2
+    half-blocks per hop — the balanced schedule.
+    """
+    del causal  # zigzag IS the causal layout (validated by the wrapper)
+    axis_size = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+    bh, s, d = q3.shape
+    hs = s // 2
+    qa, qb = q3[:, :hs], q3[:, hs:]
+
+    def init_state():
+        return (jnp.zeros((bh, hs, d), jnp.float32),
+                jnp.full((bh, hs), NEG_INF, jnp.float32),
+                jnp.zeros((bh, hs), jnp.float32))
+
+    def quad(state, q_half, k_half, v_half, diag):
+        out_j, lse_j = _pair_fwd(q_half, k_half, v_half, diag, scale,
+                                 True, block_q, block_k, interpret)
+        return _lse_fold(*state, out_j, lse_j)
+
+    def fold(sa, sb, k_blk, v_blk, hop):
+        src = (my - hop) % axis_size
+        kc, vc = k_blk[:, :hs], v_blk[:, :hs]
+        kd, vd = k_blk[:, hs:], v_blk[:, hs:]
+        diag = src == my
+        sa = jax.lax.cond(
+            src <= my, lambda: quad(sa, qa, kc, vc, diag), lambda: sa)
+        sb = quad(sb, qb, kc, vc, jnp.bool_(False))
+        sb = jax.lax.cond(
+            src >= my, lambda: quad(sb, qb, kd, vd, diag), lambda: sb)
+        return sa, sb
+
+    def hop_step(carry, hop):
+        sa, sb, k_blk, v_blk = carry
+        sa, sb = fold(sa, sb, k_blk, v_blk, hop)
+        k_next = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_next = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (sa, sb, k_next, v_next), None
+
+    (sa, sb, k_last, v_last), _ = jax.lax.scan(
+        hop_step, (init_state(), init_state(), k3, v3),
+        jnp.arange(axis_size - 1),
+    )
+    sa, sb = fold(sa, sb, k_last, v_last, axis_size - 1)
+
+    def finish(state):
+        o, m, z = state
+        z_safe = jnp.maximum(z, 1e-30)
+        return (o / z_safe[..., None]).astype(q3.dtype), m + jnp.log(z_safe)
+
+    out_a, lse_a = finish(sa)
+    out_b, lse_b = finish(sb)
+    return (jnp.concatenate([out_a, out_b], axis=1),
+            jnp.concatenate([lse_a, lse_b], axis=1))
+
+
+def _zig_vjp_bwd(scale, causal, block_q, block_k, interpret, axis_name,
+                 res, do):
+    """Zigzag backward: same three quadrants, grads per half; dK/dV
+    accumulators for BOTH col halves rotate with their K/V blocks."""
+    del causal
+    q3, k3, v3, out, lse = res
+    axis_size = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+    hs = q3.shape[1] // 2
+
+    do_c = do.astype(q3.dtype)
+    dterm = jnp.sum(
+        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    qa, qb = q3[:, :hs], q3[:, hs:]
+    do_a, do_b = do_c[:, :hs], do_c[:, hs:]
+    lse_a, lse_b = lse[:, :hs], lse[:, hs:]
+    dt_a, dt_b = dterm[:, :hs], dterm[:, hs:]
+
+    def quad_bwd(q_h, k_h, v_h, do_h, lse_h, dt_h, diag):
+        def run(c):
+            return _flash_pair_grads(
+                q_h, k_h, v_h, do_h, lse_h, dt_h, scale=scale, causal=c,
+                block_q=block_q, block_k=block_k, interpret=interpret)
+
+        return jax.lax.cond(diag, lambda: run(True), lambda: run(False))
+
+    def fold(dqa, dqb, dkc, dvc, dkd, dvd, k_blk, v_blk, hop):
+        src = (my - hop) % axis_size
+        kc, vc = k_blk[:, :hs], v_blk[:, :hs]
+        kd, vd = k_blk[:, hs:], v_blk[:, hs:]
+        diag = src == my
+
+        def fold_a():
+            dq_p, dk_p, dv_p = quad_bwd(qa, kc, vc, do_a, lse_a, dt_a,
+                                        diag)
+            return (dqa + dq_p.astype(jnp.float32),
+                    dkc + dk_p.astype(jnp.float32),
+                    dvc + dv_p.astype(jnp.float32))
+
+        dqa, dkc, dvc = jax.lax.cond(
+            src <= my, fold_a, lambda: (dqa, dkc, dvc))
+
+        dq_p, dk_p, dv_p = quad_bwd(qb, kc, vc, do_b, lse_b, dt_b,
+                                    jnp.bool_(False))
+        dqb = dqb + dq_p.astype(jnp.float32)
+        dkc = dkc + dk_p.astype(jnp.float32)
+        dvc = dvc + dv_p.astype(jnp.float32)
+
+        def fold_c():
+            dq_p, dk_p, dv_p = quad_bwd(qb, kd, vd, do_b, lse_b, dt_b,
+                                        diag)
+            return (dqb + dq_p.astype(jnp.float32),
+                    dkd + dk_p.astype(jnp.float32),
+                    dvd + dv_p.astype(jnp.float32))
+
+        dqb, dkd, dvd = jax.lax.cond(
+            src >= my, fold_c, lambda: (dqb, dkd, dvd))
+        return dqa, dqb, dkc, dvc, dkd, dvd
+
+    def hop_step(carry, hop):
+        dqa, dqb, k_blk, v_blk, dkc, dvc, dkd, dvd = carry
+        dqa, dqb, dkc, dvc, dkd, dvd = fold(
+            dqa, dqb, dkc, dvc, dkd, dvd, k_blk, v_blk, hop)
+        k_blk, v_blk, dkc, dvc, dkd, dvd = jax.lax.ppermute(
+            (k_blk, v_blk, dkc, dvc, dkd, dvd), axis_name, perm)
+        return (dqa, dqb, k_blk, v_blk, dkc, dvc, dkd, dvd), None
+
+    zero_h = lambda like: jnp.zeros(  # noqa: E731
+        (like.shape[0], hs, like.shape[2]), jnp.float32)
+    carry0 = (zero_h(q3), zero_h(q3), k3, v3,
+              zero_h(k3), zero_h(v3), zero_h(k3), zero_h(v3))
+    (dqa, dqb, k_last, v_last, dkc, dvc, dkd, dvd), _ = jax.lax.scan(
+        hop_step, carry0, jnp.arange(axis_size - 1))
+    dqa, dqb, dkc, dvc, dkd, dvd = fold(
+        dqa, dqb, dkc, dvc, dkd, dvd, k_last, v_last, axis_size - 1)
+    # one more rotation brings the accumulators home
+    dkc, dvc, dkd, dvd = jax.lax.ppermute(
+        (dkc, dvc, dkd, dvd), axis_name, perm)
+    dq = jnp.concatenate([dqa, dqb], axis=1)
+    dk = jnp.concatenate([dkc, dkd], axis=1)
+    dv = jnp.concatenate([dvc, dvd], axis=1)
+    return dq.astype(q3.dtype), dk.astype(k3.dtype), dv.astype(v3.dtype)
+
+
+def _ring_vjp_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret,
+                  axis_name, zigzag):
+    impl = _zig_fwd_impl if zigzag else _ring_fwd_impl
+    out, lse = impl(q3, k3, v3, scale, causal, block_q, block_k,
+                    interpret, axis_name)
     return out, (q3, k3, v3, out, lse)
 
 
 def _ring_vjp_bwd(scale, causal, block_q, block_k, interpret, axis_name,
-                  res, do):
+                  zigzag, res, do):
+    if zigzag:
+        return _zig_vjp_bwd(scale, causal, block_q, block_k, interpret,
+                            axis_name, res, do)
     q3, k3, v3, out, lse = res
     axis_size = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
@@ -227,13 +425,19 @@ def ring_attention(
     block_q: int = 512,
     block_k: int = 512,
     interpret: Optional[bool] = None,
+    zigzag: bool = False,
 ) -> jax.Array:
     """Exact attention with K/V ring rotation over ``axis_name``.
 
     Args:
       q, k, v: per-shard ``[batch, seq_local, heads, head_dim]``; the
         global sequence is sharded contiguously over ``axis_name``
-        (shard i holds positions ``[i * seq_local, (i+1) * seq_local)``).
+        (shard i holds positions ``[i * seq_local, (i+1) * seq_local)``)
+        — or, with ``zigzag=True``, in the :func:`zigzag_indices`
+        layout (shard i holds chunks ``i`` and ``2N-1-i``), which
+        balances the causal fold work across shards (kills the
+        per-rotation idle tail of later shards). Requires ``causal``
+        and an even ``seq_local``.
       axis_name: bound mesh axis (inside ``shard_map``/``pmap``).
       scale: logit scale; default ``head_dim ** -0.5``.
       causal: causal masking over GLOBAL positions.
@@ -253,11 +457,23 @@ def ring_attention(
     if scale is None:
         scale = q.shape[-1] ** -0.5
     b, s_loc, h, d = q.shape
-    block_q = _round8(min(block_q, s_loc))
-    block_k = _round8(min(block_k, k.shape[1]))
+    if zigzag:
+        if not causal:
+            raise ValueError(
+                "zigzag layout only applies to causal attention (there "
+                "is no load imbalance to fix without causality)"
+            )
+        if s_loc % 2:
+            raise ValueError(
+                f"zigzag needs an even per-shard sequence, got {s_loc}"
+            )
+    eff_q = s_loc // 2 if zigzag else s_loc
+    eff_k = k.shape[1] // 2 if zigzag else k.shape[1]
+    block_q = _round8(min(block_q, eff_q))
+    block_k = _round8(min(block_k, eff_k))
     out3 = _ring(
         _merge_heads(q), _merge_heads(k), _merge_heads(v), float(scale),
         bool(causal), int(block_q), int(block_k), bool(interpret),
-        axis_name,
+        axis_name, bool(zigzag),
     )
     return _split_heads(out3, b, h)

@@ -33,7 +33,8 @@ from .optim import Transform, apply_updates
 from .state import TrainState
 
 
-def _next_token_targets(tokens, seq_axis: Optional[str]):
+def _next_token_targets(tokens, seq_axis: Optional[str],
+                        zigzag: bool = False):
     """(targets, valid) for the next-token objective.
 
     ``targets[:, j]`` is the token following position ``j`` (globally);
@@ -51,7 +52,35 @@ def _next_token_targets(tokens, seq_axis: Optional[str]):
 
     axis_size = jax.lax.psum(1, seq_axis)
     idx = jax.lax.axis_index(seq_axis)
-    # right neighbor's first column completes this shard's shift
+    if zigzag:
+        # shard i holds chunks (i, 2N-1-i): chunk-internal positions
+        # shift locally; each chunk's LAST position needs the first
+        # token of the globally-next chunk:
+        # - chunk i's successor is chunk i+1 = shard i+1's first half
+        #   (except i = N-1, whose successor chunk N is this shard's
+        #   OWN second half);
+        # - chunk 2N-1-i's successor is chunk 2N-i = shard i-1's second
+        #   half (except i = 0, whose chunk 2N-1 ends the sequence).
+        c = s // 2
+        ta, tb = tokens[:, :c], tokens[:, c:]
+        recv_a = jax.lax.ppermute(  # shard i <- shard i+1's ta[:, 0]
+            ta[:, 0], seq_axis,
+            [((i + 1) % axis_size, i) for i in range(axis_size)],
+        )
+        recv_b = jax.lax.ppermute(  # shard i <- shard i-1's tb[:, 0]
+            tb[:, 0], seq_axis,
+            [(i, (i + 1) % axis_size) for i in range(axis_size)],
+        )
+        next_a = jnp.where(idx == axis_size - 1, tb[:, 0], recv_a)
+        targets = jnp.concatenate(
+            [ta[:, 1:], next_a[:, None], tb[:, 1:], recv_b[:, None]],
+            axis=1,
+        )
+        valid = jnp.ones((b, s), bool)
+        # global last position = chunk 2N-1's last col = shard 0's tb end
+        valid = valid.at[:, -1].set(idx != 0)
+        return targets, valid
+    # contiguous: right neighbor's first column completes the shift
     # (perm sends shard i+1's value to shard i)
     perm = [((i + 1) % axis_size, i) for i in range(axis_size)]
     next_first = jax.lax.ppermute(tokens[:, 0], seq_axis, perm)
@@ -93,9 +122,15 @@ def make_lm_train_step(
     """
     axes = (axis_name,) if seq_axis is None else (axis_name, seq_axis)
     is_moe = getattr(model, "n_experts", 0) > 0
+    # zigzag SP: the model was built with sp_mode="zigzag", so tokens
+    # must arrive in the zigzag_indices layout (handled transparently
+    # below — callers keep passing natural-order global tokens) and the
+    # label shift crosses chunk boundaries instead of shard boundaries
+    zigzag = (seq_axis is not None
+              and getattr(model, "sp_mode", "ring") == "zigzag")
 
     def body(state: TrainState, tokens):
-        targets, valid = _next_token_targets(tokens, seq_axis)
+        targets, valid = _next_token_targets(tokens, seq_axis, zigzag)
         w = valid.astype(jnp.float32)
         # Constants wrt params, computed before differentiation: global
         # predictable-position count and shard count (for layer-mean
@@ -185,6 +220,14 @@ def make_lm_train_step(
                 f"seq_len {s} is not divisible by the sequence-axis "
                 f"size {sp} (mesh axis {seq_axis!r})"
             )
+        if zigzag:
+            # permute natural-order tokens into the zigzag layout so
+            # contiguous sharding lands chunks (i, 2N-1-i) on shard i;
+            # the loss is a masked mean — permutation-invariant
+            from ..parallel.ring_attention import zigzag_indices
+
+            perm = zigzag_indices(s, sp).reshape(-1)
+            tokens = tokens[:, perm]
         return sharded(state, tokens)
 
     return jax.jit(checked, donate_argnums=(0,))
