@@ -163,7 +163,7 @@ class FolderImageNet(IndexedDataset):
             )
         return self._pool
 
-    def get(self, indices, rng, train):
+    def get(self, indices, rng, train, seeds=None):
         from PIL import Image, ImageFile  # lazy: ships with torchvision stacks
 
         # Real ImageNet shards contain truncated JPEGs (and CMYK,
@@ -180,8 +180,13 @@ class FolderImageNet(IndexedDataset):
         out = np.empty((len(idx), s, s, 3), np.uint8)
         # Per-image child seeds drawn ONCE from the epoch stream, so the
         # augmentation randomness is deterministic regardless of decode
-        # order / worker count (serial and parallel bit-match).
-        seeds = rng.integers(0, 2**63, size=len(idx))
+        # order / worker count (serial and parallel bit-match). A caller
+        # may pass pre-drawn ``seeds`` instead (the loader draws them per
+        # REPLICA stream but decodes all replicas in one pool round).
+        if seeds is None:
+            seeds = rng.integers(0, 2**63, size=len(idx))
+        else:
+            seeds = np.asarray(seeds)
 
         def work(row: int) -> None:
             r = np.random.default_rng(seeds[row])
@@ -391,16 +396,51 @@ class IndexedLoader:
             np.asarray(r) + self.world_size * np.arange(self._shard_len())
             for r in self.replica_ids
         ]
-        rng = np.random.default_rng(
-            np.random.SeedSequence([self.seed, self._epoch, 77])
-        )
+        # one decode/augment stream PER REPLICA (seed, epoch, 77, r): a
+        # host assembling only replica r must draw the same augmentations
+        # r would get on a single host (same fix as ShardedLoader —
+        # pinned by the 2-host e2e test)
+        rngs = [
+            np.random.default_rng(
+                np.random.SeedSequence([self.seed, self._epoch, 77, int(r)])
+            )
+            for r in self.replica_ids
+        ]
         for b in range(len(self)):
             lo = b * self.per_replica
             hi = lo + self.per_replica
-            idx = np.concatenate([np.asarray(s[lo:hi]) for s in shards])
-            images, labels = self.dataset.get(idx, rng, self.train)
-            if self.train and isinstance(self.dataset, SyntheticImageNet):
-                images = _synthetic_train_aug(images, rng)
+            idx_parts = [np.asarray(s[lo:hi]) for s in shards]
+            if isinstance(self.dataset, FolderImageNet):
+                # seeds drawn per REPLICA stream, decode in ONE pool
+                # round (per-replica get calls would serialize the
+                # thread-pool decode at a fraction of its width)
+                seeds = np.concatenate([
+                    r.integers(0, 2**63, size=len(p))
+                    for p, r in zip(idx_parts, rngs)
+                ])
+                images, labels = self.dataset.get(
+                    np.concatenate(idx_parts), None, self.train,
+                    seeds=seeds)
+            elif isinstance(self.dataset, SyntheticImageNet):
+                # index-deterministic (rng unused by get); only the
+                # train aug draws, per replica stream
+                images, labels = self.dataset.get(
+                    np.concatenate(idx_parts), rngs[0], self.train)
+                if self.train:
+                    images = np.concatenate([
+                        _synthetic_train_aug(part, r)
+                        for part, r in zip(
+                            np.array_split(images, len(rngs)), rngs)
+                    ])
+            else:
+                # general protocol: one get per replica with its stream
+                img_parts, lab_parts = [], []
+                for p, r in zip(idx_parts, rngs):
+                    ims, labs = self.dataset.get(p, r, self.train)
+                    img_parts.append(ims)
+                    lab_parts.append(labs)
+                images = np.concatenate(img_parts)
+                labels = np.concatenate(lab_parts)
             out = (normalize_imagenet(images), labels.astype(np.int32))
             if self.with_valid:
                 valid = np.concatenate(

@@ -123,15 +123,31 @@ class ShardedLoader:
         shards = [padded[r :: self.world_size] for r in self.replica_ids]
         valids = [s.valid_mask() for s in self.samplers]
         n_batches = len(self)
-        aug_rng = np.random.default_rng(
-            np.random.SeedSequence([self.seed, self._epoch])
-        )
+        # ONE augmentation stream PER REPLICA, seeded by (seed, epoch,
+        # replica_id): a host assembling only replica r must draw
+        # exactly the augmentations replica r would get on a single
+        # host, or multi-host training silently diverges from the
+        # equivalent single-host run (caught by the 2-host e2e test).
+        aug_rngs = [
+            np.random.default_rng(
+                np.random.SeedSequence([self.seed, self._epoch, int(r)])
+            )
+            for r in self.replica_ids
+        ]
         for b in range(n_batches):
             lo, hi = b * self.per_replica, (b + 1) * self.per_replica
             idx = np.concatenate([np.asarray(s[lo:hi]) for s in shards])
             imgs = self.images[idx]
             if self.train:
-                imgs = random_crop_flip(imgs, aug_rng)
+                # split by the ACTUAL per-replica chunk of this batch —
+                # the final batch is ragged under drop_last=False, and
+                # slicing by the nominal per_replica there would feed
+                # rows to the wrong replica's stream
+                imgs = np.concatenate([
+                    random_crop_flip(part, rng)
+                    for part, rng in zip(
+                        np.array_split(imgs, len(aug_rngs)), aug_rngs)
+                ])
             out = (normalize(imgs), self.labels[idx].astype(np.int32))
             if self.with_valid:
                 valid = np.concatenate([v[lo:hi] for v in valids])

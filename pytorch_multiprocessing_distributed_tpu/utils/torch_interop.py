@@ -182,6 +182,59 @@ def from_torch_state_dict(state_dict, params, batch_stats):
     return new_params, new_stats
 
 
+def torch_functional_forward(sd, x_nchw, train: bool = False):
+    """Reference-convention ResNet forward in TORCH, driven directly off
+    a state_dict (``F.conv2d``/``F.batch_norm`` — no module rebuild).
+
+    The cross-framework validation harness: the logits-parity test and
+    the convergence comparison both run THIS against the framework's
+    Flax model on identical weights. ``train=True`` uses batch
+    statistics and updates the dict's ``running_mean``/``running_var``
+    in place (torch momentum 0.1 — the same convention
+    ``ops.SyncBatchNorm`` implements). CIFAR stem (3x3/1 conv, no
+    maxpool, window-4 avg pool), i.e. reference ``model/resnet.py``.
+    Requires torch.
+    """
+    import torch.nn.functional as F
+
+    def bn(name, t):
+        return F.batch_norm(
+            t, sd[f"{name}.running_mean"], sd[f"{name}.running_var"],
+            sd[f"{name}.weight"], sd[f"{name}.bias"],
+            training=train, momentum=0.1, eps=1e-5,
+        )
+
+    def conv(name, t, stride):
+        w = sd[f"{name}.weight"]
+        return F.conv2d(t, w, stride=stride, padding=w.shape[-1] // 2)
+
+    out = F.relu(bn("bn1", conv("conv1", x_nchw, 1)))
+    for stage in range(1, 5):
+        i = 0
+        while f"layer{stage}.{i}.conv1.weight" in sd:
+            prefix = f"layer{stage}.{i}"
+            stride = 2 if (stage > 1 and i == 0) else 1
+            bottleneck = f"{prefix}.conv3.weight" in sd
+            h = F.relu(bn(f"{prefix}.bn1",
+                          conv(f"{prefix}.conv1", out,
+                               1 if bottleneck else stride)))
+            if bottleneck:
+                h = F.relu(bn(f"{prefix}.bn2",
+                              conv(f"{prefix}.conv2", h, stride)))
+                h = bn(f"{prefix}.bn3", conv(f"{prefix}.conv3", h, 1))
+            else:
+                h = bn(f"{prefix}.bn2", conv(f"{prefix}.conv2", h, 1))
+            if f"{prefix}.shortcut.0.weight" in sd:
+                short = bn(f"{prefix}.shortcut.1",
+                           conv(f"{prefix}.shortcut.0", out, stride))
+            else:
+                short = out
+            out = F.relu(h + short)
+            i += 1
+    out = F.avg_pool2d(out, 4).flatten(1)
+    return out @ sd["linear.weight"].T + sd["linear.bias"]
+
+
 def save_torch_checkpoint(path: str, params, batch_stats) -> str:
     """Write a torch-loadable ``.pth`` (requires torch)."""
     import torch

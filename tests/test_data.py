@@ -129,6 +129,40 @@ class TestShardedLoader:
         np.testing.assert_allclose(np.asarray(xe), normalize(imgs), atol=1e-6)
 
 
+class TestPerReplicaAugStreams:
+    def test_single_replica_host_matches_full_host(self):
+        """A host assembling only replica r must produce EXACTLY the
+        rows a full host assembles for r — including augmentation — in
+        every batch, ragged final batch included (n=40, world=4,
+        batch=32: the last batch has 2 rows/replica, not 8). This is
+        the multi-host/single-host equivalence the 2-host e2e test
+        pins end to end."""
+        imgs, lbls = synthetic_cifar10(40)
+        world, batch = 4, 32
+        per_replica = batch // world
+
+        def batches(replica_ids):
+            loader = ShardedLoader(
+                imgs, lbls, batch_size=batch, world_size=world,
+                replica_ids=replica_ids, train=True, seed=3)
+            loader.set_epoch(2)
+            return list(loader)
+
+        full = batches(None)
+        for r in range(world):
+            solo = batches([r])
+            assert len(solo) == len(full)
+            for (xs, ys), (xf, yf) in zip(solo, full):
+                k = len(xf) // world  # ragged tail: k < per_replica
+                np.testing.assert_array_equal(
+                    np.asarray(ys), np.asarray(yf[r * k:(r + 1) * k]))
+                np.testing.assert_allclose(
+                    np.asarray(xs), np.asarray(xf[r * k:(r + 1) * k]),
+                    atol=0, err_msg=f"replica {r} aug stream diverged")
+        assert len(full[-1][0]) == world * (40 // world - per_replica) or \
+            len(full[-1][0]) < batch  # the tail really is ragged
+
+
 class TestPrefetch:
     def test_prefetch_yields_sharded_arrays(self):
         import jax

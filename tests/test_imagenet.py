@@ -129,6 +129,35 @@ class TestFolderImageNet:
             np.testing.assert_array_equal(a, b)
             np.testing.assert_array_equal(la, lb)
 
+    def test_loader_single_replica_host_matches_full_host(self, tmp_path):
+        """IndexedLoader over a real JPEG tree: a host assembling only
+        replica r reproduces rows r of the full host bit-exactly —
+        per-replica seed streams + one-pool-round decode."""
+        from pytorch_multiprocessing_distributed_tpu.data.imagenet import (
+            FolderImageNet, IndexedLoader)
+
+        _make_jpeg_tree(tmp_path, n_classes=3, per_class=6)  # 18 images
+        ds = FolderImageNet(tmp_path, "train", image_size=32,
+                            num_workers=2)
+
+        def batches(replica_ids):
+            loader = IndexedLoader(
+                ds, batch_size=8, world_size=4, replica_ids=replica_ids,
+                train=True, seed=1, prefetch_batches=0)
+            loader.set_epoch(1)
+            return list(loader)
+
+        full = batches(None)
+        for r in (0, 3):
+            solo = batches([r])
+            assert len(solo) == len(full)
+            for (xs, ys), (xf, yf) in zip(solo, full):
+                k = len(xf) // 4
+                np.testing.assert_array_equal(
+                    np.asarray(xs), np.asarray(xf[r * k:(r + 1) * k]))
+                np.testing.assert_array_equal(
+                    np.asarray(ys), np.asarray(yf[r * k:(r + 1) * k]))
+
     def test_folder_layout_and_labels(self, tmp_path):
         from pytorch_multiprocessing_distributed_tpu.data.imagenet import (
             FolderImageNet)

@@ -13,7 +13,6 @@ import numpy as np
 import pytest
 
 torch = pytest.importorskip("torch")
-import torch.nn.functional as F  # noqa: E402
 
 from pytorch_multiprocessing_distributed_tpu import models
 from pytorch_multiprocessing_distributed_tpu.utils.torch_interop import (
@@ -21,6 +20,7 @@ from pytorch_multiprocessing_distributed_tpu.utils.torch_interop import (
     load_torch_checkpoint,
     save_torch_checkpoint,
     to_torch_state_dict,
+    torch_functional_forward,
 )
 
 
@@ -41,46 +41,9 @@ def _init_model(name, **kw):
     return model, params, stats
 
 
-def _torch_forward(sd, x_nchw):
-    """Reference-convention functional forward: conv1/bn1 stem, blocks
-    keyed layer{s}.{i}.*, window-4 avg pool, linear head."""
-
-    def bn(name, t):
-        return F.batch_norm(
-            t, sd[f"{name}.running_mean"], sd[f"{name}.running_var"],
-            sd[f"{name}.weight"], sd[f"{name}.bias"],
-            training=False, eps=1e-5,
-        )
-
-    def conv(name, t, stride):
-        w = sd[f"{name}.weight"]
-        return F.conv2d(t, w, stride=stride, padding=w.shape[-1] // 2)
-
-    out = F.relu(bn("bn1", conv("conv1", x_nchw, 1)))
-    for stage in range(1, 5):
-        i = 0
-        while f"layer{stage}.{i}.conv1.weight" in sd:
-            prefix = f"layer{stage}.{i}"
-            stride = 2 if (stage > 1 and i == 0) else 1
-            bottleneck = f"{prefix}.conv3.weight" in sd
-            h = F.relu(bn(f"{prefix}.bn1",
-                          conv(f"{prefix}.conv1", out, 1 if bottleneck
-                               else stride)))
-            if bottleneck:
-                h = F.relu(bn(f"{prefix}.bn2",
-                              conv(f"{prefix}.conv2", h, stride)))
-                h = bn(f"{prefix}.bn3", conv(f"{prefix}.conv3", h, 1))
-            else:
-                h = bn(f"{prefix}.bn2", conv(f"{prefix}.conv2", h, 1))
-            if f"{prefix}.shortcut.0.weight" in sd:
-                short = bn(f"{prefix}.shortcut.1",
-                           conv(f"{prefix}.shortcut.0", out, stride))
-            else:
-                short = out
-            out = F.relu(h + short)
-            i += 1
-    out = F.avg_pool2d(out, 4).flatten(1)
-    return out @ sd["linear.weight"].T + sd["linear.bias"]
+# the functional torch forward lives in the package (it is the shared
+# validation harness for this test AND benchmarks/convergence.py)
+_torch_forward = torch_functional_forward
 
 
 @pytest.mark.parametrize("name", ["res", "resnet50"])
