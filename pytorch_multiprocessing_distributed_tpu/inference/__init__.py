@@ -1,0 +1,5 @@
+"""Inference: KV-cached autoregressive generation for the LM family."""
+
+from .generate import generate
+
+__all__ = ["generate"]

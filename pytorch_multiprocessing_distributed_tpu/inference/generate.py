@@ -1,0 +1,209 @@
+"""KV-cached autoregressive generation for the GPT family.
+
+The reference is a vision trainer with no inference path; a complete LM
+framework needs one. TPU-idiomatic by construction:
+
+- STATIC shapes end to end: the KV cache is ``[B, max_seq_len, H, Dh]``
+  per layer from the start, positions advance by ``dynamic_update_slice``
+  — one compiled program serves every step (no per-length recompiles);
+- the decode loop is a ``lax.scan`` over step indices inside ONE jit —
+  no host round-trip per token;
+- prefill is a single vectorized causal pass over the prompt (MXU-sized
+  matmuls), decode steps are the bandwidth-bound cached attention.
+
+Mirrors the model's own conventions (``models/gpt.py``): matmuls in
+``model.dtype``, LayerNorm/softmax/head in f32, eps 1e-6. Works off the
+plain GPT param tree — the same params `make_lm_train_step` trains.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_LN_EPS = 1e-6
+
+
+def _ln(x, p):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, -1, keepdims=True)
+    # fast variance (E[x^2] - E[x]^2), matching flax LayerNorm's default
+    # — the cached path must be BIT-identical to the model's forward or
+    # near-tied argmaxes flip tokens
+    var = jnp.mean(xf * xf, -1, keepdims=True) - mu * mu
+    out = (xf - mu) * jax.lax.rsqrt(var + _LN_EPS)
+    return out * p["scale"] + p["bias"]
+
+
+def _dense(x, p, dtype):
+    return x.astype(dtype) @ p["kernel"].astype(dtype) + p["bias"].astype(dtype)
+
+
+def _split_heads(t, h):
+    b, s, d = t.shape
+    return t.reshape(b, s, h, d // h)
+
+
+def _block_prefill(p, x, h, dtype):
+    """Full causal pass over the prompt; returns (y, k, v)."""
+    b, s, _ = x.shape
+    hn = _ln(x, p["ln1"]).astype(dtype)
+    q, k, v = jnp.split(_dense(hn, p["attn"]["wqkv"], dtype), 3, axis=-1)
+    q, k, v = _split_heads(q, h), _split_heads(k, h), _split_heads(v, h)
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    mask = jnp.tril(jnp.ones((s, s), bool))
+    probs = jax.nn.softmax(jnp.where(mask, logits, -jnp.inf), axis=-1)
+    att = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    att = att.reshape(b, s, -1).astype(dtype)
+    x = x + _dense(att, p["attn"]["wo"], dtype)
+    hn = _ln(x, p["ln2"]).astype(dtype)
+    y = _dense(hn, p["fc1"], dtype)
+    y = _dense(jax.nn.gelu(y), p["fc2"], dtype)
+    return x + y, k, v
+
+
+def _block_decode(p, x_t, k_cache, v_cache, pos, h, dtype):
+    """One cached step: x_t [B, 1, D]; caches [B, S, H, Dh]."""
+    b = x_t.shape[0]
+    hn = _ln(x_t, p["ln1"]).astype(dtype)
+    q, k, v = jnp.split(_dense(hn, p["attn"]["wqkv"], dtype), 3, axis=-1)
+    q, k, v = _split_heads(q, h), _split_heads(k, h), _split_heads(v, h)
+    k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
+    v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k_cache.astype(jnp.float32)) * scale  # [B,H,1,S]
+    mask = jnp.arange(k_cache.shape[1]) <= pos
+    probs = jax.nn.softmax(
+        jnp.where(mask[None, None, None, :], logits, -jnp.inf), axis=-1)
+    att = jnp.einsum("bhqk,bkhd->bqhd", probs,
+                     v_cache.astype(jnp.float32))
+    att = att.reshape(b, 1, -1).astype(dtype)
+    x_t = x_t + _dense(att, p["attn"]["wo"], dtype)
+    hn = _ln(x_t, p["ln2"]).astype(dtype)
+    y = _dense(hn, p["fc1"], dtype)
+    y = _dense(jax.nn.gelu(y), p["fc2"], dtype)
+    return x_t + y, k_cache, v_cache
+
+
+def _embed(params, tokens, pos_start, dtype):
+    s = tokens.shape[1]
+    pos = jax.lax.dynamic_slice_in_dim(
+        params["pos_embed"], pos_start, s, axis=0)
+    # cast-then-add, exactly as GPT.__call__ does: under bf16,
+    # bf16(a) + bf16(b) != bf16(a + b) and the drift flips tokens
+    return (params["embed"][tokens].astype(dtype) + pos.astype(dtype))
+
+
+def _logits(params, x):
+    h = _ln(x, params["ln_final"])
+    return (h @ params["head"]["kernel"].astype(jnp.float32)
+            + params["head"]["bias"])
+
+
+def _sample(logits, temperature, top_k, key):
+    """[B, V] logits -> [B] tokens (greedy when temperature == 0)."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / temperature
+    if top_k:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
+@partial(jax.jit, static_argnames=("model", "max_new_tokens",
+                                   "temperature", "top_k"))
+def generate(
+    model,
+    params,
+    prompt: jax.Array,
+    *,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    rng: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Generate ``max_new_tokens`` continuations of ``prompt``.
+
+    Args:
+      model: the (dense, non-SP) ``GPT`` the params belong to — supplies
+        geometry (heads, dtype, max_seq_len); hashable, so it is a jit
+        static.
+      params: plain GPT param tree (as trained).
+      prompt: ``[B, T]`` int tokens, ``T + max_new_tokens <=
+        model.max_seq_len``.
+      temperature: 0 = greedy; else softmax temperature sampling.
+      top_k: restrict sampling to the k highest logits (0 = full vocab).
+      rng: PRNGKey (required when temperature > 0).
+
+    Returns ``[B, T + max_new_tokens]`` tokens (prompt included).
+    """
+    b, t = prompt.shape
+    s_max = t + max_new_tokens
+    if s_max > model.max_seq_len:
+        raise ValueError(
+            f"prompt {t} + max_new_tokens {max_new_tokens} exceeds "
+            f"max_seq_len={model.max_seq_len}"
+        )
+    if temperature > 0.0 and rng is None:
+        raise ValueError("sampling (temperature > 0) requires rng")
+    if getattr(model, "n_experts", 0) > 0 or (
+        getattr(model, "seq_axis", None) is not None
+    ):
+        raise NotImplementedError(
+            "generate covers dense, non-sequence-parallel GPTs (MoE "
+            "blocks keep their feed-forward under 'moe', and decode is "
+            "single-shard)"
+        )
+    dtype = model.dtype
+    h = model.num_heads
+    n_layers = model.num_layers  # trusted like num_heads/hidden_size:
+    # a gappy params tree then fails LOUDLY at the missing block key
+    head_dim = model.hidden_size // h
+
+    # ---- prefill: one vectorized causal pass, caches written [0, t)
+    x = _embed(params, prompt, 0, dtype)
+    k_caches = jnp.zeros((n_layers, b, s_max, h, head_dim), dtype)
+    v_caches = jnp.zeros((n_layers, b, s_max, h, head_dim), dtype)
+    for i in range(n_layers):
+        x, k, v = _block_prefill(params[f"block_{i}"], x, h, dtype)
+        k_caches = k_caches.at[i, :, :t].set(k.astype(dtype))
+        v_caches = v_caches.at[i, :, :t].set(v.astype(dtype))
+    first_logits = _logits(params, x[:, -1:])[:, 0]  # [B, V]
+
+    keys = (jax.random.split(rng, max_new_tokens) if rng is not None
+            else jnp.zeros((max_new_tokens, 2), jnp.uint32))
+    tok0 = _sample(first_logits, temperature, top_k, keys[0])
+
+    def step(carry, inp):
+        tok, k_caches, v_caches = carry
+        pos, key = inp
+        x_t = _embed(params, tok[:, None], pos, dtype)
+        new_k, new_v = [], []
+        for i in range(n_layers):
+            x_t, kc, vc = _block_decode(
+                params[f"block_{i}"], x_t, k_caches[i], v_caches[i],
+                pos, h, dtype)
+            new_k.append(kc)
+            new_v.append(vc)
+        logits = _logits(params, x_t)[:, 0]
+        nxt = _sample(logits, temperature, top_k, key)
+        return (nxt, jnp.stack(new_k), jnp.stack(new_v)), tok
+
+    # scan positions t .. t+max_new-1; step j CONSUMES token j-1 (written
+    # at position t+j-1) and emits token j
+    if max_new_tokens > 1:
+        positions = jnp.arange(t, s_max - 1)
+        (last, _, _), toks = jax.lax.scan(
+            step, (tok0, k_caches, v_caches), (positions, keys[1:]))
+        generated = jnp.concatenate(
+            [jnp.moveaxis(toks, 0, 1), last[:, None]], axis=1)
+    else:
+        generated = tok0[:, None]
+    return jnp.concatenate([prompt, generated], axis=1)

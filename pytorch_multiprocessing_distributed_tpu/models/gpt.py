@@ -165,6 +165,7 @@ class GPT(nn.Module):
     sp_mode: str = "ring"
     n_experts: int = 0  # > 0: MoE feed-forward in every block
     expert_axis: Optional[str] = None
+    attn_impl: str = "flash"  # "flash" (Pallas) | "xla" (plain masked)
     bn_axis: Optional[str] = None  # unused (no BN); registry parity
 
     @nn.compact
@@ -215,7 +216,8 @@ class GPT(nn.Module):
         for i in range(self.num_layers):
             x = Block(self.num_heads, self.mlp_dim, self.dtype,
                       self.seq_axis, self.sp_mode, self.n_experts,
-                      self.expert_axis, name=f"block_{i}")(x)
+                      self.expert_axis, self.attn_impl,
+                      name=f"block_{i}")(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
         logits = nn.Dense(self.vocab_size, dtype=jnp.float32,
                           kernel_init=dense_init, name="head")(x)
