@@ -146,6 +146,15 @@ def generate(
     """
     b, t = prompt.shape
     s_max = t + max_new_tokens
+    if max_new_tokens < 1:
+        raise ValueError(
+            f"max_new_tokens must be >= 1, got {max_new_tokens}"
+        )
+    if top_k < 0 or top_k > model.vocab_size:
+        raise ValueError(
+            f"top_k must be in [0, vocab_size={model.vocab_size}], "
+            f"got {top_k}"
+        )
     if s_max > model.max_seq_len:
         raise ValueError(
             f"prompt {t} + max_new_tokens {max_new_tokens} exceeds "

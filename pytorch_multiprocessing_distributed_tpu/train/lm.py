@@ -93,6 +93,27 @@ def _next_token_targets(tokens, seq_axis: Optional[str],
     return targets, valid
 
 
+def _collect_moe_losses(mut):
+    """(aux, z) layer-means from a ``mutable=['losses']`` apply result.
+
+    sow appends ``(scalar,)`` tuples keyed moe_aux/moe_z, one path per
+    MoE layer; the mean over layers keeps the loss weights
+    geometry-independent. Zeros when the model has no MoE blocks.
+    """
+    flat = flatten_dict(mut.get("losses", {}))
+    aux_terms = [v for path, vals in flat.items()
+                 if path[-1] == "moe_aux"
+                 for v in jax.tree_util.tree_leaves(vals)]
+    z_terms = [v for path, vals in flat.items()
+               if path[-1] == "moe_z"
+               for v in jax.tree_util.tree_leaves(vals)]
+    aux = (sum(aux_terms) / len(aux_terms)
+           if aux_terms else jnp.zeros((), jnp.float32))
+    z = (sum(z_terms) / len(z_terms)
+         if z_terms else jnp.zeros((), jnp.float32))
+    return aux, z
+
+
 def make_lm_train_step(
     model,
     optimizer: Transform,
@@ -153,20 +174,7 @@ def make_lm_train_step(
                 logits.reshape(-1, logits.shape[-1]), targets.reshape(-1)
             ).reshape(targets.shape)
             ce_sum = jnp.sum(flat_ce * w)
-            # sow appends (scalar,) tuples keyed moe_aux/moe_z, one path
-            # per MoE layer; mean over layers keeps the weight
-            # geometry-independent
-            flat = flatten_dict(mut.get("losses", {}))
-            aux_terms = [v for path, vals in flat.items()
-                         if path[-1] == "moe_aux"
-                         for v in jax.tree_util.tree_leaves(vals)]
-            z_terms = [v for path, vals in flat.items()
-                       if path[-1] == "moe_z"
-                       for v in jax.tree_util.tree_leaves(vals)]
-            aux = (sum(aux_terms) / len(aux_terms)
-                   if aux_terms else jnp.zeros((), jnp.float32))
-            z = (sum(z_terms) / len(z_terms)
-                 if z_terms else jnp.zeros((), jnp.float32))
+            aux, z = _collect_moe_losses(mut)
             obj = ce_sum / count + (
                 moe_aux_weight * aux + moe_z_weight * z
             ) / world
@@ -231,6 +239,82 @@ def make_lm_train_step(
         return sharded(state, tokens)
 
     return jax.jit(checked, donate_argnums=(0,))
+
+
+def make_lm_train_step_tp(
+    model,
+    optimizer: Transform,
+    mesh: Mesh,
+    *,
+    zero1: bool = False,
+    fsdp: bool = False,
+    remat: bool = False,
+    moe_aux_weight: float = 0.01,
+    moe_z_weight: float = 1e-3,
+):
+    """Build the jitted DP x TP LM train step (GSPMD path).
+
+    The LM twin of :func:`..train.step.make_train_step_tp`: the body is
+    written with GLOBAL semantics and the shardings carry the
+    parallelism — the generic trailing-dim rule
+    (:func:`..train.step.tp_param_spec`) puts every Dense output-feature
+    dim (wqkv/fc1 columns, wo/fc2 via their own trailing dims, the
+    vocab head) and the embedding hidden dim on the ``model`` axis,
+    tokens live on ``data``, and GSPMD inserts the Megatron-style
+    collectives. ``zero1``/``fsdp`` compose exactly as on the image
+    path. ``state`` must be placed with
+    :func:`..train.step.shard_state` first.
+
+    Requires a model built WITHOUT ``seq_axis`` (TP x SP composition
+    runs through the shard_map path, not GSPMD).
+    """
+    if getattr(model, "seq_axis", None) is not None:
+        raise ValueError(
+            "make_lm_train_step_tp requires a model built with "
+            "seq_axis=None: under GSPMD the sequence stays unsharded "
+            "(use make_lm_train_step(seq_axis=...) for SP)"
+        )
+    is_moe = getattr(model, "n_experts", 0) > 0
+
+    def body(state: TrainState, tokens):
+        targets, valid = _next_token_targets(tokens, None)
+        w = valid.astype(jnp.float32)
+        count = jnp.sum(w)
+
+        def obj(params):
+            logits, mut = model.apply(
+                {"params": params}, tokens, train=True, mutable=["losses"]
+            )
+            flat_ce = cross_entropy_per_sample(
+                logits.reshape(-1, logits.shape[-1]), targets.reshape(-1)
+            ).reshape(targets.shape)
+            ce_mean = jnp.sum(flat_ce * w) / count
+            aux, z = _collect_moe_losses(mut)
+            total = ce_mean + moe_aux_weight * aux + moe_z_weight * z
+            return total, (ce_mean, aux)
+
+        if remat:
+            obj = jax.checkpoint(obj)
+        (_, (loss, aux)), grads = jax.value_and_grad(
+            obj, has_aux=True
+        )(state.params)
+        updates, new_opt = optimizer.update(
+            grads, state.opt_state, state.params, lr_step=state.epoch
+        )
+        new_state = state.replace(
+            params=apply_updates(state.params, updates), opt_state=new_opt
+        )
+        metrics = {"loss": loss, "count": count}
+        if is_moe:
+            metrics["moe_aux"] = aux
+        return new_state, metrics
+
+    from .step import lazy_gspmd_jit
+
+    return lazy_gspmd_jit(
+        body, mesh, arg_specs=(P(DATA_AXIS),), returns_state=True,
+        zero1=zero1, fsdp=fsdp,
+    )
 
 
 def create_lm_train_state(model, rng, sample_tokens,

@@ -483,28 +483,43 @@ def make_train_step_tp(
                        dp_size=mesh.shape[DATA_AXIS],
                        clip_grad_norm=clip_grad_norm, ema_decay=ema_decay)
 
-    def _build(state_sh):
-        batch_sh = NamedSharding(mesh, P(DATA_AXIS))
-        img_sh = NamedSharding(mesh, P(DATA_AXIS, None, None, None))
-        repl = NamedSharding(mesh, P())
-        return jax.jit(
-            body,
-            in_shardings=(state_sh, img_sh, batch_sh),
-            out_shardings=(state_sh, repl),
-            donate_argnums=(0,),
-        )
+    return lazy_gspmd_jit(
+        body, mesh,
+        arg_specs=(P(DATA_AXIS, None, None, None), P(DATA_AXIS)),
+        returns_state=True, zero1=zero1, fsdp=fsdp,
+    )
 
+
+def lazy_gspmd_jit(body, mesh: Mesh, *, arg_specs, returns_state: bool,
+                   zero1: bool = False, fsdp: bool = False):
+    """Lazily-bound GSPMD jit: the ONE place the 'cache the jitted
+    program keyed on the state's pytree structure, build in/out
+    shardings from state_shardings on first call' idiom lives
+    (train/eval image TP steps and the LM TP step all bind through
+    here — a future change to the caching key applies everywhere).
+
+    ``body(state, *args)``; ``arg_specs`` are the PartitionSpecs of the
+    non-state args; metrics outputs are replicated.
+    """
     compiled = {}
 
-    def step(state, images, labels):
-        # in_shardings depend on the state pytree structure; bind lazily
-        # on first call (and on structure change, e.g. after resume).
+    def step(state, *args):
+        # in_shardings depend on the state pytree structure; bind
+        # lazily on first call (and on structure change, e.g. resume)
         key = jax.tree.structure(state)
         if key not in compiled:
-            compiled[key] = _build(
-                state_shardings(state, mesh, zero1=zero1, fsdp=fsdp)
+            state_sh = state_shardings(state, mesh, zero1=zero1,
+                                       fsdp=fsdp)
+            in_sh = (state_sh,) + tuple(
+                NamedSharding(mesh, s) for s in arg_specs)
+            repl = NamedSharding(mesh, P())
+            compiled[key] = jax.jit(
+                body,
+                in_shardings=in_sh,
+                out_shardings=(state_sh, repl) if returns_state else repl,
+                donate_argnums=(0,) if returns_state else (),
             )
-        return compiled[key](state, images, labels)
+        return compiled[key](state, *args)
 
     return step
 
@@ -519,24 +534,12 @@ def make_eval_step_tp(model, mesh: Mesh, *, zero1: bool = False,
     """
     _check_tp_model(model)
     body = _eval_body(model, axis_name=None, loss_fn=loss_fn)
-
-    compiled = {}
-
-    def step(state, images, labels, valid):
-        key = jax.tree.structure(state)
-        if key not in compiled:
-            state_sh = state_shardings(state, mesh, zero1=zero1, fsdp=fsdp)
-            img_sh = NamedSharding(mesh, P(DATA_AXIS, None, None, None))
-            vec_sh = NamedSharding(mesh, P(DATA_AXIS))
-            repl = NamedSharding(mesh, P())
-            compiled[key] = jax.jit(
-                body,
-                in_shardings=(state_sh, img_sh, vec_sh, vec_sh),
-                out_shardings=repl,
-            )
-        return compiled[key](state, images, labels, valid)
-
-    return step
+    return lazy_gspmd_jit(
+        body, mesh,
+        arg_specs=(P(DATA_AXIS, None, None, None), P(DATA_AXIS),
+                   P(DATA_AXIS)),
+        returns_state=False, zero1=zero1, fsdp=fsdp,
+    )
 
 
 def shard_batch(batch, mesh: Mesh, axis_name: str = DATA_AXIS):
