@@ -1,14 +1,17 @@
 #!/bin/bash
-# Background TPU liveness watcher. Probes the backend in short-lived
-# subprocesses (a wedged probe cannot poison anything) and records the first
-# success to .tpu_alive so long-running work can react.
-# Usage: bash benchmarks/tpu_watch.sh [interval_seconds] [probe_timeout]
-INTERVAL=${1:-120}
-PROBE_TIMEOUT=${2:-150}
+# Background TPU liveness watcher — PATIENT probes (no kill).
+#
+# Evidence from this environment (see memory/VERDICT r3): killing a
+# probe mid-bring-up is what wedges the axon tunnel for hours; a probe
+# left alone either completes or errors out (observed ~25 min to an
+# UNAVAILABLE). So each probe runs with NO timeout; failures back off
+# and retry. First success writes .tpu_alive.
+# Usage: bash benchmarks/tpu_watch.sh [retry_sleep_seconds]
+SLEEP=${1:-180}
 cd "$(dirname "$0")/.." || exit 1
 rm -f .tpu_alive
 while true; do
-  if timeout "$PROBE_TIMEOUT" python -c "
+  if python -c "
 import jax
 ds = jax.devices()
 assert ds and ds[0].platform != 'cpu', ds
@@ -19,6 +22,6 @@ print(len(ds), ds[0].device_kind)
     echo "[tpu_watch] TPU alive: $(cat .tpu_probe_out)"
     exit 0
   fi
-  echo "[tpu_watch] $(date -u +%FT%TZ) probe failed/hung; retrying in ${INTERVAL}s"
-  sleep "$INTERVAL"
+  echo "[tpu_watch] $(date -u +%FT%TZ) probe errored ($(tail -1 .tpu_probe_err | cut -c1-120)); retrying in ${SLEEP}s"
+  sleep "$SLEEP"
 done

@@ -19,7 +19,7 @@ from .collectives import (
     reduce_tensor,
 )
 from .sampler import DistributedShardSampler
-from .ring_attention import ring_attention
+from .ring_attention import ring_attention, zigzag_indices
 from .ulysses import ulysses_attention
 from .pipeline import pipeline_apply
 from .gpt_pipeline import (

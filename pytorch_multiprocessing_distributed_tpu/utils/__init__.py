@@ -9,5 +9,21 @@ from .meters import AverageMeter
 from .logger import Logger
 from .metrics import accuracy, topk_accuracy
 from .plotting import draw_plot
+from .torch_interop import (
+    from_torch_state_dict,
+    load_torch_checkpoint,
+    save_torch_checkpoint,
+    to_torch_state_dict,
+)
 
-__all__ = ["AverageMeter", "Logger", "accuracy", "topk_accuracy", "draw_plot"]
+__all__ = [
+    "AverageMeter",
+    "Logger",
+    "accuracy",
+    "topk_accuracy",
+    "draw_plot",
+    "to_torch_state_dict",
+    "from_torch_state_dict",
+    "save_torch_checkpoint",
+    "load_torch_checkpoint",
+]
