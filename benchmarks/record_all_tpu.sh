@@ -1,0 +1,36 @@
+#!/bin/bash
+# One-shot TPU evidence capture — run the moment the chip is granted.
+# Produces/refreshes every artifact the round needs:
+#   benchmarks/baseline_record.json   (record_baselines.py, all configs
+#                                      + gpt_lm, two_window_slope tags)
+#   benchmarks/attention_bench_tpu.txt (flash vs XLA, fwd+bwd, causal +
+#                                      non-causal — backs COVERAGE.md)
+#   benchmarks/generate_bench_tpu.txt  (decode tokens/sec)
+#   benchmarks/mfu_tune_results.json   (resnet50 flag/batch sweep)
+#   benchmarks/convergence_record.json (framework-on-TPU vs torch-CPU)
+# Prints a section header per step; steps are independent — a failure
+# moves on so one flaky stage can't void the rest.
+set -u
+cd "$(dirname "$0")/.." || exit 1
+note() { echo "=== $* ($(date -u +%T))" >&2; }
+
+note "baselines (all configs, slope estimator)"
+python benchmarks/record_baselines.py
+
+note "attention bench (non-causal)"
+python benchmarks/attention_bench.py > benchmarks/attention_bench_tpu.txt 2>&1
+note "attention bench (causal)"
+python benchmarks/attention_bench.py --causal >> benchmarks/attention_bench_tpu.txt 2>&1
+tail -8 benchmarks/attention_bench_tpu.txt >&2
+
+note "generate bench"
+python benchmarks/generate_bench.py > benchmarks/generate_bench_tpu.txt 2>&1
+tail -4 benchmarks/generate_bench_tpu.txt >&2
+
+note "MFU tune sweep (resnet50 north star)"
+python benchmarks/mfu_tune.py --config resnet50_imagenet
+
+note "convergence (framework on TPU vs torch CPU)"
+python benchmarks/convergence.py --epochs 8 --train_size 2048
+
+note "done — review artifacts, then commit"
