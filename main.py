@@ -126,14 +126,10 @@ def main(args):
             f"(got --model {args.model})"
         )
     # Backend selection must happen before device queries.
-    if os.environ.get("PMDT_FORCE_CPU_DEVICES"):
-        n = int(os.environ["PMDT_FORCE_CPU_DEVICES"])
-        os.environ["XLA_FLAGS"] = (
-            os.environ.get("XLA_FLAGS", "")
-            + f" --xla_force_host_platform_device_count={n}"
-        ).strip()
-        import jax
-        jax.config.update("jax_platforms", "cpu")
+    from pytorch_multiprocessing_distributed_tpu.utils.hostenv import (
+        force_cpu_devices_from_env)
+
+    force_cpu_devices_from_env()
 
     import jax
     import jax.numpy as jnp

@@ -1,0 +1,24 @@
+"""Host environment bootstrap shared by the CLIs (main.py, train_lm.py).
+
+``PMDT_FORCE_CPU_DEVICES=N`` virtualizes an N-device CPU mesh — the
+chip-free way to run every multi-device code path (tests do the same in
+conftest.py). Must run before the first backend init: ``XLA_FLAGS`` is
+read when the backend comes up, and ``jax_platforms`` must be pinned
+via ``jax.config`` because this environment pre-imports jax with
+``JAX_PLATFORMS=axon`` (env vars alone are too late).
+"""
+
+import os
+
+
+def force_cpu_devices_from_env() -> None:
+    n = os.environ.get("PMDT_FORCE_CPU_DEVICES")
+    if not n:
+        return
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={int(n)}"
+    ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

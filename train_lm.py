@@ -1,0 +1,237 @@
+"""Language-model training CLI — the LM counterpart of ``main.py``.
+
+The reference trains ConvNets only; this CLI is the framework-native
+entry point for the GPT family, surfacing every LM parallelism strategy
+through flags on ONE mesh abstraction:
+
+    --parallel dp               pure data parallelism (shard_map + psum)
+    --parallel sp --degree 4    sequence parallelism over a (data, seq)
+                                mesh; --sp_mode ring|zigzag|ulysses
+    --parallel tp --degree 2    GSPMD tensor parallelism (Megatron-style
+                                trailing-dim sharding, zero1/fsdp-ready)
+    --parallel pp --degree 4    pipelined training (GPipe schedule,
+                                vocab-parallel embed/head, per-stage
+                                block residency)
+
+plus ``--n_experts`` for Switch-MoE feed-forwards (trained against the
+load-balancing aux + router z losses). Artifacts mirror ``main.py``:
+``train.log`` rows (``{epoch:04d} {loss:.6f} {ppl:.6f}``), a final
+``model_{epoch}.pth`` checkpoint, and (dense models) a greedy sample
+from ``inference.generate`` as a smoke signal.
+
+Data-free by construction: ``--corpus_tokens`` synthesizes a
+deterministic Zipf stream (``data.synthetic_tokens``); pass
+``--corpus`` with a ``.npy``/binary int32 file for real tokens.
+
+Run on the CPU mesh:  PMDT_FORCE_CPU_DEVICES=8 python train_lm.py \\
+    --model gpt_tiny --parallel sp --degree 4 --sp_mode zigzag \\
+    --epochs 2 --save_path /tmp/lm
+"""
+
+import argparse
+import math
+import os
+import time
+
+parser = argparse.ArgumentParser(
+    description="TPU-native GPT training (LM counterpart of main.py)")
+parser.add_argument('--model', default='gpt_tiny', type=str,
+                    help='gpt_tiny | gpt_small | gpt_medium')
+parser.add_argument('--batch_size', default=32, type=int,
+                    help='global batch (sequences per step)')
+parser.add_argument('--seq_len', default=128, type=int)
+parser.add_argument('--epochs', default=2, type=int)
+parser.add_argument('--lr', default=0.1, type=float)
+parser.add_argument('--save_path', default='./lm_run/', type=str)
+parser.add_argument('--print_freq', default=10, type=int)
+parser.add_argument('--seed', default=0, type=int)
+parser.add_argument('--corpus', default='', type=str,
+                    help='int32 token file (.npy); empty = synthetic')
+parser.add_argument('--corpus_tokens', default=200_000, type=int,
+                    help='synthetic stream length when --corpus is empty')
+parser.add_argument('--dtype', default='float32',
+                    choices=['float32', 'bfloat16'])
+parser.add_argument('--parallel', default='dp',
+                    choices=['dp', 'sp', 'tp', 'pp'])
+parser.add_argument('--degree', default=1, type=int,
+                    help='size of the sp/tp/pp axis (data axis gets the '
+                         'rest of the devices)')
+parser.add_argument('--sp_mode', default='ring',
+                    choices=['ring', 'zigzag', 'ulysses'])
+parser.add_argument('--n_experts', default=0, type=int,
+                    help='> 0: Switch-MoE feed-forward in every block')
+parser.add_argument('--moe_aux_weight', default=0.01, type=float)
+parser.add_argument('--remat', action='store_true')
+parser.add_argument('--zero1', action='store_true',
+                    help='ZeRO-1 optimizer sharding (tp path only)')
+parser.add_argument('--fsdp', action='store_true',
+                    help='ZeRO-3 param sharding (tp path only)')
+parser.add_argument('--sample', default=0, type=int,
+                    help='after training, print N greedy-sampled tokens '
+                         '(dense dp/tp models only)')
+
+
+def main(args):
+    from pytorch_multiprocessing_distributed_tpu.utils.hostenv import (
+        force_cpu_devices_from_env)
+
+    force_cpu_devices_from_env()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pytorch_multiprocessing_distributed_tpu import models
+    from pytorch_multiprocessing_distributed_tpu.data.lm import (
+        TokenLoader, synthetic_tokens)
+    from pytorch_multiprocessing_distributed_tpu.parallel import (
+        dist, make_mesh)
+    from pytorch_multiprocessing_distributed_tpu.train.checkpoint import (
+        save_checkpoint)
+    from pytorch_multiprocessing_distributed_tpu.train.lm import (
+        create_lm_train_state, make_lm_train_step, make_lm_train_step_tp)
+    from pytorch_multiprocessing_distributed_tpu.train.optim import sgd
+    from pytorch_multiprocessing_distributed_tpu.train.step import (
+        shard_batch, shard_state)
+    from pytorch_multiprocessing_distributed_tpu.utils import Logger
+
+    dtype = jnp.bfloat16 if args.dtype == 'bfloat16' else jnp.float32
+
+    model_kw = dict(dtype=dtype, n_experts=args.n_experts)
+    if args.parallel == 'sp':
+        model_kw.update(seq_axis='seq', sp_mode=args.sp_mode)
+    if args.parallel in ('tp', 'pp'):
+        # Pallas kernels cannot run under the pp step's check_vma
+        # shard_map; for tp the XLA path avoids interpret-mode cost off
+        # TPU while staying exact
+        model_kw.update(attn_impl='xla')
+    model = models.get_model(args.model, **model_kw)
+    # Every inapplicable/oversized flag combo fails BEFORE the run (the
+    # main.py convention: a dropped flag or a post-training crash after
+    # hours of work is worse than an immediate error).
+    if args.seq_len > model.max_seq_len:
+        raise SystemExit(
+            f"--seq_len {args.seq_len} exceeds the model's "
+            f"max_seq_len {model.max_seq_len}")
+    if (args.zero1 or args.fsdp) and args.parallel != 'tp':
+        raise SystemExit(
+            "--zero1/--fsdp shard state through the GSPMD path; use "
+            f"--parallel tp (got --parallel {args.parallel})")
+    if args.remat and args.parallel == 'pp':
+        raise SystemExit(
+            "--remat is not wired into the pipelined step (the GPipe "
+            "schedule already bounds live activations to the in-flight "
+            "microbatches)")
+    if args.sample:
+        if args.parallel not in ('dp', 'tp') or args.n_experts:
+            raise SystemExit(
+                "--sample needs a dense dp/tp model (generation is "
+                "single-shard, non-MoE)")
+        if args.seq_len + args.sample > model.max_seq_len:
+            raise SystemExit(
+                f"--seq_len {args.seq_len} + --sample {args.sample} "
+                f"exceeds max_seq_len {model.max_seq_len}")
+
+    # backend/devices touched only AFTER every pure-flag validation —
+    # an invalid combo must not cost a (possibly slow) TPU bring-up
+    dist.init_process()
+    n_dev = len(jax.devices())
+    deg = args.degree if args.parallel != 'dp' else 1
+    if n_dev % max(1, deg):
+        raise SystemExit(f"{n_dev} devices not divisible by --degree {deg}")
+    dp = n_dev // max(1, deg)
+
+    if args.corpus:
+        tokens = np.load(args.corpus).astype(np.int32)
+        if tokens.max() >= model.vocab_size or tokens.min() < 0:
+            # jit CLAMPS out-of-range gathers silently — without this
+            # check an oversized-vocab corpus trains on garbage
+            raise SystemExit(
+                f"--corpus token ids span [{tokens.min()}, "
+                f"{tokens.max()}] but --model {args.model} has "
+                f"vocab_size {model.vocab_size}")
+    else:
+        tokens = synthetic_tokens(
+            args.corpus_tokens, vocab_size=model.vocab_size,
+            seed=args.seed)
+    loader = TokenLoader(
+        tokens, batch_size=args.batch_size, seq_len=args.seq_len,
+        world_size=dp, seed=args.seed)
+
+    opt = sgd(learning_rate=args.lr)
+    rng = jax.random.PRNGKey(args.seed)
+    sample_tok = jnp.zeros((2, args.seq_len), jnp.int32)
+
+    if args.parallel == 'pp':
+        from pytorch_multiprocessing_distributed_tpu.parallel import (
+            create_pipelined_lm_state, make_pipelined_lm_train_step)
+
+        mesh = make_mesh(dp, deg, axis_names=('data', 'pipe'))
+        state = create_pipelined_lm_state(
+            model, rng, sample_tok, opt, n_stages=deg)
+        step = make_pipelined_lm_train_step(model, opt, mesh)
+    elif args.parallel == 'tp':
+        mesh = make_mesh(dp, deg)
+        state = create_lm_train_state(model, rng, sample_tok, opt)
+        state = shard_state(state, mesh, zero1=args.zero1, fsdp=args.fsdp)
+        step = make_lm_train_step_tp(
+            model, opt, mesh, zero1=args.zero1, fsdp=args.fsdp,
+            remat=args.remat, moe_aux_weight=args.moe_aux_weight)
+    else:
+        axes = ('data', 'seq') if args.parallel == 'sp' else ('data',)
+        mesh = (make_mesh(dp, deg, axis_names=axes)
+                if args.parallel == 'sp' else make_mesh(dp))
+        state = create_lm_train_state(model, rng, sample_tok, opt)
+        step = make_lm_train_step(
+            model, opt, mesh,
+            seq_axis='seq' if args.parallel == 'sp' else None,
+            remat=args.remat, moe_aux_weight=args.moe_aux_weight)
+
+    os.makedirs(args.save_path, exist_ok=True)
+    logger = Logger(os.path.join(args.save_path, 'train.log'))
+    for epoch in range(1, args.epochs + 1):
+        state = state.replace(epoch=jnp.asarray(epoch, jnp.int32))
+        loader.set_epoch(epoch)
+        t0, losses, seen = time.time(), 0.0, 0
+        for i, batch in enumerate(loader):
+            tok = jnp.asarray(batch)
+            if args.parallel in ('tp', 'pp'):
+                state, metrics = step(state, tok)
+            else:
+                (tok_sharded,) = shard_batch((tok,), mesh)
+                state, metrics = step(state, tok_sharded)
+            if i % args.print_freq == 0 or i == len(loader) - 1:
+                loss = float(np.asarray(metrics['loss']))
+                losses, seen = losses + loss, seen + 1
+                if dist.is_primary():
+                    extra = ''
+                    if 'moe_aux' in metrics:
+                        extra = (f"\tAux "
+                                 f"{float(np.asarray(metrics['moe_aux'])):.3f}")
+                    print(f"Epoch: [{epoch}][{i}/{len(loader)}]\t"
+                          f"Loss {loss:.4f}\t"
+                          f"Tok/s {args.batch_size * args.seq_len * (i + 1) / (time.time() - t0):.0f}"
+                          f"{extra}", flush=True)
+        avg = losses / max(1, seen)
+        if dist.is_primary():
+            logger.write([epoch, avg, math.exp(min(avg, 20.0))])
+    save_checkpoint(args.save_path, state, args.epochs)
+
+    if args.sample and args.parallel in ('dp', 'tp') \
+            and args.n_experts == 0:
+        from pytorch_multiprocessing_distributed_tpu.inference import (
+            generate)
+
+        dense = model.clone(seq_axis=None)
+        params = jax.device_get(state.params)
+        prompt = jnp.asarray(tokens[: args.seq_len][None, :])
+        out = generate(dense, params, prompt,
+                       max_new_tokens=args.sample)
+        if dist.is_primary():
+            print("sample:", np.asarray(out[0, -args.sample:]).tolist())
+
+    dist.destroy_process_group()
+
+
+if __name__ == '__main__':
+    main(parser.parse_args())
