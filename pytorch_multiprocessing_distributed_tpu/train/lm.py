@@ -122,6 +122,7 @@ def make_lm_train_step(
     axis_name: str = DATA_AXIS,
     seq_axis: Optional[str] = None,
     remat: bool = False,
+    grad_accum: int = 1,
     moe_aux_weight: float = 0.01,
     moe_z_weight: float = 1e-3,
 ):
@@ -132,6 +133,9 @@ def make_lm_train_step(
         [B, S, V]``), built with the SAME ``seq_axis``.
       mesh: 1-D ``(data,)`` mesh, or 2-D ``(data, seq)`` when
         ``seq_axis`` is set.
+      grad_accum: microbatches per update over the batch dim (activation
+        memory of one microbatch — the long-context memory knob beside
+        ``remat``); exact same update as the single-shot step.
 
     Returns ``step(state, tokens) -> (state, metrics)``; ``tokens`` is
     the global ``[B, S]`` int array, ``metrics = {loss, count}`` (loss =
@@ -141,6 +145,11 @@ def make_lm_train_step(
     into its ``losses`` collection (``moe_aux_weight`` /
     ``moe_z_weight``; metrics gain ``moe_aux``).
     """
+    if grad_accum < 1:
+        raise ValueError(
+            f"grad_accum must be >= 1, got {grad_accum} (1 = no "
+            "accumulation; 0/negative would silently disable it)"
+        )
     axes = (axis_name,) if seq_axis is None else (axis_name, seq_axis)
     is_moe = getattr(model, "n_experts", 0) > 0
     # zigzag SP: the model was built with sp_mode="zigzag", so tokens
@@ -164,27 +173,60 @@ def make_lm_train_step(
         # under shard_map is a notorious factor-of-N trap; ring
         # attention's own custom VJP handles its internal comms). The
         # local objective is pre-normalized (CE by the global count, aux
-        # by the shard count) so ONE psum of the local grads outside is
-        # exactly the global-mean gradient.
-        def local_obj(params):
+        # by shard count x microbatch count) so ONE psum of the summed
+        # local grads outside is exactly the global-mean gradient.
+        def local_obj(params, tok, tgt, ww):
             logits, mut = model.apply(
-                {"params": params}, tokens, train=True, mutable=["losses"]
+                {"params": params}, tok, train=True, mutable=["losses"]
             )
             flat_ce = cross_entropy_per_sample(
-                logits.reshape(-1, logits.shape[-1]), targets.reshape(-1)
-            ).reshape(targets.shape)
-            ce_sum = jnp.sum(flat_ce * w)
+                logits.reshape(-1, logits.shape[-1]), tgt.reshape(-1)
+            ).reshape(tgt.shape)
+            ce_sum = jnp.sum(flat_ce * ww)
             aux, z = _collect_moe_losses(mut)
             obj = ce_sum / count + (
                 moe_aux_weight * aux + moe_z_weight * z
-            ) / world
+            ) / (world * grad_accum)
             return obj, (ce_sum, aux)
 
         if remat:
             local_obj = jax.checkpoint(local_obj)
-        (_, (loss_sum, aux)), grads = jax.value_and_grad(
-            local_obj, has_aux=True
-        )(state.params)
+
+        if grad_accum == 1:
+            (_, (loss_sum, aux)), grads = jax.value_and_grad(
+                local_obj, has_aux=True
+            )(state.params, tokens, targets, w)
+        else:
+            b = tokens.shape[0]
+            if b % grad_accum:
+                raise ValueError(
+                    f"per-device batch {b} is not divisible by "
+                    f"grad_accum={grad_accum}"
+                )
+
+            from .step import strided_microbatches
+
+            def to_micro(x):
+                return strided_microbatches(x, grad_accum)
+
+            def micro(carry, mb):
+                gsum, lsum, asum = carry
+                (_, (ce, aux_mb)), g = jax.value_and_grad(
+                    local_obj, has_aux=True
+                )(state.params, *mb)
+                return (jax.tree.map(jnp.add, gsum, g),
+                        lsum + ce, asum + aux_mb), None
+
+            carry0 = (
+                jax.tree.map(jnp.zeros_like, state.params),
+                jnp.zeros((), jnp.float32),
+                jnp.zeros((), jnp.float32),
+            )
+            (grads, loss_sum, aux_sum), _ = jax.lax.scan(
+                micro, carry0,
+                (to_micro(tokens), to_micro(targets), to_micro(w)),
+            )
+            aux = aux_sum / grad_accum
         loss = jax.lax.psum(loss_sum, axes) / count
         grads = jax.tree.map(lambda g: jax.lax.psum(g, axes), grads)
 
@@ -218,10 +260,11 @@ def make_lm_train_step(
         # not an opaque shard_map sharding failure — mirrors the image
         # path's and TokenLoader's checks.
         b, s = tokens.shape
-        if b % dp:
+        if b % (dp * grad_accum):
             raise ValueError(
-                f"global batch {b} is not divisible by the data-axis "
-                f"size {dp} (mesh axis {axis_name!r})"
+                f"global batch {b} must divide by data-axis size x "
+                f"grad_accum = {dp} x {grad_accum} (mesh axis "
+                f"{axis_name!r})"
             )
         if seq_axis is not None and s % sp:
             raise ValueError(

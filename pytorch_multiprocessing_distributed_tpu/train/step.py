@@ -123,10 +123,7 @@ def _train_body(model, optimizer: Transform, loss_fn: Callable,
                 )
 
             def to_micro(x):
-                # [b, ...] -> [accum, b//accum, ...], strided assignment
-                return x.reshape(
-                    b // grad_accum, grad_accum, *x.shape[1:]
-                ).swapaxes(0, 1)
+                return strided_microbatches(x, grad_accum)
 
             def micro(carry, mb):
                 stats, gsum, lsum, csum = carry
@@ -347,6 +344,16 @@ def _check_tp_model(model) -> None:
             "Build the model with bn_axis=None for model_parallel > 1 "
             "(see main.py)."
         )
+
+
+def strided_microbatches(x, accum: int):
+    """``[b, ...] -> [accum, b//accum, ...]``, STRIDED (sample ``i`` to
+    microbatch ``i % accum``): under GSPMD the batch dim's data-axis
+    sharding stays device-local through the reshape — a contiguous
+    split would gather each microbatch from a device subset (an
+    all-to-all). The ONE copy of the convention (image + LM steps)."""
+    b = x.shape[0]
+    return x.reshape(b // accum, accum, *x.shape[1:]).swapaxes(0, 1)
 
 
 def tp_param_spec(leaf, tp: int) -> P:

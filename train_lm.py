@@ -62,6 +62,8 @@ parser.add_argument('--n_experts', default=0, type=int,
                     help='> 0: Switch-MoE feed-forward in every block')
 parser.add_argument('--moe_aux_weight', default=0.01, type=float)
 parser.add_argument('--remat', action='store_true')
+parser.add_argument('--grad_accum', default=1, type=int,
+                    help='microbatches per update (dp/sp paths)')
 parser.add_argument('--zero1', action='store_true',
                     help='ZeRO-1 optimizer sharding (tp path only)')
 parser.add_argument('--fsdp', action='store_true',
@@ -122,6 +124,10 @@ def main(args):
             "--remat is not wired into the pipelined step (the GPipe "
             "schedule already bounds live activations to the in-flight "
             "microbatches)")
+    if args.grad_accum > 1 and args.parallel in ('tp', 'pp'):
+        raise SystemExit(
+            "--grad_accum is wired into the dp/sp step (pp microbatches "
+            "already; for tp use a smaller global batch)")
     if args.sample:
         if args.parallel not in ('dp', 'tp') or args.n_experts:
             raise SystemExit(
@@ -185,7 +191,8 @@ def main(args):
         step = make_lm_train_step(
             model, opt, mesh,
             seq_axis='seq' if args.parallel == 'sp' else None,
-            remat=args.remat, moe_aux_weight=args.moe_aux_weight)
+            remat=args.remat, grad_accum=args.grad_accum,
+            moe_aux_weight=args.moe_aux_weight)
 
     os.makedirs(args.save_path, exist_ok=True)
     logger = Logger(os.path.join(args.save_path, 'train.log'))
