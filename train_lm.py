@@ -42,6 +42,11 @@ parser.add_argument('--batch_size', default=32, type=int,
 parser.add_argument('--seq_len', default=128, type=int)
 parser.add_argument('--epochs', default=2, type=int)
 parser.add_argument('--lr', default=0.1, type=float)
+parser.add_argument('--lr_schedule', default='constant',
+                    choices=['constant', 'cosine'],
+                    help='cosine = decay to 0 over --epochs with '
+                         '--warmup_epochs linear warmup')
+parser.add_argument('--warmup_epochs', default=0, type=int)
 parser.add_argument('--save_path', default='./lm_run/', type=str)
 parser.add_argument('--print_freq', default=10, type=int)
 parser.add_argument('--seed', default=0, type=int)
@@ -138,6 +143,18 @@ def main(args):
                 f"--seq_len {args.seq_len} + --sample {args.sample} "
                 f"exceeds max_seq_len {model.max_seq_len}")
 
+    if args.lr_schedule == 'cosine':
+        from pytorch_multiprocessing_distributed_tpu.train.optim import (
+            cosine_lr)
+
+        lr = cosine_lr(args.lr, args.epochs,
+                       warmup_epochs=args.warmup_epochs)
+    else:
+        if args.warmup_epochs:
+            raise SystemExit(
+                "--warmup_epochs applies to --lr_schedule cosine")
+        lr = args.lr
+
     # backend/devices touched only AFTER every pure-flag validation —
     # an invalid combo must not cost a (possibly slow) TPU bring-up
     dist.init_process()
@@ -164,7 +181,7 @@ def main(args):
         tokens, batch_size=args.batch_size, seq_len=args.seq_len,
         world_size=dp, seed=args.seed)
 
-    opt = sgd(learning_rate=args.lr)
+    opt = sgd(learning_rate=lr)
     rng = jax.random.PRNGKey(args.seed)
     sample_tok = jnp.zeros((2, args.seq_len), jnp.int32)
 
