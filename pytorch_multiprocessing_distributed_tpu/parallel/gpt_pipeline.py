@@ -15,10 +15,14 @@ instead:
   over ``pipe`` — stage *s* holds only its own layers; microbatches flow
   through :func:`.pipeline.pipeline_apply` (``ppermute`` ring, GPipe
   schedule, differentiable scan);
-- **head**: output-vocab sharded over ``pipe``; the next-token loss is
-  computed vocab-parallel (local partial logits, ``pmax``/``psum``
-  log-sum-exp) so the full ``[B, S, V]`` logits tensor never
-  materializes anywhere.
+- **head**: output-vocab sharded over ``pipe``; under the default
+  ``schedule="gpipe"`` the next-token loss is computed vocab-parallel
+  (local partial logits, ``pmax``/``psum`` log-sum-exp) so the full
+  ``[B, S, V]`` logits tensor never materializes anywhere. The
+  ``"1f1b"`` schedule instead weight-GATHERS the head for the step and
+  evaluates a dense per-microbatch CE where the last stage's output
+  lands (``[mb, s, V]`` only — params stay vocab-sharded at rest; the
+  trade buys O(n_stages) activation residency, see ``body_1f1b``).
 
 Every parameter therefore has exactly one resident shard per pipe
 stage (embed/head rows live where their slice lives), composing with
@@ -175,15 +179,23 @@ def make_pipelined_lm_train_step(
     axis_name: str = DATA_AXIS,
     pipe_axis: str = PIPE_AXIS,
     n_microbatches: Optional[int] = None,
+    schedule: str = "gpipe",
 ):
     """Build the jitted DP x PP LM train step.
 
     Args:
       model: a dense ``GPT`` (provides block geometry and dtype).
       mesh: 2-D ``(data, pipe)`` mesh (either axis may be 1).
-      n_microbatches: GPipe microbatches per step (default: the pipe
-        axis size — the minimum that keeps every stage busy; more
-        shrinks the bubble fraction ``(S-1)/(M+S-1)`` further).
+      n_microbatches: microbatches per step (default: the pipe axis
+        size — the minimum that keeps every stage busy; more shrinks
+        the bubble fraction further).
+      schedule: ``"gpipe"`` (autodiff through the forward schedule —
+        simplest, but the reversed scan stashes residuals for all M
+        microbatches) or ``"1f1b"`` (:func:`.pipeline.pipeline_1f1b` —
+        each microbatch's backward starts as soon as its forward leaves
+        the last stage, O(n_stages) activation residency independent of
+        M, rematerialized stage backward). Same math either way — the
+        trajectory-parity test pins gpipe == 1f1b == plain DP.
 
     Returns ``step(state, tokens) -> (state, metrics)`` with ``state``
     from :func:`create_pipelined_lm_state`; ``tokens`` is the global
@@ -194,8 +206,12 @@ def make_pipelined_lm_train_step(
     from ..train.lm import _next_token_targets
     from ..train.optim import OptState, apply_updates
     from ..train.state import TrainState
-    from .pipeline import pipeline_apply
+    from .pipeline import pipeline_1f1b, pipeline_apply
 
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(
+            f"schedule must be 'gpipe' or '1f1b', got {schedule!r}"
+        )
     n_stages = int(mesh.shape[pipe_axis])
     dp = int(mesh.shape[axis_name])
     m = n_microbatches or n_stages
@@ -205,6 +221,33 @@ def make_pipelined_lm_train_step(
     # exact math.
     block = Block(model.num_heads, model.mlp_dim, model.dtype,
                   attn_impl="xla")
+
+    # Pieces shared verbatim by the gpipe and 1f1b bodies — ONE copy so
+    # the two schedules cannot drift apart numerically.
+    def stage_fn(stage_params, x):
+        # stage_params leaves [L/S, ...]: scan this stage's layers
+        def layer(carry, lp):
+            return block.apply({"params": lp}, carry), None
+
+        y, _ = jax.lax.scan(layer, x, stage_params)
+        return y
+
+    def vocab_parallel_embed(emb, pos, tokens, i):
+        """Gather the locally-owned rows, psum to materialize [B, S, D]."""
+        emb0 = emb[0]  # [Vs, D]
+        vs = emb0.shape[0]
+        start = i * vs
+        idx = tokens - start
+        mine = jnp.logical_and(idx >= 0, idx < vs)
+        h = emb0[jnp.clip(idx, 0, vs - 1)] * mine[..., None]
+        h = jax.lax.psum(h, pipe_axis)
+        return (h + pos[: tokens.shape[1]]).astype(model.dtype)
+
+    def final_ln(h, lnf):
+        mu = jnp.mean(h, -1, keepdims=True)
+        var = jnp.var(h, -1, keepdims=True)
+        h = (h - mu) * jax.lax.rsqrt(var + _LN_EPS)
+        return h * lnf["scale"] + lnf["bias"]
 
     def body(state: TrainState, tokens):
         targets, valid = _next_token_targets(tokens, None)
@@ -220,36 +263,19 @@ def make_pipelined_lm_train_step(
 
         def local_obj(p):
             # ---- vocab-parallel embedding (rows live on their stage)
-            emb = p["embed"][0]  # [Vs, D]
-            vs = emb.shape[0]
+            vs = p["embed"].shape[1]
             start = i * vs
-            idx = tokens - start
-            mine = jnp.logical_and(idx >= 0, idx < vs)
-            h = emb[jnp.clip(idx, 0, vs - 1)] * mine[..., None]
-            h = jax.lax.psum(h, pipe_axis)  # [B, S, D] on every stage
-            h = (h + p["pos"][:s]).astype(model.dtype)
+            h = vocab_parallel_embed(p["embed"], p["pos"], tokens, i)
 
             # ---- GPipe over the block stages
             micro = h.reshape(m, b // m, s, h.shape[-1])
-
-            def stage_fn(stage_params, x):
-                # stage_params leaves [L/S, ...]: scan this stage's layers
-                def layer(carry, lp):
-                    return block.apply({"params": lp}, carry), None
-
-                y, _ = jax.lax.scan(layer, x, stage_params)
-                return y
-
             out = pipeline_apply(
                 stage_fn, p["blocks"], micro, axis_name=pipe_axis
             )
             h = out.reshape(b, s, -1).astype(jnp.float32)
 
             # ---- final LN (replicated; flax LayerNorm convention)
-            mu = jnp.mean(h, -1, keepdims=True)
-            var = jnp.var(h, -1, keepdims=True)
-            h = (h - mu) * jax.lax.rsqrt(var + _LN_EPS)
-            h = h * p["ln_f"]["scale"] + p["ln_f"]["bias"]
+            h = final_ln(h, p["ln_f"])
 
             # ---- vocab-parallel head + log-sum-exp CE: the [B, S, V]
             # logits never materialize; each stage scores its vocab
@@ -298,6 +324,101 @@ def make_pipelined_lm_train_step(
         loss = jax.lax.psum(ce_sum, axis_name) / count
         return new_state, {"loss": loss, "count": count}
 
+    def body_1f1b(state: TrainState, tokens):
+        """Manual-VJP twin of ``body`` built on :func:`pipeline_1f1b`.
+
+        Differences from the GPipe body, both standard 1F1B structure:
+        the per-microbatch loss must be computable where the last
+        stage's output lands, so (a) the head is weight-GATHERED over
+        ``pipe`` for the step (Megatron-style: gather the [D, V/S]
+        slices, grads return through the all_gather transpose as a
+        psum_scatter — params stay vocab-sharded at rest), and (b) the
+        final-LN + CE run per-microbatch inside the schedule rather
+        than once over the full batch.
+        """
+        targets, valid = _next_token_targets(tokens, None)
+        w = valid.astype(jnp.float32)
+        count = jax.lax.psum(jnp.sum(w), axis_name)
+        b, s = tokens.shape
+        if b % m:
+            raise ValueError(
+                f"per-replica batch {b} is not divisible by "
+                f"n_microbatches={m}"
+            )
+        i = jax.lax.axis_index(pipe_axis)
+        p = state.params
+        mb = b // m
+
+        # ---- vocab-parallel embedding, differentiated via vjp so the
+        # schedule's input cotangent flows back to the embed rows
+        def embed_fn(emb, pos):
+            h = vocab_parallel_embed(emb, pos, tokens, i)
+            return h.reshape(m, mb, s, h.shape[-1])
+
+        micro, embed_vjp = jax.vjp(embed_fn, p["embed"], p["pos"])
+
+        # ---- gather the vocab-sharded head for the last-stage loss
+        def gather_fn(hk, hb):
+            full_k = jax.lax.all_gather(
+                hk[0], pipe_axis, axis=1, tiled=True
+            )  # [D, S*Vs]
+            full_b = jax.lax.all_gather(
+                hb[0], pipe_axis, axis=0, tiled=True
+            )  # [S*Vs]; padded slots carry -1e9 => zero softmax mass
+            return full_k, full_b
+
+        (full_k, full_b), gather_vjp = jax.vjp(
+            gather_fn, p["head_k"], p["head_b"]
+        )
+        loss_params = (full_k, full_b, p["ln_f"])
+        aux = (
+            targets.reshape(m, mb, s),
+            w.reshape(m, mb, s),
+        )
+
+        def mb_loss(lp, y, aux_j):
+            fk, fb, lnf = lp
+            tj, wj = aux_j
+            h = final_ln(y.astype(jnp.float32), lnf)
+            logits = h @ fk + fb  # [mb, s, Vpad] f32
+            gmax = jax.lax.stop_gradient(jnp.max(logits, -1))
+            lse = jnp.log(jnp.sum(
+                jnp.exp(logits - gmax[..., None]), -1
+            )) + gmax
+            tlogit = jnp.take_along_axis(
+                logits, tj[..., None], -1
+            )[..., 0]
+            return jnp.sum((lse - tlogit) * wj) / count
+
+        loss_local, d_blocks, d_lp, d_micro = pipeline_1f1b(
+            stage_fn, p["blocks"], micro, mb_loss, loss_params, aux,
+            axis_name=pipe_axis,
+        )
+        d_fk, d_fb, d_lnf = d_lp
+        # gather_vjp's psum_scatter SUMS the per-shard partials itself —
+        # feed them unreduced (a pre-psum would overcount by n_stages)
+        d_hk, d_hb = gather_vjp((d_fk, d_fb))
+        d_emb, d_pos = embed_vjp(d_micro)
+        grads = {
+            "embed": d_emb,
+            "pos": d_pos,
+            "blocks": d_blocks,
+            # ln_f is replicated over pipe; its partials need the psum
+            "ln_f": jax.tree.map(
+                lambda g: jax.lax.psum(g, pipe_axis), d_lnf
+            ),
+            "head_k": d_hk,
+            "head_b": d_hb,
+        }
+        updates, new_opt = optimizer.update(
+            grads, state.opt_state, state.params, lr_step=state.epoch
+        )
+        new_state = state.replace(
+            params=apply_updates(state.params, updates), opt_state=new_opt
+        )
+        loss = jax.lax.psum(loss_local, axis_name)
+        return new_state, {"loss": loss, "count": count}
+
     def specs_for(state):
         # ONE source of truth for the param layout (pipeline_specs),
         # mirrored onto the full TrainState pytree
@@ -324,7 +445,7 @@ def make_pipelined_lm_train_step(
             )
         sspec = specs_for(state)
         sharded = jax.shard_map(
-            body,
+            body_1f1b if schedule == "1f1b" else body,
             mesh=mesh,
             in_specs=(sspec, P(axis_name)),
             out_specs=(sspec, {"loss": P(), "count": P()}),

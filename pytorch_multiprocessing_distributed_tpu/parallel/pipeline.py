@@ -39,6 +39,33 @@ import jax
 import jax.numpy as jnp
 
 
+def _vary(x, axis_name):
+    """Mark ``x`` device-varying over ``axis_name`` if it isn't already
+    (check_vma bookkeeping for values entering the per-shard schedule)."""
+    if axis_name in getattr(jax.typeof(x), "vma", frozenset()):
+        return x
+    return jax.lax.pcast(x, axis_name, to="varying")
+
+
+def _zeros_vma(shape, dtype, vma_of):
+    """Zeros carrying ``vma_of``'s device-varying axes — fresh constants
+    are replication-invariant, which would make a scan carry's vma
+    narrower than the values written into it (jax.vjp then rejects the
+    cotangents as type-mismatched)."""
+    z = jnp.zeros(shape, dtype)
+    want = getattr(jax.typeof(vma_of), "vma", frozenset())
+    have = getattr(jax.typeof(z), "vma", frozenset())
+    for ax in want - have:
+        z = jax.lax.pcast(z, ax, to="varying")
+    return z
+
+
+def _zeros_like_tree_vma(tree):
+    return jax.tree.map(
+        lambda l: _zeros_vma(jnp.shape(l), jnp.result_type(l), l), tree
+    )
+
+
 def pipeline_apply(
     stage_fn: Callable,
     stage_params,
@@ -70,12 +97,7 @@ def pipeline_apply(
     # check_vma=False the transpose of the final psum over-counts
     # gradients by the axis size. Mark the device-varying values
     # explicitly so the checker accepts the scan carries.
-    def vary(x):
-        if axis_name in getattr(jax.typeof(x), "vma", frozenset()):
-            return x  # caller already passed a varying value
-        return jax.lax.pcast(x, axis_name, to="varying")
-
-    microbatches = vary(microbatches)
+    microbatches = _vary(microbatches, axis_name)
 
     def tick(carry, t):
         act, out = carry
@@ -103,3 +125,146 @@ def pipeline_apply(
     # `out` is populated only on the last shard; replicate it
     mask = (i == n - 1).astype(out.dtype)
     return jax.lax.psum(out * mask, axis_name)
+
+
+def pipeline_1f1b(
+    stage_fn: Callable,
+    stage_params,
+    microbatches: jax.Array,
+    loss_fn: Callable,
+    loss_params,
+    aux,
+    *,
+    axis_name: str,
+):
+    """1F1B pipelined training pass: loss + grads in one schedule.
+
+    :func:`pipeline_apply` + autodiff is GPipe: ALL forwards run before
+    any backward, so the reversed scan stashes per-tick residuals for
+    every one of the ``M`` microbatches — activation memory grows with
+    ``M``, which defeats the point of microbatching. 1F1B starts each
+    microbatch's backward as soon as its forward leaves the last stage;
+    at any instant a stage holds at most ``2S - 1`` stage-INPUTS (a
+    rolling buffer, independent of ``M``) and rematerializes the stage
+    forward inside the backward tick (the classic remat trade: one extra
+    stage-forward per backward buys O(S) instead of O(M) residency).
+
+    Schedule (tick ``t``, stage ``s`` of ``S``, microbatch ``j``):
+    forward of ``j`` runs at ``t = j + s``; the last stage computes the
+    per-microbatch loss and its output cotangent immediately; backward
+    of ``j`` runs at ``t = j + 2S - 1 - s``. Every steady-state tick is
+    exactly one-forward-one-backward per stage. Activations hop +1 on
+    the ``ppermute`` ring, cotangents hop -1, both overlapped with
+    compute by XLA. Total ``M + 2S - 1`` ticks.
+
+    Args:
+      stage_fn: ``stage_fn(params, x) -> y`` with ``y.shape == x.shape``
+        (pure local compute — no collectives; it runs under ``jax.vjp``
+        inside the schedule).
+      stage_params: THIS shard's stage parameters (leaves carry the
+        leading stage dim of 1 from a ``P(axis_name)`` in_spec).
+      microbatches: ``[M, mb, ...]`` input microbatches.
+      loss_fn: ``loss_fn(loss_params, y, aux_j) -> scalar`` per-
+        microbatch loss, evaluated where the LAST stage's output lands.
+        Local ops only — it executes on every stage every tick (masked),
+        so a collective inside it would change meaning.
+      loss_params: parameters of the loss head (e.g. final-LN / head
+        weights). Grads come back as per-shard PARTIAL sums (nonzero
+        only where the last stage contributed): ``psum`` them for
+        replicated params, or feed them raw to the transpose of the
+        collective that built them (e.g. an ``all_gather``'s vjp).
+      aux: pytree of ``[M, ...]`` per-microbatch loss inputs (targets,
+        weights); no gradients flow to it.
+      axis_name: the bound pipe mesh axis.
+
+    Returns:
+      ``(loss_sum, dstage_params, dloss_params, dmicrobatches)``:
+      summed loss over microbatches (replicated over the axis), grads
+      for this shard's stage params (same leading-1 shape), UNREDUCED
+      per-shard loss-param grads (see above), and the ``[M, mb, ...]``
+      input cotangent (replicated over the axis).
+    """
+    n = jax.lax.psum(1, axis_name)  # static python int under shard_map
+    i = jax.lax.axis_index(axis_name)
+    m = microbatches.shape[0]
+    buf = 2 * n - 1  # max in-flight stage-inputs (stage 0's lifetime)
+    params = jax.tree.map(lambda l: jnp.squeeze(l, axis=0), stage_params)
+    perm_fwd = [(j, (j + 1) % n) for j in range(n)]
+    perm_bwd = [(j, (j - 1) % n) for j in range(n)]
+
+    microbatches = _vary(microbatches, axis_name)
+    aux = jax.tree.map(lambda l: _vary(l, axis_name), aux)
+    loss_params = jax.tree.map(lambda l: _vary(l, axis_name), loss_params)
+
+    def masked_add(acc, g, mask):
+        return jax.tree.map(
+            lambda a, gg: a + gg * mask.astype(gg.dtype), acc, g
+        )
+
+    def tick(carry, t):
+        act_in, cot_in, resid, dy_buf, dps, dlps, dmb, loss_acc = carry
+
+        # ---- forward: microbatch j_f = t - i flows through this stage
+        j_f = t - i
+        f_valid = jnp.logical_and(j_f >= 0, j_f < m)
+        inj = microbatches[jnp.clip(t, 0, m - 1)]
+        x_in = jnp.where(i == 0, inj, act_in)
+        y = stage_fn(params, x_in)
+
+        # last stage: per-microbatch loss + output cotangent for j_f,
+        # banked one tick (its backward runs at t + 1)
+        aux_j = jax.tree.map(lambda l: l[jnp.clip(j_f, 0, m - 1)], aux)
+        loss_j, loss_vjp = jax.vjp(
+            lambda lp, yy: loss_fn(lp, yy, aux_j), loss_params, y
+        )
+        dlp_j, dy_j = loss_vjp(jnp.ones_like(loss_j))
+        l_valid = jnp.logical_and(f_valid, i == n - 1)
+        loss_acc = loss_acc + jnp.where(l_valid, loss_j, 0.0)
+        dlps = masked_add(dlps, dlp_j, l_valid)
+        new_dy = jnp.where(l_valid, dy_j, jnp.zeros_like(dy_j))
+
+        # ---- backward: microbatch j_b = t - (2S-1) + i, rematerialized
+        # from the stored stage input. Residual READ happens before the
+        # forward WRITE below: at stage 0 the two share a slot on the
+        # very tick j_b's storage is retired (j_f - j_b == buf).
+        j_b = t - (2 * n - 1) + i
+        b_valid = jnp.logical_and(j_b >= 0, j_b < m)
+        x_saved = resid[jnp.mod(j_b, buf)]
+        g_in = jnp.where(i == n - 1, dy_buf, cot_in)
+        _, stage_vjp = jax.vjp(stage_fn, params, x_saved)
+        dp_j, dx_j = stage_vjp(g_in)
+        dps = masked_add(dps, dp_j, b_valid)
+        sb = jnp.clip(j_b, 0, m - 1)
+        take = jnp.logical_and(b_valid, i == 0)
+        dmb = dmb.at[sb].set(jnp.where(take, dx_j, dmb[sb]))
+
+        # now bank this tick's forward input
+        sf = jnp.mod(j_f, buf)
+        resid = resid.at[sf].set(jnp.where(f_valid, x_in, resid[sf]))
+
+        act_out = jax.lax.ppermute(y, axis_name, perm_fwd)
+        cot_out = jax.lax.ppermute(dx_j, axis_name, perm_bwd)
+        return (
+            act_out, cot_out, resid, new_dy, dps, dlps, dmb, loss_acc
+        ), None
+
+    mb0 = microbatches[0]
+    z = _zeros_vma(mb0.shape, mb0.dtype, mb0)
+    carry0 = (
+        z,                                                # fwd ring
+        z,                                                # bwd ring
+        _zeros_vma((buf,) + z.shape, z.dtype, mb0),       # input residuals
+        z,                                        # banked loss cotangent
+        _zeros_like_tree_vma(params),             # stage-param grads
+        _zeros_like_tree_vma(loss_params),
+        _zeros_vma(microbatches.shape, microbatches.dtype, mb0),
+        _zeros_vma((), jnp.float32, mb0),         # loss accumulator
+    )
+    (_, _, _, _, dps, dlps, dmb, loss_acc), _ = jax.lax.scan(
+        tick, carry0, jnp.arange(m + 2 * n - 1)
+    )
+
+    loss_sum = jax.lax.psum(loss_acc, axis_name)  # last stage holds it
+    dmb = jax.lax.psum(dmb, axis_name)            # stage 0 holds it
+    dstage = jax.tree.map(lambda g: jnp.expand_dims(g, 0), dps)
+    return loss_sum, dstage, dlps, dmb

@@ -56,6 +56,25 @@ def test_cli_tp_and_pp_trajectories_match(tmp_path):
 
 
 @pytest.mark.slow
+def test_cli_pp_1f1b_matches_gpipe(tmp_path):
+    _, g_loss = _run(tmp_path / "g", "--parallel", "pp", "--degree", "4")
+    _, f_loss = _run(tmp_path / "f", "--parallel", "pp", "--degree", "4",
+                     "--pp_schedule", "1f1b")
+    assert abs(g_loss - f_loss) < 5e-3 * g_loss
+
+
+def test_cli_pp_schedule_needs_pp(tmp_path):
+    env = dict(os.environ, PMDT_FORCE_CPU_DEVICES="8")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "train_lm.py"),
+         "--parallel", "dp", "--pp_schedule", "1f1b"],
+        env=env, capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert proc.returncode != 0
+    assert "--parallel pp" in proc.stderr
+
+
+@pytest.mark.slow
 def test_cli_moe_reports_aux(tmp_path):
     out, _ = _run(tmp_path, "--parallel", "dp", "--n_experts", "2")
     assert "Aux" in out

@@ -58,6 +58,11 @@ parser.add_argument('--dtype', default='float32',
                     choices=['float32', 'bfloat16'])
 parser.add_argument('--parallel', default='dp',
                     choices=['dp', 'sp', 'tp', 'pp'])
+parser.add_argument('--pp_schedule', default='gpipe',
+                    choices=['gpipe', '1f1b'],
+                    help='pipeline schedule: gpipe (autodiff through '
+                         'the forward schedule) or 1f1b (interleaved '
+                         'fwd/bwd, O(stages) activation residency)')
 parser.add_argument('--degree', default=1, type=int,
                     help='size of the sp/tp/pp axis (data axis gets the '
                          'rest of the devices)')
@@ -124,11 +129,15 @@ def main(args):
         raise SystemExit(
             "--zero1/--fsdp shard state through the GSPMD path; use "
             f"--parallel tp (got --parallel {args.parallel})")
+    if args.pp_schedule != 'gpipe' and args.parallel != 'pp':
+        raise SystemExit(
+            f"--pp_schedule {args.pp_schedule} only applies to "
+            f"--parallel pp (got --parallel {args.parallel})")
     if args.remat and args.parallel == 'pp':
         raise SystemExit(
-            "--remat is not wired into the pipelined step (the GPipe "
-            "schedule already bounds live activations to the in-flight "
-            "microbatches)")
+            "--remat is not wired into the pipelined step (gpipe bounds "
+            "live activations to the in-flight microbatches; 1f1b "
+            "already rematerializes each stage backward internally)")
     if args.grad_accum > 1 and args.parallel in ('tp', 'pp'):
         raise SystemExit(
             "--grad_accum is wired into the dp/sp step (pp microbatches "
@@ -192,7 +201,8 @@ def main(args):
         mesh = make_mesh(dp, deg, axis_names=('data', 'pipe'))
         state = create_pipelined_lm_state(
             model, rng, sample_tok, opt, n_stages=deg)
-        step = make_pipelined_lm_train_step(model, opt, mesh)
+        step = make_pipelined_lm_train_step(
+            model, opt, mesh, schedule=args.pp_schedule)
     elif args.parallel == 'tp':
         mesh = make_mesh(dp, deg)
         state = create_lm_train_state(model, rng, sample_tok, opt)
