@@ -121,6 +121,7 @@ class Block(nn.Module):
     n_experts: int = 0  # > 0: Switch-style MoE feed-forward (EP seam)
     expert_axis: Optional[str] = None
     attn_impl: str = "flash"
+    moe_top_k: int = 1
 
     @nn.compact
     def __call__(self, x):
@@ -137,7 +138,7 @@ class Block(nn.Module):
             h = MoEMlp(
                 n_experts=self.n_experts, d_hidden=self.mlp_dim,
                 expert_axis=self.expert_axis, dtype=self.dtype,
-                name="moe",
+                top_k=self.moe_top_k, name="moe",
             )(h)
         else:
             h = nn.Dense(self.mlp_dim, dtype=self.dtype,
@@ -166,6 +167,7 @@ class GPT(nn.Module):
     n_experts: int = 0  # > 0: MoE feed-forward in every block
     expert_axis: Optional[str] = None
     attn_impl: str = "flash"  # "flash" (Pallas) | "xla" (plain masked)
+    moe_top_k: int = 1  # experts per token (1 = Switch, 2 = GShard)
     bn_axis: Optional[str] = None  # unused (no BN); registry parity
 
     @nn.compact
@@ -216,7 +218,7 @@ class GPT(nn.Module):
         for i in range(self.num_layers):
             x = Block(self.num_heads, self.mlp_dim, self.dtype,
                       self.seq_axis, self.sp_mode, self.n_experts,
-                      self.expert_axis, self.attn_impl,
+                      self.expert_axis, self.attn_impl, self.moe_top_k,
                       name=f"block_{i}")(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
         logits = nn.Dense(self.vocab_size, dtype=jnp.float32,

@@ -9,15 +9,19 @@ annotation, and GSPMD partitions the dispatch/combine einsums —
 lowering them to the all-to-all exchanges an NCCL MoE implementation
 would hand-write.
 
-Routing (Switch Transformer, top-1):
+Routing (Switch Transformer top-1 by default; ``top_k >= 2`` switches
+to GShard-style renormalized top-k with choice-priority capacity):
   gates  = softmax(x @ Wg)                      [B, S, E]
-  expert = argmax(gates)                        [B, S]
-  slot   = position of each token within its expert's capacity C
-           (C = ceil(S * capacity_factor / E)); tokens past capacity
-           are DROPPED (their output is 0 — the residual carries them)
-  dispatch[b, s, e, c] = 1 iff token (b, s) is slot c of expert e
+  expert = top_k(gates) choices                 [B, S, K]
+  slot   = position of each (token, choice) within its expert's
+           capacity C = ceil(S * K * capacity_factor / E); choice j
+           claims slots only after every choice < j; assignments past
+           capacity are DROPPED (output 0 — the residual carries them)
+  dispatch[b, s, e, c] = 1 iff some choice of token (b, s) is slot c
+           of expert e
   h = expert_mlp_e(dispatch^T x)                [E, B, C, D] (vmapped)
-  y[b, s] = gate[b, s, expert] * h[expert, b, slot]
+  y[b, s] = sum_j weight_j * h[expert_j, b, slot_j]
+           (weight = raw top prob for K=1, renormalized top-K else)
 
 Under ``shard_expert_params`` + a mesh, each device stores E/ep of the
 expert weights and computes only its experts' FLOPs.
@@ -45,6 +49,11 @@ class MoEMlp(nn.Module):
         (use together with :func:`shard_expert_params`); ``None`` runs
         unconstrained (single device / tests).
       dtype: compute dtype (params stay f32).
+      top_k: experts per token. 1 = Switch (combine weight is the RAW
+        top softmax probability); >= 2 = GShard-style (weights are the
+        top-k probabilities renormalized to sum to 1; choice ``j``
+        claims capacity slots only after every choice ``< j`` — a
+        token's secondary expert drops before anyone's primary does).
     """
 
     n_experts: int
@@ -52,12 +61,22 @@ class MoEMlp(nn.Module):
     capacity_factor: float = 1.0
     expert_axis: Optional[str] = None
     dtype: Any = None
+    top_k: int = 1
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
+        if not 1 <= self.top_k <= self.n_experts:
+            raise ValueError(
+                f"top_k must be in [1, n_experts={self.n_experts}], "
+                f"got {self.top_k}"
+            )
         b, s, d = x.shape
         e = self.n_experts
-        cap = max(1, int(-(-s * self.capacity_factor // e)))
+        # capacity scales with top_k: k assignments per token compete
+        # for the same expert slots (GShard sizes top-2 at 2S/E)
+        cap = max(
+            1, int(-(-s * self.top_k * self.capacity_factor // e))
+        )
         dtype = self.dtype or x.dtype
 
         wg = self.param("gate", nn.initializers.lecun_normal(), (d, e),
@@ -72,25 +91,30 @@ class MoEMlp(nn.Module):
             jnp.float32)
         b2 = self.param("b2", nn.initializers.zeros, (e, d), jnp.float32)
 
+        k = self.top_k
         router_logits = x.astype(jnp.float32) @ wg  # [B, S, E]
         gates = jax.nn.softmax(
             router_logits, axis=-1
         )  # [B, S, E] — routing math in f32 always
-        expert = jnp.argmax(gates, axis=-1)  # [B, S]
-        gate = jnp.max(gates, axis=-1)  # [B, S]
-
-        onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)  # [B, S, E]
+        topv, topi = jax.lax.top_k(gates, k)  # [B, S, K]
+        if k == 1:
+            weights = topv  # Switch: the raw top probability
+        else:
+            # GShard: renormalize over the selected experts
+            weights = topv / jnp.sum(topv, axis=-1, keepdims=True)
+        onehots = jax.nn.one_hot(topi, e, dtype=jnp.float32)  # [B, S, K, E]
 
         # Load-balancing auxiliary loss (Switch Transformer): E * <f, p>
-        # where f_e = fraction of tokens dispatched to expert e (hard,
-        # pre-capacity) and p_e = mean router probability of expert e.
-        # Minimized (= 1.0) at uniform routing; without it top-1 routing
-        # collapses onto a few experts in real training. Differentiable
-        # through p only (f is argmax-hard), which is exactly the Switch
-        # formulation. Sown under the "losses" collection — training
-        # steps read it via ``mutable=["losses"]`` and add
-        # ``weight * aux``; eval/apply without mutable discards it.
-        f = jnp.mean(onehot.reshape(-1, e), axis=0)  # [E]
+        # where f_e = fraction of tokens whose PRIMARY choice is expert
+        # e (hard, pre-capacity — also the GShard convention for top-2)
+        # and p_e = mean router probability of expert e. Minimized
+        # (= 1.0) at uniform routing; without it routing collapses onto
+        # a few experts in real training. Differentiable through p only
+        # (f is argmax-hard), which is exactly the Switch formulation.
+        # Sown under the "losses" collection — training steps read it
+        # via ``mutable=["losses"]`` and add ``weight * aux``;
+        # eval/apply without mutable discards it.
+        f = jnp.mean(onehots[:, :, 0, :].reshape(-1, e), axis=0)  # [E]
         p = jnp.mean(gates.reshape(-1, e), axis=0)  # [E]
         self.sow("losses", "moe_aux", e * jnp.sum(f * p))
         # Router z-loss (ST-MoE): mean logsumexp(logits)^2 keeps router
@@ -99,15 +123,29 @@ class MoEMlp(nn.Module):
             "losses", "moe_z",
             jnp.mean(jax.nn.logsumexp(router_logits, axis=-1) ** 2),
         )
-        # slot of each token within its expert (0-based), per batch row
-        pos = jnp.cumsum(onehot, axis=1) * onehot  # [B, S, E], 1-based
-        slot = (jnp.sum(pos, axis=-1) - 1.0).astype(jnp.int32)  # [B, S]
-        kept = (slot < cap)[..., None]  # tokens past capacity drop
-        dispatch = (
-            onehot[..., None]
-            * jax.nn.one_hot(jnp.clip(slot, 0, cap - 1), cap)[:, :, None, :]
-            * kept[..., None]
-        )  # [B, S, E, C]
+
+        # Per-choice capacity slots: choice j's tokens claim an
+        # expert's slots only after every choice < j (sequence order
+        # within a choice), so a secondary assignment can never evict a
+        # primary one. ``offset`` carries the running per-expert count.
+        dispatches = []
+        offset = jnp.zeros((b, 1, e), jnp.float32)
+        for j in range(k):
+            oh = onehots[:, :, j, :]  # [B, S, E]
+            pos = (jnp.cumsum(oh, axis=1) + offset) * oh  # 1-based
+            slot = (jnp.sum(pos, axis=-1) - 1.0).astype(jnp.int32)
+            offset = offset + jnp.sum(oh, axis=1, keepdims=True)
+            kept = (slot < cap)[..., None]  # tokens past capacity drop
+            dispatches.append(
+                oh[..., None]
+                * jax.nn.one_hot(
+                    jnp.clip(slot, 0, cap - 1), cap
+                )[:, :, None, :]
+                * kept[..., None]
+            )  # [B, S, E, C]
+        # a token's choices go to DIFFERENT experts, so the per-choice
+        # dispatch masks are disjoint and their sum stays one-hot
+        dispatch = sum(dispatches)
 
         xin = x.astype(dtype)
         expert_in = jnp.einsum(
@@ -122,7 +160,9 @@ class MoEMlp(nn.Module):
         h = jax.vmap(one_expert)(expert_in, w1, b1, w2, b2)  # [E, B, C, D]
         h = self._constrain(h)
 
-        combine = dispatch * gate[..., None, None]  # [B, S, E, C]
+        combine = sum(
+            dispatches[j] * weights[:, :, j, None, None] for j in range(k)
+        )  # [B, S, E, C]
         y = jnp.einsum(
             "bsec,ebcd->bsd", combine.astype(dtype), h
         )  # the all-to-all return + weighted combine

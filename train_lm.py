@@ -70,6 +70,9 @@ parser.add_argument('--sp_mode', default='ring',
                     choices=['ring', 'zigzag', 'ulysses'])
 parser.add_argument('--n_experts', default=0, type=int,
                     help='> 0: Switch-MoE feed-forward in every block')
+parser.add_argument('--moe_top_k', default=1, type=int,
+                    help='experts per token: 1 = Switch (raw top prob), '
+                         '>= 2 = GShard (renormalized top-k weights)')
 parser.add_argument('--moe_aux_weight', default=0.01, type=float)
 parser.add_argument('--remat', action='store_true')
 parser.add_argument('--grad_accum', default=1, type=int,
@@ -110,6 +113,10 @@ def main(args):
     dtype = jnp.bfloat16 if args.dtype == 'bfloat16' else jnp.float32
 
     model_kw = dict(dtype=dtype, n_experts=args.n_experts)
+    if args.moe_top_k != 1:
+        if not args.n_experts:
+            raise SystemExit('--moe_top_k needs --n_experts > 0')
+        model_kw.update(moe_top_k=args.moe_top_k)
     if args.parallel == 'sp':
         model_kw.update(seq_axis='seq', sp_mode=args.sp_mode)
     if args.parallel in ('tp', 'pp'):
