@@ -1,0 +1,73 @@
+#!/bin/bash
+# Patient resumption of the TPU evidence capture after a tunnel wedge.
+#
+# The wedge pattern (seen round 3 and again round 4): a bench process
+# killed mid-run wedges the axon tunnel; the NEXT process hangs in
+# backend init for ~25 min (sometimes hours). Killing the hung process
+# mid-bring-up deepens the wedge, so this script never kills anything —
+# it probes the backend in short-lived throwaway subprocesses and only
+# when a probe comes back healthy does it run the remaining capture
+# steps, each under a generous timeout so one sick step can't block the
+# rest.
+#
+# Usage: bash benchmarks/tpu_resume.sh [steps...]
+#   steps default: resnet50 vit attn generate mfu convergence
+set -u
+cd "$(dirname "$0")/.." || exit 1
+note() { echo "=== $* ($(date -u +%T))" >&2; }
+
+probe() {
+    timeout 240 python - <<'EOF' >/dev/null 2>&1
+import jax
+assert jax.devices()[0].platform == "tpu"
+x = jax.numpy.ones((128, 128))
+jax.block_until_ready(x @ x)
+EOF
+}
+
+run_step() {
+    case "$1" in
+    resnet50)
+        note "baseline: resnet50_imagenet"
+        timeout 2400 python benchmarks/record_baselines.py \
+            --configs resnet50_imagenet ;;
+    vit)
+        note "baseline: vit_b16_imagenet"
+        timeout 2400 python benchmarks/record_baselines.py \
+            --configs vit_b16_imagenet ;;
+    attn)
+        note "attention bench"
+        timeout 1800 python benchmarks/attention_bench.py \
+            > benchmarks/attention_bench_tpu.txt 2>&1
+        timeout 1800 python benchmarks/attention_bench.py --causal \
+            >> benchmarks/attention_bench_tpu.txt 2>&1 ;;
+    generate)
+        note "generate bench"
+        timeout 1800 python benchmarks/generate_bench.py \
+            > benchmarks/generate_bench_tpu.txt 2>&1 ;;
+    mfu)
+        note "MFU tune sweep (resnet50 north star)"
+        timeout 5400 python benchmarks/mfu_tune.py \
+            --config resnet50_imagenet ;;
+    convergence)
+        note "convergence (framework on TPU vs torch CPU)"
+        timeout 3600 python benchmarks/convergence.py \
+            --epochs 8 --train_size 2048 ;;
+    *)
+        echo "unknown step: $1" >&2 ;;
+    esac
+}
+
+steps=("${@:-}")
+if [ -z "${steps[0]:-}" ]; then
+    steps=(resnet50 vit attn generate mfu convergence)
+fi
+
+for step in "${steps[@]}"; do
+    until probe; do
+        note "backend unhealthy — sleeping 8 min before reprobe"
+        sleep 480
+    done
+    run_step "$step"
+done
+note "done"

@@ -114,6 +114,41 @@ def _collect_moe_losses(mut):
     return aux, z
 
 
+def _checked_token_entry(sharded, mesh, axis_name, seq_axis, zigzag,
+                         grad_accum: int = 1):
+    """Shared train/eval entry wrapper: trace-time shape validation (a
+    mismatched global batch must raise a framework-style error, not an
+    opaque shard_map sharding failure — mirrors the image path's and
+    TokenLoader's checks) plus the transparent zigzag token permutation
+    (callers keep passing natural-order global tokens; the loss is a
+    masked mean — permutation-invariant)."""
+    dp = int(mesh.shape[axis_name])
+    sp = int(mesh.shape[seq_axis]) if seq_axis is not None else 1
+
+    def checked(state, tokens):
+        b, s = tokens.shape
+        if b % (dp * grad_accum):
+            need = (f"data-axis size x grad_accum = {dp} x {grad_accum}"
+                    if grad_accum > 1 else f"data-axis size {dp}")
+            raise ValueError(
+                f"global batch {b} must divide by {need} "
+                f"(mesh axis {axis_name!r})"
+            )
+        if seq_axis is not None and s % sp:
+            raise ValueError(
+                f"seq_len {s} is not divisible by the sequence-axis "
+                f"size {sp} (mesh axis {seq_axis!r})"
+            )
+        if zigzag:
+            from ..parallel.ring_attention import zigzag_indices
+
+            perm = zigzag_indices(s, sp).reshape(-1)
+            tokens = tokens[:, perm]
+        return sharded(state, tokens)
+
+    return checked
+
+
 def make_lm_train_step(
     model,
     optimizer: Transform,
@@ -251,37 +286,11 @@ def make_lm_train_step(
         out_specs=(P(), P()),
         check_vma=False,
     )
-    dp = int(mesh.shape[axis_name])
-    sp = int(mesh.shape[seq_axis]) if seq_axis is not None else 1
-
-    def checked(state, tokens):
-        # Trace-time shape validation (shapes are static under jit): a
-        # mismatched global batch must raise a framework-style error,
-        # not an opaque shard_map sharding failure — mirrors the image
-        # path's and TokenLoader's checks.
-        b, s = tokens.shape
-        if b % (dp * grad_accum):
-            raise ValueError(
-                f"global batch {b} must divide by data-axis size x "
-                f"grad_accum = {dp} x {grad_accum} (mesh axis "
-                f"{axis_name!r})"
-            )
-        if seq_axis is not None and s % sp:
-            raise ValueError(
-                f"seq_len {s} is not divisible by the sequence-axis "
-                f"size {sp} (mesh axis {seq_axis!r})"
-            )
-        if zigzag:
-            # permute natural-order tokens into the zigzag layout so
-            # contiguous sharding lands chunks (i, 2N-1-i) on shard i;
-            # the loss is a masked mean — permutation-invariant
-            from ..parallel.ring_attention import zigzag_indices
-
-            perm = zigzag_indices(s, sp).reshape(-1)
-            tokens = tokens[:, perm]
-        return sharded(state, tokens)
-
-    return jax.jit(checked, donate_argnums=(0,))
+    return jax.jit(
+        _checked_token_entry(sharded, mesh, axis_name, seq_axis, zigzag,
+                             grad_accum),
+        donate_argnums=(0,),
+    )
 
 
 def make_lm_train_step_tp(
@@ -356,6 +365,83 @@ def make_lm_train_step_tp(
 
     return lazy_gspmd_jit(
         body, mesh, arg_specs=(P(DATA_AXIS),), returns_state=True,
+        zero1=zero1, fsdp=fsdp,
+    )
+
+
+def make_lm_eval_step(
+    model,
+    mesh: Mesh,
+    *,
+    axis_name: str = DATA_AXIS,
+    seq_axis: Optional[str] = None,
+):
+    """Forward-only next-token CE over held-out tokens (DP x SP paths).
+
+    The LM twin of the image :func:`..train.step.make_eval_step`: same
+    mesh/axis conventions as :func:`make_lm_train_step` (including the
+    zigzag token permutation and the cross-shard label shift), eval-mode
+    apply (MoE aux sows are discarded — flax drops non-mutable
+    collections), exact masked-mean accounting via a psum-ed global
+    count. Returns ``eval_step(state, tokens) -> {loss, count}``.
+    """
+    axes = (axis_name,) if seq_axis is None else (axis_name, seq_axis)
+    zigzag = (seq_axis is not None
+              and getattr(model, "sp_mode", "ring") == "zigzag")
+
+    def body(state: TrainState, tokens):
+        targets, valid = _next_token_targets(tokens, seq_axis, zigzag)
+        w = valid.astype(jnp.float32)
+        count = jax.lax.psum(jnp.sum(w), axes)
+        logits = model.apply({"params": state.params}, tokens,
+                             train=False)
+        flat_ce = cross_entropy_per_sample(
+            logits.reshape(-1, logits.shape[-1]), targets.reshape(-1)
+        ).reshape(targets.shape)
+        loss = jax.lax.psum(jnp.sum(flat_ce * w), axes) / count
+        return {"loss": loss, "count": count}
+
+    if seq_axis is None:
+        in_specs = (P(), P(axis_name))
+    else:
+        in_specs = (P(), P(axis_name, seq_axis))
+    sharded = jax.shard_map(
+        body, mesh=mesh, in_specs=in_specs, out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(
+        _checked_token_entry(sharded, mesh, axis_name, seq_axis, zigzag)
+    )
+
+
+def make_lm_eval_step_tp(model, mesh: Mesh, *, zero1: bool = False,
+                         fsdp: bool = False):
+    """Eval twin of :func:`make_lm_train_step_tp` (GSPMD path).
+
+    ``zero1``/``fsdp`` must match the train step's so in_shardings
+    agree with where the state actually lives.
+    """
+    if getattr(model, "seq_axis", None) is not None:
+        raise ValueError(
+            "make_lm_eval_step_tp requires a model built with "
+            "seq_axis=None (use make_lm_eval_step(seq_axis=...) for SP)"
+        )
+
+    def body(state: TrainState, tokens):
+        targets, valid = _next_token_targets(tokens, None)
+        w = valid.astype(jnp.float32)
+        count = jnp.sum(w)
+        logits = model.apply({"params": state.params}, tokens,
+                             train=False)
+        flat_ce = cross_entropy_per_sample(
+            logits.reshape(-1, logits.shape[-1]), targets.reshape(-1)
+        ).reshape(targets.shape)
+        return {"loss": jnp.sum(flat_ce * w) / count, "count": count}
+
+    from .step import lazy_gspmd_jit
+
+    return lazy_gspmd_jit(
+        body, mesh, arg_specs=(P(DATA_AXIS),), returns_state=False,
         zero1=zero1, fsdp=fsdp,
     )
 

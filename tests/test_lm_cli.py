@@ -63,6 +63,28 @@ def test_cli_pp_1f1b_matches_gpipe(tmp_path):
     assert abs(g_loss - f_loss) < 5e-3 * g_loss
 
 
+@pytest.mark.slow
+def test_cli_val_frac_writes_test_log(tmp_path):
+    out, _ = _run(tmp_path, "--val_frac", "0.15")
+    assert "Val: [1]" in out
+    rows = (tmp_path / "run" / "test.log").read_text().strip().splitlines()
+    assert len(rows) == 1
+    epoch, loss, ppl = rows[0].split()
+    assert epoch == "0001"
+    assert 0 < float(loss) < 8.0
+
+
+def test_cli_val_frac_rejects_pp(tmp_path):
+    env = dict(os.environ, PMDT_FORCE_CPU_DEVICES="8")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "train_lm.py"),
+         "--parallel", "pp", "--degree", "4", "--val_frac", "0.2"],
+        env=env, capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert proc.returncode != 0
+    assert "pipelined" in proc.stderr
+
+
 def test_cli_pp_schedule_needs_pp(tmp_path):
     env = dict(os.environ, PMDT_FORCE_CPU_DEVICES="8")
     proc = subprocess.run(

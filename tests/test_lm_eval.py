@@ -1,0 +1,88 @@
+"""LM eval steps: forward-only CE must equal the train step's reported
+(pre-update) loss on the same params/tokens, across dp / sp / tp paths."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_multiprocessing_distributed_tpu import models
+from pytorch_multiprocessing_distributed_tpu.parallel import make_mesh
+from pytorch_multiprocessing_distributed_tpu.train.lm import (
+    create_lm_train_state,
+    make_lm_eval_step,
+    make_lm_eval_step_tp,
+    make_lm_train_step,
+    make_lm_train_step_tp,
+)
+from pytorch_multiprocessing_distributed_tpu.train.optim import sgd
+from pytorch_multiprocessing_distributed_tpu.train.step import (
+    shard_batch,
+    shard_state,
+)
+
+
+def _setup(**model_kw):
+    model = models.get_model("gpt_tiny", **model_kw)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, model.vocab_size, (16, 32))
+    )
+    state = create_lm_train_state(
+        model, jax.random.PRNGKey(0), tokens[:2], sgd(learning_rate=0.1)
+    )
+    return model, state, tokens
+
+
+def test_eval_matches_train_loss_dp():
+    model, state, tokens = _setup()
+    mesh = make_mesh(8)
+    train = make_lm_train_step(model, sgd(learning_rate=0.1), mesh)
+    ev = make_lm_eval_step(model, mesh)
+    (tok,) = shard_batch((tokens,), mesh)
+    m_eval = ev(state, tok)
+    _, m_train = train(state, tok)
+    np.testing.assert_allclose(
+        float(m_eval["loss"]), float(m_train["loss"]), rtol=1e-5
+    )
+    assert float(m_eval["count"]) == float(m_train["count"]) == 16 * 32 - 16
+
+
+@pytest.mark.parametrize("sp_mode", ["ring", "zigzag"])
+def test_eval_matches_train_loss_sp(sp_mode):
+    model, state, tokens = _setup(seq_axis="seq", sp_mode=sp_mode,
+                                  attn_impl="xla")
+    mesh = make_mesh(2, 4, axis_names=("data", "seq"))
+    train = make_lm_train_step(
+        model, sgd(learning_rate=0.1), mesh, seq_axis="seq"
+    )
+    ev = make_lm_eval_step(model, mesh, seq_axis="seq")
+    (tok,) = shard_batch((tokens,), mesh)
+    m_eval = ev(state, tok)
+    _, m_train = train(state, tok)
+    np.testing.assert_allclose(
+        float(m_eval["loss"]), float(m_train["loss"]), rtol=1e-5
+    )
+
+
+def test_eval_matches_train_loss_tp():
+    model, state, tokens = _setup(attn_impl="xla")
+    mesh = make_mesh(2, 4)
+    state = shard_state(state, mesh)
+    train = make_lm_train_step_tp(model, sgd(learning_rate=0.1), mesh)
+    ev = make_lm_eval_step_tp(model, mesh)
+    m_eval = ev(state, tokens)
+    _, m_train = train(state, tokens)
+    np.testing.assert_allclose(
+        float(m_eval["loss"]), float(m_train["loss"]), rtol=1e-5
+    )
+
+
+def test_eval_validation():
+    model, state, tokens = _setup()
+    mesh = make_mesh(8)
+    ev = make_lm_eval_step(model, mesh)
+    with pytest.raises(ValueError, match="batch"):
+        ev(state, tokens[:6])  # 6 % 8 != 0
+    sp_model = models.get_model("gpt_tiny", seq_axis="seq")
+    with pytest.raises(ValueError, match="seq_axis=None"):
+        make_lm_eval_step_tp(sp_model, mesh)

@@ -81,6 +81,10 @@ parser.add_argument('--zero1', action='store_true',
                     help='ZeRO-1 optimizer sharding (tp path only)')
 parser.add_argument('--fsdp', action='store_true',
                     help='ZeRO-3 param sharding (tp path only)')
+parser.add_argument('--val_frac', default=0.0, type=float,
+                    help='hold out this fraction of the token stream '
+                         'and log per-epoch val loss/ppl to test.log '
+                         '(dp/sp/tp paths)')
 parser.add_argument('--sample', default=0, type=int,
                     help='after training, print N greedy-sampled tokens '
                          '(dense dp/tp models only)')
@@ -149,6 +153,15 @@ def main(args):
         raise SystemExit(
             "--grad_accum is wired into the dp/sp step (pp microbatches "
             "already; for tp use a smaller global batch)")
+    if args.val_frac:
+        if not 0.0 < args.val_frac < 1.0:
+            raise SystemExit(
+                f"--val_frac must be in (0, 1), got {args.val_frac}")
+        if args.parallel == 'pp':
+            raise SystemExit(
+                "--val_frac is not wired into the pipelined step (the "
+                "eval forward is unpipelined; use dp/sp/tp, or eval a "
+                "pp checkpoint post-hoc)")
     if args.sample:
         if args.parallel not in ('dp', 'tp') or args.n_experts:
             raise SystemExit(
@@ -193,6 +206,20 @@ def main(args):
         tokens = synthetic_tokens(
             args.corpus_tokens, vocab_size=model.vocab_size,
             seed=args.seed)
+    val_loader = None
+    if args.val_frac:
+        n_val = int(len(tokens) * args.val_frac)
+        min_val = args.batch_size * args.seq_len
+        if n_val < min_val:
+            raise SystemExit(
+                f"--val_frac {args.val_frac} holds out {n_val} tokens "
+                f"but one eval batch needs {min_val} — grow the corpus "
+                f"or the fraction")
+        tokens, val_tokens = tokens[:-n_val], tokens[-n_val:]
+        val_loader = TokenLoader(
+            val_tokens, batch_size=args.batch_size,
+            seq_len=args.seq_len, world_size=dp, shuffle=False,
+            seed=args.seed)
     loader = TokenLoader(
         tokens, batch_size=args.batch_size, seq_len=args.seq_len,
         world_size=dp, seed=args.seed)
@@ -228,8 +255,23 @@ def main(args):
             remat=args.remat, grad_accum=args.grad_accum,
             moe_aux_weight=args.moe_aux_weight)
 
+    eval_step = None
+    if val_loader is not None:
+        from pytorch_multiprocessing_distributed_tpu.train.lm import (
+            make_lm_eval_step, make_lm_eval_step_tp)
+
+        if args.parallel == 'tp':
+            eval_step = make_lm_eval_step_tp(
+                model, mesh, zero1=args.zero1, fsdp=args.fsdp)
+        else:
+            eval_step = make_lm_eval_step(
+                model, mesh,
+                seq_axis='seq' if args.parallel == 'sp' else None)
+
     os.makedirs(args.save_path, exist_ok=True)
     logger = Logger(os.path.join(args.save_path, 'train.log'))
+    test_logger = (Logger(os.path.join(args.save_path, 'test.log'))
+                   if val_loader is not None else None)
     for epoch in range(1, args.epochs + 1):
         state = state.replace(epoch=jnp.asarray(epoch, jnp.int32))
         loader.set_epoch(epoch)
@@ -256,6 +298,21 @@ def main(args):
         avg = losses / max(1, seen)
         if dist.is_primary():
             logger.write([epoch, avg, math.exp(min(avg, 20.0))])
+        if eval_step is not None:
+            tot, cnt = 0.0, 0.0
+            for batch in val_loader:
+                tok = jnp.asarray(batch)
+                if args.parallel != 'tp':
+                    (tok,) = shard_batch((tok,), mesh)
+                m = eval_step(state, tok)
+                c = float(np.asarray(m['count']))
+                tot, cnt = tot + float(np.asarray(m['loss'])) * c, cnt + c
+            vloss = tot / max(1.0, cnt)
+            if dist.is_primary():
+                print(f"Val: [{epoch}]\tLoss {vloss:.4f}\t"
+                      f"PPL {math.exp(min(vloss, 20.0)):.2f}", flush=True)
+                test_logger.write(
+                    [epoch, vloss, math.exp(min(vloss, 20.0))])
     save_checkpoint(args.save_path, state, args.epochs)
 
     if args.sample and args.parallel in ('dp', 'tp') \
