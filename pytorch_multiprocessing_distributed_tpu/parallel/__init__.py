@@ -21,10 +21,11 @@ from .collectives import (
 from .sampler import DistributedShardSampler
 from .ring_attention import ring_attention, zigzag_indices
 from .ulysses import ulysses_attention
-from .pipeline import pipeline_apply
+from .pipeline import pipeline_1f1b, pipeline_apply
 from .gpt_pipeline import (
     PIPE_AXIS,
     create_pipelined_lm_state,
+    make_pipelined_lm_eval_step,
     make_pipelined_lm_train_step,
     stack_pipeline_params,
     unstack_pipeline_params,
@@ -53,6 +54,7 @@ __all__ = [
     "DistributedShardSampler",
     "ring_attention",
     "ulysses_attention",
+    "pipeline_1f1b",
     "pipeline_apply",
     "init_process",
     "destroy_process_group",

@@ -171,6 +171,125 @@ def create_pipelined_lm_state(model, rng, sample_tokens,
     )
 
 
+def _shared_parts(model, pipe_axis):
+    """Closures shared by every pipelined body (gpipe train, 1f1b
+    train, eval) — ONE copy so the execution paths cannot drift
+    numerically."""
+    from ..models.gpt import Block
+
+    # attn_impl="xla": the Pallas flash kernel cannot declare vma for
+    # the check_vma=True shard_map these steps REQUIRE (collective AD
+    # correctness, see .pipeline); plain masked attention is the same
+    # exact math.
+    block = Block(model.num_heads, model.mlp_dim, model.dtype,
+                  attn_impl="xla")
+
+    def stage_fn(stage_params, x):
+        # stage_params leaves [L/S, ...]: scan this stage's layers
+        def layer(carry, lp):
+            return block.apply({"params": lp}, carry), None
+
+        y, _ = jax.lax.scan(layer, x, stage_params)
+        return y
+
+    def vocab_parallel_embed(emb, pos, tokens, i):
+        """Gather the locally-owned rows, psum to materialize [B, S, D]."""
+        emb0 = emb[0]  # [Vs, D]
+        vs = emb0.shape[0]
+        start = i * vs
+        idx = tokens - start
+        mine = jnp.logical_and(idx >= 0, idx < vs)
+        h = emb0[jnp.clip(idx, 0, vs - 1)] * mine[..., None]
+        h = jax.lax.psum(h, pipe_axis)
+        return (h + pos[: tokens.shape[1]]).astype(model.dtype)
+
+    def final_ln(h, lnf):
+        mu = jnp.mean(h, -1, keepdims=True)
+        var = jnp.var(h, -1, keepdims=True)
+        h = (h - mu) * jax.lax.rsqrt(var + _LN_EPS)
+        return h * lnf["scale"] + lnf["bias"]
+
+    return stage_fn, vocab_parallel_embed, final_ln
+
+
+def _make_forward_ce(model, axis_name, pipe_axis, m):
+    """The GPipe forward objective shared by the gpipe train body and
+    the eval step: vocab-parallel embed -> pipelined blocks -> final LN
+    -> vocab-parallel log-sum-exp CE (the [B, S, V] logits never
+    materialize). Returns ``forward_ce(p, tokens) -> (obj, (ce_sum,
+    count))`` with ``obj`` normalized for differentiation."""
+    from ..train.lm import _next_token_targets
+    from .pipeline import pipeline_apply
+
+    stage_fn, vocab_parallel_embed, final_ln = _shared_parts(
+        model, pipe_axis
+    )
+
+    def forward_ce(p, tokens):
+        targets, valid = _next_token_targets(tokens, None)
+        w = valid.astype(jnp.float32)
+        count = jax.lax.psum(jnp.sum(w), axis_name)
+        b, s = tokens.shape
+        if b % m:
+            raise ValueError(
+                f"per-replica batch {b} is not divisible by "
+                f"n_microbatches={m}"
+            )
+        i = jax.lax.axis_index(pipe_axis)
+
+        vs = p["embed"].shape[1]
+        start = i * vs
+        h = vocab_parallel_embed(p["embed"], p["pos"], tokens, i)
+
+        micro = h.reshape(m, b // m, s, h.shape[-1])
+        out = pipeline_apply(
+            stage_fn, p["blocks"], micro, axis_name=pipe_axis
+        )
+        h = out.reshape(b, s, -1).astype(jnp.float32)
+        h = final_ln(h, p["ln_f"])
+
+        # ---- vocab-parallel head + log-sum-exp CE: each stage scores
+        # its vocab slice (padded slots carry bias -1e9 => zero mass).
+        # The matmul stays f32: the plain GPT head is f32-pinned
+        # (models/gpt.py nn.Dense(dtype=f32)) and trajectory parity
+        # must hold for bf16 models too.
+        logits = h @ p["head_k"][0] + p["head_b"][0]
+        # stop_gradient BEFORE pmax: the max-shift is numerical
+        # stabilization only (lse is shift-invariant) and pmax has
+        # no AD rule — its input must already carry a zero tangent
+        gmax = jax.lax.pmax(
+            jax.lax.stop_gradient(jnp.max(logits, -1)), pipe_axis
+        )
+        lse = jnp.log(jax.lax.psum(
+            jnp.sum(jnp.exp(logits - gmax[..., None]), -1), pipe_axis
+        )) + gmax
+        tidx = targets - start
+        tmine = jnp.logical_and(tidx >= 0, tidx < vs)
+        tlogit = jnp.take_along_axis(
+            logits, jnp.clip(tidx, 0, vs - 1)[..., None], -1
+        )[..., 0] * tmine
+        tlogit = jax.lax.psum(tlogit, pipe_axis)
+        ce_sum = jnp.sum((lse - tlogit) * w)
+        return ce_sum / count, (ce_sum, count)
+
+    return forward_ce
+
+
+def _state_specs(state, pipe_axis):
+    """ONE source of truth for the pipelined state layout
+    (pipeline_specs), mirrored onto the full TrainState pytree."""
+    from ..train.optim import OptState
+    from ..train.state import TrainState
+
+    ps = pipeline_specs(state.params, pipe_axis)
+    return TrainState(
+        params=ps,
+        batch_stats={},
+        opt_state=OptState(momentum=ps, count=P(), initialized=P()),
+        epoch=P(),
+    )
+
+
 def make_pipelined_lm_train_step(
     model,
     optimizer: "Transform",
@@ -202,11 +321,10 @@ def make_pipelined_lm_train_step(
     ``[B, S]`` int array and ``metrics = {loss, count}`` matches
     :func:`..train.lm.make_lm_train_step` (exact mean next-token CE).
     """
-    from ..models.gpt import Block
     from ..train.lm import _next_token_targets
-    from ..train.optim import OptState, apply_updates
+    from ..train.optim import apply_updates
     from ..train.state import TrainState
-    from .pipeline import pipeline_1f1b, pipeline_apply
+    from .pipeline import pipeline_1f1b
 
     if schedule not in ("gpipe", "1f1b"):
         raise ValueError(
@@ -215,96 +333,15 @@ def make_pipelined_lm_train_step(
     n_stages = int(mesh.shape[pipe_axis])
     dp = int(mesh.shape[axis_name])
     m = n_microbatches or n_stages
-    # attn_impl="xla": the Pallas flash kernel cannot declare vma for
-    # the check_vma=True shard_map this step REQUIRES (collective AD
-    # correctness, see .pipeline); plain masked attention is the same
-    # exact math.
-    block = Block(model.num_heads, model.mlp_dim, model.dtype,
-                  attn_impl="xla")
-
-    # Pieces shared verbatim by the gpipe and 1f1b bodies — ONE copy so
-    # the two schedules cannot drift apart numerically.
-    def stage_fn(stage_params, x):
-        # stage_params leaves [L/S, ...]: scan this stage's layers
-        def layer(carry, lp):
-            return block.apply({"params": lp}, carry), None
-
-        y, _ = jax.lax.scan(layer, x, stage_params)
-        return y
-
-    def vocab_parallel_embed(emb, pos, tokens, i):
-        """Gather the locally-owned rows, psum to materialize [B, S, D]."""
-        emb0 = emb[0]  # [Vs, D]
-        vs = emb0.shape[0]
-        start = i * vs
-        idx = tokens - start
-        mine = jnp.logical_and(idx >= 0, idx < vs)
-        h = emb0[jnp.clip(idx, 0, vs - 1)] * mine[..., None]
-        h = jax.lax.psum(h, pipe_axis)
-        return (h + pos[: tokens.shape[1]]).astype(model.dtype)
-
-    def final_ln(h, lnf):
-        mu = jnp.mean(h, -1, keepdims=True)
-        var = jnp.var(h, -1, keepdims=True)
-        h = (h - mu) * jax.lax.rsqrt(var + _LN_EPS)
-        return h * lnf["scale"] + lnf["bias"]
+    stage_fn, vocab_parallel_embed, final_ln = _shared_parts(
+        model, pipe_axis
+    )
+    forward_ce = _make_forward_ce(model, axis_name, pipe_axis, m)
 
     def body(state: TrainState, tokens):
-        targets, valid = _next_token_targets(tokens, None)
-        w = valid.astype(jnp.float32)
-        count = jax.lax.psum(jnp.sum(w), axis_name)
-        b, s = tokens.shape
-        if b % m:
-            raise ValueError(
-                f"per-replica batch {b} is not divisible by "
-                f"n_microbatches={m}"
-            )
-        i = jax.lax.axis_index(pipe_axis)
-
-        def local_obj(p):
-            # ---- vocab-parallel embedding (rows live on their stage)
-            vs = p["embed"].shape[1]
-            start = i * vs
-            h = vocab_parallel_embed(p["embed"], p["pos"], tokens, i)
-
-            # ---- GPipe over the block stages
-            micro = h.reshape(m, b // m, s, h.shape[-1])
-            out = pipeline_apply(
-                stage_fn, p["blocks"], micro, axis_name=pipe_axis
-            )
-            h = out.reshape(b, s, -1).astype(jnp.float32)
-
-            # ---- final LN (replicated; flax LayerNorm convention)
-            h = final_ln(h, p["ln_f"])
-
-            # ---- vocab-parallel head + log-sum-exp CE: the [B, S, V]
-            # logits never materialize; each stage scores its vocab
-            # slice (padded slots carry bias -1e9 => zero mass). The
-            # matmul stays f32: the plain GPT head is f32-pinned
-            # (models/gpt.py nn.Dense(dtype=f32)) and trajectory parity
-            # must hold for bf16 models too.
-            logits = h @ p["head_k"][0] + p["head_b"][0]
-            # stop_gradient BEFORE pmax: the max-shift is numerical
-            # stabilization only (lse is shift-invariant) and pmax has
-            # no AD rule — its input must already carry a zero tangent
-            gmax = jax.lax.pmax(
-                jax.lax.stop_gradient(jnp.max(logits, -1)), pipe_axis
-            )
-            lse = jnp.log(jax.lax.psum(
-                jnp.sum(jnp.exp(logits - gmax[..., None]), -1), pipe_axis
-            )) + gmax
-            tidx = targets - start
-            tmine = jnp.logical_and(tidx >= 0, tidx < vs)
-            tlogit = jnp.take_along_axis(
-                logits, jnp.clip(tidx, 0, vs - 1)[..., None], -1
-            )[..., 0] * tmine
-            tlogit = jax.lax.psum(tlogit, pipe_axis)
-            ce_sum = jnp.sum((lse - tlogit) * w)
-            return ce_sum / count, ce_sum
-
-        (_, ce_sum), grads = jax.value_and_grad(
-            local_obj, has_aux=True
-        )(state.params)
+        (_, (ce_sum, count)), grads = jax.value_and_grad(
+            forward_ce, has_aux=True
+        )(state.params, tokens)
         # NO explicit grad psums here. Under check_vma=True the vma-aware
         # AD transposes already reduce each cotangent over every mesh
         # axis its parameter is INVARIANT along: pipe-sharded leaves come
@@ -419,16 +456,53 @@ def make_pipelined_lm_train_step(
         loss = jax.lax.psum(loss_local, axis_name)
         return new_state, {"loss": loss, "count": count}
 
-    def specs_for(state):
-        # ONE source of truth for the param layout (pipeline_specs),
-        # mirrored onto the full TrainState pytree
-        ps = pipeline_specs(state.params, pipe_axis)
-        return TrainState(
-            params=ps,
-            batch_stats={},
-            opt_state=OptState(momentum=ps, count=P(), initialized=P()),
-            epoch=P(),
+    def step(state, tokens):
+        if state.params["embed"].shape[0] != n_stages:
+            raise ValueError(
+                f"state was stacked for "
+                f"{state.params['embed'].shape[0]} stages but the mesh "
+                f"{pipe_axis!r} axis has {n_stages} — create the state "
+                f"with n_stages matching the mesh"
+            )
+        if tokens.shape[0] % (dp * m):
+            raise ValueError(
+                f"global batch {tokens.shape[0]} must divide by "
+                f"data axis x n_microbatches = {dp} x {m}"
+            )
+        sspec = _state_specs(state, pipe_axis)
+        sharded = jax.shard_map(
+            body_1f1b if schedule == "1f1b" else body,
+            mesh=mesh,
+            in_specs=(sspec, P(axis_name)),
+            out_specs=(sspec, {"loss": P(), "count": P()}),
         )
+        return sharded(state, tokens)
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def make_pipelined_lm_eval_step(
+    model,
+    mesh: Mesh,
+    *,
+    axis_name: str = DATA_AXIS,
+    pipe_axis: str = PIPE_AXIS,
+    n_microbatches: Optional[int] = None,
+):
+    """Forward-only pipelined eval: exact mean next-token CE through the
+    same GPipe forward (vocab-parallel embed/head, per-stage blocks) as
+    the train step — `eval(state, tokens) -> {loss, count}` matching
+    :func:`..train.lm.make_lm_eval_step`'s contract. ``state`` is the
+    full pipelined TrainState (opt buffers ride along untouched)."""
+    n_stages = int(mesh.shape[pipe_axis])
+    dp = int(mesh.shape[axis_name])
+    m = n_microbatches or n_stages
+    forward_ce = _make_forward_ce(model, axis_name, pipe_axis, m)
+
+    def body(state, tokens):
+        _, (ce_sum, count) = forward_ce(state.params, tokens)
+        loss = jax.lax.psum(ce_sum, axis_name) / count
+        return {"loss": loss, "count": count}
 
     def step(state, tokens):
         if state.params["embed"].shape[0] != n_stages:
@@ -443,13 +517,12 @@ def make_pipelined_lm_train_step(
                 f"global batch {tokens.shape[0]} must divide by "
                 f"data axis x n_microbatches = {dp} x {m}"
             )
-        sspec = specs_for(state)
         sharded = jax.shard_map(
-            body_1f1b if schedule == "1f1b" else body,
+            body,
             mesh=mesh,
-            in_specs=(sspec, P(axis_name)),
-            out_specs=(sspec, {"loss": P(), "count": P()}),
+            in_specs=(_state_specs(state, pipe_axis), P(axis_name)),
+            out_specs={"loss": P(), "count": P()},
         )
         return sharded(state, tokens)
 
-    return jax.jit(step, donate_argnums=(0,))
+    return jax.jit(step)

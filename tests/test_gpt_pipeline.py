@@ -16,6 +16,7 @@ from pytorch_multiprocessing_distributed_tpu import models
 from pytorch_multiprocessing_distributed_tpu.parallel import make_mesh
 from pytorch_multiprocessing_distributed_tpu.parallel.gpt_pipeline import (
     create_pipelined_lm_state,
+    make_pipelined_lm_eval_step,
     make_pipelined_lm_train_step,
     stack_pipeline_params,
     unstack_pipeline_params,
@@ -113,6 +114,24 @@ def test_1f1b_matches_gpipe_trajectory():
         np.testing.assert_allclose(
             np.asarray(leaf_g), np.asarray(leaf_f), rtol=2e-3, atol=2e-5
         )
+
+
+def test_pipelined_eval_matches_train_loss():
+    """The forward-only pipelined eval reports exactly the train step's
+    pre-update loss on the same state/tokens (shared forward_ce)."""
+    model, tokens = _tokens()
+    opt = sgd(learning_rate=0.1)
+    mesh = make_mesh(2, 4, axis_names=("data", "pipe"))
+    state = create_pipelined_lm_state(
+        model, jax.random.PRNGKey(0), tokens[:2], opt, n_stages=4)
+    ev = make_pipelined_lm_eval_step(model, mesh)
+    step = make_pipelined_lm_train_step(model, opt, mesh)
+    m_eval = ev(state, tokens)
+    _, m_train = step(state, tokens)
+    np.testing.assert_allclose(
+        float(np.asarray(m_eval["loss"])),
+        float(np.asarray(m_train["loss"])), rtol=1e-6)
+    assert float(m_eval["count"]) == float(m_train["count"])
 
 
 def test_schedule_validation():

@@ -83,8 +83,7 @@ parser.add_argument('--fsdp', action='store_true',
                     help='ZeRO-3 param sharding (tp path only)')
 parser.add_argument('--val_frac', default=0.0, type=float,
                     help='hold out this fraction of the token stream '
-                         'and log per-epoch val loss/ppl to test.log '
-                         '(dp/sp/tp paths)')
+                         'and log per-epoch val loss/ppl to test.log')
 parser.add_argument('--sample', default=0, type=int,
                     help='after training, print N greedy-sampled tokens '
                          '(dense dp/tp models only)')
@@ -153,15 +152,9 @@ def main(args):
         raise SystemExit(
             "--grad_accum is wired into the dp/sp step (pp microbatches "
             "already; for tp use a smaller global batch)")
-    if args.val_frac:
-        if not 0.0 < args.val_frac < 1.0:
-            raise SystemExit(
-                f"--val_frac must be in (0, 1), got {args.val_frac}")
-        if args.parallel == 'pp':
-            raise SystemExit(
-                "--val_frac is not wired into the pipelined step (the "
-                "eval forward is unpipelined; use dp/sp/tp, or eval a "
-                "pp checkpoint post-hoc)")
+    if args.val_frac and not 0.0 < args.val_frac < 1.0:
+        raise SystemExit(
+            f"--val_frac must be in (0, 1), got {args.val_frac}")
     if args.sample:
         if args.parallel not in ('dp', 'tp') or args.n_experts:
             raise SystemExit(
@@ -260,7 +253,12 @@ def main(args):
         from pytorch_multiprocessing_distributed_tpu.train.lm import (
             make_lm_eval_step, make_lm_eval_step_tp)
 
-        if args.parallel == 'tp':
+        if args.parallel == 'pp':
+            from pytorch_multiprocessing_distributed_tpu.parallel import (
+                make_pipelined_lm_eval_step)
+
+            eval_step = make_pipelined_lm_eval_step(model, mesh)
+        elif args.parallel == 'tp':
             eval_step = make_lm_eval_step_tp(
                 model, mesh, zero1=args.zero1, fsdp=args.fsdp)
         else:
@@ -302,7 +300,7 @@ def main(args):
             tot, cnt = 0.0, 0.0
             for batch in val_loader:
                 tok = jnp.asarray(batch)
-                if args.parallel != 'tp':
+                if args.parallel not in ('tp', 'pp'):
                     (tok,) = shard_batch((tok,), mesh)
                 m = eval_step(state, tok)
                 c = float(np.asarray(m['count']))
