@@ -1,0 +1,52 @@
+#!/bin/bash
+# Priority-ordered TPU evidence capture for a SHORT grant window.
+# Run the moment `.tpu_alive` appears (tpu_watch.sh) — highest-value
+# steps first, so a window that closes mid-run costs the least-needed
+# artifact. Complements record_all_tpu.sh (the exhaustive version).
+#
+# Each step is bounded by `timeout` as a last resort: a hung client
+# kill risks re-wedging the tunnel (observed round 3/4), but an
+# UNBOUNDED hang costs every later step of the window with certainty.
+# 45 min comfortably covers the observed ~25 min error-out path.
+set -u
+cd "$(dirname "$0")/.." || exit 1
+note() { echo "=== $* ($(date -u +%T))" >&2; }
+T="timeout -k 30 2700"
+
+note "1. baselines still missing/legacy (need-first order)"
+$T python benchmarks/record_baselines.py --missing
+
+note "2. per-op profile of the MFU-gap config (resnet50)"
+$T python benchmarks/profile_step.py --config resnet50_imagenet
+
+note "3. resnet50 geometry probes: batch 128 + remat (HBM-pressure hypothesis)"
+$T python bench.py --config resnet50_imagenet --batch_size 128
+$T python bench.py --config resnet50_imagenet --remat
+
+note "4. MFU flag sweep (short: the profile + probes above pick the lever)"
+$T python benchmarks/mfu_tune.py --config resnet50_imagenet \
+    --batches 0,128 --flag_sets baseline,lhs
+
+note "5. attention artifact (flash vs XLA, backs COVERAGE.md)"
+# temp-then-move: a failed run must not clobber a previous GOOD artifact
+tmp=$(mktemp)
+if $T python benchmarks/attention_bench.py > "$tmp" 2>&1 \
+   && $T python benchmarks/attention_bench.py --causal >> "$tmp" 2>&1; then
+  mv "$tmp" benchmarks/attention_bench_tpu.txt
+  tail -8 benchmarks/attention_bench_tpu.txt >&2
+else
+  echo "attention bench failed; keeping prior artifact" >&2
+  tail -4 "$tmp" >&2; rm -f "$tmp"
+fi
+
+note "6. decode throughput"
+tmp=$(mktemp)
+if $T python benchmarks/generate_bench.py > "$tmp" 2>&1; then
+  mv "$tmp" benchmarks/generate_bench_tpu.txt
+  tail -4 benchmarks/generate_bench_tpu.txt >&2
+else
+  echo "generate bench failed; keeping prior artifact" >&2
+  tail -4 "$tmp" >&2; rm -f "$tmp"
+fi
+
+note "done — review artifacts, then commit"

@@ -7,9 +7,17 @@ updates via bench.py's own record logic (first valid canonical run per
 metric writes it; a slope-estimator run replaces a legacy whole-window
 record).
 
+Grant windows on this environment are short and can close mid-run
+(measured round 4: the pool dropped between two configs of one
+invocation), so the run order is NEED-first: configs whose on-disk
+record is missing or still carries the legacy whole-window estimator
+run before configs that already have a valid slope record.
+``--missing`` restricts the run to exactly those needy configs — the
+shortest path to a complete record set when a grant appears.
+
 Use after a measurement-methodology change or on new hardware:
 
-    python benchmarks/record_baselines.py [--configs a b c]
+    python benchmarks/record_baselines.py [--configs a b c] [--missing]
 """
 
 import argparse
@@ -17,21 +25,66 @@ import json
 import os
 import subprocess
 import sys
+import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RECORD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "baseline_record.json")
+
+
+def legacy_metrics():
+    """-> (legacy, rec): metric names PRESENT in the record but written
+    under the pre-slope estimator, plus the record itself. Absent
+    metrics are not in either — callers must also check ``m not in
+    rec`` (see ``needs`` below)."""
+    try:
+        with open(RECORD) as f:
+            rec = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        rec = {}
+    return {
+        m for m, v in rec.items()
+        if not (isinstance(v, dict)
+                and v.get("estimator") == "two_window_slope")
+    }, rec
 
 
 def main() -> int:
     sys.path.insert(0, REPO)
-    from bench import CONFIGS  # noqa: E402
+    from bench import CONFIGS, metric_for  # noqa: E402
 
     p = argparse.ArgumentParser()
     p.add_argument("--configs", nargs="+", default=sorted(CONFIGS),
                    choices=sorted(CONFIGS))
+    p.add_argument("--missing", action="store_true",
+                   help="only configs whose baseline record is absent or "
+                        "legacy (pre-slope-estimator)")
+    p.add_argument("--settle", type=float, default=20.0,
+                   help="seconds between configs (the single-tenant chip "
+                        "needs the previous client's teardown to finish "
+                        "before the next probe)")
     args = p.parse_args()
 
+    legacy, rec = legacy_metrics()
+
+    def needs(config):
+        m = metric_for(config)[0]
+        return m not in rec or m in legacy
+
+    configs = [c for c in args.configs if not args.missing or needs(c)]
+    # need-first: a closing grant window should cost the LEAST needed
+    # config, not the most
+    configs.sort(key=lambda c: (not needs(c), c))
+    if not configs:
+        print("all requested configs already have slope-estimator "
+              "records; nothing to do", file=sys.stderr)
+        return 0
+    print(f"run order: {configs}", file=sys.stderr, flush=True)
+
     rc = 0
-    for config in args.configs:
+    for k, config in enumerate(configs):
+        if k:
+            time.sleep(args.settle)
         print(f"=== {config}", file=sys.stderr, flush=True)
         try:
             proc = subprocess.run(

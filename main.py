@@ -89,6 +89,15 @@ parser.add_argument('--save_every', default=0, type=int,
 parser.add_argument('--keep_checkpoints', default=0, type=int,
                     help='retain only the K newest periodic checkpoints '
                          '(0 = keep all)')
+parser.add_argument('--ckpt_backend', default='msgpack',
+                    choices=['msgpack', 'orbax'],
+                    help='msgpack = reference-parity model_{epoch}.pth '
+                         '(one host-gathered file, torch-interoperable); '
+                         'orbax = sharded per-host writes under '
+                         '{save_path}/orbax/ — no gather, scales with '
+                         'the model; needs shared storage across hosts. '
+                         "With orbax, --resume takes 'auto' or an epoch "
+                         'number')
 parser.add_argument('--lr', default=0.0, type=float,
                     help='base learning rate (0 = optimizer default: '
                          '0.1 sgd / 1e-3 lamb, the reference values)')
@@ -249,7 +258,41 @@ def main(args):
         ema=args.ema > 0,
     )
     start_epoch = 1
-    if args.resume == "auto":
+    if args.ckpt_backend == "orbax" and args.resume:
+        from pytorch_multiprocessing_distributed_tpu.train.orbax_ckpt import (
+            OrbaxCheckpointer)
+
+        ck = OrbaxCheckpointer(args.save_path)
+        if args.resume == "auto":
+            epoch = ck.latest_epoch()
+        else:
+            try:
+                epoch = int(args.resume)
+            except ValueError:
+                raise SystemExit(
+                    f"--ckpt_backend orbax: --resume must be 'auto' or "
+                    f"an epoch number (orbax checkpoints are epoch-keyed "
+                    f"directories under {{save_path}}/orbax/), got "
+                    f"{args.resume!r}"
+                )
+        if epoch is None:
+            if dist.is_primary():
+                print(f"--resume auto: no orbax checkpoint under "
+                      f"{args.save_path}; starting fresh")
+        else:
+            # device_get: the restore lands committed on the template's
+            # (single-device, pre-shard_state) placement; committed
+            # leaves would then fight the mesh sharding inside the
+            # jitted step. Host arrays are placement-free — the trainer
+            # re-shards them exactly like a fresh init (shard_state for
+            # zero1/fsdp/TP, jit replication for plain DP).
+            state = jax.device_get(ck.restore(state, epoch))
+            start_epoch = int(state.epoch) + 1
+            if dist.is_primary():
+                print(f"Resumed from {ck.directory}/{epoch} "
+                      f"(continuing at epoch {start_epoch})")
+        ck.close()
+    elif args.resume == "auto":
         from pytorch_multiprocessing_distributed_tpu.train.checkpoint import (
             resolve_auto_resume)
 
@@ -257,7 +300,7 @@ def main(args):
         if not args.resume and dist.is_primary():
             print(f"--resume auto: no checkpoint under {args.save_path}; "
                   "starting fresh")
-    if args.resume:
+    if args.ckpt_backend != "orbax" and args.resume:
         state = load_checkpoint(args.resume, state)
         # continue the epoch series (LR schedule + log numbering) from
         # where the checkpoint left off
@@ -289,6 +332,7 @@ def main(args):
         ema_decay=args.ema or None,
         save_every=args.save_every,
         keep_checkpoints=args.keep_checkpoints,
+        ckpt_backend=args.ckpt_backend,
     )
     if args.profile:
         from pytorch_multiprocessing_distributed_tpu.utils.profiler import trace

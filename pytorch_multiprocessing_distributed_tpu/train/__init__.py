@@ -18,6 +18,7 @@ from .step import (
     tp_param_spec,
 )
 from .checkpoint import save_checkpoint, load_checkpoint
+from .orbax_ckpt import OrbaxCheckpointer
 
 __all__ = [
     "sgd",
@@ -35,4 +36,5 @@ __all__ = [
     "tp_param_spec",
     "save_checkpoint",
     "load_checkpoint",
+    "OrbaxCheckpointer",
 ]

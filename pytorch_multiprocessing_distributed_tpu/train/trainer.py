@@ -79,6 +79,7 @@ class Trainer:
         ema_decay=None,
         save_every: int = 0,
         keep_checkpoints: int = 0,
+        ckpt_backend: str = "msgpack",
     ):
         self.mesh = mesh
         self.state = state
@@ -95,6 +96,22 @@ class Trainer:
         # only, main.py:75-77) with optional keep-K retention
         self.save_every = save_every
         self.keep_checkpoints = keep_checkpoints
+        # "msgpack" = reference-parity model_{epoch}.pth (host-gathered,
+        # torch-interoperable); "orbax" = sharded per-host OCDBT writes
+        # under {save_path}/orbax/ — no gather, scales with the model
+        # (requires save_path on SHARED storage across hosts)
+        if ckpt_backend == "orbax":
+            from .orbax_ckpt import OrbaxCheckpointer
+
+            self._orbax = OrbaxCheckpointer(
+                save_path, keep=keep_checkpoints or None
+            )
+        elif ckpt_backend != "msgpack":
+            raise ValueError(
+                f"ckpt_backend must be 'msgpack' or 'orbax', "
+                f"got {ckpt_backend!r}"
+            )
+        self.ckpt_backend = ckpt_backend
         # evaluate/checkpoint with EMA weights when tracking is on
         self.ema_decay = ema_decay
         from ..ops.losses import cross_entropy_loss
@@ -192,15 +209,19 @@ class Trainer:
         # would destroy a clean artifact for zero resume benefit.
         from .checkpoint import checkpoint_path
 
-        target = checkpoint_path(self.save_path, epoch - 1)
+        if self.ckpt_backend == "orbax":
+            target = os.path.join(self._orbax.directory, str(epoch - 1))
+            exists = self._orbax.has_epoch(epoch - 1)
+        else:
+            target = checkpoint_path(self.save_path, epoch - 1)
+            exists = os.path.exists(target)
         # The skip-vs-save decision must be UNIFORM across hosts: only
-        # the primary writes checkpoints, so with a non-shared save_path
-        # the file exists only there — a per-host os.path.exists would
-        # send the primary down the skip branch while workers enter
-        # save_checkpoint's gather collective, deadlocking the slice.
-        # The primary's verdict is broadcast (same pattern as
-        # resolve_auto_resume).
-        exists = os.path.exists(target)
+        # the primary writes msgpack checkpoints, so with a non-shared
+        # save_path the file exists only there — a per-host
+        # os.path.exists would send the primary down the skip branch
+        # while workers enter save_checkpoint's gather collective,
+        # deadlocking the slice. The primary's verdict is broadcast
+        # (same pattern as resolve_auto_resume).
         if jax.process_count() > 1:
             import numpy as _np
             from jax.experimental import multihost_utils
@@ -212,12 +233,26 @@ class Trainer:
             if dist.is_primary():
                 print(f"keeping existing {target} (same resume point)")
         else:
-            save_checkpoint(
-                self.save_path,
+            self._save_state(
                 self.state.replace(epoch=jnp.asarray(epoch - 1, jnp.int32)),
                 epoch - 1,
             )
         raise SystemExit(0)
+
+    def _save_state(self, state: TrainState, epoch: int) -> None:
+        """One checkpoint write through the configured backend. EVERY
+        host calls this: the msgpack path's sharded-leaf gather is a
+        collective (the write itself is primary-gated inside), and the
+        orbax path has every host writing its own shards."""
+        if self.ckpt_backend == "orbax":
+            self._orbax.save(state, epoch)
+            # durable before returning: both call sites (end-of-epoch,
+            # preemption) rely on the artifact existing when they move on
+            self._orbax.wait()
+        else:
+            save_checkpoint(self.save_path, state, epoch)
+            if dist.is_primary():
+                prune_checkpoints(self.save_path, self.keep_checkpoints)
 
     def fit(self) -> TrainState:
         """The reference's epoch loop (``main.py:67-82``)."""
@@ -234,14 +269,7 @@ class Trainer:
                 self.validate(epoch, mode="test")
                 periodic = self.save_every and epoch % self.save_every == 0
                 if epoch == self.epochs or periodic:
-                    # EVERY host calls this: the sharded-state gather
-                    # inside is a collective; save_checkpoint itself
-                    # gates the actual write on the primary.
-                    save_checkpoint(self.save_path, self.state, epoch)
-                    if dist.is_primary():
-                        prune_checkpoints(
-                            self.save_path, self.keep_checkpoints
-                        )
+                    self._save_state(self.state, epoch)
         finally:
             # a caller's process must not permanently swallow SIGTERM
             # after training ends

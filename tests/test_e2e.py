@@ -126,6 +126,34 @@ def test_cli_resume(tmp_path):
 
 
 @pytest.mark.slow
+def test_cli_orbax_backend_resume(tmp_path):
+    """--ckpt_backend orbax: sharded per-host writes + auto-resume
+    (epoch-keyed orbax/ dirs instead of model_{epoch}.pth)."""
+    save = tmp_path / "run"
+    env = dict(os.environ, PMDT_FORCE_CPU_DEVICES="8", PMDT_SMALL_SYNTH="1")
+    base_cmd = [
+        sys.executable, "main.py",
+        "--batch_size", "64", "--world_size", "8", "--synthetic",
+        "--save_path", str(save), "--print-freq", "100",
+        "--ckpt_backend", "orbax",
+    ]
+    p1 = subprocess.run(
+        base_cmd + ["--epochs", "1"], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=900,
+    )
+    assert p1.returncode == 0, p1.stderr[-3000:]
+    assert (save / "orbax" / "1").is_dir()
+    assert not (save / "model_1.pth").exists()
+    p2 = subprocess.run(
+        base_cmd + ["--epochs", "2", "--resume", "auto"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=900,
+    )
+    assert p2.returncode == 0, p2.stderr[-3000:]
+    assert "continuing at epoch 2" in p2.stdout
+    assert (save / "orbax" / "2").is_dir()
+
+
+@pytest.mark.slow
 def test_cli_vit_lamb_profile(tmp_path):
     """BASELINE configs #4/#5 seam: a ViT trains under LAMB through the
     unchanged trainer (the reference's model-swap seam, main.py:39-40),

@@ -1,0 +1,113 @@
+"""Orbax checkpoint backend: sharded round-trip, retention, resume keys.
+
+The msgpack writer is gather-then-write (tested in test_trainer_extras /
+test_e2e); this backend's contract is the opposite — NO gather: sharded
+leaves restore sharded, placed by the template's shardings.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_multiprocessing_distributed_tpu import models
+from pytorch_multiprocessing_distributed_tpu.parallel import make_mesh
+from pytorch_multiprocessing_distributed_tpu.parallel.mesh import DATA_AXIS
+from pytorch_multiprocessing_distributed_tpu.train import (
+    OrbaxCheckpointer,
+    create_train_state,
+)
+from pytorch_multiprocessing_distributed_tpu.train.optim import sgd
+from pytorch_multiprocessing_distributed_tpu.train.step import shard_state
+
+
+def _tiny_state(seed=0):
+    model = models.ResNet18(bn_axis=None)
+    opt = sgd(learning_rate=0.1)
+    return create_train_state(
+        model, jax.random.PRNGKey(seed), jnp.zeros((2, 32, 32, 3)), opt
+    )
+
+
+def _assert_tree_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_round_trip_and_latest(tmp_path):
+    state = _tiny_state(0)
+    with OrbaxCheckpointer(str(tmp_path)) as ck:
+        assert ck.latest_epoch() is None
+        ck.save(state, 1)
+        ck.save(state.replace(epoch=jnp.asarray(2, jnp.int32)), 2)
+        ck.wait()
+        assert ck.latest_epoch() == 2
+
+        template = _tiny_state(1)  # different init: must be overwritten
+        restored = ck.restore(template)
+        assert int(restored.epoch) == 2
+        _assert_tree_equal(restored.params, state.params)
+
+        # explicit epoch key
+        r1 = ck.restore(template, epoch=1)
+        assert int(r1.epoch) == 1
+
+
+def test_restore_places_on_template_shardings(tmp_path):
+    mesh = make_mesh()
+    state = shard_state(_tiny_state(0), mesh, fsdp=True)
+    with OrbaxCheckpointer(str(tmp_path)) as ck:
+        ck.save(state, 3)
+        ck.wait()
+        template = shard_state(_tiny_state(1), mesh, fsdp=True)
+        restored = ck.restore(template, epoch=3)
+    _assert_tree_equal(restored.params, state.params)
+    # the restore must land ON the template's (FSDP) shardings — pick a
+    # leaf that actually shards and compare
+    kernels = [
+        (a, b)
+        for a, b in zip(
+            jax.tree.leaves(restored.params), jax.tree.leaves(state.params)
+        )
+        if a.ndim == 4 and DATA_AXIS in b.sharding.spec
+    ]
+    assert kernels, "expected at least one FSDP-sharded conv kernel"
+    for a, b in kernels:
+        assert a.sharding == b.sharding
+
+
+def test_save_overwrites_existing_epoch(tmp_path):
+    """msgpack-parity semantics: re-running into the same save_path
+    replaces the epoch artifact instead of raising
+    StepAlreadyExistsError after a full epoch of training."""
+    a, b = _tiny_state(0), _tiny_state(1)
+    with OrbaxCheckpointer(str(tmp_path)) as ck:
+        ck.save(a, 1)
+        ck.save(b, 1)  # must not raise
+        ck.wait()
+        assert ck.has_epoch(1) and ck.manager.all_steps() == [1]
+        restored = ck.restore(_tiny_state(2), epoch=1)
+    _assert_tree_equal(restored.params, b.params)
+
+
+def test_retention_keeps_newest(tmp_path):
+    state = _tiny_state(0)
+    with OrbaxCheckpointer(str(tmp_path), keep=1) as ck:
+        for e in (1, 2, 3):
+            ck.save(state.replace(epoch=jnp.asarray(e, jnp.int32)), e)
+        ck.wait()
+        assert ck.latest_epoch() == 3
+        assert ck.manager.all_steps() == [3]
+
+
+def test_trainer_rejects_unknown_backend():
+    from pytorch_multiprocessing_distributed_tpu.train.trainer import Trainer
+
+    with pytest.raises(ValueError, match="ckpt_backend"):
+        Trainer(
+            model=None, optimizer=None, mesh=make_mesh(),
+            state=None, train_loader=None, test_loader=None,
+            save_path=".", epochs=1, ckpt_backend="zip",
+        )

@@ -1,0 +1,61 @@
+"""profile_step's xplane aggregation, on a synthetic trace proto.
+
+The real trace comes from jax.profiler on chip; here we build an XSpace
+by hand (tensorflow-bundled proto) and pin the aggregation contract:
+durations summed per (plane, line, op), hlo_category picked off event
+metadata stats.
+"""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+xplane_pb2 = pytest.importorskip(
+    "tensorflow.tsl.profiler.protobuf.xplane_pb2"
+)
+
+from benchmarks.profile_step import parse_xplanes  # noqa: E402
+
+
+def _build_space():
+    space = xplane_pb2.XSpace()
+    plane = space.planes.add(name="/device:TPU:0")
+    plane.stat_metadata[1].id = 1
+    plane.stat_metadata[1].name = "hlo_category"
+    em = plane.event_metadata[10]
+    em.id = 10
+    em.name = "fusion.42"
+    st = em.stats.add()
+    st.metadata_id = 1
+    st.str_value = "convolution"
+    em2 = plane.event_metadata[11]
+    em2.id = 11
+    em2.name = "copy.1"
+    line = plane.lines.add(name="XLA Ops")
+    for md, dur in ((10, 5000), (10, 7000), (11, 1000)):
+        ev = line.events.add()
+        ev.metadata_id = md
+        ev.duration_ps = dur
+    return space
+
+
+def test_parse_aggregates_by_op(tmp_path):
+    space = _build_space()
+    p = tmp_path / "host.xplane.pb"
+    p.write_bytes(space.SerializeToString())
+    rows = parse_xplanes(str(tmp_path))
+    by_op = {r[2]: r for r in rows}
+    plane, line, name, cat, ps, n = by_op["fusion.42"]
+    assert (plane, line) == ("/device:TPU:0", "XLA Ops")
+    assert cat == "convolution"
+    assert ps == 12000 and n == 2
+    assert by_op["copy.1"][4] == 1000
+    assert by_op["copy.1"][3] is None  # no category stat
+
+
+def test_parse_requires_traces(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        parse_xplanes(str(tmp_path))
