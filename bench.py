@@ -289,9 +289,17 @@ def compile_step(step, *args):
     return compiled, flops
 
 
-def run_bench(config: str, dtype_name: str, batch_size: int,
-              min_window: float, warmup: int, devices, note,
-              remat: bool = False) -> dict:
+def build_workload(config: str, dtype_name: str, batch_size: int,
+                   devices, remat: bool = False):
+    """Construct the EXACT program a config benches: the jitted train
+    step, its initialized state, the resident device batch, and the
+    item count per step. The ONE place this lives — ``run_bench`` times
+    it and ``benchmarks/profile_step.py`` traces it, so the profiled
+    program can never drift from the benched one.
+
+    Returns ``(step, state, batch_args, items_per_step, batch)`` with
+    ``batch`` after the data-axis divisibility rounding.
+    """
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -306,19 +314,15 @@ def run_bench(config: str, dtype_name: str, batch_size: int,
 
     cfg = CONFIGS[config]
     n_dev = len(devices)
-    platform = devices[0].platform
-    is_tpu = platform == "tpu"
+    is_tpu = devices[0].platform == "tpu"
     mesh = make_mesh(n_dev, devices=devices)
     dtype = jnp.bfloat16 if dtype_name == "bfloat16" else jnp.float32
     batch = batch_size or cfg["batch"]
     is_lm = bool(cfg.get("lm"))
     if not is_tpu:
         # CPU fallback is a liveness signal, not a perf number — shrink
-        # so the line still appears in bounded time (the probe retry
-        # budget may already have spent ~11 minutes of the driver's
-        # patience before this path runs).
+        # so a line still appears in bounded time.
         batch = min(batch, (1 if is_lm else 4) * n_dev)
-        min_window, warmup = min(min_window, 0.2), min(warmup, 1)
     if batch % n_dev:
         batch += n_dev - batch % n_dev  # keep the data axis even
     rng = np.random.default_rng(0)
@@ -359,6 +363,22 @@ def run_bench(config: str, dtype_name: str, batch_size: int,
         batch_args = shard_batch((x, y), mesh)
         items_per_step = batch  # images
 
+    return step, state, batch_args, items_per_step, batch
+
+
+def run_bench(config: str, dtype_name: str, batch_size: int,
+              min_window: float, warmup: int, devices, note,
+              remat: bool = False) -> dict:
+    import numpy as np
+
+    n_dev = len(devices)
+    platform = devices[0].platform
+    is_tpu = platform == "tpu"
+    if not is_tpu:
+        min_window, warmup = min(min_window, 0.2), min(warmup, 1)
+    step, state, batch_args, items_per_step, batch = build_workload(
+        config, dtype_name, batch_size, devices, remat=remat
+    )
     step, flops = compile_step(step, state, *batch_args)
 
     from pytorch_multiprocessing_distributed_tpu.utils.profiler import sync
@@ -547,6 +567,15 @@ def main():
             devices, note = init_devices()
         _log(f"devices: {len(devices)} x "
              f"{getattr(devices[0], 'device_kind', devices[0].platform)}")
+        # post-probe: the cache is for (slow, tunnel-bound) TPU
+        # compiles; enable_compilation_cache itself skips CPU
+        from pytorch_multiprocessing_distributed_tpu.utils.compile_cache import (  # noqa: E501
+            enable_compilation_cache)
+
+        cache_dir = enable_compilation_cache(
+            platform_hint=devices[0].platform)
+        if cache_dir:
+            _log(f"compilation cache: {cache_dir}")
         result = run_bench(args.config, args.dtype, args.batch_size,
                            args.min_window, args.warmup, devices, note,
                            remat=args.remat)

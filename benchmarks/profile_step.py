@@ -26,7 +26,8 @@ import benchmarks._common as _common  # noqa: E402  (platform guard)
 
 
 def parse_xplanes(trace_dir):
-    """-> list of (plane_name, line_name, event_name, total_ps, count)."""
+    """-> [(plane_name, line_name, event_name, hlo_category,
+    total_ps, count), ...] aggregated per (plane, line, op)."""
     from tensorflow.tsl.profiler.protobuf import xplane_pb2
 
     paths = glob.glob(
@@ -98,7 +99,6 @@ def main():
     import tempfile
 
     import jax
-    import numpy as np
 
     import bench
 
@@ -108,50 +108,13 @@ def main():
                                    "the real chip"}))
         return 1
 
-    # Build the identical program bench.py times (model/step/batch).
-    import jax.numpy as jnp
-
-    from pytorch_multiprocessing_distributed_tpu import models
-    from pytorch_multiprocessing_distributed_tpu.parallel import make_mesh
-    from pytorch_multiprocessing_distributed_tpu.train import (
-        create_train_state, make_train_step)
-    from pytorch_multiprocessing_distributed_tpu.train.lamb import lamb
-    from pytorch_multiprocessing_distributed_tpu.train.optim import sgd
-    from pytorch_multiprocessing_distributed_tpu.train.step import shard_batch
     from pytorch_multiprocessing_distributed_tpu.utils.profiler import sync
 
-    cfg = bench.CONFIGS[args.config]
-    mesh = make_mesh(len(devices), devices=devices)
-    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
-    batch = args.batch_size or cfg["batch"]
-    rng = np.random.default_rng(0)
-    if cfg.get("lm"):
-        from pytorch_multiprocessing_distributed_tpu.train.lm import (
-            create_lm_train_state, make_lm_train_step)
-
-        s = cfg["seq_len"]
-        model = models.get_model(cfg["model"], dtype=dtype,
-                                 max_seq_len=max(s, 1024))
-        opt = sgd(learning_rate=0.1)
-        tokens = jnp.asarray(rng.integers(0, model.vocab_size, (batch, s)))
-        state = create_lm_train_state(model, jax.random.PRNGKey(0),
-                                      tokens[:2], opt)
-        step = make_lm_train_step(model, opt, mesh, remat=args.remat)
-        batch_args = shard_batch((tokens,), mesh)
-    else:
-        s = cfg["image_size"]
-        model = models.get_model(cfg["model"], dtype=dtype, bn_axis="data",
-                                 num_classes=cfg["num_classes"],
-                                 stem=cfg["stem"])
-        opt = (lamb(learning_rate=1e-3)
-               if cfg.get("optimizer") == "lamb" else sgd(learning_rate=0.1))
-        state = create_train_state(model, jax.random.PRNGKey(0),
-                                   jnp.zeros((2, s, s, 3)), opt)
-        step = make_train_step(model, opt, mesh, remat=args.remat)
-        x = jnp.asarray(rng.normal(size=(batch, s, s, 3)), jnp.float32)
-        y = jnp.asarray(rng.integers(0, cfg["num_classes"], (batch,)))
-        batch_args = shard_batch((x, y), mesh)
-
+    # the EXACT program bench.py times — one shared builder, no drift
+    step, state, batch_args, _, batch = bench.build_workload(
+        args.config, args.dtype, args.batch_size, devices,
+        remat=args.remat,
+    )
     step, flops = bench.compile_step(step, state, *batch_args)
     for _ in range(3):  # steady state before the trace
         state, m = step(state, *batch_args)

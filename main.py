@@ -139,6 +139,10 @@ def main(args):
         force_cpu_devices_from_env)
 
     force_cpu_devices_from_env()
+    from pytorch_multiprocessing_distributed_tpu.utils.compile_cache import (
+        enable_compilation_cache)
+
+    enable_compilation_cache()
 
     import jax
     import jax.numpy as jnp
@@ -265,6 +269,19 @@ def main(args):
         ck = OrbaxCheckpointer(args.save_path)
         if args.resume == "auto":
             epoch = ck.latest_epoch()
+            if jax.process_count() > 1:
+                # the PRIMARY's verdict decides for every host — per-host
+                # resolution can disagree (NFS attribute-cache staleness,
+                # partially visible steps) and misaligned start epochs
+                # deadlock the per-epoch collectives; same pattern as
+                # checkpoint.resolve_auto_resume
+                import numpy as _np
+                from jax.experimental import multihost_utils
+
+                epoch = int(multihost_utils.broadcast_one_to_all(
+                    _np.int32(-1 if epoch is None else epoch)
+                ))
+                epoch = None if epoch < 0 else epoch
         else:
             try:
                 epoch = int(args.resume)

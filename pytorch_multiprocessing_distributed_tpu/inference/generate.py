@@ -12,7 +12,7 @@ framework needs one. TPU-idiomatic by construction:
   matmuls), decode steps are the bandwidth-bound cached attention.
 
 Mirrors the model's own conventions (``models/gpt.py``): matmuls in
-``model.dtype``, LayerNorm/softmax/head in f32, eps 1e-6. Works off the
+``model.dtype``, LayerNorm/softmax/head in f32, eps from ``model.ln_eps``. Works off the
 plain GPT param tree — the same params `make_lm_train_step` trains.
 """
 
@@ -24,17 +24,20 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+# flax-default fallback for models predating the ln_eps field; every
+# helper takes eps EXPLICITLY (a forgotten argument must TypeError,
+# not silently run 1e-6 on a GPT-2 checkpoint)
 _LN_EPS = 1e-6
 
 
-def _ln(x, p):
+def _ln(x, p, eps):
     xf = x.astype(jnp.float32)
     mu = jnp.mean(xf, -1, keepdims=True)
     # fast variance (E[x^2] - E[x]^2), matching flax LayerNorm's default
     # — the cached path must be BIT-identical to the model's forward or
     # near-tied argmaxes flip tokens
     var = jnp.mean(xf * xf, -1, keepdims=True) - mu * mu
-    out = (xf - mu) * jax.lax.rsqrt(var + _LN_EPS)
+    out = (xf - mu) * jax.lax.rsqrt(var + eps)
     return out * p["scale"] + p["bias"]
 
 
@@ -47,10 +50,10 @@ def _split_heads(t, h):
     return t.reshape(b, s, h, d // h)
 
 
-def _block_prefill(p, x, h, dtype):
+def _block_prefill(p, x, h, dtype, eps):
     """Full causal pass over the prompt; returns (y, k, v)."""
     b, s, _ = x.shape
-    hn = _ln(x, p["ln1"]).astype(dtype)
+    hn = _ln(x, p["ln1"], eps).astype(dtype)
     q, k, v = jnp.split(_dense(hn, p["attn"]["wqkv"], dtype), 3, axis=-1)
     q, k, v = _split_heads(q, h), _split_heads(k, h), _split_heads(v, h)
     scale = q.shape[-1] ** -0.5
@@ -61,16 +64,16 @@ def _block_prefill(p, x, h, dtype):
     att = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
     att = att.reshape(b, s, -1).astype(dtype)
     x = x + _dense(att, p["attn"]["wo"], dtype)
-    hn = _ln(x, p["ln2"]).astype(dtype)
+    hn = _ln(x, p["ln2"], eps).astype(dtype)
     y = _dense(hn, p["fc1"], dtype)
     y = _dense(jax.nn.gelu(y), p["fc2"], dtype)
     return x + y, k, v
 
 
-def _block_decode(p, x_t, k_cache, v_cache, pos, h, dtype):
+def _block_decode(p, x_t, k_cache, v_cache, pos, h, dtype, eps):
     """One cached step: x_t [B, 1, D]; caches [B, S, H, Dh]."""
     b = x_t.shape[0]
-    hn = _ln(x_t, p["ln1"]).astype(dtype)
+    hn = _ln(x_t, p["ln1"], eps).astype(dtype)
     q, k, v = jnp.split(_dense(hn, p["attn"]["wqkv"], dtype), 3, axis=-1)
     q, k, v = _split_heads(q, h), _split_heads(k, h), _split_heads(v, h)
     k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
@@ -85,7 +88,7 @@ def _block_decode(p, x_t, k_cache, v_cache, pos, h, dtype):
                      v_cache.astype(jnp.float32))
     att = att.reshape(b, 1, -1).astype(dtype)
     x_t = x_t + _dense(att, p["attn"]["wo"], dtype)
-    hn = _ln(x_t, p["ln2"]).astype(dtype)
+    hn = _ln(x_t, p["ln2"], eps).astype(dtype)
     y = _dense(hn, p["fc1"], dtype)
     y = _dense(jax.nn.gelu(y), p["fc2"], dtype)
     return x_t + y, k_cache, v_cache
@@ -100,8 +103,8 @@ def _embed(params, tokens, pos_start, dtype):
     return (params["embed"][tokens].astype(dtype) + pos.astype(dtype))
 
 
-def _logits(params, x):
-    h = _ln(x, params["ln_final"])
+def _logits(params, x, eps):
+    h = _ln(x, params["ln_final"], eps)
     return (h @ params["head"]["kernel"].astype(jnp.float32)
             + params["head"]["bias"])
 
@@ -171,6 +174,7 @@ def generate(
             "single-shard)"
         )
     dtype = model.dtype
+    eps = getattr(model, "ln_eps", _LN_EPS)
     h = model.num_heads
     n_layers = model.num_layers  # trusted like num_heads/hidden_size:
     # a gappy params tree then fails LOUDLY at the missing block key
@@ -181,10 +185,11 @@ def generate(
     k_caches = jnp.zeros((n_layers, b, s_max, h, head_dim), dtype)
     v_caches = jnp.zeros((n_layers, b, s_max, h, head_dim), dtype)
     for i in range(n_layers):
-        x, k, v = _block_prefill(params[f"block_{i}"], x, h, dtype)
+        x, k, v = _block_prefill(params[f"block_{i}"], x, h, dtype,
+                                 eps)
         k_caches = k_caches.at[i, :, :t].set(k.astype(dtype))
         v_caches = v_caches.at[i, :, :t].set(v.astype(dtype))
-    first_logits = _logits(params, x[:, -1:])[:, 0]  # [B, V]
+    first_logits = _logits(params, x[:, -1:], eps)[:, 0]  # [B, V]
 
     keys = (jax.random.split(rng, max_new_tokens) if rng is not None
             else jnp.zeros((max_new_tokens, 2), jnp.uint32))
@@ -198,10 +203,10 @@ def generate(
         for i in range(n_layers):
             x_t, kc, vc = _block_decode(
                 params[f"block_{i}"], x_t, k_caches[i], v_caches[i],
-                pos, h, dtype)
+                pos, h, dtype, eps)
             new_k.append(kc)
             new_v.append(vc)
-        logits = _logits(params, x_t)[:, 0]
+        logits = _logits(params, x_t, eps)[:, 0]
         nxt = _sample(logits, temperature, top_k, key)
         return (nxt, jnp.stack(new_k), jnp.stack(new_v)), tok
 

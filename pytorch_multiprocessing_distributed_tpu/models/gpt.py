@@ -122,15 +122,20 @@ class Block(nn.Module):
     expert_axis: Optional[str] = None
     attn_impl: str = "flash"
     moe_top_k: int = 1
+    # flax default; GPT-2 checkpoints use 1e-5
+    # (utils.gpt_interop.from_gpt2_state_dict sets it)
+    ln_eps: float = 1e-6
 
     @nn.compact
     def __call__(self, x):
-        h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+        h = nn.LayerNorm(epsilon=self.ln_eps, dtype=jnp.float32,
+                         name="ln1")(x)
         x = x + CausalSelfAttention(
             self.num_heads, self.dtype, self.seq_axis, self.sp_mode,
             attn_impl=self.attn_impl, name="attn"
         )(h)
-        h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        h = nn.LayerNorm(epsilon=self.ln_eps, dtype=jnp.float32,
+                         name="ln2")(x)
         if self.n_experts > 0:
             # sparse feed-forward: top-1 routed experts (ops.MoEMlp —
             # expert weights shard over ``expert_axis`` under GSPMD via
@@ -168,6 +173,10 @@ class GPT(nn.Module):
     expert_axis: Optional[str] = None
     attn_impl: str = "flash"  # "flash" (Pallas) | "xla" (plain masked)
     moe_top_k: int = 1  # experts per token (1 = Switch, 2 = GShard)
+    # flax LayerNorm default; HF GPT-2 checkpoints need 1e-5 — set by
+    # utils.gpt_interop.from_gpt2_state_dict so imported weights
+    # reproduce the torch logits exactly
+    ln_eps: float = 1e-6
     bn_axis: Optional[str] = None  # unused (no BN); registry parity
 
     @nn.compact
@@ -219,8 +228,9 @@ class GPT(nn.Module):
             x = Block(self.num_heads, self.mlp_dim, self.dtype,
                       self.seq_axis, self.sp_mode, self.n_experts,
                       self.expert_axis, self.attn_impl, self.moe_top_k,
-                      name=f"block_{i}")(x)
-        x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
+                      ln_eps=self.ln_eps, name=f"block_{i}")(x)
+        x = nn.LayerNorm(epsilon=self.ln_eps, dtype=jnp.float32,
+                         name="ln_final")(x)
         logits = nn.Dense(self.vocab_size, dtype=jnp.float32,
                           kernel_init=dense_init, name="head")(x)
         return logits.astype(jnp.float32)

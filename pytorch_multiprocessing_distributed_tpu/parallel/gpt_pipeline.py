@@ -181,8 +181,9 @@ def _shared_parts(model, pipe_axis):
     # the check_vma=True shard_map these steps REQUIRE (collective AD
     # correctness, see .pipeline); plain masked attention is the same
     # exact math.
+    ln_eps = getattr(model, "ln_eps", _LN_EPS)
     block = Block(model.num_heads, model.mlp_dim, model.dtype,
-                  attn_impl="xla")
+                  attn_impl="xla", ln_eps=ln_eps)
 
     def stage_fn(stage_params, x):
         # stage_params leaves [L/S, ...]: scan this stage's layers
@@ -206,7 +207,7 @@ def _shared_parts(model, pipe_axis):
     def final_ln(h, lnf):
         mu = jnp.mean(h, -1, keepdims=True)
         var = jnp.var(h, -1, keepdims=True)
-        h = (h - mu) * jax.lax.rsqrt(var + _LN_EPS)
+        h = (h - mu) * jax.lax.rsqrt(var + ln_eps)
         return h * lnf["scale"] + lnf["bias"]
 
     return stage_fn, vocab_parallel_embed, final_ln

@@ -15,6 +15,13 @@ from .torch_interop import (
     save_torch_checkpoint,
     to_torch_state_dict,
 )
+from .gpt_interop import (
+    from_gpt2_state_dict,
+    load_gpt2_checkpoint,
+    save_gpt2_checkpoint,
+    to_gpt2_state_dict,
+)
+from .compile_cache import enable_compilation_cache
 
 __all__ = [
     "AverageMeter",
@@ -26,4 +33,9 @@ __all__ = [
     "from_torch_state_dict",
     "save_torch_checkpoint",
     "load_torch_checkpoint",
+    "to_gpt2_state_dict",
+    "from_gpt2_state_dict",
+    "save_gpt2_checkpoint",
+    "load_gpt2_checkpoint",
+    "enable_compilation_cache",
 ]

@@ -1,0 +1,92 @@
+"""Persistent XLA compilation cache.
+
+Every jitted program in this framework is traced and compiled once per
+process; on TPU a cold ResNet-50/GPT compile costs 20-40 s — and over
+this environment's remote-compile tunnel it has been observed far
+slower (a cold ``gpt_lm`` bench spent most of a short chip grant in
+compilation). JAX can persist compiled executables keyed by (HLO,
+platform, flags); enabling it makes every re-run of the same program —
+across processes and sessions — skip straight to execution. The first
+run of a grant window pays compile once; every later bench/profile/
+tune invocation in the window reuses it.
+
+Enabled by default by the CLIs and benchmark harnesses (``bench.py``,
+``main.py``, ``train_lm.py``, ``benchmarks/_common``); off per-run via
+``PMDT_XLA_CACHE=off``, relocated via ``PMDT_XLA_CACHE=/path``.
+
+The reference has no analogue (cuDNN autotune caches live inside the
+driver); this is the XLA-native equivalent of "warm starts".
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+_DEFAULT = os.path.join(os.path.expanduser("~"), ".cache", "pmdt_xla")
+_OFF = ("0", "off", "none", "false")
+
+
+def enable_compilation_cache(
+    path: Optional[str] = None, platform_hint: Optional[str] = None,
+) -> Optional[str]:
+    """Point JAX's persistent compilation cache at ``path`` (default:
+    ``$PMDT_XLA_CACHE`` or ``~/.cache/pmdt_xla``). Returns the directory
+    in use, or None when disabled (``PMDT_XLA_CACHE=off``, or the CPU
+    platform — see below) or when this jax build lacks the config knobs
+    (older jaxlibs — non-fatal).
+
+    CPU runs skip the cache: XLA:CPU AOT results embed exact host
+    machine features, and reloading across processes has been observed
+    (this machine) to log feature-mismatch errors warning of SIGILL —
+    while CPU compiles are cheap anyway. The cache's purpose is the
+    20-40 s (or tunnel-bound) TPU compiles. ``platform_hint`` overrides
+    the ``jax_platforms``/``JAX_PLATFORMS`` detection when the caller
+    already knows the backend (bench.py passes the probed platform).
+
+    Safe to call any time before the first compile; idempotent.
+    """
+    env = os.environ.get("PMDT_XLA_CACHE", "")
+    if env.lower() in _OFF:
+        return None
+    path = path or env or _DEFAULT
+    if path.lower() in _OFF:
+        return None
+    import jax
+
+    plat = (platform_hint or jax.config.jax_platforms
+            or os.environ.get("JAX_PLATFORMS", ""))
+    if not plat:
+        # no hint and no config/env signal: ask the backend itself.
+        # This initializes jax's platform — acceptable at every call
+        # site without a hint (the CLIs use devices moments later;
+        # bench.py, which must NOT touch a possibly-sick plugin before
+        # its subprocess probe, always passes platform_hint).
+        try:
+            plat = jax.default_backend()
+        except Exception:  # noqa: BLE001 — cache is best-effort
+            plat = ""
+    if plat and plat.split(",")[0].strip().lower() == "cpu":
+        return None
+
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+    except (OSError, AttributeError) as e:  # unwritable dir / old jax
+        import sys
+
+        print(f"[pmdt] compilation cache disabled ({e})", file=sys.stderr)
+        return None
+    try:
+        # default min-compile-time gate (1 s) is tuned for huge fleets;
+        # here EVERY TPU compile is worth keeping (tunnel round-trips),
+        # while trivial sub-ms CPU test jits stay out via the 0.1 s bar
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+    except AttributeError as e:
+        # knob absent on this jax: the cache above is STILL active (its
+        # default 1 s gate) — report that honestly rather than "off"
+        import sys
+
+        print(f"[pmdt] compile cache on, default admission gate ({e})",
+              file=sys.stderr)
+    return path

@@ -1,0 +1,204 @@
+"""HF/torch GPT-2 ``state_dict`` interop for the GPT family.
+
+The ResNet interop (:mod:`.torch_interop`) covers the reference's own
+artifact; this module does the same for the framework's LM flagship:
+HF-format GPT-2 weights (``GPT2LMHeadModel`` / ``GPT2Model``
+``state_dict``) load into :class:`..models.gpt.GPT`, and framework-
+trained GPTs export to an HF-loadable ``state_dict``. Because our GPT
+is architecturally GPT-2 (pre-LN, learned positions, tanh-GELU), the
+mapping is structural, not approximate — imported weights reproduce the
+torch logits (test-pinned, ``tests/test_gpt_interop.py``), which also
+pins our block math against the canonical implementation.
+
+Layout notes (torch GPT-2 uses ``Conv1D`` with ``weight[in, out]``,
+exactly flax ``Dense.kernel`` — no transposes except the head):
+
+====================  ==========================  ===============
+framework (Flax)      HF GPT-2                    transform
+====================  ==========================  ===============
+``embed`` [V, D]      ``wte.weight`` [V, D]       identity
+``pos_embed`` [P, D]  ``wpe.weight`` [P, D]       identity
+``block_i.ln1/ln2``   ``h.i.ln_1/ln_2``           scale<->weight
+``attn.wqkv.kernel``  ``h.i.attn.c_attn.weight``  identity
+``attn.wo.kernel``    ``h.i.attn.c_proj.weight``  identity
+``fc1/fc2.kernel``    ``h.i.mlp.c_fc/c_proj``     identity
+``ln_final``          ``ln_f``                    scale<->weight
+``head.kernel``[D,V]  ``lm_head.weight`` [V, D]   transpose
+``head.bias`` [V]     (tied head has none)        zeros on import
+====================  ==========================  ===============
+
+GPT-2 LayerNorms use ``eps=1e-5`` (flax default is 1e-6): the imported
+model is built with ``ln_eps=1e-5`` so the logits parity is exact, and
+every execution path (train step, pipelined trainer, KV-cached
+generate) honors ``model.ln_eps``.
+
+``torch`` is only needed by the ``.pth`` file helpers (lazy import);
+the dict converters are numpy-only.
+"""
+
+from __future__ import annotations
+
+import re
+from collections import OrderedDict
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+GPT2_LN_EPS = 1e-5
+
+_BLOCK_RE = re.compile(r"^h\.(\d+)\.")
+
+
+def _np(t) -> np.ndarray:
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().numpy()
+    return np.asarray(t, dtype=np.float32)
+
+
+def _normalize(sd: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    """Strip the ``transformer.`` prefix, drop non-parameter buffers
+    (``attn.bias`` causal masks, ``attn.masked_bias``)."""
+    out = {}
+    for k, v in sd.items():
+        if k.startswith("transformer."):
+            k = k[len("transformer."):]
+        # causal-mask buffers, not parameters. Dot-anchored so the REAL
+        # ``...c_attn.bias`` parameter is kept.
+        if k.endswith(".attn.bias") or k.endswith(".attn.masked_bias"):
+            continue
+        out[k] = v
+    return out
+
+
+def gpt2_geometry(sd: Dict[str, Any]) -> Dict[str, int]:
+    """Infer (vocab_size, max_seq_len, hidden_size, num_layers, mlp_dim)
+    from a normalized-or-not GPT-2 state dict. ``num_heads`` is not
+    recoverable from weights — callers supply it (12 for GPT-2 small)."""
+    sd = _normalize(sd)
+    v, d = sd["wte.weight"].shape
+    p = sd["wpe.weight"].shape[0]
+    layers = 1 + max(
+        int(m.group(1)) for k in sd if (m := _BLOCK_RE.match(k))
+    )
+    mlp = sd["h.0.mlp.c_fc.weight"].shape[1]
+    return dict(vocab_size=int(v), max_seq_len=int(p), hidden_size=int(d),
+                num_layers=int(layers), mlp_dim=int(mlp))
+
+
+def from_gpt2_state_dict(
+    sd: Dict[str, Any], num_heads: int, **model_kw,
+) -> Tuple["GPT", Dict[str, Any]]:
+    """-> ``(model, params)``: a :class:`GPT` built for the checkpoint's
+    geometry (``ln_eps=1e-5``) plus its param tree. ``model_kw`` passes
+    through (e.g. ``dtype=jnp.bfloat16``, ``attn_impl="xla"``)."""
+    from ..models.gpt import GPT
+
+    sd = _normalize(sd)
+    geo = gpt2_geometry(sd)
+    if geo["hidden_size"] % num_heads:
+        raise ValueError(
+            f"hidden_size {geo['hidden_size']} not divisible by "
+            f"num_heads={num_heads}"
+        )
+    kw = dict(geo, num_heads=num_heads, ln_eps=GPT2_LN_EPS)
+    kw.update(model_kw)  # caller overrides (dtype, attn_impl, ...)
+    model = GPT(**kw)
+
+    def ln(prefix):
+        return {"scale": _np(sd[f"{prefix}.weight"]),
+                "bias": _np(sd[f"{prefix}.bias"])}
+
+    def dense(prefix):
+        return {"kernel": _np(sd[f"{prefix}.weight"]),
+                "bias": _np(sd[f"{prefix}.bias"])}
+
+    wte = _np(sd["wte.weight"])
+    head_w = _np(sd["lm_head.weight"]) if "lm_head.weight" in sd else wte
+    params = {
+        "embed": wte,
+        "pos_embed": _np(sd["wpe.weight"]),
+        "ln_final": ln("ln_f"),
+        # GPT-2's tied head has no bias; our untied head does — zeros
+        # keep the logits identical
+        "head": {"kernel": head_w.T.copy(),
+                 "bias": np.zeros((geo["vocab_size"],), np.float32)},
+    }
+    for i in range(geo["num_layers"]):
+        params[f"block_{i}"] = {
+            "ln1": ln(f"h.{i}.ln_1"),
+            "attn": {"wqkv": dense(f"h.{i}.attn.c_attn"),
+                     "wo": dense(f"h.{i}.attn.c_proj")},
+            "ln2": ln(f"h.{i}.ln_2"),
+            "fc1": dense(f"h.{i}.mlp.c_fc"),
+            "fc2": dense(f"h.{i}.mlp.c_proj"),
+        }
+    return model, params
+
+
+def to_gpt2_state_dict(params: Dict[str, Any]) -> "OrderedDict":
+    """Framework GPT params -> HF-format ``state_dict`` (torch tensors,
+    ``transformer.*`` + ``lm_head.weight`` naming).
+
+    Our head is untied, so ``lm_head.weight`` carries OUR head kernel —
+    load the export with ``GPT2Config(tie_word_embeddings=False)`` (a
+    tied config would silently replace the head with ``wte``). The head
+    bias has no GPT-2 slot: a non-zero one (possible after framework
+    training) cannot be represented, so export refuses rather than
+    silently change the model's logits."""
+    import jax
+    import torch
+
+    params = jax.device_get(params)
+    bias = np.asarray(params["head"]["bias"])
+    if np.abs(bias).max() > 0:
+        raise ValueError(
+            "GPT-2 has no head-bias slot and this head's bias is "
+            "non-zero — folding it away would change the logits. "
+            "Zero the bias (or keep the framework checkpoint format)."
+        )
+
+    def t(a):
+        return torch.from_numpy(np.ascontiguousarray(np.asarray(a)))
+
+    sd = OrderedDict()
+    sd["transformer.wte.weight"] = t(params["embed"])
+    sd["transformer.wpe.weight"] = t(params["pos_embed"])
+    i = 0
+    while f"block_{i}" in params:
+        b = params[f"block_{i}"]
+        pre = f"transformer.h.{i}"
+        sd[f"{pre}.ln_1.weight"] = t(b["ln1"]["scale"])
+        sd[f"{pre}.ln_1.bias"] = t(b["ln1"]["bias"])
+        sd[f"{pre}.attn.c_attn.weight"] = t(b["attn"]["wqkv"]["kernel"])
+        sd[f"{pre}.attn.c_attn.bias"] = t(b["attn"]["wqkv"]["bias"])
+        sd[f"{pre}.attn.c_proj.weight"] = t(b["attn"]["wo"]["kernel"])
+        sd[f"{pre}.attn.c_proj.bias"] = t(b["attn"]["wo"]["bias"])
+        sd[f"{pre}.ln_2.weight"] = t(b["ln2"]["scale"])
+        sd[f"{pre}.ln_2.bias"] = t(b["ln2"]["bias"])
+        sd[f"{pre}.mlp.c_fc.weight"] = t(b["fc1"]["kernel"])
+        sd[f"{pre}.mlp.c_fc.bias"] = t(b["fc1"]["bias"])
+        sd[f"{pre}.mlp.c_proj.weight"] = t(b["fc2"]["kernel"])
+        sd[f"{pre}.mlp.c_proj.bias"] = t(b["fc2"]["bias"])
+        i += 1
+    sd["transformer.ln_f.weight"] = t(params["ln_final"]["scale"])
+    sd["transformer.ln_f.bias"] = t(params["ln_final"]["bias"])
+    sd["lm_head.weight"] = t(np.asarray(params["head"]["kernel"]).T)
+    return sd
+
+
+def load_gpt2_checkpoint(path: str, num_heads: int, **model_kw):
+    """``torch.load`` a GPT-2 ``state_dict`` file -> ``(model, params)``."""
+    import torch
+
+    sd = torch.load(path, map_location="cpu", weights_only=True)
+    if not isinstance(sd, dict):
+        raise ValueError(f"{path} does not contain a state_dict")
+    return from_gpt2_state_dict(sd, num_heads, **model_kw)
+
+
+def save_gpt2_checkpoint(path: str, params: Dict[str, Any]) -> str:
+    """Write the HF-format export with ``torch.save``; returns path."""
+    import torch
+
+    torch.save(to_gpt2_state_dict(params), path)
+    return path

@@ -78,6 +78,30 @@ def test_restore_places_on_template_shardings(tmp_path):
         assert a.sharding == b.sharding
 
 
+def test_elastic_restore_across_world_sizes(tmp_path):
+    """Elastic recovery: a checkpoint written FSDP-sharded over 8
+    devices restores onto a 4-device mesh (and vice versa would too) —
+    the template's shardings, not the writer's, decide placement. The
+    msgpack path gets this via its host gather; orbax does it with no
+    gather on either side."""
+    mesh8 = make_mesh()
+    state8 = shard_state(_tiny_state(0), mesh8, fsdp=True)
+    with OrbaxCheckpointer(str(tmp_path)) as ck:
+        ck.save(state8, 5)
+        ck.wait()
+        mesh4 = make_mesh(4, devices=jax.devices()[:4])
+        template4 = shard_state(_tiny_state(1), mesh4, fsdp=True)
+        restored = ck.restore(template4, epoch=5)
+    _assert_tree_equal(restored.params, state8.params)
+    four = [
+        l for l in jax.tree.leaves(restored.params)
+        if isinstance(l, jax.Array)
+    ]
+    assert four and all(
+        len(l.sharding.device_set) <= 4 for l in four
+    ), "restored leaves must live on the 4-device mesh"
+
+
 def test_save_overwrites_existing_epoch(tmp_path):
     """msgpack-parity semantics: re-running into the same save_path
     replaces the epoch artifact instead of raising

@@ -94,6 +94,10 @@ def main(args):
         force_cpu_devices_from_env)
 
     force_cpu_devices_from_env()
+    from pytorch_multiprocessing_distributed_tpu.utils.compile_cache import (
+        enable_compilation_cache)
+
+    enable_compilation_cache()
 
     import jax
     import jax.numpy as jnp
