@@ -105,8 +105,10 @@ def _embed(params, tokens, pos_start, dtype):
 
 def _logits(params, x, eps):
     h = _ln(x, params["ln_final"], eps)
-    return (h @ params["head"]["kernel"].astype(jnp.float32)
-            + params["head"]["bias"])
+    out = h @ params["head"]["kernel"].astype(jnp.float32)
+    if "bias" in params["head"]:  # absent on head_bias=False models
+        out = out + params["head"]["bias"]
+    return out
 
 
 def _sample(logits, temperature, top_k, key):

@@ -177,6 +177,9 @@ class GPT(nn.Module):
     # utils.gpt_interop.from_gpt2_state_dict so imported weights
     # reproduce the torch logits exactly
     ln_eps: float = 1e-6
+    # GPT-2's (tied) head has no bias slot: interop-bound models train
+    # with head_bias=False so the export is exact (utils.gpt_interop)
+    head_bias: bool = True
     bn_axis: Optional[str] = None  # unused (no BN); registry parity
 
     @nn.compact
@@ -232,7 +235,8 @@ class GPT(nn.Module):
         x = nn.LayerNorm(epsilon=self.ln_eps, dtype=jnp.float32,
                          name="ln_final")(x)
         logits = nn.Dense(self.vocab_size, dtype=jnp.float32,
-                          kernel_init=dense_init, name="head")(x)
+                          kernel_init=dense_init, name="head",
+                          use_bias=self.head_bias)(x)
         return logits.astype(jnp.float32)
 
 

@@ -72,6 +72,16 @@ def stack_pipeline_params(params, n_stages: int):
     num_layers = _num_layers(params)
     if num_layers == 0:
         raise ValueError("params has no block_<i> entries — not a GPT tree")
+    if "bias" not in params["head"]:
+        # the vocab-parallel head masks its padded slots through the
+        # bias (-1e9 => zero softmax mass); a biasless head
+        # (head_bias=False, the HF-interop configuration) has no slot
+        # to carry that mask
+        raise NotImplementedError(
+            "pipeline parallelism requires the default head_bias=True "
+            "GPT (the pipe-sharded head uses the bias to mask padded "
+            "vocab slots)"
+        )
     if num_layers % n_stages:
         raise ValueError(
             f"{num_layers} layers not divisible by n_stages={n_stages}"

@@ -24,8 +24,11 @@ framework (Flax)      HF GPT-2                    transform
 ``fc1/fc2.kernel``    ``h.i.mlp.c_fc/c_proj``     identity
 ``ln_final``          ``ln_f``                    scale<->weight
 ``head.kernel``[D,V]  ``lm_head.weight`` [V, D]   transpose
-``head.bias`` [V]     (tied head has none)        zeros on import
 ====================  ==========================  ===============
+
+The tied GPT-2 head has no bias, so imports build ``head_bias=False``
+models (no ``head.bias`` leaf at all) and exports refuse a
+present-and-nonzero bias rather than silently dropping it.
 
 GPT-2 LayerNorms use ``eps=1e-5`` (flax default is 1e-6): the imported
 model is built with ``ln_eps=1e-5`` so the logits parity is exact, and
@@ -100,7 +103,10 @@ def from_gpt2_state_dict(
             f"hidden_size {geo['hidden_size']} not divisible by "
             f"num_heads={num_heads}"
         )
-    kw = dict(geo, num_heads=num_heads, ln_eps=GPT2_LN_EPS)
+    # head_bias=False: GPT-2's tied head has no bias slot, so the
+    # imported model trains WITHOUT one — re-export stays exact
+    kw = dict(geo, num_heads=num_heads, ln_eps=GPT2_LN_EPS,
+              head_bias=False)
     kw.update(model_kw)  # caller overrides (dtype, attn_impl, ...)
     model = GPT(**kw)
 
@@ -118,10 +124,7 @@ def from_gpt2_state_dict(
         "embed": wte,
         "pos_embed": _np(sd["wpe.weight"]),
         "ln_final": ln("ln_f"),
-        # GPT-2's tied head has no bias; our untied head does — zeros
-        # keep the logits identical
-        "head": {"kernel": head_w.T.copy(),
-                 "bias": np.zeros((geo["vocab_size"],), np.float32)},
+        "head": {"kernel": head_w.T.copy()},  # biasless, like the source
     }
     for i in range(geo["num_layers"]):
         params[f"block_{i}"] = {
@@ -142,23 +145,27 @@ def to_gpt2_state_dict(params: Dict[str, Any]) -> "OrderedDict":
     Our head is untied, so ``lm_head.weight`` carries OUR head kernel —
     load the export with ``GPT2Config(tie_word_embeddings=False)`` (a
     tied config would silently replace the head with ``wte``). The head
-    bias has no GPT-2 slot: a non-zero one (possible after framework
-    training) cannot be represented, so export refuses rather than
-    silently change the model's logits."""
+    bias has no GPT-2 slot: models meant for export train biasless
+    (``GPT(head_bias=False)``, what :func:`from_gpt2_state_dict`
+    builds); a present-and-nonzero bias cannot be represented, so
+    export refuses rather than silently change the model's logits."""
     import jax
     import torch
 
     params = jax.device_get(params)
-    bias = np.asarray(params["head"]["bias"])
+    bias = np.asarray(params["head"].get("bias", 0.0))
     if np.abs(bias).max() > 0:
         raise ValueError(
             "GPT-2 has no head-bias slot and this head's bias is "
             "non-zero — folding it away would change the logits. "
-            "Zero the bias (or keep the framework checkpoint format)."
+            "Train with GPT(head_bias=False) for exact export (or keep "
+            "the framework checkpoint format)."
         )
 
     def t(a):
-        return torch.from_numpy(np.ascontiguousarray(np.asarray(a)))
+        # copy: jax.device_get hands back non-writable views, which
+        # torch.from_numpy would alias with an undefined-behavior warning
+        return torch.from_numpy(np.array(a, copy=True))
 
     sd = OrderedDict()
     sd["transformer.wte.weight"] = t(params["embed"])

@@ -85,8 +85,14 @@ def test_round_trip_export(hf_model):
 
 
 def test_export_refuses_nonzero_head_bias(hf_model):
+    """Imported models are biasless (head_bias=False); a default
+    head_bias=True model trained in-framework has a bias GPT-2 cannot
+    represent — export must refuse, not silently drop it."""
     _, params = from_gpt2_state_dict(hf_model.state_dict(), num_heads=2)
-    params["head"]["bias"] = np.ones_like(params["head"]["bias"])
+    assert "bias" not in params["head"]  # biasless by construction
+    params["head"]["bias"] = np.ones(
+        params["head"]["kernel"].shape[1], np.float32
+    )
     with pytest.raises(ValueError, match="head-bias"):
         to_gpt2_state_dict(params)
 

@@ -98,3 +98,65 @@ def test_cli_pp_schedule_needs_pp(tmp_path):
 def test_cli_moe_reports_aux(tmp_path):
     out, _ = _run(tmp_path, "--parallel", "dp", "--n_experts", "2")
     assert "Aux" in out
+
+
+@pytest.mark.slow
+def test_cli_hf_init_and_export_round_trip(tmp_path):
+    """--hf_init loads an HF GPT-2 state_dict (geometry-checked),
+    training runs with the GPT-2 configuration (ln_eps=1e-5, biasless
+    head), and --hf_export writes a state_dict transformers can load
+    with tie_word_embeddings=False."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    config = transformers.GPT2Config(
+        vocab_size=257, n_positions=256, n_embd=128, n_layer=4,
+        n_head=4, resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    src = transformers.GPT2LMHeadModel(config).eval()
+    ckpt = tmp_path / "gpt2_src.pth"
+    torch.save(src.state_dict(), ckpt)
+
+    out, _ = _run(tmp_path, "--parallel", "dp",
+                  "--hf_init", str(ckpt), "--hf_export")
+    assert "HF export:" in out
+    exported = tmp_path / "run" / "model_1.hf.pth"
+    assert exported.exists()
+
+    dst = transformers.GPT2LMHeadModel(config)
+    sd = torch.load(exported, map_location="cpu", weights_only=True)
+    missing, unexpected = dst.load_state_dict(sd, strict=False)
+    # buffers (causal masks) may be "missing" from the export; no
+    # PARAMETER may be, and nothing unexpected may appear
+    assert not unexpected, unexpected
+    params_missing = [m for m in missing if not m.endswith(".attn.bias")
+                      and not m.endswith(".attn.masked_bias")]
+    assert not params_missing, params_missing
+    # trained-for-one-epoch weights must differ from the source
+    assert not torch.equal(sd["transformer.wte.weight"],
+                           src.state_dict()["transformer.wte.weight"])
+
+
+@pytest.mark.slow
+def test_cli_hf_init_geometry_mismatch_fails_fast(tmp_path):
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    config = transformers.GPT2Config(
+        vocab_size=97, n_positions=64, n_embd=32, n_layer=2, n_head=2)
+    ckpt = tmp_path / "wrong_geo.pth"
+    torch.save(transformers.GPT2LMHeadModel(config).state_dict(), ckpt)
+
+    env = dict(os.environ, PMDT_FORCE_CPU_DEVICES="8")
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "train_lm.py"),
+         "--model", "gpt_tiny", "--epochs", "1",
+         "--hf_init", str(ckpt), "--save_path", str(tmp_path / "x")],
+        env=env, capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert proc.returncode != 0
+    assert "geometry" in proc.stdout + proc.stderr

@@ -84,6 +84,17 @@ parser.add_argument('--fsdp', action='store_true',
 parser.add_argument('--val_frac', default=0.0, type=float,
                     help='hold out this fraction of the token stream '
                          'and log per-epoch val loss/ppl to test.log')
+parser.add_argument('--hf_init', default='', type=str, metavar='PATH',
+                    help='initialize from an HF-format GPT-2 state_dict '
+                         '(torch .pth/.bin); geometry must match --model. '
+                         'Builds the GPT-2 configuration (ln_eps=1e-5, '
+                         'biasless head) so re-export stays exact')
+parser.add_argument('--hf_export', action='store_true',
+                    help='after training, also write the weights as an '
+                         'HF-loadable GPT-2 state_dict '
+                         '(model_{epochs}.hf.pth). Trains with a '
+                         'biasless head (GPT-2 has no head-bias slot); '
+                         'dense dp/sp/tp models only')
 parser.add_argument('--sample', default=0, type=int,
                     help='after training, print N greedy-sampled tokens '
                          '(dense dp/tp models only)')
@@ -131,7 +142,31 @@ def main(args):
         # shard_map; for tp the XLA path avoids interpret-mode cost off
         # TPU while staying exact
         model_kw.update(attn_impl='xla')
+    if args.hf_init or args.hf_export:
+        if args.parallel == 'pp' or args.n_experts:
+            raise SystemExit(
+                '--hf_init/--hf_export cover dense dp/sp/tp GPTs (the '
+                'pipe-sharded head needs its bias for vocab padding; '
+                'MoE blocks have no GPT-2 representation)')
+        # GPT-2 configuration: its LN eps, and no head-bias slot — the
+        # export must not have to drop a trained parameter
+        model_kw.update(ln_eps=1e-5, head_bias=False)
     model = models.get_model(args.model, **model_kw)
+    hf_params = None
+    if args.hf_init:
+        from pytorch_multiprocessing_distributed_tpu.utils.gpt_interop import (
+            load_gpt2_checkpoint)
+
+        hf_model, hf_params = load_gpt2_checkpoint(
+            args.hf_init, model.num_heads, **model_kw)
+        mine = {k: getattr(model, k) for k in (
+            'vocab_size', 'max_seq_len', 'hidden_size', 'num_layers',
+            'mlp_dim')}
+        theirs = {k: getattr(hf_model, k) for k in mine}
+        if mine != theirs:
+            raise SystemExit(
+                f'--hf_init geometry {theirs} does not match '
+                f'--model {args.model} {mine}')
     # Every inapplicable/oversized flag combo fails BEFORE the run (the
     # main.py convention: a dropped flag or a post-training crash after
     # hours of work is worse than an immediate error).
@@ -225,6 +260,15 @@ def main(args):
     rng = jax.random.PRNGKey(args.seed)
     sample_tok = jnp.zeros((2, args.seq_len), jnp.int32)
 
+    def init_state():
+        st = create_lm_train_state(model, rng, sample_tok, opt)
+        if hf_params is not None:
+            # same tree structure by construction (geometry checked
+            # above, head_bias/ln_eps already in model_kw)
+            st = st.replace(
+                params=jax.tree.map(jnp.asarray, hf_params))
+        return st
+
     if args.parallel == 'pp':
         from pytorch_multiprocessing_distributed_tpu.parallel import (
             create_pipelined_lm_state, make_pipelined_lm_train_step)
@@ -236,7 +280,7 @@ def main(args):
             model, opt, mesh, schedule=args.pp_schedule)
     elif args.parallel == 'tp':
         mesh = make_mesh(dp, deg)
-        state = create_lm_train_state(model, rng, sample_tok, opt)
+        state = init_state()
         state = shard_state(state, mesh, zero1=args.zero1, fsdp=args.fsdp)
         step = make_lm_train_step_tp(
             model, opt, mesh, zero1=args.zero1, fsdp=args.fsdp,
@@ -245,7 +289,7 @@ def main(args):
         axes = ('data', 'seq') if args.parallel == 'sp' else ('data',)
         mesh = (make_mesh(dp, deg, axis_names=axes)
                 if args.parallel == 'sp' else make_mesh(dp))
-        state = create_lm_train_state(model, rng, sample_tok, opt)
+        state = init_state()
         step = make_lm_train_step(
             model, opt, mesh,
             seq_axis='seq' if args.parallel == 'sp' else None,
@@ -315,7 +359,24 @@ def main(args):
                       f"PPL {math.exp(min(vloss, 20.0)):.2f}", flush=True)
                 test_logger.write(
                     [epoch, vloss, math.exp(min(vloss, 20.0))])
+    if args.hf_export:
+        from pytorch_multiprocessing_distributed_tpu.train.checkpoint import (
+            _gather_for_host)
+
+        # ONE collective gather serves both writes below: gathered
+        # leaves are fully addressable, so save_checkpoint's internal
+        # gather becomes a no-op pass-through
+        state = _gather_for_host(state)
     save_checkpoint(args.save_path, state, args.epochs)
+    if args.hf_export:
+        from pytorch_multiprocessing_distributed_tpu.utils.gpt_interop import (
+            save_gpt2_checkpoint)
+
+        if dist.is_primary():
+            out = os.path.join(args.save_path,
+                               f"model_{args.epochs}.hf.pth")
+            save_gpt2_checkpoint(out, state.params)
+            print(f"HF export: {out}", flush=True)
 
     if args.sample and args.parallel in ('dp', 'tp') \
             and args.n_experts == 0:
