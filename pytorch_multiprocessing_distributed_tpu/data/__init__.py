@@ -11,6 +11,7 @@ H2D analogue, SURVEY.md §2.2).
 from .cifar import load_cifar10, synthetic_cifar10
 from .transforms import normalize, random_crop_flip
 from .lm import TokenLoader, synthetic_tokens
+from .text import load_text_corpus, tokenize, detokenize
 from .pipeline import ShardedLoader, get_loader, prefetch_to_device
 from .imagenet import (
     FolderImageNet,
@@ -23,6 +24,9 @@ from .imagenet import (
 __all__ = [
     "TokenLoader",
     "synthetic_tokens",
+    "load_text_corpus",
+    "tokenize",
+    "detokenize",
     "load_cifar10",
     "synthetic_cifar10",
     "normalize",

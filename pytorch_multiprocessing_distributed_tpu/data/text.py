@@ -1,0 +1,69 @@
+"""Byte-level text corpus: raw text files -> LM token streams.
+
+The zero-egress answer to "train on my text": no vocab files, no
+downloaded tokenizer — every byte is a token (ids 0..255), and id 256
+separates documents. That is exactly ``gpt_tiny``'s 257-token vocab, so
+``train_lm.py --corpus my.txt`` works out of the box; larger vocabs
+simply leave the rest of their embedding rows cold. Byte-level LMs are
+a standard, competitive baseline (the reference has no text path at
+all — SURVEY.md scopes it to CIFAR images).
+
+Round trip is lossless: ``detokenize(tokenize(text)) == text`` for any
+UTF-8 input (invalid sequences degrade to U+FFFD only at the final
+string decode; the byte stream itself is preserved exactly).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterable, Union
+
+import numpy as np
+
+#: document-separator token id (first id past the byte range)
+DOC_SEP = 256
+
+#: smallest vocab that fits byte tokens + the separator
+BYTE_VOCAB = 257
+
+
+def tokenize(text: Union[str, bytes]) -> np.ndarray:
+    """Text (or raw bytes) -> int32 token ids in [0, 255]."""
+    if isinstance(text, str):
+        text = text.encode("utf-8")
+    return np.frombuffer(text, dtype=np.uint8).astype(np.int32)
+
+
+def detokenize(tokens: Iterable[int]) -> str:
+    """Token ids -> text. Ids > 255 (DOC_SEP, or cold ids a model with a
+    larger vocab may emit early in training) become newlines rather than
+    corrupting the byte stream."""
+    arr = np.asarray(list(tokens) if not hasattr(tokens, "astype")
+                     else tokens).astype(np.int64).ravel()
+    arr = np.where(arr > 255, np.int64(ord("\n")), arr)
+    arr = np.where(arr < 0, np.int64(ord("\n")), arr)
+    return arr.astype(np.uint8).tobytes().decode("utf-8", errors="replace")
+
+
+def load_text_corpus(path: str) -> np.ndarray:
+    """A ``.txt``/arbitrary file — or a directory of them — as one
+    int32 token stream, files joined by :data:`DOC_SEP`.
+
+    Directory mode reads every regular file in sorted order (stable
+    across hosts — the loaders shard this stream deterministically)."""
+    if os.path.isdir(path):
+        names = sorted(
+            n for n in os.listdir(path)
+            if os.path.isfile(os.path.join(path, n))
+        )
+        if not names:
+            raise FileNotFoundError(f"no files under corpus dir {path}")
+        parts = []
+        for k, name in enumerate(names):
+            if k:
+                parts.append(np.asarray([DOC_SEP], np.int32))
+            with open(os.path.join(path, name), "rb") as f:
+                parts.append(tokenize(f.read()))
+        return np.concatenate(parts)
+    with open(path, "rb") as f:
+        return tokenize(f.read())

@@ -1,0 +1,42 @@
+"""Byte-level text corpus (data.text): lossless round trip, dir mode."""
+
+import numpy as np
+
+from pytorch_multiprocessing_distributed_tpu.data.text import (
+    BYTE_VOCAB,
+    DOC_SEP,
+    detokenize,
+    load_text_corpus,
+    tokenize,
+)
+
+
+def test_round_trip_lossless():
+    text = "héllo wörld\n日本語 ascii 123\t~"
+    toks = tokenize(text)
+    assert toks.dtype == np.int32
+    assert toks.min() >= 0 and toks.max() <= 255
+    assert detokenize(toks) == text
+
+
+def test_detokenize_maps_out_of_range_to_newline():
+    assert detokenize([72, 105, DOC_SEP, 33]) == "Hi\n!"
+    assert detokenize(np.asarray([300, -1, 65])) == "\n\nA"
+
+
+def test_file_and_dir_corpus(tmp_path):
+    (tmp_path / "b.txt").write_text("second")
+    (tmp_path / "a.txt").write_text("first")
+    one = load_text_corpus(str(tmp_path / "a.txt"))
+    assert detokenize(one) == "first"
+    both = load_text_corpus(str(tmp_path))
+    # sorted order, DOC_SEP joined; everything inside BYTE_VOCAB
+    assert both.max() == DOC_SEP and both.max() < BYTE_VOCAB
+    assert detokenize(both) == "first\nsecond"
+
+
+def test_empty_dir_fails(tmp_path):
+    import pytest
+
+    with pytest.raises(FileNotFoundError):
+        load_text_corpus(str(tmp_path))

@@ -21,7 +21,9 @@ from ``inference.generate`` as a smoke signal.
 
 Data-free by construction: ``--corpus_tokens`` synthesizes a
 deterministic Zipf stream (``data.synthetic_tokens``); pass
-``--corpus`` with a ``.npy``/binary int32 file for real tokens.
+``--corpus`` with an ``np.save``-format int32 token file (detected by
+magic bytes) or ANY text file / directory (byte-level tokens,
+``data.text``).
 
 Run on the CPU mesh:  PMDT_FORCE_CPU_DEVICES=8 python train_lm.py \\
     --model gpt_tiny --parallel sp --degree 4 --sp_mode zigzag \\
@@ -51,7 +53,11 @@ parser.add_argument('--save_path', default='./lm_run/', type=str)
 parser.add_argument('--print_freq', default=10, type=int)
 parser.add_argument('--seed', default=0, type=int)
 parser.add_argument('--corpus', default='', type=str,
-                    help='int32 token file (.npy); empty = synthetic')
+                    help='token source: a .npy int32 file, OR any text '
+                         'file / directory of text files (byte-level '
+                         'tokens, ids 0..255 + 256 as doc separator — '
+                         'fits gpt_tiny\'s 257 vocab out of the box); '
+                         'empty = synthetic stream')
 parser.add_argument('--corpus_tokens', default=200_000, type=int,
                     help='synthetic stream length when --corpus is empty')
 parser.add_argument('--dtype', default='float32',
@@ -225,8 +231,29 @@ def main(args):
         raise SystemExit(f"{n_dev} devices not divisible by --degree {deg}")
     dp = n_dev // max(1, deg)
 
+    corpus_is_text = False
     if args.corpus:
-        tokens = np.load(args.corpus).astype(np.int32)
+        def _is_npy(path):
+            # magic-byte sniff, not extension: a renamed np.save output
+            # must not be silently reinterpreted as raw text (byte
+            # tokens always pass the vocab guard below)
+            if os.path.isdir(path):
+                return False
+            with open(path, 'rb') as f:
+                return f.read(6) == b'\x93NUMPY'
+
+        if _is_npy(args.corpus):
+            tokens = np.load(args.corpus).astype(np.int32)
+        else:
+            # anything else is raw text: byte-level tokens (ids 0..255,
+            # 256 = document separator) — no vocab files needed
+            from pytorch_multiprocessing_distributed_tpu.data.text import (
+                load_text_corpus)
+
+            tokens = load_text_corpus(args.corpus)
+            corpus_is_text = True
+        if len(tokens) == 0:
+            raise SystemExit(f"--corpus {args.corpus} contains no tokens")
         if tokens.max() >= model.vocab_size or tokens.min() < 0:
             # jit CLAMPS out-of-range gathers silently — without this
             # check an oversized-vocab corpus trains on garbage
@@ -389,7 +416,13 @@ def main(args):
         out = generate(dense, params, prompt,
                        max_new_tokens=args.sample)
         if dist.is_primary():
-            print("sample:", np.asarray(out[0, -args.sample:]).tolist())
+            ids = np.asarray(out[0, -args.sample:]).tolist()
+            print("sample:", ids)
+            if corpus_is_text:
+                from pytorch_multiprocessing_distributed_tpu.data.text import (
+                    detokenize)
+
+                print("sample text:", repr(detokenize(ids)), flush=True)
 
     dist.destroy_process_group()
 
