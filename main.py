@@ -98,6 +98,11 @@ parser.add_argument('--ckpt_backend', default='msgpack',
                          'the model; needs shared storage across hosts. '
                          "With orbax, --resume takes 'auto' or an epoch "
                          'number')
+parser.add_argument('--ckpt_async', action='store_true',
+                    help='overlap checkpoint serialization with training '
+                         '(orbax backend only); the final-epoch and '
+                         'preemption saves are always durable before '
+                         'exit')
 parser.add_argument('--lr', default=0.0, type=float,
                     help='base learning rate (0 = optimizer default: '
                          '0.1 sgd / 1e-3 lamb, the reference values)')
@@ -350,6 +355,7 @@ def main(args):
         save_every=args.save_every,
         keep_checkpoints=args.keep_checkpoints,
         ckpt_backend=args.ckpt_backend,
+        ckpt_async=args.ckpt_async,
     )
     if args.profile:
         from pytorch_multiprocessing_distributed_tpu.utils.profiler import trace

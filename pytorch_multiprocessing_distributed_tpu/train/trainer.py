@@ -80,6 +80,7 @@ class Trainer:
         save_every: int = 0,
         keep_checkpoints: int = 0,
         ckpt_backend: str = "msgpack",
+        ckpt_async: bool = False,
     ):
         self.mesh = mesh
         self.state = state
@@ -104,7 +105,13 @@ class Trainer:
             from .orbax_ckpt import OrbaxCheckpointer
 
             self._orbax = OrbaxCheckpointer(
-                save_path, keep=keep_checkpoints or None
+                save_path, keep=keep_checkpoints or None,
+                async_=ckpt_async,
+            )
+        elif ckpt_async:
+            raise ValueError(
+                "ckpt_async requires ckpt_backend='orbax' (the msgpack "
+                "writer is synchronous by design)"
             )
         elif ckpt_backend != "msgpack":
             raise ValueError(
@@ -239,16 +246,21 @@ class Trainer:
             )
         raise SystemExit(0)
 
-    def _save_state(self, state: TrainState, epoch: int) -> None:
+    def _save_state(self, state: TrainState, epoch: int,
+                    wait: bool = True) -> None:
         """One checkpoint write through the configured backend. EVERY
         host calls this: the msgpack path's sharded-leaf gather is a
         collective (the write itself is primary-gated inside), and the
-        orbax path has every host writing its own shards."""
+        orbax path has every host writing its own shards.
+
+        ``wait=False`` (async orbax) lets a periodic mid-training save
+        overlap serialization with the next epochs; callers that rely
+        on the artifact existing when they move on (final epoch,
+        preemption exit) keep the default."""
         if self.ckpt_backend == "orbax":
             self._orbax.save(state, epoch)
-            # durable before returning: both call sites (end-of-epoch,
-            # preemption) rely on the artifact existing when they move on
-            self._orbax.wait()
+            if wait:
+                self._orbax.wait()
         else:
             save_checkpoint(self.save_path, state, epoch)
             if dist.is_primary():
@@ -269,8 +281,17 @@ class Trainer:
                 self.validate(epoch, mode="test")
                 periodic = self.save_every and epoch % self.save_every == 0
                 if epoch == self.epochs or periodic:
-                    self._save_state(self.state, epoch)
+                    # mid-training periodic saves may overlap with the
+                    # next epochs (async orbax); the final one is durable
+                    # before fit returns
+                    self._save_state(self.state, epoch,
+                                     wait=epoch == self.epochs)
         finally:
+            if self.ckpt_backend == "orbax":
+                # an async periodic save may still be in flight (e.g.
+                # when an exception unwinds the epoch loop) — make it
+                # durable before the process can exit
+                self._orbax.wait()
             # a caller's process must not permanently swallow SIGTERM
             # after training ends
             if prev_handler is not _HANDLER_NOT_INSTALLED:

@@ -126,6 +126,27 @@ def test_retention_keeps_newest(tmp_path):
         assert ck.manager.all_steps() == [3]
 
 
+def test_async_save_durable_after_wait(tmp_path):
+    state = _tiny_state(0)
+    with OrbaxCheckpointer(str(tmp_path), async_=True) as ck:
+        ck.save(state, 1)
+        ck.wait()
+        assert ck.latest_epoch() == 1
+        restored = ck.restore(_tiny_state(1), epoch=1)
+    _assert_tree_equal(restored.params, state.params)
+
+
+def test_trainer_rejects_async_without_orbax():
+    from pytorch_multiprocessing_distributed_tpu.train.trainer import Trainer
+
+    with pytest.raises(ValueError, match="ckpt_async"):
+        Trainer(
+            model=None, optimizer=None, mesh=make_mesh(),
+            state=None, train_loader=None, test_loader=None,
+            save_path=".", epochs=1, ckpt_async=True,
+        )
+
+
 def test_trainer_rejects_unknown_backend():
     from pytorch_multiprocessing_distributed_tpu.train.trainer import Trainer
 

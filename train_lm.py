@@ -233,16 +233,26 @@ def main(args):
 
     corpus_is_text = False
     if args.corpus:
-        def _is_npy(path):
-            # magic-byte sniff, not extension: a renamed np.save output
-            # must not be silently reinterpreted as raw text (byte
-            # tokens always pass the vocab guard below)
+        def _sniff(path):
+            # magic-byte sniff, not extension: numpy tooling output must
+            # not be silently reinterpreted as raw text (its bytes are
+            # all <= 255, so it would pass the vocab guard below)
             if os.path.isdir(path):
-                return False
+                return 'text'
             with open(path, 'rb') as f:
-                return f.read(6) == b'\x93NUMPY'
+                head = f.read(6)
+            if head == b'\x93NUMPY':
+                return 'npy'
+            if head[:4] == b'PK\x03\x04':  # zip: np.savez / .npz
+                return 'npz'
+            return 'text'
 
-        if _is_npy(args.corpus):
+        kind = _sniff(args.corpus)
+        if kind == 'npz':
+            raise SystemExit(
+                f"--corpus {args.corpus} is an npz/zip archive — pass "
+                "the np.save (.npy) array itself, or a text file")
+        if kind == 'npy':
             tokens = np.load(args.corpus).astype(np.int32)
         else:
             # anything else is raw text: byte-level tokens (ids 0..255,
