@@ -290,7 +290,7 @@ def compile_step(step, *args):
 
 
 def build_workload(config: str, dtype_name: str, batch_size: int,
-                   devices, remat: bool = False):
+                   devices, remat: bool = False, vocab_chunks: int = 0):
     """Construct the EXACT program a config benches: the jitted train
     step, its initialized state, the resident device batch, and the
     item count per step. The ONE place this lives — ``run_bench`` times
@@ -319,6 +319,11 @@ def build_workload(config: str, dtype_name: str, batch_size: int,
     dtype = jnp.bfloat16 if dtype_name == "bfloat16" else jnp.float32
     batch = batch_size or cfg["batch"]
     is_lm = bool(cfg.get("lm"))
+    if vocab_chunks and not is_lm:
+        raise ValueError(
+            f"--vocab_chunks streams the LM head; {config} is not an "
+            "LM config"
+        )
     if not is_tpu:
         # CPU fallback is a liveness signal, not a perf number — shrink
         # so a line still appears in bounded time.
@@ -343,7 +348,8 @@ def build_workload(config: str, dtype_name: str, batch_size: int,
         state = create_lm_train_state(
             model, jax.random.PRNGKey(0), tokens[:2], opt
         )
-        step = make_lm_train_step(model, opt, mesh, remat=remat)
+        step = make_lm_train_step(model, opt, mesh, remat=remat,
+                                  vocab_chunks=vocab_chunks)
         batch_args = shard_batch((tokens,), mesh)
         items_per_step = batch * s  # tokens
     else:
@@ -368,7 +374,7 @@ def build_workload(config: str, dtype_name: str, batch_size: int,
 
 def run_bench(config: str, dtype_name: str, batch_size: int,
               min_window: float, warmup: int, devices, note,
-              remat: bool = False) -> dict:
+              remat: bool = False, vocab_chunks: int = 0) -> dict:
     import numpy as np
 
     n_dev = len(devices)
@@ -377,7 +383,8 @@ def run_bench(config: str, dtype_name: str, batch_size: int,
     if not is_tpu:
         min_window, warmup = min(min_window, 0.2), min(warmup, 1)
     step, state, batch_args, items_per_step, batch = build_workload(
-        config, dtype_name, batch_size, devices, remat=remat
+        config, dtype_name, batch_size, devices, remat=remat,
+        vocab_chunks=vocab_chunks,
     )
     step, flops = compile_step(step, state, *batch_args)
 
@@ -493,8 +500,10 @@ def run_bench(config: str, dtype_name: str, batch_size: int,
             # batch: mesh-alignment rounding of the config's own batch
             # must not bar a config from ever recording a baseline.
             "canonical": (batch_size == 0 and dtype_name == "bfloat16"
-                          and is_tpu and not remat),
+                          and is_tpu and not remat
+                          and vocab_chunks == 0),
             "remat": remat,
+            "vocab_chunks": vocab_chunks,
             "flops_per_step_per_chip": flops,
             "peak_flops_per_chip": peak,
         },
@@ -552,6 +561,10 @@ def main():
     p.add_argument("--remat", action="store_true",
                    help="rematerialize activations (jax.checkpoint) — "
                         "trades ~1.3x step time for the activation HBM")
+    p.add_argument("--vocab_chunks", default=0, type=int,
+                   help="LM configs: stream the head+CE over N vocab "
+                        "slices (logits never materialize); 0 = dense. "
+                        "Non-canonical probe knob like --remat")
     args = p.parse_args()
 
     result = None
@@ -578,7 +591,8 @@ def main():
             _log(f"compilation cache: {cache_dir}")
         result = run_bench(args.config, args.dtype, args.batch_size,
                            args.min_window, args.warmup, devices, note,
-                           remat=args.remat)
+                           remat=args.remat,
+                           vocab_chunks=args.vocab_chunks)
     except BaseException as e:  # noqa: BLE001 — the JSON line must appear
         _log(traceback.format_exc())
         result = {

@@ -27,6 +27,9 @@ note "4. MFU flag sweep (short: the profile + probes above pick the lever)"
 $T python benchmarks/mfu_tune.py --config resnet50_imagenet \
     --batches 0,128 --flag_sets baseline,lhs
 
+note "4b. gpt_lm streamed-CE probe (logits never materialize — faster?)"
+$T python bench.py --config gpt_lm --vocab_chunks 8
+
 note "5. attention artifact (flash vs XLA, backs COVERAGE.md)"
 # temp-then-move: a failed run must not clobber a previous GOOD artifact
 tmp=$(mktemp)

@@ -183,7 +183,12 @@ class GPT(nn.Module):
     bn_axis: Optional[str] = None  # unused (no BN); registry parity
 
     @nn.compact
-    def __call__(self, tokens, train: bool = False):
+    def __call__(self, tokens, train: bool = False,
+                 return_hidden: bool = False):
+        """``return_hidden=True`` stops after the final LayerNorm and
+        returns ``[B, S, D]`` f32 hiddens instead of logits — the input
+        the streamed head+CE (:func:`..ops.losses.chunked_lm_ce`)
+        consumes so the ``[B, S, V]`` logits never materialize."""
         b, s = tokens.shape
         embed = self.param(
             "embed", dense_init, (self.vocab_size, self.hidden_size),
@@ -234,6 +239,14 @@ class GPT(nn.Module):
                       ln_eps=self.ln_eps, name=f"block_{i}")(x)
         x = nn.LayerNorm(epsilon=self.ln_eps, dtype=jnp.float32,
                          name="ln_final")(x)
+        if return_hidden:
+            if self.is_initializing():
+                # params must be complete regardless of the first apply:
+                # touch the head so init still creates it
+                nn.Dense(self.vocab_size, dtype=jnp.float32,
+                         kernel_init=dense_init, name="head",
+                         use_bias=self.head_bias)(x[:, :1])
+            return x.astype(jnp.float32)
         logits = nn.Dense(self.vocab_size, dtype=jnp.float32,
                           kernel_init=dense_init, name="head",
                           use_bias=self.head_bias)(x)

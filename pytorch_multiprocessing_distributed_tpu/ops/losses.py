@@ -36,6 +36,154 @@ def cross_entropy_loss(logits: jax.Array, targets: jax.Array) -> jax.Array:
 cross_entropy_loss.per_sample = cross_entropy_per_sample
 
 
+def chunked_lm_ce(
+    h: jax.Array,
+    kernel: jax.Array,
+    bias,
+    targets: jax.Array,
+    weights: jax.Array,
+    n_chunks: int,
+) -> jax.Array:
+    """Next-token CE fused with the LM head, streamed over vocab chunks.
+
+    The dense path materializes ``[B, S, V]`` f32 logits (GPT-2 small at
+    8x1024: 1.6 GB) plus their softmax cotangent in the backward. Here
+    the head matmul and the log-sum-exp stream over ``n_chunks`` vocab
+    slices (``lax.scan``): live memory is ``O(B*S*V/n_chunks)`` while
+    the result — ``sum(weights * CE)`` — is EXACTLY the dense value
+    (same f32 ops, streaming max/LSE fold). The custom VJP recomputes
+    each chunk's logits (flash-attention-style remat) and streams
+    ``dh``/``dkernel``/``dbias`` the same way, so the full logits tensor
+    never exists in either pass. The sequential analogue of the
+    pipelined trainer's vocab-PARALLEL LSE loss (parallel/gpt_pipeline).
+
+    Args:
+      h: ``[B, S, D]`` final hidden states (post final-LN).
+      kernel: ``[D, V]`` head weights.
+      bias: ``[V]`` head bias, or None (``GPT(head_bias=False)``).
+      targets: ``[B, S]`` int next-token labels.
+      weights: ``[B, S]`` f32 validity weights.
+      n_chunks: vocab slices to stream over (V is padded up to a
+        multiple; padded slots carry -inf bias => exactly zero mass).
+
+    Returns the scalar ``sum(weights * per_position_CE)``.
+    """
+    return _chunked_ce(h.astype(jnp.float32), kernel, bias, targets,
+                       weights, n_chunks)
+
+
+from functools import partial as _partial  # noqa: E402
+
+
+@_partial(jax.custom_vjp, nondiff_argnums=(5,))
+def _chunked_ce(h, kernel, bias, targets, weights, n_chunks):
+    ce_sum, _res = _chunked_ce_fwd_impl(h, kernel, bias, targets, weights,
+                                        n_chunks)
+    return ce_sum
+
+
+def _chunk_views(kernel, bias, n_chunks):
+    """-> (k_chunks [n, D, Vc], b_chunks [n, Vc], vc). Pads V up to a
+    multiple of n_chunks; padded slots get bias -inf (zero softmax
+    mass) and kernel 0."""
+    d, v = kernel.shape
+    vc = -(-v // n_chunks)
+    pad = n_chunks * vc - v
+    kernel = jnp.pad(kernel.astype(jnp.float32), ((0, 0), (0, pad)))
+    if bias is None:
+        bias = jnp.zeros((v,), jnp.float32)
+    bias = jnp.pad(bias.astype(jnp.float32), (0, pad),
+                   constant_values=-jnp.inf)
+    k_chunks = kernel.reshape(d, n_chunks, vc).transpose(1, 0, 2)
+    b_chunks = bias.reshape(n_chunks, vc)
+    return k_chunks, b_chunks, vc
+
+
+def _chunked_ce_fwd_impl(h, kernel, bias, targets, weights, n_chunks):
+    b, s, d = h.shape
+    hf = h.reshape(-1, d)  # [N, D], N = B*S
+    tgt = targets.reshape(-1)
+    k_chunks, b_chunks, vc = _chunk_views(kernel, bias, n_chunks)
+
+    def fold(carry, ck):
+        m, sse, tlog, c = carry
+        kc, bc = ck
+        logits = hf @ kc + bc  # [N, Vc] f32
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+        sse = sse * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(logits - m_new[:, None]), axis=-1
+        )
+        # target logit if it falls in this chunk
+        idx = tgt - c * vc
+        mine = jnp.logical_and(idx >= 0, idx < vc)
+        picked = jnp.take_along_axis(
+            logits, jnp.clip(idx, 0, vc - 1)[:, None], axis=-1
+        )[:, 0]
+        tlog = tlog + jnp.where(mine, picked, 0.0)
+        return (m_new, sse, tlog, c + 1), None
+
+    n = hf.shape[0]
+    init = (jnp.full((n,), -jnp.inf, jnp.float32),
+            jnp.zeros((n,), jnp.float32),
+            jnp.zeros((n,), jnp.float32),
+            jnp.zeros((), jnp.int32))
+    (m, sse, tlog, _), _ = jax.lax.scan(fold, init, (k_chunks, b_chunks))
+    lse = jnp.log(sse) + m
+    w = weights.reshape(-1)
+    ce_pos = lse - tlog
+    ce_sum = jnp.sum(ce_pos * w)
+    return ce_sum, (lse, ce_pos)
+
+
+def _chunked_ce_fwd(h, kernel, bias, targets, weights, n_chunks):
+    # NB custom_vjp convention: fwd keeps the PRIMAL signature (the
+    # nondiff arg stays in place); only bwd receives it first.
+    ce_sum, (lse, ce_pos) = _chunked_ce_fwd_impl(
+        h, kernel, bias, targets, weights, n_chunks)
+    return ce_sum, (h, kernel, bias, targets, weights, lse, ce_pos)
+
+
+def _chunked_ce_bwd(n_chunks, res, g):
+    import numpy as np
+
+    h, kernel, bias, targets, weights, lse, ce_pos = res
+    b, s, d = h.shape
+    hf = h.reshape(-1, d)
+    tgt = targets.reshape(-1)
+    gw = (g * weights.reshape(-1)).astype(jnp.float32)  # [N]
+    k_chunks, b_chunks, vc = _chunk_views(kernel, bias, n_chunks)
+
+    def fold(carry, ck):
+        dh, c = carry
+        kc, bc = ck
+        logits = hf @ kc + bc                        # recompute [N, Vc]
+        p = jnp.exp(logits - lse[:, None])           # softmax slice
+        idx = tgt - c * vc
+        mine = jnp.logical_and(idx >= 0, idx < vc)
+        onehot = jnp.zeros_like(p).at[
+            jnp.arange(p.shape[0]), jnp.clip(idx, 0, vc - 1)
+        ].set(jnp.where(mine, 1.0, 0.0))
+        dl = gw[:, None] * (p - onehot)              # [N, Vc]
+        dh = dh + dl @ kc.T
+        dkc = hf.T @ dl                              # [D, Vc]
+        dbc = jnp.sum(dl, axis=0)                    # [Vc]
+        return (dh, c + 1), (dkc, dbc)
+
+    init = (jnp.zeros_like(hf), jnp.zeros((), jnp.int32))
+    (dh, _), (dks, dbs) = jax.lax.scan(fold, init, (k_chunks, b_chunks))
+    v = kernel.shape[1]
+    dkernel = dks.transpose(1, 0, 2).reshape(d, -1)[:, :v]
+    dbias = None if bias is None else dbs.reshape(-1)[:v]
+    dtargets = np.zeros(targets.shape, jax.dtypes.float0)
+    # d(ce_sum)/d(w) = g * per-position CE (saved from the forward)
+    dweights = (g * ce_pos).reshape(weights.shape).astype(weights.dtype)
+    return (dh.reshape(b, s, d).astype(h.dtype), dkernel, dbias,
+            dtargets, dweights)
+
+
+_chunked_ce.defvjp(_chunked_ce_fwd, _chunked_ce_bwd)
+
+
 def smooth_cross_entropy_loss(label_smoothing: float):
     """Factory: mean cross-entropy with label smoothing ``eps``.
 

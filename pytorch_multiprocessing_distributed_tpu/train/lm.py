@@ -160,6 +160,7 @@ def make_lm_train_step(
     grad_accum: int = 1,
     moe_aux_weight: float = 0.01,
     moe_z_weight: float = 1e-3,
+    vocab_chunks: int = 0,
 ):
     """Build the jitted LM train step.
 
@@ -171,6 +172,11 @@ def make_lm_train_step(
       grad_accum: microbatches per update over the batch dim (activation
         memory of one microbatch — the long-context memory knob beside
         ``remat``); exact same update as the single-shot step.
+      vocab_chunks: > 1 streams the head matmul + CE over this many
+        vocab slices (:func:`..ops.losses.chunked_lm_ce`): the
+        ``[B, S, V]`` logits never materialize in either pass — the
+        big-vocab memory knob. Exactly the dense objective (parity
+        test-pinned); 0/1 = dense path.
 
     Returns ``step(state, tokens) -> (state, metrics)``; ``tokens`` is
     the global ``[B, S]`` int array, ``metrics = {loss, count}`` (loss =
@@ -211,13 +217,26 @@ def make_lm_train_step(
         # by shard count x microbatch count) so ONE psum of the summed
         # local grads outside is exactly the global-mean gradient.
         def local_obj(params, tok, tgt, ww):
-            logits, mut = model.apply(
-                {"params": params}, tok, train=True, mutable=["losses"]
-            )
-            flat_ce = cross_entropy_per_sample(
-                logits.reshape(-1, logits.shape[-1]), tgt.reshape(-1)
-            ).reshape(tgt.shape)
-            ce_sum = jnp.sum(flat_ce * ww)
+            if vocab_chunks > 1:
+                from ..ops.losses import chunked_lm_ce
+
+                hidden, mut = model.apply(
+                    {"params": params}, tok, train=True,
+                    return_hidden=True, mutable=["losses"]
+                )
+                ce_sum = chunked_lm_ce(
+                    hidden, params["head"]["kernel"],
+                    params["head"].get("bias"), tgt, ww, vocab_chunks,
+                )
+            else:
+                logits, mut = model.apply(
+                    {"params": params}, tok, train=True,
+                    mutable=["losses"]
+                )
+                flat_ce = cross_entropy_per_sample(
+                    logits.reshape(-1, logits.shape[-1]), tgt.reshape(-1)
+                ).reshape(tgt.shape)
+                ce_sum = jnp.sum(flat_ce * ww)
             aux, z = _collect_moe_losses(mut)
             obj = ce_sum / count + (
                 moe_aux_weight * aux + moe_z_weight * z
@@ -375,6 +394,7 @@ def make_lm_eval_step(
     *,
     axis_name: str = DATA_AXIS,
     seq_axis: Optional[str] = None,
+    vocab_chunks: int = 0,
 ):
     """Forward-only next-token CE over held-out tokens (DP x SP paths).
 
@@ -384,6 +404,10 @@ def make_lm_eval_step(
     apply (MoE aux sows are discarded — flax drops non-mutable
     collections), exact masked-mean accounting via a psum-ed global
     count. Returns ``eval_step(state, tokens) -> {loss, count}``.
+
+    ``vocab_chunks`` streams the head+CE exactly like the train step —
+    a run that only fits BECAUSE of chunking must not OOM at its first
+    validation pass.
     """
     axes = (axis_name,) if seq_axis is None else (axis_name, seq_axis)
     zigzag = (seq_axis is not None
@@ -393,12 +417,24 @@ def make_lm_eval_step(
         targets, valid = _next_token_targets(tokens, seq_axis, zigzag)
         w = valid.astype(jnp.float32)
         count = jax.lax.psum(jnp.sum(w), axes)
-        logits = model.apply({"params": state.params}, tokens,
-                             train=False)
-        flat_ce = cross_entropy_per_sample(
-            logits.reshape(-1, logits.shape[-1]), targets.reshape(-1)
-        ).reshape(targets.shape)
-        loss = jax.lax.psum(jnp.sum(flat_ce * w), axes) / count
+        if vocab_chunks > 1:
+            from ..ops.losses import chunked_lm_ce
+
+            hidden = model.apply({"params": state.params}, tokens,
+                                 train=False, return_hidden=True)
+            ce_sum = chunked_lm_ce(
+                hidden, state.params["head"]["kernel"],
+                state.params["head"].get("bias"), targets, w,
+                vocab_chunks,
+            )
+        else:
+            logits = model.apply({"params": state.params}, tokens,
+                                 train=False)
+            flat_ce = cross_entropy_per_sample(
+                logits.reshape(-1, logits.shape[-1]), targets.reshape(-1)
+            ).reshape(targets.shape)
+            ce_sum = jnp.sum(flat_ce * w)
+        loss = jax.lax.psum(ce_sum, axes) / count
         return {"loss": loss, "count": count}
 
     if seq_axis is None:

@@ -74,9 +74,13 @@ class OrbaxCheckpointer:
         the artifact, matching the msgpack writer's ``model_{epoch}.pth``
         semantics — orbax's default would raise StepAlreadyExistsError
         after a full epoch of training."""
+        # settle in-flight async work FIRST: an epoch whose commit is
+        # mid-flight is invisible to has_epoch, and a blind re-save of
+        # it would raise StepAlreadyExistsError (observed shape: async
+        # periodic save + SIGTERM re-saving the same resume point)
+        self.manager.wait_until_finished()
         if self.has_epoch(epoch):
-            self.manager.wait_until_finished()  # never delete under an
-            self.manager.delete(epoch)          # in-flight async write
+            self.manager.delete(epoch)
         self.manager.save(epoch, args=self._ocp.args.StandardSave(state))
         return os.path.join(self.directory, str(epoch))
 

@@ -287,25 +287,32 @@ class Trainer:
                     self._save_state(self.state, epoch,
                                      wait=epoch == self.epochs)
         finally:
-            if self.ckpt_backend == "orbax":
-                # an async periodic save may still be in flight (e.g.
-                # when an exception unwinds the epoch loop) — make it
-                # durable before the process can exit
-                self._orbax.wait()
-            # a caller's process must not permanently swallow SIGTERM
-            # after training ends
-            if prev_handler is not _HANDLER_NOT_INSTALLED:
-                import signal
-
-                # None = prior handler lives in C and is invisible to
-                # Python; SIG_DFL at least lets TERM terminate again
-                signal.signal(
-                    signal.SIGTERM,
-                    signal.SIG_DFL if prev_handler is None else prev_handler,
-                )
+            try:
+                if self.ckpt_backend == "orbax":
+                    # an async periodic save may still be in flight
+                    # (e.g. when an exception unwinds the epoch loop) —
+                    # make it durable before the process can exit
+                    self._orbax.wait()
+            finally:
+                # a caller's process must not permanently swallow
+                # SIGTERM after training ends — restore EVEN IF the
+                # wait above raises (failed async commit)
+                self._restore_handler(prev_handler)
         if dist.is_primary():
             draw_plot(self.save_path)
         return self.state
+
+    @staticmethod
+    def _restore_handler(prev_handler) -> None:
+        if prev_handler is not _HANDLER_NOT_INSTALLED:
+            import signal
+
+            # None = prior handler lives in C and is invisible to
+            # Python; SIG_DFL at least lets TERM terminate again
+            signal.signal(
+                signal.SIGTERM,
+                signal.SIG_DFL if prev_handler is None else prev_handler,
+            )
 
     # -------------------------------------------------------------- train
 

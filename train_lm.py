@@ -81,6 +81,11 @@ parser.add_argument('--moe_top_k', default=1, type=int,
                          '>= 2 = GShard (renormalized top-k weights)')
 parser.add_argument('--moe_aux_weight', default=0.01, type=float)
 parser.add_argument('--remat', action='store_true')
+parser.add_argument('--vocab_chunks', default=0, type=int,
+                    help='stream the LM head + cross-entropy over N '
+                         'vocab slices so [B,S,V] logits never '
+                         'materialize (big-vocab memory knob; exact '
+                         'same objective). dp/sp paths; 0 = dense')
 parser.add_argument('--grad_accum', default=1, type=int,
                     help='microbatches per update (dp/sp paths)')
 parser.add_argument('--zero1', action='store_true',
@@ -193,6 +198,11 @@ def main(args):
             "--remat is not wired into the pipelined step (gpipe bounds "
             "live activations to the in-flight microbatches; 1f1b "
             "already rematerializes each stage backward internally)")
+    if args.vocab_chunks > 1 and args.parallel in ('tp', 'pp'):
+        raise SystemExit(
+            '--vocab_chunks streams the head inside the dp/sp step '
+            '(tp shards the head over the model axis; pp computes a '
+            'vocab-parallel LSE already)')
     if args.grad_accum > 1 and args.parallel in ('tp', 'pp'):
         raise SystemExit(
             "--grad_accum is wired into the dp/sp step (pp microbatches "
@@ -331,7 +341,8 @@ def main(args):
             model, opt, mesh,
             seq_axis='seq' if args.parallel == 'sp' else None,
             remat=args.remat, grad_accum=args.grad_accum,
-            moe_aux_weight=args.moe_aux_weight)
+            moe_aux_weight=args.moe_aux_weight,
+            vocab_chunks=args.vocab_chunks)
 
     eval_step = None
     if val_loader is not None:
@@ -349,7 +360,8 @@ def main(args):
         else:
             eval_step = make_lm_eval_step(
                 model, mesh,
-                seq_axis='seq' if args.parallel == 'sp' else None)
+                seq_axis='seq' if args.parallel == 'sp' else None,
+                vocab_chunks=args.vocab_chunks)
 
     os.makedirs(args.save_path, exist_ok=True)
     logger = Logger(os.path.join(args.save_path, 'train.log'))
