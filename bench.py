@@ -639,6 +639,10 @@ def main():
             )
             # legacy records lack the remat key; treat them as non-remat
             and bool(base.get("remat", False)) == bool(extra.get("remat"))
+            # same rationale for the streamed-CE knob: a chunked probe
+            # is a different experiment than the dense canonical run
+            and int(base.get("vocab_chunks", 0) or 0)
+            == int(extra.get("vocab_chunks", 0) or 0)
             # a record written under a different step-time estimator is a
             # different measurement, not a baseline (the slope estimator
             # reads 10-30% faster than the whole-window quotient purely

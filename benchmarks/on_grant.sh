@@ -52,4 +52,7 @@ else
   tail -4 "$tmp" >&2; rm -f "$tmp"
 fi
 
+note "7. cross-hardware convergence (framework on TPU vs torch on CPU)"
+$T python benchmarks/convergence.py --epochs 4 --train_size 1024
+
 note "done — review artifacts, then commit"
