@@ -101,6 +101,15 @@ CONFIGS = {
     "gpt_lm": dict(
         lm=True, model="gpt_small", seq_len=1024, batch=8,
     ),
+    # long-context variant: 4x the sequence — the [S, S] attention
+    # never materializes (flash kernel), so this measures what the
+    # long-context stack actually sustains. batch 2 = same tokens/step
+    # as gpt_lm ON THE SINGLE-CHIP canonical geometry (build_workload
+    # rounds the global batch up to the data-axis size on wider meshes,
+    # where per-chip tokens/step then differ).
+    "gpt_lm_long": dict(
+        lm=True, model="gpt_small", seq_len=4096, batch=2,
+    ),
 }
 
 

@@ -231,6 +231,42 @@ def test_cli_sigterm_checkpoints_and_resumes(tmp_path):
     """Preemption drill: SIGTERM mid-training must produce a clean exit
     with a resumable checkpoint (trainer._checkpoint_if_preempted), and
     --resume auto must pick it up and finish the run."""
+    save, text, done = _sigterm_drill(tmp_path)
+    # epoch 1 is the last COMPLETED epoch -> model_1.pth
+    assert (save / "model_1.pth").exists(), text[-2000:]
+    assert "Resumed from" in done.stdout
+    assert (save / "model_3.pth").exists()
+    rows = (save / "train.log").read_text().splitlines()
+    assert [r.split()[0] for r in rows] == ["0001", "0002", "0003"]
+
+
+@pytest.mark.slow
+def test_cli_sigterm_async_orbax(tmp_path):
+    """Preemption drill on the async orbax backend: SIGTERM during
+    epoch 2 with --save_every 1 means epoch 1's ASYNC save may still be
+    in flight when the handler re-saves the same resume point — the
+    save must settle in-flight commits (no StepAlreadyExistsError), the
+    exit stays clean, and --resume auto continues."""
+    save, text, done = _sigterm_drill(
+        tmp_path,
+        "--ckpt_backend", "orbax", "--ckpt_async", "--save_every", "1",
+    )
+    # epoch 1's checkpoint exists (async save settled, kept or re-saved)
+    assert (save / "orbax" / "1").is_dir(), text[-2000:]
+    assert "continuing at epoch 2" in done.stdout
+    assert (save / "orbax" / "3").is_dir()
+
+
+def _sigterm_drill(tmp_path, *extra_flags):
+    """Shared preemption skeleton: spawn a 3-epoch CLI run, SIGTERM it
+    when epoch 2 starts (REAL deadline: select()-bounded reads, so a
+    child that wedges without printing fails at the timeout instead of
+    hanging the suite), assert the clean checkpoint-and-exit, then
+    finish the run with --resume auto.
+
+    Returns ``(save_path, combined_first_run_output, resume_proc)``.
+    """
+    import select
     import signal
     import time as _time
 
@@ -252,6 +288,7 @@ def test_cli_sigterm_checkpoints_and_resumes(tmp_path):
         "--synthetic",
         "--print-freq", "1",
         "--save_path", str(save),
+        *extra_flags,
     ]
     # stderr merged into stdout: a separate undrained stderr pipe can
     # fill and deadlock the child before "Epoch: [2]" ever prints
@@ -259,27 +296,33 @@ def test_cli_sigterm_checkpoints_and_resumes(tmp_path):
         cmd, cwd=REPO, env=env,
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
     )
-    # wait for epoch 2 to start (epoch 1 completed), then preempt
-    deadline = _time.time() + 600
-    seen_epoch2 = False
-    lines = []
-    while _time.time() < deadline:
-        line = proc.stdout.readline()
-        if not line:
-            break
-        lines.append(line)
-        if "Epoch: [2]" in line:
-            seen_epoch2 = True
-            proc.send_signal(signal.SIGTERM)
-            break
-    assert seen_epoch2, "".join(lines)[-3000:]
-    out, _ = proc.communicate(timeout=600)
-    assert proc.returncode == 0, ("".join(lines) + out)[-3000:]
-    assert "SIGTERM received: checkpointing at epoch 2" in (
-        "".join(lines) + out
-    )
-    # epoch 1 is the last COMPLETED epoch -> model_1.pth
-    assert (save / "model_1.pth").exists()
+    try:
+        # wait for epoch 2 to start (epoch 1 completed), then preempt
+        deadline = _time.time() + 600
+        seen_epoch2 = False
+        lines = []
+        while _time.time() < deadline:
+            ready, _, _ = select.select(
+                [proc.stdout], [], [], max(0.1, deadline - _time.time())
+            )
+            if not ready:
+                break  # deadline with no new output
+            line = proc.stdout.readline()
+            if not line:
+                break
+            lines.append(line)
+            if "Epoch: [2]" in line:
+                seen_epoch2 = True
+                proc.send_signal(signal.SIGTERM)
+                break
+        assert seen_epoch2, "".join(lines)[-3000:]
+        out, _ = proc.communicate(timeout=600)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    text = "".join(lines) + out
+    assert proc.returncode == 0, text[-3000:]
+    assert "SIGTERM received: checkpointing at epoch 2" in text
 
     # resume auto finishes epochs 2..3
     done = subprocess.run(
@@ -287,69 +330,4 @@ def test_cli_sigterm_checkpoints_and_resumes(tmp_path):
         capture_output=True, text=True, timeout=900,
     )
     assert done.returncode == 0, done.stderr[-3000:]
-    assert "Resumed from" in done.stdout
-    assert (save / "model_3.pth").exists()
-    rows = (save / "train.log").read_text().splitlines()
-    assert [r.split()[0] for r in rows] == ["0001", "0002", "0003"]
-
-
-@pytest.mark.slow
-def test_cli_sigterm_async_orbax(tmp_path):
-    """Preemption drill on the async orbax backend: SIGTERM during
-    epoch 2 with --save_every 1 means epoch 1's ASYNC save may still be
-    in flight when the handler re-saves the same resume point — the
-    save must settle in-flight commits (no StepAlreadyExistsError), the
-    exit stays clean, and --resume auto continues."""
-    import signal
-    import time as _time
-
-    save = tmp_path / "run"
-    env = dict(
-        os.environ,
-        PMDT_FORCE_CPU_DEVICES="8",
-        PMDT_SMALL_SYNTH="512",
-        PYTHONUNBUFFERED="1",
-    )
-    cmd = [
-        sys.executable, "main.py",
-        "--batch_size", "64",
-        "--epochs", "3",
-        "--world_size", "8",
-        "--synthetic",
-        "--print-freq", "1",
-        "--ckpt_backend", "orbax",
-        "--ckpt_async",
-        "--save_every", "1",
-        "--save_path", str(save),
-    ]
-    proc = subprocess.Popen(
-        cmd, cwd=REPO, env=env,
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-    )
-    deadline = _time.time() + 600
-    seen_epoch2 = False
-    lines = []
-    while _time.time() < deadline:
-        line = proc.stdout.readline()
-        if not line:
-            break
-        lines.append(line)
-        if "Epoch: [2]" in line:
-            seen_epoch2 = True
-            proc.send_signal(signal.SIGTERM)
-            break
-    assert seen_epoch2, "".join(lines)[-3000:]
-    out, _ = proc.communicate(timeout=600)
-    text = "".join(lines) + out
-    assert proc.returncode == 0, text[-3000:]
-    assert "SIGTERM received: checkpointing at epoch 2" in text
-    # epoch 1's checkpoint exists (async save settled, kept or re-saved)
-    assert (save / "orbax" / "1").is_dir(), text[-2000:]
-
-    done = subprocess.run(
-        cmd + ["--resume", "auto"], cwd=REPO, env=env,
-        capture_output=True, text=True, timeout=900,
-    )
-    assert done.returncode == 0, done.stderr[-3000:]
-    assert "continuing at epoch 2" in done.stdout
-    assert (save / "orbax" / "3").is_dir()
+    return save, text, done
