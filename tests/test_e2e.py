@@ -291,36 +291,41 @@ def _sigterm_drill(tmp_path, *extra_flags):
         *extra_flags,
     ]
     # stderr merged into stdout: a separate undrained stderr pipe can
-    # fill and deadlock the child before "Epoch: [2]" ever prints
+    # fill and deadlock the child before "Epoch: [2]" ever prints.
+    # bufsize=0 + os.read: select() must see exactly what is unread —
+    # a TextIOWrapper's read-ahead could hold the trigger line while
+    # select blocks on the drained fd
     proc = subprocess.Popen(
-        cmd, cwd=REPO, env=env,
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cmd, cwd=REPO, env=env, bufsize=0,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
     )
     try:
         # wait for epoch 2 to start (epoch 1 completed), then preempt
         deadline = _time.time() + 600
         seen_epoch2 = False
-        lines = []
+        buf = b""
         while _time.time() < deadline:
             ready, _, _ = select.select(
                 [proc.stdout], [], [], max(0.1, deadline - _time.time())
             )
             if not ready:
                 break  # deadline with no new output
-            line = proc.stdout.readline()
-            if not line:
-                break
-            lines.append(line)
-            if "Epoch: [2]" in line:
+            chunk = os.read(proc.stdout.fileno(), 65536)
+            if not chunk:
+                break  # child closed stdout
+            buf += chunk
+            if b"Epoch: [2]" in buf:
                 seen_epoch2 = True
                 proc.send_signal(signal.SIGTERM)
                 break
-        assert seen_epoch2, "".join(lines)[-3000:]
+        head = buf.decode(errors="replace")
+        assert seen_epoch2, head[-3000:]
         out, _ = proc.communicate(timeout=600)
     finally:
         if proc.poll() is None:
             proc.kill()
-    text = "".join(lines) + out
+            proc.wait()  # reap: no zombie/fd leak on assertion unwind
+    text = head + out.decode(errors="replace")
     assert proc.returncode == 0, text[-3000:]
     assert "SIGTERM received: checkpointing at epoch 2" in text
 
