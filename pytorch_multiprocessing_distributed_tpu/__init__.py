@@ -25,7 +25,7 @@ The public CLI (repo-root ``main.py``) keeps the reference's seven flags,
 rank-0 logging/checkpoint/plot artifacts, and training semantics.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.4.0"
 
 from . import utils  # noqa: F401
 
