@@ -1,0 +1,37 @@
+# Convenience targets for the canonical workflows. Each one is the
+# exact invocation the docs/tests/driver use — no hidden flags.
+
+PYTEST_ENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
+
+.PHONY: test test-fast dryrun bench bench-cpu store clean
+
+# full suite on the virtual 8-device CPU mesh (incl. slow e2e CLI runs)
+test:
+	$(PYTEST_ENV) python -m pytest tests/ -q
+
+# fast suite (slow-marked e2e runs excluded)
+test-fast:
+	$(PYTEST_ENV) python -m pytest tests/ -q -m "not slow"
+
+# the driver's multi-chip dry-run: full sharded train steps
+# (dp/tp/zero1/fsdp/sp/zigzag/ulysses/moe/pp/1f1b/chunked-CE) on 8
+# virtual devices
+dryrun:
+	python -c "import jax; jax.config.update('jax_platforms','cpu'); \
+	import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun OK')"
+
+# one-JSON-line benchmark (probes the TPU, falls back to CPU liveness)
+bench:
+	python bench.py
+
+# bench without touching the TPU plugin at all
+bench-cpu:
+	python bench.py --platform cpu
+
+# the C++ TCP rendezvous store (ctypes-loaded on demand at runtime)
+store:
+	$(MAKE) -C csrc
+
+clean:
+	rm -rf csrc/build .pytest_cache
+	find . -name __pycache__ -type d -exec rm -rf {} +
