@@ -82,9 +82,15 @@ def main() -> int:
     print(f"run order: {configs}", file=sys.stderr, flush=True)
 
     rc = 0
+    prev_platform = None
     for k, config in enumerate(configs):
-        if k:
+        # teardown-settle exists for the single-tenant chip. Skip it
+        # only when the previous run is KNOWN to have fallen back to
+        # CPU; a TPU run, or an error line whose platform is unknown
+        # (the crash may have happened after TPU init), still settles.
+        if k and prev_platform != "cpu":
             time.sleep(args.settle)
+        prev_platform = None
         print(f"=== {config}", file=sys.stderr, flush=True)
         try:
             proc = subprocess.run(
@@ -109,13 +115,15 @@ def main() -> int:
                   file=sys.stderr)
             print(proc.stderr[-2000:], file=sys.stderr)
             return 1
-        # an ERROR line (fenced {metric, value, error} with no extra)
-        # is a per-config failure: record it and keep going
+        # platform BEFORE the error check: a sanity-gate failure line
+        # still carries extra.platform="tpu" (the run held the chip)
+        extra = result.get("extra", {})
+        prev_platform = extra.get("platform")
+        # an ERROR line is a per-config failure: record it, keep going
         if "error" in result:
             print(f"!! {config}: {result['error']}", file=sys.stderr)
             rc = 3
             continue
-        extra = result.get("extra", {})
         if extra.get("platform") != "tpu":
             print(
                 f"!! {config} fell back to {extra.get('platform')} "

@@ -23,6 +23,20 @@ import numpy as np
 #: document-separator token id (first id past the byte range)
 DOC_SEP = 256
 
+
+def sniff_bytes(head: bytes) -> str:
+    """Classify a file's leading bytes: ``'npy'`` (np.save), ``'npz'``
+    (zip: np.savez), or ``'text'``. Magic bytes, not extension — numpy
+    tooling output is all bytes <= 255, so byte-tokenizing it would
+    pass every downstream vocab guard and train on garbage silently.
+    Single source of truth for the CLI's ``--corpus`` sniff and the
+    per-file guards below."""
+    if head[:6] == b"\x93NUMPY":
+        return "npy"
+    if head[:4] == b"PK\x03\x04":
+        return "npz"
+    return "text"
+
 #: smallest vocab that fits byte tokens + the separator
 BYTE_VOCAB = 257
 
@@ -63,7 +77,19 @@ def load_text_corpus(path: str) -> np.ndarray:
             if k:
                 parts.append(np.asarray([DOC_SEP], np.int32))
             with open(os.path.join(path, name), "rb") as f:
-                parts.append(tokenize(f.read()))
+                data = f.read()
+            if sniff_bytes(data) != "text":
+                raise ValueError(
+                    f"corpus dir {path} contains numpy tooling output "
+                    f"({name!r}) — pass the .npy array directly as "
+                    "--corpus, or keep only text files in the directory")
+            parts.append(tokenize(data))
         return np.concatenate(parts)
     with open(path, "rb") as f:
-        return tokenize(f.read())
+        data = f.read()
+    if sniff_bytes(data) != "text":
+        raise ValueError(
+            f"{path} is numpy tooling output, not text — load it with "
+            "np.load (the train_lm CLI does this for .npy --corpus "
+            "files automatically)")
+    return tokenize(data)

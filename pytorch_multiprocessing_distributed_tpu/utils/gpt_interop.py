@@ -78,6 +78,15 @@ def gpt2_geometry(sd: Dict[str, Any]) -> Dict[str, int]:
     from a normalized-or-not GPT-2 state dict. ``num_heads`` is not
     recoverable from weights — callers supply it (12 for GPT-2 small)."""
     sd = _normalize(sd)
+    required = ("wte.weight", "wpe.weight", "h.0.mlp.c_fc.weight")
+    missing = [k for k in required if k not in sd]
+    if missing:
+        raise ValueError(
+            "state dict does not look like a GPT-2 checkpoint: missing "
+            f"{missing} (have {len(sd)} keys, e.g. "
+            f"{sorted(sd)[:3]}). Expected HF/nanoGPT-style keys "
+            "('wte.weight', 'wpe.weight', 'h.N.*', optionally prefixed "
+            "'transformer.').")
     v, d = sd["wte.weight"].shape
     p = sd["wpe.weight"].shape[0]
     layers = 1 + max(

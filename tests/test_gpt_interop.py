@@ -116,3 +116,17 @@ def test_generate_runs_on_imported_weights(hf_model, tokens):
         nxt = jnp.argmax(logits[:, -1], axis=-1)
         cur = jnp.concatenate([cur, nxt[:, None]], axis=1)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(cur))
+
+
+def test_geometry_rejects_non_gpt2_state_dict():
+    """A random state dict must raise a descriptive error naming the
+    missing keys, not an opaque KeyError."""
+    import pytest
+
+    from pytorch_multiprocessing_distributed_tpu.utils.gpt_interop import (
+        gpt2_geometry)
+
+    with pytest.raises(ValueError, match="GPT-2.*wte.weight"):
+        gpt2_geometry({"conv1.weight": np.zeros((3, 3))})
+    with pytest.raises(ValueError, match="GPT-2"):
+        gpt2_geometry({})

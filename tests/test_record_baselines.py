@@ -1,0 +1,95 @@
+"""benchmarks/record_baselines.py harness logic (no chip, no subprocess).
+
+Pins the need-first ordering and the settle-skip rule: the 20 s
+teardown settle between configs exists for the single-tenant chip, so
+it must only fire after a run that actually reported platform=tpu —
+error lines and CPU fallbacks have no teardown to wait for (ADVICE r4).
+"""
+
+import importlib.util
+import json
+import os
+import sys
+import types
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_module():
+    spec = importlib.util.spec_from_file_location(
+        "record_baselines",
+        os.path.join(REPO, "benchmarks", "record_baselines.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _run(monkeypatch, mod, configs, lines):
+    """Drive main() with canned per-config bench JSON lines; return
+    (sleep_calls, rc)."""
+    sleeps = []
+    monkeypatch.setattr(mod.time, "sleep", lambda s: sleeps.append(s))
+
+    it = iter(lines)
+
+    def fake_run(cmd, **kw):
+        return types.SimpleNamespace(
+            stdout=json.dumps(next(it)) + "\n", stderr="", returncode=0)
+
+    monkeypatch.setattr(mod.subprocess, "run", fake_run)
+    monkeypatch.setattr(
+        sys, "argv", ["record_baselines.py", "--configs"] + configs)
+    # every config "needs" a record: point the record file at nothing
+    monkeypatch.setattr(mod, "RECORD", "/nonexistent/record.json")
+    rc = mod.main()
+    return sleeps, rc
+
+
+def _tpu_line(metric):
+    return {"metric": metric, "value": 1.0, "unit": "x",
+            "extra": {"platform": "tpu"}}
+
+
+def test_no_settle_after_known_cpu_fallback(monkeypatch):
+    mod = _load_module()
+    # every run is a KNOWN cpu-platform error line: nothing held the
+    # chip, so no teardown settle between configs
+    err = {"metric": "m", "value": 0, "error": "backend unavailable",
+           "extra": {"platform": "cpu"}}
+    sleeps, rc = _run(
+        monkeypatch, mod,
+        ["gpt_lm", "resnet18_cifar", "resnet50_imagenet"],
+        [err, err, err])
+    assert sleeps == []
+    assert rc == 3  # per-config failures recorded, run continued
+
+
+def test_settle_after_tpu_error_line(monkeypatch):
+    mod = _load_module()
+    # a sanity-gate failure still carries extra.platform="tpu" — the
+    # run HELD the chip, so the next config must wait for teardown;
+    # an error with no extra (crash timing unknown) settles too
+    tpu_err = {"metric": "m", "value": 0, "error": "non-linear timing",
+               "extra": {"platform": "tpu"}}
+    bare_err = {"metric": "m", "value": 0, "error": "crashed"}
+    sleeps, rc = _run(
+        monkeypatch, mod,
+        ["gpt_lm", "resnet18_cifar", "resnet50_imagenet"],
+        [tpu_err, bare_err, bare_err])
+    assert len(sleeps) == 2
+    assert rc == 3
+
+
+def test_settle_between_tpu_runs(monkeypatch):
+    mod = _load_module()
+    sys.path.insert(0, REPO)
+    from bench import metric_for
+
+    lines = [_tpu_line(metric_for(c)[0])
+             for c in ("gpt_lm", "resnet18_cifar")]
+    # order is need-first but both need here; two TPU runs => 1 settle
+    sleeps, rc = _run(
+        monkeypatch, mod, ["resnet18_cifar", "gpt_lm"], lines)
+    assert len(sleeps) == 1
+    assert rc == 0

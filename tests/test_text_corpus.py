@@ -40,3 +40,28 @@ def test_empty_dir_fails(tmp_path):
 
     with pytest.raises(FileNotFoundError):
         load_text_corpus(str(tmp_path))
+
+
+def test_dir_rejects_numpy_artifacts(tmp_path):
+    """A .npy/.npz dropped in a corpus dir must fail loudly, not get
+    byte-tokenized as 'text' (its bytes all pass the vocab guard)."""
+    import pytest
+
+    (tmp_path / "a.txt").write_text("fine")
+    np.save(tmp_path / "oops.npy", np.arange(4, dtype=np.int32))
+    with pytest.raises(ValueError, match="numpy tooling output"):
+        load_text_corpus(str(tmp_path))
+    (tmp_path / "oops.npy").unlink()
+    np.savez(tmp_path / "oops.npz", a=np.arange(4))
+    with pytest.raises(ValueError, match="numpy tooling output"):
+        load_text_corpus(str(tmp_path))
+
+
+def test_single_file_rejects_numpy_artifact(tmp_path):
+    """The library's single-file path must sniff too, not just the CLI
+    (a direct load_text_corpus('x.npy') call is the same trap)."""
+    import pytest
+
+    np.save(tmp_path / "t.npy", np.arange(4, dtype=np.int32))
+    with pytest.raises(ValueError, match="numpy tooling output"):
+        load_text_corpus(str(tmp_path / "t.npy"))

@@ -243,19 +243,16 @@ def main(args):
 
     corpus_is_text = False
     if args.corpus:
+        from pytorch_multiprocessing_distributed_tpu.data.text import (
+            sniff_bytes)
+
         def _sniff(path):
-            # magic-byte sniff, not extension: numpy tooling output must
-            # not be silently reinterpreted as raw text (its bytes are
-            # all <= 255, so it would pass the vocab guard below)
+            # magic bytes, not extension (see data.text.sniff_bytes);
+            # directories defer to load_text_corpus's per-file sniff
             if os.path.isdir(path):
                 return 'text'
             with open(path, 'rb') as f:
-                head = f.read(6)
-            if head == b'\x93NUMPY':
-                return 'npy'
-            if head[:4] == b'PK\x03\x04':  # zip: np.savez / .npz
-                return 'npz'
-            return 'text'
+                return sniff_bytes(f.read(6))
 
         kind = _sniff(args.corpus)
         if kind == 'npz':
@@ -270,7 +267,12 @@ def main(args):
             from pytorch_multiprocessing_distributed_tpu.data.text import (
                 load_text_corpus)
 
-            tokens = load_text_corpus(args.corpus)
+            try:
+                tokens = load_text_corpus(args.corpus)
+            except ValueError as e:
+                # e.g. a .npy dropped inside a corpus directory: same
+                # clean one-line exit as the sibling misuse paths
+                raise SystemExit(str(e))
             corpus_is_text = True
         if len(tokens) == 0:
             raise SystemExit(f"--corpus {args.corpus} contains no tokens")
