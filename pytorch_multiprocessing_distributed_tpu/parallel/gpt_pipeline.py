@@ -66,22 +66,17 @@ def stack_pipeline_params(params, n_stages: int):
     ``n_stages`` dim: ``embed`` ``[S, ceil(V/S), D]`` (vocab
     row-sharded, zero-padded), ``blocks`` ``[S, L/S, ...]``, ``head_k``
     ``[S, D, ceil(V/S)]`` / ``head_b`` ``[S, ceil(V/S)]`` (vocab
-    col-sharded; padded bias slots get ``-1e9`` so their softmax mass
-    is exactly zero). ``pos`` and ``ln_f`` are small and replicated.
+    col-sharded, zero-padded). ``head_b`` is present only when the GPT
+    has a head bias — a ``head_bias=False`` model (the HF-GPT-2 interop
+    configuration) simply has no such leaf. Padded vocab slots are NOT
+    masked here: the forward passes mask them explicitly from the true
+    ``vocab_size`` (``slot_id >= vocab_size -> -1e9``), so masking
+    never depends on a bias slot existing. ``pos`` and ``ln_f`` are
+    small and replicated.
     """
     num_layers = _num_layers(params)
     if num_layers == 0:
         raise ValueError("params has no block_<i> entries — not a GPT tree")
-    if "bias" not in params["head"]:
-        # the vocab-parallel head masks its padded slots through the
-        # bias (-1e9 => zero softmax mass); a biasless head
-        # (head_bias=False, the HF-interop configuration) has no slot
-        # to carry that mask
-        raise NotImplementedError(
-            "pipeline parallelism requires the default head_bias=True "
-            "GPT (the pipe-sharded head uses the bias to mask padded "
-            "vocab slots)"
-        )
     if num_layers % n_stages:
         raise ValueError(
             f"{num_layers} layers not divisible by n_stages={n_stages}"
@@ -103,11 +98,8 @@ def stack_pipeline_params(params, n_stages: int):
     head_k = params["head"]["kernel"]  # [D, V]
     head_k = jnp.pad(head_k, ((0, 0), (0, pad)))
     head_k = head_k.reshape(d, n_stages, vs).transpose(1, 0, 2)
-    head_b = jnp.pad(
-        params["head"]["bias"], (0, pad), constant_values=-1e9
-    ).reshape(n_stages, vs)
 
-    return {
+    out = {
         "embed": embed,
         # copy pass-through leaves: sharing buffers with the source tree
         # would let a donating step on the SOURCE state delete them
@@ -116,8 +108,11 @@ def stack_pipeline_params(params, n_stages: int):
         "ln_f": jax.tree.map(lambda l: jnp.array(l, copy=True),
                              params["ln_final"]),
         "head_k": head_k,
-        "head_b": head_b,
     }
+    if "bias" in params["head"]:
+        out["head_b"] = jnp.pad(
+            params["head"]["bias"], (0, pad)).reshape(n_stages, vs)
+    return out
 
 
 def unstack_pipeline_params(pipe_params, vocab_size: int):
@@ -126,15 +121,18 @@ def unstack_pipeline_params(pipe_params, vocab_size: int):
     blocks = pipe_params["blocks"]
     any_leaf = jax.tree_util.tree_leaves(blocks)[0]
     per = any_leaf.shape[1]
+    head = {
+        "kernel": pipe_params["head_k"].transpose(1, 0, 2).reshape(
+            d, n_stages * vs)[:, :vocab_size],
+    }
+    if "head_b" in pipe_params:
+        head["bias"] = pipe_params["head_b"].reshape(
+            n_stages * vs)[:vocab_size]
     out = {
         "embed": pipe_params["embed"].reshape(n_stages * vs, d)[:vocab_size],
         "pos_embed": pipe_params["pos"],
         "ln_final": pipe_params["ln_f"],
-        "head": {
-            "kernel": pipe_params["head_k"].transpose(1, 0, 2).reshape(
-                d, n_stages * vs)[:, :vocab_size],
-            "bias": pipe_params["head_b"].reshape(n_stages * vs)[:vocab_size],
-        },
+        "head": head,
     }
     for s in range(n_stages):
         for j in range(per):
@@ -146,22 +144,28 @@ def unstack_pipeline_params(pipe_params, vocab_size: int):
 
 def pipeline_specs(pipe_params, pipe_axis: str = PIPE_AXIS):
     """PartitionSpec tree matching :func:`stack_pipeline_params` output."""
-    return {
+    specs = {
         "embed": P(pipe_axis),
         "pos": P(),
         "blocks": jax.tree.map(lambda _: P(pipe_axis),
                                pipe_params["blocks"]),
         "ln_f": jax.tree.map(lambda _: P(), pipe_params["ln_f"]),
         "head_k": P(pipe_axis),
-        "head_b": P(pipe_axis),
     }
+    if "head_b" in pipe_params:
+        specs["head_b"] = P(pipe_axis)
+    return specs
 
 
 def create_pipelined_lm_state(model, rng, sample_tokens,
                               optimizer: "Transform",
-                              n_stages: int) -> "TrainState":
+                              n_stages: int,
+                              params=None) -> "TrainState":
     """Init the GPT normally, restack for the pipe axis, init optimizer
-    buffers on the stacked tree (so they shard identically)."""
+    buffers on the stacked tree (so they shard identically). Pass
+    ``params`` (a dense GPT param tree, e.g. imported HF-GPT-2 weights
+    from :func:`..utils.gpt_interop.from_gpt2_state_dict`) to stack
+    those instead of a fresh init."""
     from ..train.state import TrainState
 
     if getattr(model, "n_experts", 0) > 0:
@@ -171,8 +175,10 @@ def create_pipelined_lm_state(model, rng, sample_tokens,
         )
     if getattr(model, "seq_axis", None) is not None:
         model = model.clone(seq_axis=None)
-    variables = model.init(rng, sample_tokens, train=False)
-    params = stack_pipeline_params(variables["params"], n_stages)
+    if params is None:
+        params = model.init(rng, sample_tokens, train=False)["params"]
+    params = stack_pipeline_params(
+        jax.tree.map(jnp.asarray, params), n_stages)
     return TrainState(
         params=params,
         batch_stats={},
@@ -260,11 +266,17 @@ def _make_forward_ce(model, axis_name, pipe_axis, m):
         h = final_ln(h, p["ln_f"])
 
         # ---- vocab-parallel head + log-sum-exp CE: each stage scores
-        # its vocab slice (padded slots carry bias -1e9 => zero mass).
-        # The matmul stays f32: the plain GPT head is f32-pinned
-        # (models/gpt.py nn.Dense(dtype=f32)) and trajectory parity
-        # must hold for bf16 models too.
-        logits = h @ p["head_k"][0] + p["head_b"][0]
+        # its vocab slice; padded slots are masked to -1e9 (zero softmax
+        # mass) from the TRUE vocab size — explicit, so it works with or
+        # without a head bias (head_bias=False is the HF-GPT-2 interop
+        # configuration). The matmul stays f32: the plain GPT head is
+        # f32-pinned (models/gpt.py nn.Dense(dtype=f32)) and trajectory
+        # parity must hold for bf16 models too.
+        logits = h @ p["head_k"][0]
+        if "head_b" in p:
+            logits = logits + p["head_b"][0]
+        slot_valid = start + jnp.arange(vs) < model.vocab_size
+        logits = jnp.where(slot_valid, logits, -1e9)
         # stop_gradient BEFORE pmax: the max-shift is numerical
         # stabilization only (lse is shift-invariant) and pmax has
         # no AD rule — its input must already carry a zero tangent
@@ -405,19 +417,26 @@ def make_pipelined_lm_train_step(
 
         micro, embed_vjp = jax.vjp(embed_fn, p["embed"], p["pos"])
 
-        # ---- gather the vocab-sharded head for the last-stage loss
-        def gather_fn(hk, hb):
+        # ---- gather the vocab-sharded head for the last-stage loss.
+        # Padded vocab slots are masked inside mb_loss from the true
+        # vocab size — no bias slot needed to carry the mask, so a
+        # biasless (head_bias=False, HF-interop) head gathers only its
+        # kernel.
+        has_bias = "head_b" in p
+        head_leaves = (
+            (p["head_k"], p["head_b"]) if has_bias else (p["head_k"],)
+        )
+
+        def gather_fn(*hs):
             full_k = jax.lax.all_gather(
-                hk[0], pipe_axis, axis=1, tiled=True
+                hs[0][0], pipe_axis, axis=1, tiled=True
             )  # [D, S*Vs]
-            full_b = jax.lax.all_gather(
-                hb[0], pipe_axis, axis=0, tiled=True
-            )  # [S*Vs]; padded slots carry -1e9 => zero softmax mass
+            full_b = (jax.lax.all_gather(
+                hs[1][0], pipe_axis, axis=0, tiled=True
+            ) if has_bias else None)  # [S*Vs]
             return full_k, full_b
 
-        (full_k, full_b), gather_vjp = jax.vjp(
-            gather_fn, p["head_k"], p["head_b"]
-        )
+        (full_k, full_b), gather_vjp = jax.vjp(gather_fn, *head_leaves)
         loss_params = (full_k, full_b, p["ln_f"])
         aux = (
             targets.reshape(m, mb, s),
@@ -428,7 +447,12 @@ def make_pipelined_lm_train_step(
             fk, fb, lnf = lp
             tj, wj = aux_j
             h = final_ln(y.astype(jnp.float32), lnf)
-            logits = h @ fk + fb  # [mb, s, Vpad] f32
+            logits = h @ fk  # [mb, s, Vpad] f32
+            if fb is not None:
+                logits = logits + fb
+            logits = jnp.where(
+                jnp.arange(fk.shape[1]) < model.vocab_size, logits, -1e9
+            )
             gmax = jax.lax.stop_gradient(jnp.max(logits, -1))
             lse = jnp.log(jnp.sum(
                 jnp.exp(logits - gmax[..., None]), -1
@@ -445,7 +469,7 @@ def make_pipelined_lm_train_step(
         d_fk, d_fb, d_lnf = d_lp
         # gather_vjp's psum_scatter SUMS the per-shard partials itself —
         # feed them unreduced (a pre-psum would overcount by n_stages)
-        d_hk, d_hb = gather_vjp((d_fk, d_fb))
+        d_head = gather_vjp((d_fk, d_fb))
         d_emb, d_pos = embed_vjp(d_micro)
         grads = {
             "embed": d_emb,
@@ -455,9 +479,10 @@ def make_pipelined_lm_train_step(
             "ln_f": jax.tree.map(
                 lambda g: jax.lax.psum(g, pipe_axis), d_lnf
             ),
-            "head_k": d_hk,
-            "head_b": d_hb,
+            "head_k": d_head[0],
         }
+        if has_bias:
+            grads["head_b"] = d_head[1]
         updates, new_opt = optimizer.update(
             grads, state.opt_state, state.params, lr_step=state.epoch
         )

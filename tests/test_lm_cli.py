@@ -140,6 +140,45 @@ def test_cli_hf_init_and_export_round_trip(tmp_path):
 
 
 @pytest.mark.slow
+def test_cli_hf_init_pp_matches_dense_dp(tmp_path):
+    """An HF-initialized (biasless-head) GPT-2 trains under
+    --parallel pp with the same trajectory as dense DP, and
+    --hf_export unstacks the pipe-sharded tree back to a loadable
+    GPT-2 state_dict (VERDICT r4 #5)."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    config = transformers.GPT2Config(
+        vocab_size=257, n_positions=256, n_embd=128, n_layer=4,
+        n_head=4, resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    src = transformers.GPT2LMHeadModel(config).eval()
+    ckpt = tmp_path / "gpt2_src.pth"
+    torch.save(src.state_dict(), ckpt)
+
+    _, dp_loss = _run(tmp_path / "dp", "--parallel", "dp",
+                      "--hf_init", str(ckpt))
+    out, pp_loss = _run(tmp_path / "pp", "--parallel", "pp",
+                        "--degree", "4", "--hf_init", str(ckpt),
+                        "--hf_export")
+    # same weights, same data order: pipelining is an execution
+    # strategy, not different math
+    assert abs(dp_loss - pp_loss) < 5e-3 * dp_loss, (dp_loss, pp_loss)
+
+    assert "HF export:" in out
+    exported = tmp_path / "pp" / "run" / "model_1.hf.pth"
+    sd = torch.load(exported, map_location="cpu", weights_only=True)
+    dst = transformers.GPT2LMHeadModel(config)
+    missing, unexpected = dst.load_state_dict(sd, strict=False)
+    assert not unexpected, unexpected
+    params_missing = [m for m in missing if not m.endswith(".attn.bias")
+                      and not m.endswith(".attn.masked_bias")]
+    assert not params_missing, params_missing
+
+
+@pytest.mark.slow
 def test_cli_hf_init_geometry_mismatch_fails_fast(tmp_path):
     torch = pytest.importorskip("torch")
     transformers = pytest.importorskip("transformers")

@@ -154,11 +154,10 @@ def main(args):
         # TPU while staying exact
         model_kw.update(attn_impl='xla')
     if args.hf_init or args.hf_export:
-        if args.parallel == 'pp' or args.n_experts:
+        if args.n_experts:
             raise SystemExit(
-                '--hf_init/--hf_export cover dense dp/sp/tp GPTs (the '
-                'pipe-sharded head needs its bias for vocab padding; '
-                'MoE blocks have no GPT-2 representation)')
+                '--hf_init/--hf_export cover dense GPTs (MoE blocks '
+                'have no GPT-2 representation)')
         # GPT-2 configuration: its LN eps, and no head-bias slot — the
         # export must not have to drop a trained parameter
         model_kw.update(ln_eps=1e-5, head_bias=False)
@@ -324,7 +323,8 @@ def main(args):
 
         mesh = make_mesh(dp, deg, axis_names=('data', 'pipe'))
         state = create_pipelined_lm_state(
-            model, rng, sample_tok, opt, n_stages=deg)
+            model, rng, sample_tok, opt, n_stages=deg,
+            params=hf_params)
         step = make_pipelined_lm_train_step(
             model, opt, mesh, schedule=args.pp_schedule)
     elif args.parallel == 'tp':
@@ -424,9 +424,16 @@ def main(args):
             save_gpt2_checkpoint)
 
         if dist.is_primary():
+            export_params = state.params
+            if args.parallel == 'pp':
+                from pytorch_multiprocessing_distributed_tpu.parallel import (
+                    unstack_pipeline_params)
+
+                export_params = unstack_pipeline_params(
+                    jax.device_get(state.params), model.vocab_size)
             out = os.path.join(args.save_path,
                                f"model_{args.epochs}.hf.pth")
-            save_gpt2_checkpoint(out, state.params)
+            save_gpt2_checkpoint(out, export_params)
             print(f"HF export: {out}", flush=True)
 
     if args.sample and args.parallel in ('dp', 'tp') \
