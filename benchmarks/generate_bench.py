@@ -31,10 +31,16 @@ def main():
     p.add_argument("--new_tokens", default="128,512", type=str)
     p.add_argument("--dtype", default="bfloat16",
                    choices=["float32", "bfloat16"])
+    p.add_argument("--tp", default=1, type=int,
+                   help="model-axis size for tensor-parallel decode "
+                        "(heads + KV cache + vocab head sharded; 1 = "
+                        "single-shard)")
     args = p.parse_args()
 
     from pytorch_multiprocessing_distributed_tpu import models
-    from pytorch_multiprocessing_distributed_tpu.inference import generate
+    from pytorch_multiprocessing_distributed_tpu.inference import (
+        generate, shard_params_for_tp_decode)
+    from pytorch_multiprocessing_distributed_tpu.parallel import make_mesh
 
     platform = jax.devices()[0].platform
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
@@ -46,15 +52,25 @@ def main():
     prompt = jnp.asarray(np.random.default_rng(0).integers(
         0, model.vocab_size, (args.batch, args.prompt)))
     params = model.init(jax.random.PRNGKey(0), prompt[:1])["params"]
+    mesh = None
+    if args.tp > 1:
+        n_dev = len(jax.devices())
+        if n_dev % args.tp:
+            raise SystemExit(
+                f"--tp {args.tp} does not divide {n_dev} devices "
+                "(for a CPU run: XLA_FLAGS=--xla_force_host_platform_"
+                "device_count=8 JAX_PLATFORMS=cpu)")
+        mesh = make_mesh(n_dev // args.tp, args.tp)
+        params = shard_params_for_tp_decode(params, mesh)
     print(f"# platform={platform} model={args.model} dtype={args.dtype} "
-          f"b={args.batch} prompt={args.prompt}")
+          f"b={args.batch} prompt={args.prompt} tp={args.tp}")
 
     for n in [int(x) for x in args.new_tokens.split(",")]:
         if platform != "tpu":
             n = min(n, 16)
         dt = timeit(
             lambda prompt, n=n: generate(
-                model, params, prompt, max_new_tokens=n),
+                model, params, prompt, max_new_tokens=n, mesh=mesh),
             (prompt,),
         )
         tps = args.batch * n / dt

@@ -23,11 +23,49 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 # flax-default fallback for models predating the ln_eps field; every
 # helper takes eps EXPLICITLY (a forgotten argument must TypeError,
 # not silently run 1e-6 on a GPT-2 checkpoint)
 _LN_EPS = 1e-6
+
+
+def _no_cs(x, *spec):
+    return x
+
+
+def _make_cs(mesh):
+    """Sharding-constraint helper for TP decode: ``cs(x, *axes)`` pins
+    ``x`` to ``PartitionSpec(*axes)`` on ``mesh``; the no-mesh variant
+    is the identity so the single-shard path stays constraint-free."""
+    if mesh is None:
+        return _no_cs
+
+    def cs(x, *spec):
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P(*spec)))
+
+    return cs
+
+
+def shard_params_for_tp_decode(params, mesh: Mesh):
+    """Place a plain GPT param tree TP-sharded for :func:`generate`.
+
+    Same trailing-dim rule as the GSPMD training path
+    (:func:`..train.step.tp_param_spec`): every Dense kernel's output
+    dim — wqkv (=> heads), MLP, and the [D, V] head (=> vocab) — is
+    sharded over the ``model`` axis; odd-sized leaves replicate. Each
+    device then holds 1/tp of the weights at rest, which is the memory
+    headroom TP decode exists for."""
+    from ..train.step import MODEL_AXIS, tp_param_spec
+
+    tp = int(mesh.shape[MODEL_AXIS])
+    return jax.device_put(
+        params,
+        jax.tree.map(
+            lambda l: NamedSharding(mesh, tp_param_spec(l, tp)), params),
+    )
 
 
 def _ln(x, p, eps):
@@ -50,12 +88,17 @@ def _split_heads(t, h):
     return t.reshape(b, s, h, d // h)
 
 
-def _block_prefill(p, x, h, dtype, eps):
+def _block_prefill(p, x, h, dtype, eps, cs=_no_cs):
     """Full causal pass over the prompt; returns (y, k, v)."""
     b, s, _ = x.shape
     hn = _ln(x, p["ln1"], eps).astype(dtype)
     q, k, v = jnp.split(_dense(hn, p["attn"]["wqkv"], dtype), 3, axis=-1)
     q, k, v = _split_heads(q, h), _split_heads(k, h), _split_heads(v, h)
+    # TP: heads live on the model axis — the attention einsums below
+    # then partition per-head with no resharding
+    q = cs(q, None, None, "model", None)
+    k = cs(k, None, None, "model", None)
+    v = cs(v, None, None, "model", None)
     scale = q.shape[-1] ** -0.5
     logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                         k.astype(jnp.float32)) * scale
@@ -70,12 +113,16 @@ def _block_prefill(p, x, h, dtype, eps):
     return x + y, k, v
 
 
-def _block_decode(p, x_t, k_cache, v_cache, pos, h, dtype, eps):
+def _block_decode(p, x_t, k_cache, v_cache, pos, h, dtype, eps,
+                  cs=_no_cs):
     """One cached step: x_t [B, 1, D]; caches [B, S, H, Dh]."""
     b = x_t.shape[0]
     hn = _ln(x_t, p["ln1"], eps).astype(dtype)
     q, k, v = jnp.split(_dense(hn, p["attn"]["wqkv"], dtype), 3, axis=-1)
     q, k, v = _split_heads(q, h), _split_heads(k, h), _split_heads(v, h)
+    q = cs(q, None, None, "model", None)
+    k = cs(k, None, None, "model", None)
+    v = cs(v, None, None, "model", None)
     k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
     v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
     scale = q.shape[-1] ** -0.5
@@ -103,9 +150,12 @@ def _embed(params, tokens, pos_start, dtype):
     return (params["embed"][tokens].astype(dtype) + pos.astype(dtype))
 
 
-def _logits(params, x, eps):
+def _logits(params, x, eps, cs=_no_cs):
     h = _ln(x, params["ln_final"], eps)
-    out = h @ params["head"]["kernel"].astype(jnp.float32)
+    # TP: the [D, V] head kernel is vocab-sharded; logits stay sharded
+    # through the bias add, argmax/sampling gathers only [B] tokens
+    out = cs(h @ params["head"]["kernel"].astype(jnp.float32),
+             None, None, "model")
     if "bias" in params["head"]:  # absent on head_bias=False models
         out = out + params["head"]["bias"]
     return out
@@ -123,7 +173,7 @@ def _sample(logits, temperature, top_k, key):
 
 
 @partial(jax.jit, static_argnames=("model", "max_new_tokens",
-                                   "temperature", "top_k"))
+                                   "temperature", "top_k", "mesh"))
 def generate(
     model,
     params,
@@ -133,6 +183,7 @@ def generate(
     temperature: float = 0.0,
     top_k: int = 0,
     rng: Optional[jax.Array] = None,
+    mesh: Optional[Mesh] = None,
 ) -> jax.Array:
     """Generate ``max_new_tokens`` continuations of ``prompt``.
 
@@ -140,12 +191,21 @@ def generate(
       model: the (dense, non-SP) ``GPT`` the params belong to — supplies
         geometry (heads, dtype, max_seq_len); hashable, so it is a jit
         static.
-      params: plain GPT param tree (as trained).
+      params: plain GPT param tree (as trained). For tensor-parallel
+        decode place it with :func:`shard_params_for_tp_decode` first
+        (replicated params + a mesh still compute correctly — GSPMD
+        reshards — but the memory win comes from sharded placement).
       prompt: ``[B, T]`` int tokens, ``T + max_new_tokens <=
         model.max_seq_len``.
       temperature: 0 = greedy; else softmax temperature sampling.
       top_k: restrict sampling to the k highest logits (0 = full vocab).
       rng: PRNGKey (required when temperature > 0).
+      mesh: optional ``Mesh`` with a ``model`` axis: attention heads,
+        KV caches and the vocab dim of the head matmul are then sharded
+        over it (Megatron-style TP decode, prefill AND decode). The
+        axis size must divide the number of heads. Same tokens as the
+        single-shard path — TP is an execution strategy, not different
+        math (``tests/test_generate.py`` pins this).
 
     Returns ``[B, T + max_new_tokens]`` tokens (prompt included).
     """
@@ -175,6 +235,17 @@ def generate(
             "blocks keep their feed-forward under 'moe', and decode is "
             "single-shard)"
         )
+    if mesh is not None:
+        if "model" not in mesh.axis_names:
+            raise ValueError(
+                f"TP decode needs a 'model' mesh axis, got "
+                f"{mesh.axis_names}")
+        tp = int(mesh.shape["model"])
+        if model.num_heads % tp:
+            raise ValueError(
+                f"num_heads={model.num_heads} not divisible by the "
+                f"model axis size {tp}")
+    cs = _make_cs(mesh)
     dtype = model.dtype
     eps = getattr(model, "ln_eps", _LN_EPS)
     h = model.num_heads
@@ -182,16 +253,24 @@ def generate(
     # a gappy params tree then fails LOUDLY at the missing block key
     head_dim = model.hidden_size // h
 
+    def cs_cache(c):
+        # caches [L, B, S, H, Dh]: resident head-sharded — the per-chip
+        # KV memory drops 1/tp, the actual capacity win of TP decode
+        return cs(c, None, None, None, "model", None)
+
     # ---- prefill: one vectorized causal pass, caches written [0, t)
     x = _embed(params, prompt, 0, dtype)
-    k_caches = jnp.zeros((n_layers, b, s_max, h, head_dim), dtype)
-    v_caches = jnp.zeros((n_layers, b, s_max, h, head_dim), dtype)
+    k_caches = cs_cache(jnp.zeros((n_layers, b, s_max, h, head_dim),
+                                  dtype))
+    v_caches = cs_cache(jnp.zeros((n_layers, b, s_max, h, head_dim),
+                                  dtype))
     for i in range(n_layers):
         x, k, v = _block_prefill(params[f"block_{i}"], x, h, dtype,
-                                 eps)
+                                 eps, cs)
         k_caches = k_caches.at[i, :, :t].set(k.astype(dtype))
         v_caches = v_caches.at[i, :, :t].set(v.astype(dtype))
-    first_logits = _logits(params, x[:, -1:], eps)[:, 0]  # [B, V]
+    k_caches, v_caches = cs_cache(k_caches), cs_cache(v_caches)
+    first_logits = _logits(params, x[:, -1:], eps, cs)[:, 0]  # [B, V]
 
     keys = (jax.random.split(rng, max_new_tokens) if rng is not None
             else jnp.zeros((max_new_tokens, 2), jnp.uint32))
@@ -205,12 +284,13 @@ def generate(
         for i in range(n_layers):
             x_t, kc, vc = _block_decode(
                 params[f"block_{i}"], x_t, k_caches[i], v_caches[i],
-                pos, h, dtype, eps)
+                pos, h, dtype, eps, cs)
             new_k.append(kc)
             new_v.append(vc)
-        logits = _logits(params, x_t, eps)[:, 0]
+        logits = _logits(params, x_t, eps, cs)[:, 0]
         nxt = _sample(logits, temperature, top_k, key)
-        return (nxt, jnp.stack(new_k), jnp.stack(new_v)), tok
+        return (nxt, cs_cache(jnp.stack(new_k)),
+                cs_cache(jnp.stack(new_v))), tok
 
     # scan positions t .. t+max_new-1; step j CONSUMES token j-1 (written
     # at position t+j-1) and emits token j

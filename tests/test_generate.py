@@ -90,3 +90,47 @@ def test_validation(gpt):
     moe_params = moe.init(jax.random.PRNGKey(0), prompt)["params"]
     with pytest.raises(NotImplementedError, match="MoE"):
         generate(moe, moe_params, prompt, max_new_tokens=2)
+
+
+def test_tp_decode_matches_single_shard(gpt):
+    """TP decode (heads + KV caches + vocab head sharded over the
+    'model' axis) emits EXACTLY the single-shard tokens, params resident
+    1/tp per device (VERDICT r4 #6)."""
+    from pytorch_multiprocessing_distributed_tpu.inference import (
+        shard_params_for_tp_decode)
+    from pytorch_multiprocessing_distributed_tpu.parallel import make_mesh
+
+    model, params, prompt = gpt
+    mesh = make_mesh(2, 4)  # (data=2, model=4); gpt_tiny has 4 heads
+    tp_params = shard_params_for_tp_decode(params, mesh)
+    # memory point: each device holds 1/4 of the wqkv out dim at rest
+    wqkv = tp_params["block_0"]["attn"]["wqkv"]["kernel"]
+    assert (wqkv.addressable_shards[0].data.shape[-1]
+            == wqkv.shape[-1] // 4)
+
+    single = generate(model, params, prompt, max_new_tokens=8)
+    tp = generate(model, tp_params, prompt, max_new_tokens=8, mesh=mesh)
+    np.testing.assert_array_equal(np.asarray(single), np.asarray(tp))
+
+    # sampling path too (temperature + top_k over the sharded vocab)
+    key = jax.random.PRNGKey(7)
+    s1 = generate(model, params, prompt, max_new_tokens=6,
+                  temperature=0.8, top_k=17, rng=key)
+    s2 = generate(model, tp_params, prompt, max_new_tokens=6,
+                  temperature=0.8, top_k=17, rng=key, mesh=mesh)
+    np.testing.assert_array_equal(np.asarray(s1), np.asarray(s2))
+
+
+def test_tp_decode_validation(gpt):
+    from pytorch_multiprocessing_distributed_tpu.parallel import make_mesh
+
+    model, params, prompt = gpt
+    mesh = make_mesh(1, 8)  # 8 > 4 heads
+    with pytest.raises(ValueError, match="num_heads"):
+        generate(model, params, prompt, max_new_tokens=2, mesh=mesh)
+    import jax.sharding as shd
+
+    bad = jax.sharding.Mesh(np.array(jax.devices()[:8]).reshape(8),
+                            ("pipe",))
+    with pytest.raises(ValueError, match="model"):
+        generate(model, params, prompt, max_new_tokens=2, mesh=bad)

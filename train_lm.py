@@ -442,10 +442,17 @@ def main(args):
             generate)
 
         dense = model.clone(seq_axis=None)
-        params = jax.device_get(state.params)
         prompt = jnp.asarray(tokens[: args.seq_len][None, :])
-        out = generate(dense, params, prompt,
-                       max_new_tokens=args.sample)
+        if (args.parallel == 'tp' and not (args.zero1 or args.fsdp)
+                and model.num_heads % deg == 0):
+            # decode the GSPMD-sharded params where they live: TP
+            # decode shards heads/KV-cache/vocab over the model axis
+            out = generate(dense, state.params, prompt,
+                           max_new_tokens=args.sample, mesh=mesh)
+        else:
+            params = jax.device_get(state.params)
+            out = generate(dense, params, prompt,
+                           max_new_tokens=args.sample)
         if dist.is_primary():
             ids = np.asarray(out[0, -args.sample:]).tolist()
             print("sample:", ids)
