@@ -128,8 +128,6 @@ def test_tp_decode_validation(gpt):
     mesh = make_mesh(1, 8)  # 8 > 4 heads
     with pytest.raises(ValueError, match="num_heads"):
         generate(model, params, prompt, max_new_tokens=2, mesh=mesh)
-    import jax.sharding as shd
-
     bad = jax.sharding.Mesh(np.array(jax.devices()[:8]).reshape(8),
                             ("pipe",))
     with pytest.raises(ValueError, match="model"):

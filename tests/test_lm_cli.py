@@ -48,8 +48,11 @@ def test_cli_sp_zigzag(tmp_path):
 def test_cli_tp_and_pp_trajectories_match(tmp_path):
     """Same seed/data/geometry through two different parallelizations
     of the same math -> same logged loss."""
-    _, tp_loss = _run(tmp_path / "tp", "--parallel", "tp",
-                      "--degree", "2")
+    # --sample rides the TP run: decode of the resident GSPMD-sharded
+    # params over the model axis (mesh= path in train_lm.py)
+    tp_out, tp_loss = _run(tmp_path / "tp", "--parallel", "tp",
+                           "--degree", "2", "--sample", "4")
+    assert "sample:" in tp_out
     _, pp_loss = _run(tmp_path / "pp", "--parallel", "pp",
                       "--degree", "4")
     assert abs(tp_loss - pp_loss) < 5e-3 * tp_loss

@@ -1,0 +1,94 @@
+"""Multi-host LM training end to end (VERDICT r4 #7).
+
+Two REAL processes (1 CPU device each) rendezvous through the C++ TCP
+store and run the full ``train_lm.py`` byte-corpus flow (world=2, one
+replica per host). Pins the LM-specific cross-process path the image
+e2e cannot: TokenLoader's identical global-batch construction on every
+host (window shuffle + device_put slicing) and the LM train/eval
+collectives. The 2-host trajectory must match a single-host world=2
+run: same train.log/test.log rows within cross-process psum float
+noise, logs and checkpoint only on the primary host.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _run_lm(corpus, save_path, extra_env):
+    env = dict(os.environ, **extra_env)
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    # lr 0.001 for the same reason as test_multihost_train: psum
+    # reduction order differs across process boundaries; tiny lr keeps
+    # the float noise from compounding through SGD, while loader bugs
+    # (the target of this test) would still move the loss visibly
+    return subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "train_lm.py"),
+         "--model", "gpt_tiny", "--epochs", "2", "--batch_size", "8",
+         "--seq_len", "32", "--corpus", str(corpus), "--seed", "0",
+         "--lr", "0.001", "--val_frac", "0.2",
+         "--save_path", str(save_path)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, cwd=REPO,
+    )
+
+
+@pytest.mark.slow
+def test_two_host_lm_matches_single_host(tmp_path):
+    corpus = tmp_path / "corpus.txt"
+    corpus.write_text(
+        "the quick brown fox jumps over the lazy dog. " * 150)
+
+    port = _free_port()
+    procs = [
+        _run_lm(corpus, tmp_path / f"mh{rank}", {
+            "PMDT_MASTER_ADDR": f"127.0.0.1:{port}",
+            "PMDT_WORLD_SIZE": "2",
+            "PMDT_RANK": str(rank),
+            "PMDT_FORCE_CPU_DEVICES": "1",
+        })
+        for rank in range(2)
+    ]
+    outs = [p.communicate(timeout=900)[0] for p in procs]
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out[-4000:]}"
+
+    ref = _run_lm(corpus, tmp_path / "sh",
+                  {"PMDT_FORCE_CPU_DEVICES": "2"})
+    out_ref = ref.communicate(timeout=900)[0]
+    assert ref.returncode == 0, f"single-host ref failed:\n{out_ref[-4000:]}"
+
+    def rows(d, name):
+        path = d / name
+        assert path.exists(), f"missing {path}"
+        return [[float(x) for x in line.split()]
+                for line in path.read_text().strip().splitlines()]
+
+    # worker host logs/checkpoints nothing (rank-0 semantics)
+    assert not (tmp_path / "mh1" / "train.log").exists()
+    assert not (tmp_path / "mh1" / "model_2.pth").exists()
+    assert (tmp_path / "mh0" / "model_2.pth").exists()
+
+    for name, tol in (("train.log", 2e-4), ("test.log", 2e-3)):
+        got = rows(tmp_path / "mh0", name)
+        want = rows(tmp_path / "sh", name)
+        assert len(got) == 2  # one row per epoch
+        for a, b in zip(got, want, strict=True):
+            assert a[0] == b[0]  # epoch
+            # loss and ppl within cross-process psum float noise
+            assert abs(a[1] - b[1]) < tol * max(1.0, abs(b[1])), (
+                name, a, b)
+            assert abs(a[2] - b[2]) < 10 * tol * max(1.0, abs(b[2])), (
+                name, a, b)
