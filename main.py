@@ -162,11 +162,32 @@ def main(args):
         cosine_lr, multistep_lr, sgd)
     from pytorch_multiprocessing_distributed_tpu.train.trainer import Trainer
 
-    dist.init_process()
-
-    mesh = make_mesh(args.world_size, args.model_parallel)
-    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
-
+    # Every pure-flag validation BEFORE dist/device/data work (the
+    # repo-wide convention train_lm.py states explicitly: an invalid
+    # combo must not cost a backend bring-up or a dataset read, and
+    # must never surface as an unrelated crash later).
+    if args.model in models.LM_MODELS:
+        raise ValueError(
+            f"--model {args.model} is a language model: it trains on "
+            "token sequences via pytorch_multiprocessing_distributed_tpu"
+            ".train.lm (make_lm_train_step), not through this image-"
+            "classification CLI. See MIGRATION.md."
+        )
+    if args.optimizer == "sgd_fused" and (
+        args.zero1 or args.fsdp or args.model_parallel > 1
+    ):
+        raise ValueError(
+            "--optimizer sgd_fused is the explicit shard_map-DP "
+            "path's fused kernel; under --zero1/--fsdp/--model_parallel "
+            "the GSPMD partitioner cannot shard through the opaque "
+            "Pallas call (it would replicate the moment buffers, "
+            "defeating the sharding). Use --optimizer sgd there."
+        )
+    if args.warmup_epochs and args.lr_schedule != "cosine":
+        raise ValueError(
+            "--warmup_epochs applies to --lr_schedule cosine (the "
+            "reference's MultiStepLR has no warmup)"
+        )
     # dataset-derived geometry (the reference hardcodes 32x32/10-way,
     # data.py:11 + model/resnet.py:86; here the imagenet route widens it)
     is_imagenet = args.dataset == "imagenet"
@@ -176,6 +197,12 @@ def main(args):
             "--dataset cifar is fixed at 32x32 (the reference resizes to "
             "32, data.py:11); --image_size applies to --dataset imagenet"
         )
+
+    dist.init_process()
+
+    mesh = make_mesh(args.world_size, args.model_parallel)
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+
     if not args.data_root:
         args.data_root = "./imagenet" if is_imagenet else "./cifar10_data"
     args.image_size = image_size
@@ -196,13 +223,6 @@ def main(args):
     # sync; the TP path (model_parallel > 1) runs under global-semantics
     # GSPMD jit where batch stats are global by construction, so BN must
     # NOT carry an axis name there (train/step.py make_train_step_tp).
-    if args.model in models.LM_MODELS:
-        raise ValueError(
-            f"--model {args.model} is a language model: it trains on "
-            "token sequences via pytorch_multiprocessing_distributed_tpu"
-            ".train.lm (make_lm_train_step), not through this image-"
-            "classification CLI. See MIGRATION.md."
-        )
     use_gspmd = args.model_parallel > 1 or args.zero1 or args.fsdp
     model = models.get_model(
         args.model, dtype=dtype,
@@ -219,11 +239,7 @@ def main(args):
         if args.lr_schedule == "cosine":
             return cosine_lr(base, args.epochs,
                              warmup_epochs=args.warmup_epochs)
-        if args.warmup_epochs:
-            raise ValueError(
-                "--warmup_epochs applies to --lr_schedule cosine (the "
-                "reference's MultiStepLR has no warmup)"
-            )
+        # warmup x non-cosine is rejected in the flag-validation block
         return multistep_lr(base, milestones=[60, 80], gamma=0.1)
 
     if args.optimizer == "lamb":
@@ -234,14 +250,7 @@ def main(args):
             weight_decay=0.0001,
         )
     elif args.optimizer == "sgd_fused":
-        if args.zero1 or args.fsdp or args.model_parallel > 1:
-            raise ValueError(
-                "--optimizer sgd_fused is the explicit shard_map-DP "
-                "path's fused kernel; under --zero1/--fsdp/--model_parallel "
-                "the GSPMD partitioner cannot shard through the opaque "
-                "Pallas call (it would replicate the moment buffers, "
-                "defeating the sharding). Use --optimizer sgd there."
-            )
+        # GSPMD combos rejected up in the flag-validation block
         from pytorch_multiprocessing_distributed_tpu.ops.pallas.fused_update import (
             sgd_pallas)
 

@@ -1,0 +1,100 @@
+"""PARALLELISM.md's 🚫 cells are GUARDS, not silent gaps: every
+refused flag combination must fail fast with a descriptive error
+BEFORE any device/backend work (the CLIs validate pure flags first —
+a dropped flag or a post-training crash is worse than an immediate
+error). One subprocess per guard; all exit at validation, so each is
+seconds, not a training run.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _cli(script, *flags):
+    env = dict(os.environ, PMDT_FORCE_CPU_DEVICES="8")
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, script), *flags],
+        env=env, capture_output=True, text=True, timeout=240, cwd=REPO,
+    )
+
+
+def _lm(*flags):
+    return _cli("train_lm.py", "--model", "gpt_tiny", *flags)
+
+
+def _img(*flags):
+    return _cli("main.py", *flags)
+
+
+@pytest.mark.parametrize(
+    "flags, needle",
+    [
+        # ZeRO/FSDP ride the GSPMD path only
+        (("--zero1",), "--parallel tp"),
+        (("--fsdp", "--parallel", "pp", "--degree", "4"),
+         "--parallel tp"),
+        # grad_accum: shard_map dp/sp step only
+        (("--grad_accum", "2", "--parallel", "tp", "--degree", "2"),
+         "--grad_accum"),
+        (("--grad_accum", "2", "--parallel", "pp", "--degree", "4"),
+         "--grad_accum"),
+        # streamed CE: dp/sp step only
+        (("--vocab_chunks", "4", "--parallel", "tp", "--degree", "2"),
+         "--vocab_chunks"),
+        (("--vocab_chunks", "4", "--parallel", "pp", "--degree", "4"),
+         "--vocab_chunks"),
+        # remat is not wired into the pipelined schedules
+        (("--remat", "--parallel", "pp", "--degree", "4"), "--remat"),
+        # pp schedule flag needs pp
+        (("--pp_schedule", "1f1b",), "--parallel pp"),
+        # HF interop: dense GPTs only
+        (("--hf_init", "/nonexistent.pth", "--n_experts", "2"),
+         "GPT-2"),
+        # decode: dense dp/tp only
+        (("--sample", "4", "--parallel", "sp", "--degree", "4"),
+         "--sample"),
+        (("--sample", "4", "--parallel", "pp", "--degree", "4"),
+         "--sample"),
+        (("--sample", "4", "--n_experts", "2"), "--sample"),
+        # MoE knobs need experts; MoE does not pipeline (cell b —
+        # the library guard is pinned by test_gpt_pipeline.py)
+        (("--moe_top_k", "2",), "--n_experts"),
+        (("--n_experts", "2", "--parallel", "pp", "--degree", "4"),
+         "PARALLELISM.md"),
+        # pure-flag image_size guard fires before dist init too
+    ],
+)
+def test_lm_guards_fire(flags, needle):
+    proc = _lm(*flags)
+    assert proc.returncode != 0, proc.stdout
+    assert needle in proc.stderr + proc.stdout, (
+        flags, proc.stderr[-800:])
+
+
+@pytest.mark.parametrize(
+    "flags, needle",
+    [
+        # fused SGD is the explicit shard_map-DP path's kernel
+        (("--optimizer", "sgd_fused", "--zero1"), "sgd_fused"),
+        (("--optimizer", "sgd_fused", "--model_parallel", "2"),
+         "sgd_fused"),
+        # torch export maps the ResNet family only
+        (("--model", "vit_b16", "--torch_export"), "--torch_export"),
+        # LM models train through train_lm.py
+        (("--model", "gpt_tiny",), "language model"),
+        # cifar geometry is fixed (pure-flag, pre-dist-init)
+        (("--dataset", "cifar", "--image_size", "64"), "32x32"),
+    ],
+)
+def test_image_guards_fire(flags, needle):
+    proc = _img(*flags)
+    assert proc.returncode != 0, proc.stdout
+    assert needle in proc.stderr + proc.stdout, (
+        flags, proc.stderr[-800:])

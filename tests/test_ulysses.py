@@ -143,3 +143,56 @@ def test_gpt_sp_mode_ulysses_matches_ring(mesh):
     )
     for a, b in zip(results["ring"][1], results["ulysses"][1]):
         np.testing.assert_allclose(a, b, rtol=3e-4, atol=3e-6)
+
+
+def test_gpt_ulysses_moe_matches_dp(mesh):
+    """SP x MoE (PARALLELISM.md matrix cell): Ulysses attention with a
+    routed-expert feed-forward tracks the plain DP trajectory — the
+    all-to-all head exchange and the MoE dispatch compose."""
+    from pytorch_multiprocessing_distributed_tpu import models
+    from pytorch_multiprocessing_distributed_tpu.parallel import make_mesh
+    from pytorch_multiprocessing_distributed_tpu.train.lm import (
+        create_lm_train_state,
+        make_lm_train_step,
+    )
+    from pytorch_multiprocessing_distributed_tpu.train.optim import sgd
+    from pytorch_multiprocessing_distributed_tpu.train.step import (
+        shard_batch)
+
+    devices = jax.devices()[:8]
+    mesh_sp = Mesh(np.asarray(devices).reshape(2, 4), ("data", "seq"))
+    rng = np.random.default_rng(3)
+    tok = jnp.asarray(rng.integers(0, 257, (4, 32)))
+    opt = sgd(learning_rate=0.1)
+
+    losses = {}
+    for kind in ("dp", "sp"):
+        model = models.GPT_Tiny(
+            num_layers=2, n_experts=2,
+            seq_axis="seq" if kind == "sp" else None,
+            sp_mode="ulysses")
+        state = create_lm_train_state(
+            model, jax.random.PRNGKey(0), tok, opt)
+        if kind == "sp":
+            step = make_lm_train_step(model, opt, mesh_sp,
+                                      seq_axis="seq",
+                                      moe_aux_weight=0.01)
+            batch = tok
+        else:
+            dp_mesh = make_mesh(4)  # batch 4: one sample per replica
+            step = make_lm_train_step(model, opt, dp_mesh,
+                                      moe_aux_weight=0.01)
+            (batch,) = shard_batch((tok,), dp_mesh)
+        for _ in range(2):
+            state, metrics = step(state, batch)
+        losses[kind] = float(metrics["loss"])
+
+    # tolerance covers the aux-ESTIMATOR difference, not routing bugs:
+    # the balance loss Σ_e f_e·P_e is computed over each step's local
+    # batch view (1 sample/replica under dp(4), 2 samples/data-shard
+    # under (2,4) sp), and aux_weight=0.01 feeds that few-percent
+    # estimator gap into the update — measured 9e-4 relative after two
+    # steps. A broken dispatch/all-to-all shows up orders of magnitude
+    # above this.
+    assert abs(losses["dp"] - losses["sp"]) < 3e-3 * max(
+        1.0, abs(losses["dp"])), losses
