@@ -83,12 +83,59 @@ def _dense(x, p, dtype):
     return x.astype(dtype) @ p["kernel"].astype(dtype) + p["bias"].astype(dtype)
 
 
+def _moe_ffn(p, x32, dtype, top_k):
+    """Dropless top-k routed feed-forward, mirroring ``ops.moe.MoEMlp``
+    math exactly (router in f32 on the f32 LN output, expert ReLU MLPs
+    in ``dtype``, Switch raw-top-prob / GShard renormalized combine,
+    f32 result like the training block) — minus the capacity slots:
+    at decode each token routes unconditionally. Identical to the
+    training forward whenever capacity does not bind there
+    (``moe_capacity_factor >= n_experts`` guarantees it; at the default
+    1.0 a heavily imbalanced prompt may drop tokens in the training
+    forward that decode keeps — dropless inference is the standard
+    trade)."""
+    gates = jax.nn.softmax(x32 @ p["gate"], axis=-1)  # [B, S, E] f32
+    topv, topi = jax.lax.top_k(gates, top_k)
+    if top_k == 1:
+        weights = topv  # Switch: the raw top probability
+    else:
+        weights = topv / jnp.sum(topv, axis=-1, keepdims=True)
+    xin = x32.astype(dtype)
+
+    def one_expert(w1e, b1e, w2e, b2e):
+        h = jax.nn.relu(xin @ w1e.astype(dtype) + b1e.astype(dtype))
+        return h @ w2e.astype(dtype) + b2e.astype(dtype)
+
+    # all-experts-masked-combine: E/top_k x the routed FLOPs, chosen
+    # deliberately — static shapes, MXU-shaped matmuls, no per-token
+    # weight gathers (at [D, H] per token those are worse than the
+    # extra compute for the expert counts this decodes), and decode is
+    # cache-bandwidth-bound anyway. Capacity-compacted routed execution
+    # only pays at large E.
+    ys = jax.vmap(one_expert)(p["w1"], p["b1"], p["w2"], p["b2"])
+    onehots = jax.nn.one_hot(topi, p["gate"].shape[-1],
+                             dtype=jnp.float32)  # [B, S, K, E]
+    combine = jnp.einsum("bske,bsk->bse", onehots, weights)
+    y = jnp.einsum("bse,ebsd->bsd", combine.astype(dtype), ys)
+    return y.astype(jnp.float32)  # MoEMlp returns x.dtype = f32 LN out
+
+
+def _ffn(p, x, dtype, eps, top_k):
+    """ln2 -> feed-forward (dense GELU MLP, or MoE when the block
+    carries a ``moe`` subtree), following Block's dtype conventions."""
+    if "moe" in p:
+        return _moe_ffn(p["moe"], _ln(x, p["ln2"], eps), dtype, top_k)
+    hn = _ln(x, p["ln2"], eps).astype(dtype)
+    y = _dense(hn, p["fc1"], dtype)
+    return _dense(jax.nn.gelu(y), p["fc2"], dtype)
+
+
 def _split_heads(t, h):
     b, s, d = t.shape
     return t.reshape(b, s, h, d // h)
 
 
-def _block_prefill(p, x, h, dtype, eps, cs=_no_cs):
+def _block_prefill(p, x, h, dtype, eps, cs=_no_cs, top_k=1):
     """Full causal pass over the prompt; returns (y, k, v)."""
     b, s, _ = x.shape
     hn = _ln(x, p["ln1"], eps).astype(dtype)
@@ -107,14 +154,11 @@ def _block_prefill(p, x, h, dtype, eps, cs=_no_cs):
     att = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
     att = att.reshape(b, s, -1).astype(dtype)
     x = x + _dense(att, p["attn"]["wo"], dtype)
-    hn = _ln(x, p["ln2"], eps).astype(dtype)
-    y = _dense(hn, p["fc1"], dtype)
-    y = _dense(jax.nn.gelu(y), p["fc2"], dtype)
-    return x + y, k, v
+    return x + _ffn(p, x, dtype, eps, top_k), k, v
 
 
 def _block_decode(p, x_t, k_cache, v_cache, pos, h, dtype, eps,
-                  cs=_no_cs):
+                  cs=_no_cs, top_k=1):
     """One cached step: x_t [B, 1, D]; caches [B, S, H, Dh]."""
     b = x_t.shape[0]
     hn = _ln(x_t, p["ln1"], eps).astype(dtype)
@@ -135,10 +179,7 @@ def _block_decode(p, x_t, k_cache, v_cache, pos, h, dtype, eps,
                      v_cache.astype(jnp.float32))
     att = att.reshape(b, 1, -1).astype(dtype)
     x_t = x_t + _dense(att, p["attn"]["wo"], dtype)
-    hn = _ln(x_t, p["ln2"], eps).astype(dtype)
-    y = _dense(hn, p["fc1"], dtype)
-    y = _dense(jax.nn.gelu(y), p["fc2"], dtype)
-    return x_t + y, k_cache, v_cache
+    return (x_t + _ffn(p, x_t, dtype, eps, top_k), k_cache, v_cache)
 
 
 def _embed(params, tokens, pos_start, dtype):
@@ -188,9 +229,11 @@ def generate(
     """Generate ``max_new_tokens`` continuations of ``prompt``.
 
     Args:
-      model: the (dense, non-SP) ``GPT`` the params belong to — supplies
-        geometry (heads, dtype, max_seq_len); hashable, so it is a jit
-        static.
+      model: the ``GPT`` the params belong to — supplies geometry
+        (heads, dtype, max_seq_len, moe_top_k); hashable, so it is a
+        jit static. MoE models decode with dropless routing (see
+        ``_moe_ffn``); SP models must pass their dense clone
+        (``model.clone(seq_axis=None)`` — identical params).
       params: plain GPT param tree (as trained). For tensor-parallel
         decode place it with :func:`shard_params_for_tp_decode` first
         (replicated params + a mesh still compute correctly — GSPMD
@@ -227,13 +270,11 @@ def generate(
         )
     if temperature > 0.0 and rng is None:
         raise ValueError("sampling (temperature > 0) requires rng")
-    if getattr(model, "n_experts", 0) > 0 or (
-        getattr(model, "seq_axis", None) is not None
-    ):
+    if getattr(model, "seq_axis", None) is not None:
         raise NotImplementedError(
-            "generate covers dense, non-sequence-parallel GPTs (MoE "
-            "blocks keep their feed-forward under 'moe', and decode is "
-            "single-shard)"
+            "generate wants the dense view of an SP model — pass "
+            "model.clone(seq_axis=None) (the params are identical; "
+            "train_lm.py --sample does this)"
         )
     if mesh is not None:
         if "model" not in mesh.axis_names:
@@ -248,6 +289,7 @@ def generate(
     cs = _make_cs(mesh)
     dtype = model.dtype
     eps = getattr(model, "ln_eps", _LN_EPS)
+    moe_k = getattr(model, "moe_top_k", 1)
     h = model.num_heads
     n_layers = model.num_layers  # trusted like num_heads/hidden_size:
     # a gappy params tree then fails LOUDLY at the missing block key
@@ -266,7 +308,7 @@ def generate(
                                   dtype))
     for i in range(n_layers):
         x, k, v = _block_prefill(params[f"block_{i}"], x, h, dtype,
-                                 eps, cs)
+                                 eps, cs, moe_k)
         k_caches = k_caches.at[i, :, :t].set(k.astype(dtype))
         v_caches = v_caches.at[i, :, :t].set(v.astype(dtype))
     k_caches, v_caches = cs_cache(k_caches), cs_cache(v_caches)
@@ -284,7 +326,7 @@ def generate(
         for i in range(n_layers):
             x_t, kc, vc = _block_decode(
                 params[f"block_{i}"], x_t, k_caches[i], v_caches[i],
-                pos, h, dtype, eps, cs)
+                pos, h, dtype, eps, cs, moe_k)
             new_k.append(kc)
             new_v.append(vc)
         logits = _logits(params, x_t, eps, cs)[:, 0]

@@ -122,6 +122,7 @@ class Block(nn.Module):
     expert_axis: Optional[str] = None
     attn_impl: str = "flash"
     moe_top_k: int = 1
+    moe_capacity_factor: float = 1.0
     # flax default; GPT-2 checkpoints use 1e-5
     # (utils.gpt_interop.from_gpt2_state_dict sets it)
     ln_eps: float = 1e-6
@@ -142,6 +143,7 @@ class Block(nn.Module):
             # shard_expert_params; replicated under plain shard_map DP)
             h = MoEMlp(
                 n_experts=self.n_experts, d_hidden=self.mlp_dim,
+                capacity_factor=self.moe_capacity_factor,
                 expert_axis=self.expert_axis, dtype=self.dtype,
                 top_k=self.moe_top_k, name="moe",
             )(h)
@@ -173,6 +175,9 @@ class GPT(nn.Module):
     expert_axis: Optional[str] = None
     attn_impl: str = "flash"  # "flash" (Pallas) | "xla" (plain masked)
     moe_top_k: int = 1  # experts per token (1 = Switch, 2 = GShard)
+    # per-expert capacity = ceil(S * top_k * factor / E); >= n_experts
+    # makes routing dropless (capacity can never bind)
+    moe_capacity_factor: float = 1.0
     # flax LayerNorm default; HF GPT-2 checkpoints need 1e-5 — set by
     # utils.gpt_interop.from_gpt2_state_dict so imported weights
     # reproduce the torch logits exactly
@@ -236,6 +241,7 @@ class GPT(nn.Module):
             x = Block(self.num_heads, self.mlp_dim, self.dtype,
                       self.seq_axis, self.sp_mode, self.n_experts,
                       self.expert_axis, self.attn_impl, self.moe_top_k,
+                      moe_capacity_factor=self.moe_capacity_factor,
                       ln_eps=self.ln_eps, name=f"block_{i}")(x)
         x = nn.LayerNorm(epsilon=self.ln_eps, dtype=jnp.float32,
                          name="ln_final")(x)

@@ -86,10 +86,26 @@ def test_validation(gpt):
     with pytest.raises(ValueError, match="rng"):
         generate(model, params, prompt, max_new_tokens=2,
                  temperature=0.7)
-    moe = models.get_model("gpt_tiny", n_experts=2)
-    moe_params = moe.init(jax.random.PRNGKey(0), prompt)["params"]
-    with pytest.raises(NotImplementedError, match="MoE"):
-        generate(moe, moe_params, prompt, max_new_tokens=2)
+    sp = models.get_model("gpt_tiny", seq_axis="seq")
+    with pytest.raises(NotImplementedError, match="seq_axis"):
+        generate(sp, params, prompt, max_new_tokens=2)
+
+
+def test_moe_greedy_matches_full_forward_decode():
+    """MoE decode (dropless top-k routing) emits EXACTLY the tokens
+    repeated full forwards produce when the training forward's
+    capacity never binds (moe_capacity_factor = n_experts). Covers
+    Switch (top-1) and GShard (top-2) combine rules."""
+    for top_k in (1, 2):
+        model = models.get_model(
+            "gpt_tiny", n_experts=2, moe_top_k=top_k,
+            moe_capacity_factor=2.0, attn_impl="xla")
+        tokens = jnp.asarray(np.random.default_rng(top_k).integers(
+            0, model.vocab_size, (2, 12)))
+        params = model.init(jax.random.PRNGKey(1), tokens)["params"]
+        out = generate(model, params, tokens, max_new_tokens=6)
+        ref = _naive_greedy(model, params, tokens, 6)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
 
 
 def test_tp_decode_matches_single_shard(gpt):

@@ -40,8 +40,12 @@ def test_cli_dp_with_sampling(tmp_path):
 
 @pytest.mark.slow
 def test_cli_sp_zigzag(tmp_path):
-    _run(tmp_path, "--parallel", "sp", "--degree", "4",
-         "--sp_mode", "zigzag", "--batch_size", "8")
+    # --sample after SP training: the trained params ARE the dense
+    # tree, decode runs on the seq_axis=None clone
+    out, _ = _run(tmp_path, "--parallel", "sp", "--degree", "4",
+                  "--sp_mode", "zigzag", "--batch_size", "8",
+                  "--sample", "4")
+    assert "sample:" in out
 
 
 @pytest.mark.slow
@@ -60,7 +64,10 @@ def test_cli_tp_and_pp_trajectories_match(tmp_path):
 
 @pytest.mark.slow
 def test_cli_pp_1f1b_matches_gpipe(tmp_path):
-    _, g_loss = _run(tmp_path / "g", "--parallel", "pp", "--degree", "4")
+    # --sample after PP training: decode via unstack_pipeline_params
+    g_out, g_loss = _run(tmp_path / "g", "--parallel", "pp",
+                         "--degree", "4", "--sample", "4")
+    assert "sample:" in g_out
     _, f_loss = _run(tmp_path / "f", "--parallel", "pp", "--degree", "4",
                      "--pp_schedule", "1f1b")
     assert abs(g_loss - f_loss) < 5e-3 * g_loss
@@ -99,8 +106,11 @@ def test_cli_pp_schedule_needs_pp(tmp_path):
 
 @pytest.mark.slow
 def test_cli_moe_reports_aux(tmp_path):
-    out, _ = _run(tmp_path, "--parallel", "dp", "--n_experts", "2")
+    # --sample on a MoE model: dropless decode (inference/generate.py)
+    out, _ = _run(tmp_path, "--parallel", "dp", "--n_experts", "2",
+                  "--sample", "4")
     assert "Aux" in out
+    assert "sample:" in out
 
 
 @pytest.mark.slow

@@ -57,12 +57,6 @@ def _img(*flags):
         # HF interop: dense GPTs only
         (("--hf_init", "/nonexistent.pth", "--n_experts", "2"),
          "GPT-2"),
-        # decode: dense dp/tp only
-        (("--sample", "4", "--parallel", "sp", "--degree", "4"),
-         "--sample"),
-        (("--sample", "4", "--parallel", "pp", "--degree", "4"),
-         "--sample"),
-        (("--sample", "4", "--n_experts", "2"), "--sample"),
         # MoE knobs need experts; MoE does not pipeline (cell b —
         # the library guard is pinned by test_gpt_pipeline.py)
         (("--moe_top_k", "2",), "--n_experts"),

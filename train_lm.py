@@ -216,10 +216,6 @@ def main(args):
         raise SystemExit(
             f"--val_frac must be in (0, 1), got {args.val_frac}")
     if args.sample:
-        if args.parallel not in ('dp', 'tp') or args.n_experts:
-            raise SystemExit(
-                "--sample needs a dense dp/tp model (generation is "
-                "single-shard, non-MoE)")
         if args.seq_len + args.sample > model.max_seq_len:
             raise SystemExit(
                 f"--seq_len {args.seq_len} + --sample {args.sample} "
@@ -442,21 +438,35 @@ def main(args):
             save_gpt2_checkpoint(out, export_params)
             print(f"HF export: {out}", flush=True)
 
-    if args.sample and args.parallel in ('dp', 'tp') \
-            and args.n_experts == 0:
+    if args.sample:
         from pytorch_multiprocessing_distributed_tpu.inference import (
             generate)
 
         dense = model.clone(seq_axis=None)
         prompt = jnp.asarray(tokens[: args.seq_len][None, :])
         if (args.parallel == 'tp' and not (args.zero1 or args.fsdp)
-                and model.num_heads % deg == 0):
+                and model.num_heads % deg == 0 and not args.n_experts):
             # decode the GSPMD-sharded params where they live: TP
             # decode shards heads/KV-cache/vocab over the model axis
             out = generate(dense, state.params, prompt,
                            max_new_tokens=args.sample, mesh=mesh)
         else:
-            params = jax.device_get(state.params)
+            # every other trained state decodes single-shard: sp params
+            # are already the dense tree (replicated), pp restacks, MoE
+            # decodes droplessly (inference/generate.py). Gather first —
+            # pipe/model-sharded leaves span hosts in multi-host runs and
+            # a bare device_get would crash AFTER the whole training run
+            # (collective: every host calls it, like save_checkpoint)
+            from pytorch_multiprocessing_distributed_tpu.train.checkpoint import (
+                _gather_for_host)
+
+            params = jax.device_get(_gather_for_host(state.params))
+            if args.parallel == 'pp':
+                from pytorch_multiprocessing_distributed_tpu.parallel import (
+                    unstack_pipeline_params)
+
+                params = unstack_pipeline_params(
+                    params, model.vocab_size)
             out = generate(dense, params, prompt,
                            max_new_tokens=args.sample)
         if dist.is_primary():
