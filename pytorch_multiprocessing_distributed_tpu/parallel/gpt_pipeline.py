@@ -168,11 +168,6 @@ def create_pipelined_lm_state(model, rng, sample_tokens,
     those instead of a fresh init."""
     from ..train.state import TrainState
 
-    if getattr(model, "n_experts", 0) > 0:
-        raise NotImplementedError(
-            "pipeline parallelism currently covers dense GPT blocks "
-            "(MoE routing state does not stack across stages)"
-        )
     if getattr(model, "seq_axis", None) is not None:
         model = model.clone(seq_axis=None)
     if params is None:
@@ -192,22 +187,45 @@ def _shared_parts(model, pipe_axis):
     train, eval) — ONE copy so the execution paths cannot drift
     numerically."""
     from ..models.gpt import Block
+    from ..train.lm import _collect_moe_losses
+    from .pipeline import _zeros_vma
 
     # attn_impl="xla": the Pallas flash kernel cannot declare vma for
     # the check_vma=True shard_map these steps REQUIRE (collective AD
     # correctness, see .pipeline); plain masked attention is the same
     # exact math.
     ln_eps = getattr(model, "ln_eps", _LN_EPS)
+    is_moe = getattr(model, "n_experts", 0) > 0
     block = Block(model.num_heads, model.mlp_dim, model.dtype,
-                  attn_impl="xla", ln_eps=ln_eps)
+                  attn_impl="xla", ln_eps=ln_eps,
+                  n_experts=getattr(model, "n_experts", 0),
+                  moe_top_k=getattr(model, "moe_top_k", 1),
+                  moe_capacity_factor=getattr(
+                      model, "moe_capacity_factor", 1.0))
 
-    def stage_fn(stage_params, x):
-        # stage_params leaves [L/S, ...]: scan this stage's layers
-        def layer(carry, lp):
-            return block.apply({"params": lp}, carry), None
+    if is_moe:
+        def stage_fn(stage_params, x):
+            # MoE contract: (y, [aux_sum, z_sum]) — this stage's LAYER
+            # SUM of the sown balance/z losses (the bodies normalize to
+            # the layer-mean the dense step uses)
+            def layer(carry, lp):
+                h, acc = carry
+                y, mut = block.apply({"params": lp}, h,
+                                     mutable=["losses"])
+                a, zl = _collect_moe_losses(mut)
+                return (y, acc + jnp.stack([a, zl])), None
 
-        y, _ = jax.lax.scan(layer, x, stage_params)
-        return y
+            acc0 = _zeros_vma((2,), jnp.float32, x)
+            (y, acc), _ = jax.lax.scan(layer, (x, acc0), stage_params)
+            return y, acc
+    else:
+        def stage_fn(stage_params, x):
+            # stage_params leaves [L/S, ...]: scan this stage's layers
+            def layer(carry, lp):
+                return block.apply({"params": lp}, carry), None
+
+            y, _ = jax.lax.scan(layer, x, stage_params)
+            return y
 
     def vocab_parallel_embed(emb, pos, tokens, i):
         """Gather the locally-owned rows, psum to materialize [B, S, D]."""
@@ -229,18 +247,24 @@ def _shared_parts(model, pipe_axis):
     return stage_fn, vocab_parallel_embed, final_ln
 
 
-def _make_forward_ce(model, axis_name, pipe_axis, m):
+def _make_forward_ce(model, axis_name, pipe_axis, m,
+                     moe_aux_weight=0.01, moe_z_weight=1e-3):
     """The GPipe forward objective shared by the gpipe train body and
     the eval step: vocab-parallel embed -> pipelined blocks -> final LN
     -> vocab-parallel log-sum-exp CE (the [B, S, V] logits never
-    materialize). Returns ``forward_ce(p, tokens) -> (obj, (ce_sum,
-    count))`` with ``obj`` normalized for differentiation."""
+    materialize). For MoE models the pipelined stages also accumulate
+    the sown balance/z losses (valid ticks only) and the objective adds
+    them layer-mean-normalized, mirroring the dense step. Returns
+    ``forward_ce(p, tokens) -> (obj, (ce_sum, count, moe_aux))`` with
+    ``obj`` normalized for differentiation."""
     from ..train.lm import _next_token_targets
     from .pipeline import pipeline_apply
 
     stage_fn, vocab_parallel_embed, final_ln = _shared_parts(
         model, pipe_axis
     )
+    is_moe = getattr(model, "n_experts", 0) > 0
+    n_layers = model.num_layers
 
     def forward_ce(p, tokens):
         targets, valid = _next_token_targets(tokens, None)
@@ -260,8 +284,17 @@ def _make_forward_ce(model, axis_name, pipe_axis, m):
 
         micro = h.reshape(m, b // m, s, h.shape[-1])
         out = pipeline_apply(
-            stage_fn, p["blocks"], micro, axis_name=pipe_axis
+            stage_fn, p["blocks"], micro, axis_name=pipe_axis,
+            with_aux=is_moe
         )
+        if is_moe:
+            out, aux_local = out
+            # layer-mean x microbatch-mean, matching the dense step's
+            # _collect_moe_losses normalization
+            aux_vec = jax.lax.psum(aux_local, pipe_axis) / (
+                n_layers * m)
+        else:
+            aux_vec = jnp.zeros((2,), jnp.float32)
         h = out.reshape(b, s, -1).astype(jnp.float32)
         h = final_ln(h, p["ln_f"])
 
@@ -293,7 +326,13 @@ def _make_forward_ce(model, axis_name, pipe_axis, m):
         )[..., 0] * tmine
         tlogit = jax.lax.psum(tlogit, pipe_axis)
         ce_sum = jnp.sum((lse - tlogit) * w)
-        return ce_sum / count, (ce_sum, count)
+        # /dp_world: grads come back data-summed under check_vma AD,
+        # so the local aux objective pre-divides (dense-step convention)
+        dp_world = jax.lax.psum(1, axis_name)
+        obj = ce_sum / count + (
+            moe_aux_weight * aux_vec[0] + moe_z_weight * aux_vec[1]
+        ) / dp_world
+        return obj, (ce_sum, count, aux_vec[0])
 
     return forward_ce
 
@@ -322,11 +361,18 @@ def make_pipelined_lm_train_step(
     pipe_axis: str = PIPE_AXIS,
     n_microbatches: Optional[int] = None,
     schedule: str = "gpipe",
+    moe_aux_weight: float = 0.01,
+    moe_z_weight: float = 1e-3,
 ):
     """Build the jitted DP x PP LM train step.
 
     Args:
-      model: a dense ``GPT`` (provides block geometry and dtype).
+      model: a ``GPT`` (provides block geometry and dtype) — dense or
+        MoE (``n_experts > 0``: the pipelined stages accumulate the
+        sown balance/z losses on valid ticks and both schedules train
+        against them with the dense step's layer-mean normalization;
+        the reported ``moe_aux`` is a per-microbatch estimator of the
+        same statistic, like every sharded batch view).
       mesh: 2-D ``(data, pipe)`` mesh (either axis may be 1).
       n_microbatches: microbatches per step (default: the pipe axis
         size — the minimum that keeps every stage busy; more shrinks
@@ -356,13 +402,22 @@ def make_pipelined_lm_train_step(
     n_stages = int(mesh.shape[pipe_axis])
     dp = int(mesh.shape[axis_name])
     m = n_microbatches or n_stages
+    is_moe = getattr(model, "n_experts", 0) > 0
+    n_layers = model.num_layers
     stage_fn, vocab_parallel_embed, final_ln = _shared_parts(
         model, pipe_axis
     )
-    forward_ce = _make_forward_ce(model, axis_name, pipe_axis, m)
+    forward_ce = _make_forward_ce(model, axis_name, pipe_axis, m,
+                                  moe_aux_weight, moe_z_weight)
+
+    def _metrics(loss, count, moe_aux):
+        out = {"loss": loss, "count": count}
+        if is_moe:
+            out["moe_aux"] = jax.lax.pmean(moe_aux, axis_name)
+        return out
 
     def body(state: TrainState, tokens):
-        (_, (ce_sum, count)), grads = jax.value_and_grad(
+        (_, (ce_sum, count, moe_aux)), grads = jax.value_and_grad(
             forward_ce, has_aux=True
         )(state.params, tokens)
         # NO explicit grad psums here. Under check_vma=True the vma-aware
@@ -382,7 +437,7 @@ def make_pipelined_lm_train_step(
             params=apply_updates(state.params, updates), opt_state=new_opt
         )
         loss = jax.lax.psum(ce_sum, axis_name) / count
-        return new_state, {"loss": loss, "count": count}
+        return new_state, _metrics(loss, count, moe_aux)
 
     def body_1f1b(state: TrainState, tokens):
         """Manual-VJP twin of ``body`` built on :func:`pipeline_1f1b`.
@@ -462,10 +517,27 @@ def make_pipelined_lm_train_step(
             )[..., 0]
             return jnp.sum((lse - tlogit) * wj) / count
 
-        loss_local, d_blocks, d_lp, d_micro = pipeline_1f1b(
-            stage_fn, p["blocks"], micro, mb_loss, loss_params, aux,
-            axis_name=pipe_axis,
-        )
+        dp_world = jax.lax.psum(1, axis_name)
+        if is_moe:
+            # objective adds (w_aux*A + w_z*Z) / (L*M*dp): the constant
+            # aux cotangent the schedule seeds on every backward tick
+            aux_ct = jnp.asarray(
+                [moe_aux_weight, moe_z_weight], jnp.float32
+            ) / (n_layers * m * dp_world)
+            (loss_local, d_blocks, d_lp, d_micro,
+             aux_local) = pipeline_1f1b(
+                stage_fn, p["blocks"], micro, mb_loss, loss_params,
+                aux, axis_name=pipe_axis, with_aux=True,
+                aux_cotangent=aux_ct,
+            )
+            moe_aux = jax.lax.psum(aux_local, pipe_axis)[0] / (
+                n_layers * m)
+        else:
+            loss_local, d_blocks, d_lp, d_micro = pipeline_1f1b(
+                stage_fn, p["blocks"], micro, mb_loss, loss_params,
+                aux, axis_name=pipe_axis,
+            )
+            moe_aux = jnp.zeros((), jnp.float32)
         d_fk, d_fb, d_lnf = d_lp
         # gather_vjp's psum_scatter SUMS the per-shard partials itself —
         # feed them unreduced (a pre-psum would overcount by n_stages)
@@ -490,7 +562,7 @@ def make_pipelined_lm_train_step(
             params=apply_updates(state.params, updates), opt_state=new_opt
         )
         loss = jax.lax.psum(loss_local, axis_name)
-        return new_state, {"loss": loss, "count": count}
+        return new_state, _metrics(loss, count, moe_aux)
 
     def step(state, tokens):
         if state.params["embed"].shape[0] != n_stages:
@@ -506,11 +578,14 @@ def make_pipelined_lm_train_step(
                 f"data axis x n_microbatches = {dp} x {m}"
             )
         sspec = _state_specs(state, pipe_axis)
+        mspec = {"loss": P(), "count": P()}
+        if is_moe:
+            mspec["moe_aux"] = P()
         sharded = jax.shard_map(
             body_1f1b if schedule == "1f1b" else body,
             mesh=mesh,
             in_specs=(sspec, P(axis_name)),
-            out_specs=(sspec, {"loss": P(), "count": P()}),
+            out_specs=(sspec, mspec),
         )
         return sharded(state, tokens)
 
@@ -536,7 +611,7 @@ def make_pipelined_lm_eval_step(
     forward_ce = _make_forward_ce(model, axis_name, pipe_axis, m)
 
     def body(state, tokens):
-        _, (ce_sum, count) = forward_ce(state.params, tokens)
+        _, (ce_sum, count, _aux) = forward_ce(state.params, tokens)
         loss = jax.lax.psum(ce_sum, axis_name) / count
         return {"loss": loss, "count": count}
 

@@ -47,17 +47,22 @@ def _vary(x, axis_name):
     return jax.lax.pcast(x, axis_name, to="varying")
 
 
+def _match_vma(x, vma_of):
+    """Widen ``x``'s device-varying axes to ``vma_of``'s (cotangents
+    must carry the exact vma of the output they seed)."""
+    want = getattr(jax.typeof(vma_of), "vma", frozenset())
+    have = getattr(jax.typeof(x), "vma", frozenset())
+    for ax in want - have:
+        x = jax.lax.pcast(x, ax, to="varying")
+    return x
+
+
 def _zeros_vma(shape, dtype, vma_of):
     """Zeros carrying ``vma_of``'s device-varying axes — fresh constants
     are replication-invariant, which would make a scan carry's vma
     narrower than the values written into it (jax.vjp then rejects the
     cotangents as type-mismatched)."""
-    z = jnp.zeros(shape, dtype)
-    want = getattr(jax.typeof(vma_of), "vma", frozenset())
-    have = getattr(jax.typeof(z), "vma", frozenset())
-    for ax in want - have:
-        z = jax.lax.pcast(z, ax, to="varying")
-    return z
+    return _match_vma(jnp.zeros(shape, dtype), vma_of)
 
 
 def _zeros_like_tree_vma(tree):
@@ -72,20 +77,32 @@ def pipeline_apply(
     microbatches: jax.Array,
     *,
     axis_name: str,
+    with_aux: bool = False,
 ):
     """Run the S-stage pipeline on ``M`` microbatches.
 
     Args:
       stage_fn: ``stage_fn(params, x) -> y`` with ``y.shape == x.shape``
-        (homogeneous stages — the standard PP regime).
+        (homogeneous stages — the standard PP regime). With
+        ``with_aux=True`` the contract is ``stage_fn(params, x) ->
+        (y, aux)`` where ``aux`` is a pytree of per-invocation scalars
+        (e.g. MoE balance losses).
       stage_params: THIS shard's stage parameters (pytree; leaves carry
         a leading stage dim of 1 from the ``P(axis_name)`` in_spec,
         squeezed here).
       microbatches: ``[M, mb, ...]`` replicated input microbatches.
       axis_name: the bound pipe mesh axis.
+      with_aux: accumulate the aux outputs of VALID (non-bubble) stage
+        invocations. The schedule is a plain scan, so differentiating
+        the caller's objective through the accumulated aux flows
+        gradients into routing params (and upstream activations)
+        automatically.
 
     Returns:
       ``[M, mb, ...]`` pipeline outputs, replicated across the axis.
+      With ``with_aux``: ``(outputs, aux_sum)`` where ``aux_sum`` is
+      THIS shard's sum over its valid invocations (device-varying —
+      ``psum`` over the axis for the global sum).
     """
     n = jax.lax.psum(1, axis_name)  # static python int under shard_map
     i = jax.lax.axis_index(axis_name)
@@ -100,12 +117,21 @@ def pipeline_apply(
     microbatches = _vary(microbatches, axis_name)
 
     def tick(carry, t):
-        act, out = carry
+        act, out, aux_acc = carry
         # stage 0 injects microbatch t (clipped reads feed the bubble
         # ticks; their results are masked out of `out` below)
         inj = microbatches[jnp.clip(t, 0, m - 1)]
         x = jnp.where(i == 0, inj, act)
-        y = stage_fn(params, x)
+        if with_aux:
+            y, aux_t = stage_fn(params, x)
+            # this stage computes microbatch t - i at tick t; bubble
+            # ticks process clipped garbage whose aux must not count
+            f_valid = jnp.logical_and(t - i >= 0, t - i < m)
+            aux_acc = jax.tree.map(
+                lambda a, g: a + jnp.where(f_valid, g, 0.0),
+                aux_acc, aux_t)
+        else:
+            y = stage_fn(params, x)
         # the last stage banks finished microbatch t - (n - 1)
         slot = t - (n - 1)
         valid = jnp.logical_and(
@@ -115,16 +141,25 @@ def pipeline_apply(
         out = out.at[sc].set(jnp.where(valid, y, out[sc]))
         # rotate activations one stage forward around the ring
         act = jax.lax.ppermute(y, axis_name, perm)
-        return (act, out), None
+        return (act, out, aux_acc), None
 
     act0 = jnp.zeros_like(microbatches[0])  # inherits varying-ness
     out0 = jnp.zeros_like(microbatches)
-    (act, out), _ = jax.lax.scan(
-        tick, (act0, out0), jnp.arange(m + n - 1)
+    if with_aux:
+        aux_shapes = jax.eval_shape(
+            lambda p, x: stage_fn(p, x)[1], params, microbatches[0])
+        aux0 = jax.tree.map(
+            lambda s: _zeros_vma(s.shape, s.dtype, microbatches),
+            aux_shapes)
+    else:
+        aux0 = ()
+    (act, out, aux_acc), _ = jax.lax.scan(
+        tick, (act0, out0, aux0), jnp.arange(m + n - 1)
     )
     # `out` is populated only on the last shard; replicate it
     mask = (i == n - 1).astype(out.dtype)
-    return jax.lax.psum(out * mask, axis_name)
+    out = jax.lax.psum(out * mask, axis_name)
+    return (out, aux_acc) if with_aux else out
 
 
 def pipeline_1f1b(
@@ -136,6 +171,8 @@ def pipeline_1f1b(
     aux,
     *,
     axis_name: str,
+    with_aux: bool = False,
+    aux_cotangent=None,
 ):
     """1F1B pipelined training pass: loss + grads in one schedule.
 
@@ -176,14 +213,28 @@ def pipeline_1f1b(
       aux: pytree of ``[M, ...]`` per-microbatch loss inputs (targets,
         weights); no gradients flow to it.
       axis_name: the bound pipe mesh axis.
+      with_aux: ``stage_fn(params, x) -> (y, stage_aux)`` where
+        ``stage_aux`` is a pytree of scalars (e.g. MoE balance losses).
+        The schedule then optimizes ``sum_j loss_j + <aux_cotangent,
+        sum_valid stage_aux>``: on each backward tick the aux
+        cotangent is seeded alongside the activation cotangent, so its
+        gradient reaches this stage's params AND flows upstream
+        through the cotangent ring (routing depends on the stage
+        input).
+      aux_cotangent: pytree matching ``stage_aux`` — the constant
+        d(objective)/d(stage_aux) weights (required iff ``with_aux``).
 
     Returns:
       ``(loss_sum, dstage_params, dloss_params, dmicrobatches)``:
       summed loss over microbatches (replicated over the axis), grads
       for this shard's stage params (same leading-1 shape), UNREDUCED
       per-shard loss-param grads (see above), and the ``[M, mb, ...]``
-      input cotangent (replicated over the axis).
+      input cotangent (replicated over the axis). With ``with_aux`` a
+      fifth element: THIS shard's valid-invocation aux sum
+      (device-varying — ``psum`` over the axis for the global sum).
     """
+    if with_aux and aux_cotangent is None:
+        raise ValueError("with_aux=True requires aux_cotangent")
     n = jax.lax.psum(1, axis_name)  # static python int under shard_map
     i = jax.lax.axis_index(axis_name)
     m = microbatches.shape[0]
@@ -195,6 +246,12 @@ def pipeline_1f1b(
     microbatches = _vary(microbatches, axis_name)
     aux = jax.tree.map(lambda l: _vary(l, axis_name), aux)
     loss_params = jax.tree.map(lambda l: _vary(l, axis_name), loss_params)
+    if with_aux:
+        # the stage-aux outputs inherit the microbatches' full vma (the
+        # activations they are computed from); the constant cotangent
+        # seeded into their vjp must carry the same
+        aux_cotangent = jax.tree.map(
+            lambda l: _match_vma(l, microbatches), aux_cotangent)
 
     def masked_add(acc, g, mask):
         return jax.tree.map(
@@ -202,14 +259,21 @@ def pipeline_1f1b(
         )
 
     def tick(carry, t):
-        act_in, cot_in, resid, dy_buf, dps, dlps, dmb, loss_acc = carry
+        (act_in, cot_in, resid, dy_buf, dps, dlps, dmb, loss_acc,
+         aux_acc) = carry
 
         # ---- forward: microbatch j_f = t - i flows through this stage
         j_f = t - i
         f_valid = jnp.logical_and(j_f >= 0, j_f < m)
         inj = microbatches[jnp.clip(t, 0, m - 1)]
         x_in = jnp.where(i == 0, inj, act_in)
-        y = stage_fn(params, x_in)
+        if with_aux:
+            y, aux_t = stage_fn(params, x_in)
+            aux_acc = jax.tree.map(
+                lambda a, g: a + jnp.where(f_valid, g, 0.0),
+                aux_acc, aux_t)
+        else:
+            y = stage_fn(params, x_in)
 
         # last stage: per-microbatch loss + output cotangent for j_f,
         # banked one tick (its backward runs at t + 1)
@@ -232,7 +296,15 @@ def pipeline_1f1b(
         x_saved = resid[jnp.mod(j_b, buf)]
         g_in = jnp.where(i == n - 1, dy_buf, cot_in)
         _, stage_vjp = jax.vjp(stage_fn, params, x_saved)
-        dp_j, dx_j = stage_vjp(g_in)
+        if with_aux:
+            # seed the constant aux cotangent with the activation one:
+            # the vjp routes it into this stage's params (dp_j) and
+            # upstream through dx_j. Invalid-tick contributions follow
+            # the same masking as everything else (dp masked here, dx
+            # masked at the j_b chain's accumulation points).
+            dp_j, dx_j = stage_vjp((g_in, aux_cotangent))
+        else:
+            dp_j, dx_j = stage_vjp(g_in)
         dps = masked_add(dps, dp_j, b_valid)
         sb = jnp.clip(j_b, 0, m - 1)
         take = jnp.logical_and(b_valid, i == 0)
@@ -245,11 +317,19 @@ def pipeline_1f1b(
         act_out = jax.lax.ppermute(y, axis_name, perm_fwd)
         cot_out = jax.lax.ppermute(dx_j, axis_name, perm_bwd)
         return (
-            act_out, cot_out, resid, new_dy, dps, dlps, dmb, loss_acc
+            act_out, cot_out, resid, new_dy, dps, dlps, dmb, loss_acc,
+            aux_acc
         ), None
 
     mb0 = microbatches[0]
     z = _zeros_vma(mb0.shape, mb0.dtype, mb0)
+    if with_aux:
+        aux_shapes = jax.eval_shape(
+            lambda p, x: stage_fn(p, x)[1], params, mb0)
+        aux0 = jax.tree.map(
+            lambda s: _zeros_vma(s.shape, s.dtype, mb0), aux_shapes)
+    else:
+        aux0 = ()
     carry0 = (
         z,                                                # fwd ring
         z,                                                # bwd ring
@@ -259,12 +339,15 @@ def pipeline_1f1b(
         _zeros_like_tree_vma(loss_params),
         _zeros_vma(microbatches.shape, microbatches.dtype, mb0),
         _zeros_vma((), jnp.float32, mb0),         # loss accumulator
+        aux0,                                     # stage-aux accumulator
     )
-    (_, _, _, _, dps, dlps, dmb, loss_acc), _ = jax.lax.scan(
+    (_, _, _, _, dps, dlps, dmb, loss_acc, aux_acc), _ = jax.lax.scan(
         tick, carry0, jnp.arange(m + 2 * n - 1)
     )
 
     loss_sum = jax.lax.psum(loss_acc, axis_name)  # last stage holds it
     dmb = jax.lax.psum(dmb, axis_name)            # stage 0 holds it
     dstage = jax.tree.map(lambda g: jnp.expand_dims(g, 0), dps)
+    if with_aux:
+        return loss_sum, dstage, dlps, dmb, aux_acc
     return loss_sum, dstage, dlps, dmb

@@ -57,12 +57,8 @@ def _img(*flags):
         # HF interop: dense GPTs only
         (("--hf_init", "/nonexistent.pth", "--n_experts", "2"),
          "GPT-2"),
-        # MoE knobs need experts; MoE does not pipeline (cell b —
-        # the library guard is pinned by test_gpt_pipeline.py)
+        # MoE knobs need experts
         (("--moe_top_k", "2",), "--n_experts"),
-        (("--n_experts", "2", "--parallel", "pp", "--degree", "4"),
-         "PARALLELISM.md"),
-        # pure-flag image_size guard fires before dist init too
     ],
 )
 def test_lm_guards_fire(flags, needle):

@@ -188,12 +188,6 @@ def main(args):
         raise SystemExit(
             "--zero1/--fsdp shard state through the GSPMD path; use "
             f"--parallel tp (got --parallel {args.parallel})")
-    if args.n_experts and args.parallel == 'pp':
-        raise SystemExit(
-            '--n_experts does not combine with --parallel pp: the '
-            'pipelined stages scan dense blocks; MoE routing carries '
-            'per-block aux losses that would have to flow out of the '
-            'ppermute ring (see PARALLELISM.md cell b)')
     if args.pp_schedule != 'gpipe' and args.parallel != 'pp':
         raise SystemExit(
             f"--pp_schedule {args.pp_schedule} only applies to "
@@ -328,7 +322,8 @@ def main(args):
             model, rng, sample_tok, opt, n_stages=deg,
             params=hf_params)
         step = make_pipelined_lm_train_step(
-            model, opt, mesh, schedule=args.pp_schedule)
+            model, opt, mesh, schedule=args.pp_schedule,
+            moe_aux_weight=args.moe_aux_weight)
     elif args.parallel == 'tp':
         mesh = make_mesh(dp, deg)
         state = init_state()
