@@ -285,7 +285,7 @@ def _make_forward_ce(model, axis_name, pipe_axis, m,
         micro = h.reshape(m, b // m, s, h.shape[-1])
         out = pipeline_apply(
             stage_fn, p["blocks"], micro, axis_name=pipe_axis,
-            with_aux=is_moe
+            with_stage_aux=is_moe
         )
         if is_moe:
             out, aux_local = out
@@ -527,8 +527,8 @@ def make_pipelined_lm_train_step(
             (loss_local, d_blocks, d_lp, d_micro,
              aux_local) = pipeline_1f1b(
                 stage_fn, p["blocks"], micro, mb_loss, loss_params,
-                aux, axis_name=pipe_axis, with_aux=True,
-                aux_cotangent=aux_ct,
+                aux, axis_name=pipe_axis, with_stage_aux=True,
+                stage_aux_cotangent=aux_ct,
             )
             moe_aux = jax.lax.psum(aux_local, pipe_axis)[0] / (
                 n_layers * m)

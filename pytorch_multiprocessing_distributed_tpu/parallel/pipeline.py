@@ -71,20 +71,35 @@ def _zeros_like_tree_vma(tree):
     )
 
 
+def _stage_aux_zeros(stage_fn, params, x, vma_of):
+    """Zero accumulator matching ``stage_fn``'s aux output structure
+    (shared by both schedules so their aux bookkeeping cannot drift)."""
+    aux_shapes = jax.eval_shape(lambda p, xx: stage_fn(p, xx)[1],
+                                params, x)
+    return jax.tree.map(
+        lambda s: _zeros_vma(s.shape, s.dtype, vma_of), aux_shapes)
+
+
+def _masked_aux_add(acc, aux_t, valid):
+    """Accumulate a stage-aux pytree for VALID (non-bubble) ticks only."""
+    return jax.tree.map(
+        lambda a, g: a + jnp.where(valid, g, 0.0), acc, aux_t)
+
+
 def pipeline_apply(
     stage_fn: Callable,
     stage_params,
     microbatches: jax.Array,
     *,
     axis_name: str,
-    with_aux: bool = False,
+    with_stage_aux: bool = False,
 ):
     """Run the S-stage pipeline on ``M`` microbatches.
 
     Args:
       stage_fn: ``stage_fn(params, x) -> y`` with ``y.shape == x.shape``
         (homogeneous stages — the standard PP regime). With
-        ``with_aux=True`` the contract is ``stage_fn(params, x) ->
+        ``with_stage_aux=True`` the contract is ``stage_fn(params, x) ->
         (y, aux)`` where ``aux`` is a pytree of per-invocation scalars
         (e.g. MoE balance losses).
       stage_params: THIS shard's stage parameters (pytree; leaves carry
@@ -92,7 +107,7 @@ def pipeline_apply(
         squeezed here).
       microbatches: ``[M, mb, ...]`` replicated input microbatches.
       axis_name: the bound pipe mesh axis.
-      with_aux: accumulate the aux outputs of VALID (non-bubble) stage
+      with_stage_aux: accumulate the aux outputs of VALID (non-bubble) stage
         invocations. The schedule is a plain scan, so differentiating
         the caller's objective through the accumulated aux flows
         gradients into routing params (and upstream activations)
@@ -100,7 +115,7 @@ def pipeline_apply(
 
     Returns:
       ``[M, mb, ...]`` pipeline outputs, replicated across the axis.
-      With ``with_aux``: ``(outputs, aux_sum)`` where ``aux_sum`` is
+      With ``with_stage_aux``: ``(outputs, aux_sum)`` where ``aux_sum`` is
       THIS shard's sum over its valid invocations (device-varying —
       ``psum`` over the axis for the global sum).
     """
@@ -122,14 +137,12 @@ def pipeline_apply(
         # ticks; their results are masked out of `out` below)
         inj = microbatches[jnp.clip(t, 0, m - 1)]
         x = jnp.where(i == 0, inj, act)
-        if with_aux:
+        if with_stage_aux:
             y, aux_t = stage_fn(params, x)
             # this stage computes microbatch t - i at tick t; bubble
             # ticks process clipped garbage whose aux must not count
             f_valid = jnp.logical_and(t - i >= 0, t - i < m)
-            aux_acc = jax.tree.map(
-                lambda a, g: a + jnp.where(f_valid, g, 0.0),
-                aux_acc, aux_t)
+            aux_acc = _masked_aux_add(aux_acc, aux_t, f_valid)
         else:
             y = stage_fn(params, x)
         # the last stage banks finished microbatch t - (n - 1)
@@ -145,12 +158,9 @@ def pipeline_apply(
 
     act0 = jnp.zeros_like(microbatches[0])  # inherits varying-ness
     out0 = jnp.zeros_like(microbatches)
-    if with_aux:
-        aux_shapes = jax.eval_shape(
-            lambda p, x: stage_fn(p, x)[1], params, microbatches[0])
-        aux0 = jax.tree.map(
-            lambda s: _zeros_vma(s.shape, s.dtype, microbatches),
-            aux_shapes)
+    if with_stage_aux:
+        aux0 = _stage_aux_zeros(stage_fn, params, microbatches[0],
+                                microbatches)
     else:
         aux0 = ()
     (act, out, aux_acc), _ = jax.lax.scan(
@@ -159,7 +169,7 @@ def pipeline_apply(
     # `out` is populated only on the last shard; replicate it
     mask = (i == n - 1).astype(out.dtype)
     out = jax.lax.psum(out * mask, axis_name)
-    return (out, aux_acc) if with_aux else out
+    return (out, aux_acc) if with_stage_aux else out
 
 
 def pipeline_1f1b(
@@ -171,8 +181,8 @@ def pipeline_1f1b(
     aux,
     *,
     axis_name: str,
-    with_aux: bool = False,
-    aux_cotangent=None,
+    with_stage_aux: bool = False,
+    stage_aux_cotangent=None,
 ):
     """1F1B pipelined training pass: loss + grads in one schedule.
 
@@ -213,28 +223,28 @@ def pipeline_1f1b(
       aux: pytree of ``[M, ...]`` per-microbatch loss inputs (targets,
         weights); no gradients flow to it.
       axis_name: the bound pipe mesh axis.
-      with_aux: ``stage_fn(params, x) -> (y, stage_aux)`` where
+      with_stage_aux: ``stage_fn(params, x) -> (y, stage_aux)`` where
         ``stage_aux`` is a pytree of scalars (e.g. MoE balance losses).
-        The schedule then optimizes ``sum_j loss_j + <aux_cotangent,
+        The schedule then optimizes ``sum_j loss_j + <stage_aux_cotangent,
         sum_valid stage_aux>``: on each backward tick the aux
         cotangent is seeded alongside the activation cotangent, so its
         gradient reaches this stage's params AND flows upstream
         through the cotangent ring (routing depends on the stage
         input).
-      aux_cotangent: pytree matching ``stage_aux`` — the constant
-        d(objective)/d(stage_aux) weights (required iff ``with_aux``).
+      stage_aux_cotangent: pytree matching ``stage_aux`` — the constant
+        d(objective)/d(stage_aux) weights (required iff ``with_stage_aux``).
 
     Returns:
       ``(loss_sum, dstage_params, dloss_params, dmicrobatches)``:
       summed loss over microbatches (replicated over the axis), grads
       for this shard's stage params (same leading-1 shape), UNREDUCED
       per-shard loss-param grads (see above), and the ``[M, mb, ...]``
-      input cotangent (replicated over the axis). With ``with_aux`` a
+      input cotangent (replicated over the axis). With ``with_stage_aux`` a
       fifth element: THIS shard's valid-invocation aux sum
       (device-varying — ``psum`` over the axis for the global sum).
     """
-    if with_aux and aux_cotangent is None:
-        raise ValueError("with_aux=True requires aux_cotangent")
+    if with_stage_aux and stage_aux_cotangent is None:
+        raise ValueError("with_stage_aux=True requires stage_aux_cotangent")
     n = jax.lax.psum(1, axis_name)  # static python int under shard_map
     i = jax.lax.axis_index(axis_name)
     m = microbatches.shape[0]
@@ -246,12 +256,12 @@ def pipeline_1f1b(
     microbatches = _vary(microbatches, axis_name)
     aux = jax.tree.map(lambda l: _vary(l, axis_name), aux)
     loss_params = jax.tree.map(lambda l: _vary(l, axis_name), loss_params)
-    if with_aux:
+    if with_stage_aux:
         # the stage-aux outputs inherit the microbatches' full vma (the
         # activations they are computed from); the constant cotangent
         # seeded into their vjp must carry the same
-        aux_cotangent = jax.tree.map(
-            lambda l: _match_vma(l, microbatches), aux_cotangent)
+        stage_aux_cotangent = jax.tree.map(
+            lambda l: _match_vma(l, microbatches), stage_aux_cotangent)
 
     def masked_add(acc, g, mask):
         return jax.tree.map(
@@ -267,11 +277,9 @@ def pipeline_1f1b(
         f_valid = jnp.logical_and(j_f >= 0, j_f < m)
         inj = microbatches[jnp.clip(t, 0, m - 1)]
         x_in = jnp.where(i == 0, inj, act_in)
-        if with_aux:
+        if with_stage_aux:
             y, aux_t = stage_fn(params, x_in)
-            aux_acc = jax.tree.map(
-                lambda a, g: a + jnp.where(f_valid, g, 0.0),
-                aux_acc, aux_t)
+            aux_acc = _masked_aux_add(aux_acc, aux_t, f_valid)
         else:
             y = stage_fn(params, x_in)
 
@@ -296,13 +304,13 @@ def pipeline_1f1b(
         x_saved = resid[jnp.mod(j_b, buf)]
         g_in = jnp.where(i == n - 1, dy_buf, cot_in)
         _, stage_vjp = jax.vjp(stage_fn, params, x_saved)
-        if with_aux:
+        if with_stage_aux:
             # seed the constant aux cotangent with the activation one:
             # the vjp routes it into this stage's params (dp_j) and
             # upstream through dx_j. Invalid-tick contributions follow
             # the same masking as everything else (dp masked here, dx
             # masked at the j_b chain's accumulation points).
-            dp_j, dx_j = stage_vjp((g_in, aux_cotangent))
+            dp_j, dx_j = stage_vjp((g_in, stage_aux_cotangent))
         else:
             dp_j, dx_j = stage_vjp(g_in)
         dps = masked_add(dps, dp_j, b_valid)
@@ -323,11 +331,8 @@ def pipeline_1f1b(
 
     mb0 = microbatches[0]
     z = _zeros_vma(mb0.shape, mb0.dtype, mb0)
-    if with_aux:
-        aux_shapes = jax.eval_shape(
-            lambda p, x: stage_fn(p, x)[1], params, mb0)
-        aux0 = jax.tree.map(
-            lambda s: _zeros_vma(s.shape, s.dtype, mb0), aux_shapes)
+    if with_stage_aux:
+        aux0 = _stage_aux_zeros(stage_fn, params, mb0, mb0)
     else:
         aux0 = ()
     carry0 = (
@@ -348,6 +353,6 @@ def pipeline_1f1b(
     loss_sum = jax.lax.psum(loss_acc, axis_name)  # last stage holds it
     dmb = jax.lax.psum(dmb, axis_name)            # stage 0 holds it
     dstage = jax.tree.map(lambda g: jnp.expand_dims(g, 0), dps)
-    if with_aux:
+    if with_stage_aux:
         return loss_sum, dstage, dlps, dmb, aux_acc
     return loss_sum, dstage, dlps, dmb
