@@ -148,3 +148,24 @@ def test_tp_decode_validation(gpt):
                             ("pipe",))
     with pytest.raises(ValueError, match="model"):
         generate(model, params, prompt, max_new_tokens=2, mesh=bad)
+
+
+def test_tp_decode_moe_matches_single_shard():
+    """MoE + TP decode: expert MLP weights shard on their trailing dim
+    like every other kernel (tp_param_spec); routed decode stays
+    token-exact vs single-shard."""
+    from pytorch_multiprocessing_distributed_tpu.inference import (
+        shard_params_for_tp_decode)
+    from pytorch_multiprocessing_distributed_tpu.parallel import make_mesh
+
+    model = models.get_model(
+        "gpt_tiny", n_experts=2, moe_capacity_factor=2.0,
+        attn_impl="xla")
+    tokens = jnp.asarray(np.random.default_rng(5).integers(
+        0, model.vocab_size, (2, 12)))
+    params = model.init(jax.random.PRNGKey(1), tokens)["params"]
+    mesh = make_mesh(2, 4)
+    tp_params = shard_params_for_tp_decode(params, mesh)
+    single = generate(model, params, tokens, max_new_tokens=6)
+    tp = generate(model, tp_params, tokens, max_new_tokens=6, mesh=mesh)
+    np.testing.assert_array_equal(np.asarray(single), np.asarray(tp))
