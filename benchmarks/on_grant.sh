@@ -19,8 +19,9 @@ $T python benchmarks/record_baselines.py --missing
 note "2. per-op profile of the MFU-gap config (resnet50)"
 $T python benchmarks/profile_step.py --config resnet50_imagenet
 
-note "3. resnet50 geometry probes: batch 128 + remat (HBM-pressure hypothesis)"
+note "3. resnet50 geometry probes: batch 128/512 + remat (HBM-pressure hypothesis)"
 $T python bench.py --config resnet50_imagenet --batch_size 128
+$T python bench.py --config resnet50_imagenet --batch_size 512
 $T python bench.py --config resnet50_imagenet --remat
 
 note "4. MFU flag sweep (short: the profile + probes above pick the lever)"

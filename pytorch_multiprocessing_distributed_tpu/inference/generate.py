@@ -202,7 +202,7 @@ def _logits(params, x, eps, cs=_no_cs):
     return out
 
 
-def _sample(logits, temperature, top_k, key):
+def _sample(logits, temperature, top_k, top_p, key):
     """[B, V] logits -> [B] tokens (greedy when temperature == 0)."""
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1)
@@ -210,11 +210,25 @@ def _sample(logits, temperature, top_k, key):
     if top_k:
         kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p and top_p < 1.0:
+        # nucleus: keep the smallest prefix of probability-sorted tokens
+        # whose cumulative mass reaches top_p (the top token always
+        # stays; probability ties at the cut are kept together).
+        # top_p=1.0 is a true no-op ABOVE, not here: f32 cumsum on a
+        # big vocab can hit 1.0 early and drop tail tokens
+        probs = jax.nn.softmax(logits, axis=-1)
+        sorted_p = jnp.sort(probs, axis=-1)[:, ::-1]
+        before = jnp.cumsum(sorted_p, axis=-1) - sorted_p
+        kept = before < top_p
+        cut = jnp.min(jnp.where(kept, sorted_p, jnp.inf), axis=-1,
+                      keepdims=True)
+        logits = jnp.where(probs >= cut, logits, -jnp.inf)
     return jax.random.categorical(key, logits, axis=-1)
 
 
 @partial(jax.jit, static_argnames=("model", "max_new_tokens",
-                                   "temperature", "top_k", "mesh"))
+                                   "temperature", "top_k", "top_p",
+                                   "mesh"))
 def generate(
     model,
     params,
@@ -223,6 +237,7 @@ def generate(
     max_new_tokens: int,
     temperature: float = 0.0,
     top_k: int = 0,
+    top_p: float = 0.0,
     rng: Optional[jax.Array] = None,
     mesh: Optional[Mesh] = None,
 ) -> jax.Array:
@@ -242,6 +257,9 @@ def generate(
         model.max_seq_len``.
       temperature: 0 = greedy; else softmax temperature sampling.
       top_k: restrict sampling to the k highest logits (0 = full vocab).
+      top_p: nucleus sampling — restrict to the smallest set of tokens
+        whose cumulative probability reaches ``top_p`` (0 = off;
+        composes with ``top_k``, applied after it).
       rng: PRNGKey (required when temperature > 0).
       mesh: optional ``Mesh`` with a ``model`` axis: attention heads,
         KV caches and the vocab dim of the head matmul are then sharded
@@ -263,6 +281,8 @@ def generate(
             f"top_k must be in [0, vocab_size={model.vocab_size}], "
             f"got {top_k}"
         )
+    if not 0.0 <= top_p <= 1.0:
+        raise ValueError(f"top_p must be in [0, 1], got {top_p}")
     if s_max > model.max_seq_len:
         raise ValueError(
             f"prompt {t} + max_new_tokens {max_new_tokens} exceeds "
@@ -316,7 +336,7 @@ def generate(
 
     keys = (jax.random.split(rng, max_new_tokens) if rng is not None
             else jnp.zeros((max_new_tokens, 2), jnp.uint32))
-    tok0 = _sample(first_logits, temperature, top_k, keys[0])
+    tok0 = _sample(first_logits, temperature, top_k, top_p, keys[0])
 
     def step(carry, inp):
         tok, k_caches, v_caches = carry
@@ -330,7 +350,7 @@ def generate(
             new_k.append(kc)
             new_v.append(vc)
         logits = _logits(params, x_t, eps, cs)[:, 0]
-        nxt = _sample(logits, temperature, top_k, key)
+        nxt = _sample(logits, temperature, top_k, top_p, key)
         return (nxt, cs_cache(jnp.stack(new_k)),
                 cs_cache(jnp.stack(new_v))), tok
 

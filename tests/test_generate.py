@@ -169,3 +169,25 @@ def test_tp_decode_moe_matches_single_shard():
     single = generate(model, params, tokens, max_new_tokens=6)
     tp = generate(model, tp_params, tokens, max_new_tokens=6, mesh=mesh)
     np.testing.assert_array_equal(np.asarray(single), np.asarray(tp))
+
+
+def test_top_p_nucleus_semantics(gpt):
+    """top_p=1.0 keeps the full distribution (identical draw to plain
+    sampling under the same key); a tiny top_p collapses to greedy;
+    out-of-range values are rejected."""
+    model, params, prompt = gpt
+    key = jax.random.PRNGKey(9)
+    full = generate(model, params, prompt, max_new_tokens=6,
+                    temperature=1.0, rng=key)
+    p1 = generate(model, params, prompt, max_new_tokens=6,
+                  temperature=1.0, top_p=1.0, rng=key)
+    np.testing.assert_array_equal(np.asarray(full), np.asarray(p1))
+
+    tiny = generate(model, params, prompt, max_new_tokens=6,
+                    temperature=1.0, top_p=1e-9, rng=key)
+    ref = _naive_greedy(model, params, prompt, 6)
+    np.testing.assert_array_equal(np.asarray(tiny), np.asarray(ref))
+
+    with pytest.raises(ValueError, match="top_p"):
+        generate(model, params, prompt, max_new_tokens=2,
+                 temperature=1.0, top_p=1.5, rng=key)

@@ -226,3 +226,49 @@ def test_cli_text_corpus_byte_level(tmp_path):
     # epoch-average over ONE epoch from random init: already below the
     # uniform-vocab baseline (ln 257 ~ 5.55) on byte-level English
     assert loss < 5.0
+
+
+@pytest.mark.slow
+def test_cli_resume_continues_training(tmp_path):
+    """--save_every checkpoints mid-run; --resume auto continues the
+    epoch series (log numbering + LR schedule) instead of restarting,
+    exactly like main.py's resume."""
+    out_dir = tmp_path / "run"
+    env = dict(os.environ, PMDT_FORCE_CPU_DEVICES="8")
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    base = [sys.executable, os.path.join(REPO, "train_lm.py"),
+            "--model", "gpt_tiny", "--batch_size", "16",
+            "--seq_len", "64", "--corpus_tokens", "12000",
+            "--save_path", str(out_dir)]
+    first = subprocess.run(
+        base + ["--epochs", "2", "--save_every", "1"],
+        env=env, capture_output=True, text=True, timeout=560, cwd=REPO)
+    assert first.returncode == 0, first.stdout + first.stderr
+    assert (out_dir / "model_1.pth").exists()  # periodic
+    assert (out_dir / "model_2.pth").exists()  # final
+    rows1 = (out_dir / "train.log").read_text().strip().splitlines()
+    assert len(rows1) == 2
+
+    second = subprocess.run(
+        base + ["--epochs", "3", "--resume", "auto"],
+        env=env, capture_output=True, text=True, timeout=560, cwd=REPO)
+    assert second.returncode == 0, second.stdout + second.stderr
+    assert "Resumed from" in second.stdout
+    assert "Epoch: [3]" in second.stdout
+    assert "Epoch: [1]" not in second.stdout  # did NOT restart
+    assert (out_dir / "model_3.pth").exists()
+    rows2 = (out_dir / "train.log").read_text().strip().splitlines()
+    # the resumed run appends epoch 3 only
+    assert len(rows2) == 3 and rows2[:2] == rows1
+    assert rows2[2].split()[0] == "0003"
+
+    # resume PAST --epochs: trains nothing and must NOT relabel an
+    # earlier checkpoint with later-epoch weights
+    before = (out_dir / "model_2.pth").read_bytes()
+    third = subprocess.run(
+        base + ["--epochs", "2", "--resume", "auto"],
+        env=env, capture_output=True, text=True, timeout=560, cwd=REPO)
+    assert third.returncode == 0, third.stdout + third.stderr
+    assert "nothing to train" in third.stdout
+    assert (out_dir / "model_2.pth").read_bytes() == before

@@ -50,6 +50,13 @@ parser.add_argument('--lr_schedule', default='constant',
                          '--warmup_epochs linear warmup')
 parser.add_argument('--warmup_epochs', default=0, type=int)
 parser.add_argument('--save_path', default='./lm_run/', type=str)
+parser.add_argument('--resume', default='', type=str,
+                    help="checkpoint path to resume from, or 'auto' = "
+                         "latest model_<epoch>.pth under --save_path "
+                         "(same semantics as main.py)")
+parser.add_argument('--save_every', default=0, type=int,
+                    help='also checkpoint every N epochs (0 = final '
+                         'epoch only)')
 parser.add_argument('--print_freq', default=10, type=int)
 parser.add_argument('--seed', default=0, type=int)
 parser.add_argument('--corpus', default='', type=str,
@@ -131,7 +138,7 @@ def main(args):
     from pytorch_multiprocessing_distributed_tpu.parallel import (
         dist, make_mesh)
     from pytorch_multiprocessing_distributed_tpu.train.checkpoint import (
-        save_checkpoint)
+        load_checkpoint, resolve_auto_resume, save_checkpoint)
     from pytorch_multiprocessing_distributed_tpu.train.lm import (
         create_lm_train_state, make_lm_train_step, make_lm_train_step_tp)
     from pytorch_multiprocessing_distributed_tpu.train.optim import sgd
@@ -161,6 +168,12 @@ def main(args):
         # GPT-2 configuration: its LN eps, and no head-bias slot — the
         # export must not have to drop a trained parameter
         model_kw.update(ln_eps=1e-5, head_bias=False)
+    if args.resume and args.hf_init:
+        raise SystemExit(
+            '--resume restores a full TrainState; --hf_init seeds '
+            'fresh initial weights — pick one')
+    if args.save_every < 0:
+        raise SystemExit(f'--save_every must be >= 0, got {args.save_every}')
     model = models.get_model(args.model, **model_kw)
     hf_params = None
     if args.hf_init:
@@ -313,6 +326,29 @@ def main(args):
                 params=jax.tree.map(jnp.asarray, hf_params))
         return st
 
+    # --resume: same main.py semantics (auto = primary host's latest
+    # model_<epoch>.pth broadcast to everyone; resolve AFTER dist init).
+    # The template the checkpoint restores into is each branch's
+    # freshly-built state — incl. the pipe-stacked tree for pp — so the
+    # round trip is structural, BEFORE any GSPMD placement.
+    resume_path = args.resume
+    if resume_path == 'auto':
+        resume_path = resolve_auto_resume(args.save_path) or ''
+        if not resume_path and dist.is_primary():
+            print(f"--resume auto: no checkpoint under "
+                  f"{args.save_path}; starting fresh", flush=True)
+    start_epoch = 1
+
+    def maybe_resume(st):
+        nonlocal start_epoch
+        if resume_path:
+            st = load_checkpoint(resume_path, st)
+            start_epoch = int(st.epoch) + 1
+            if dist.is_primary():
+                print(f"Resumed from {resume_path} (continuing at "
+                      f"epoch {start_epoch})", flush=True)
+        return st
+
     if args.parallel == 'pp':
         from pytorch_multiprocessing_distributed_tpu.parallel import (
             create_pipelined_lm_state, make_pipelined_lm_train_step)
@@ -321,12 +357,13 @@ def main(args):
         state = create_pipelined_lm_state(
             model, rng, sample_tok, opt, n_stages=deg,
             params=hf_params)
+        state = maybe_resume(state)
         step = make_pipelined_lm_train_step(
             model, opt, mesh, schedule=args.pp_schedule,
             moe_aux_weight=args.moe_aux_weight)
     elif args.parallel == 'tp':
         mesh = make_mesh(dp, deg)
-        state = init_state()
+        state = maybe_resume(init_state())
         state = shard_state(state, mesh, zero1=args.zero1, fsdp=args.fsdp)
         step = make_lm_train_step_tp(
             model, opt, mesh, zero1=args.zero1, fsdp=args.fsdp,
@@ -335,7 +372,7 @@ def main(args):
         axes = ('data', 'seq') if args.parallel == 'sp' else ('data',)
         mesh = (make_mesh(dp, deg, axis_names=axes)
                 if args.parallel == 'sp' else make_mesh(dp))
-        state = init_state()
+        state = maybe_resume(init_state())
         step = make_lm_train_step(
             model, opt, mesh,
             seq_axis='seq' if args.parallel == 'sp' else None,
@@ -366,7 +403,7 @@ def main(args):
     logger = Logger(os.path.join(args.save_path, 'train.log'))
     test_logger = (Logger(os.path.join(args.save_path, 'test.log'))
                    if val_loader is not None else None)
-    for epoch in range(1, args.epochs + 1):
+    for epoch in range(start_epoch, args.epochs + 1):
         state = state.replace(epoch=jnp.asarray(epoch, jnp.int32))
         loader.set_epoch(epoch)
         t0, losses, seen = time.time(), 0.0, 0
@@ -407,6 +444,11 @@ def main(args):
                       f"PPL {math.exp(min(vloss, 20.0)):.2f}", flush=True)
                 test_logger.write(
                     [epoch, vloss, math.exp(min(vloss, 20.0))])
+        if (args.save_every and epoch % args.save_every == 0
+                and epoch < args.epochs):
+            # periodic checkpoint (collective gather inside; the final
+            # epoch is saved once below)
+            save_checkpoint(args.save_path, state, epoch)
     if args.hf_export:
         from pytorch_multiprocessing_distributed_tpu.train.checkpoint import (
             _gather_for_host)
@@ -415,7 +457,14 @@ def main(args):
         # leaves are fully addressable, so save_checkpoint's internal
         # gather becomes a no-op pass-through
         state = _gather_for_host(state)
-    save_checkpoint(args.save_path, state, args.epochs)
+    if start_epoch <= args.epochs:
+        save_checkpoint(args.save_path, state, args.epochs)
+    elif dist.is_primary():
+        # resume landed past --epochs: nothing trained, and rewriting
+        # model_{epochs}.pth would relabel a LATER-epoch state
+        print(f"--resume: checkpoint already at epoch "
+              f"{start_epoch - 1} >= --epochs {args.epochs}; "
+              "nothing to train", flush=True)
     if args.hf_export:
         from pytorch_multiprocessing_distributed_tpu.utils.gpt_interop import (
             save_gpt2_checkpoint)
