@@ -282,20 +282,8 @@ def main(args):
 
         ck = OrbaxCheckpointer(args.save_path)
         if args.resume == "auto":
+            # latest_epoch broadcasts the primary's verdict itself
             epoch = ck.latest_epoch()
-            if jax.process_count() > 1:
-                # the PRIMARY's verdict decides for every host — per-host
-                # resolution can disagree (NFS attribute-cache staleness,
-                # partially visible steps) and misaligned start epochs
-                # deadlock the per-epoch collectives; same pattern as
-                # checkpoint.resolve_auto_resume
-                import numpy as _np
-                from jax.experimental import multihost_utils
-
-                epoch = int(multihost_utils.broadcast_one_to_all(
-                    _np.int32(-1 if epoch is None else epoch)
-                ))
-                epoch = None if epoch < 0 else epoch
         else:
             try:
                 epoch = int(args.resume)

@@ -25,6 +25,8 @@ from __future__ import annotations
 import os
 from typing import Optional
 
+import jax
+
 from .state import TrainState
 
 
@@ -104,7 +106,23 @@ class OrbaxCheckpointer:
         )
 
     def latest_epoch(self) -> Optional[int]:
-        return self.manager.latest_step()
+        """Newest saved epoch, PRIMARY-verdict-broadcast under
+        multi-host: per-host resolution can disagree (NFS
+        attribute-cache staleness, partially visible OCDBT commits)
+        and misaligned start epochs deadlock the per-epoch
+        collectives — same pattern as
+        ``checkpoint.resolve_auto_resume``. Every caller gets the
+        broadcast for free (main.py previously inlined it)."""
+        epoch = self.manager.latest_step()
+        if jax.process_count() > 1:
+            import numpy as np
+            from jax.experimental import multihost_utils
+
+            epoch = int(multihost_utils.broadcast_one_to_all(
+                np.int32(-1 if epoch is None else epoch)
+            ))
+            epoch = None if epoch < 0 else epoch
+        return epoch
 
     def wait(self) -> None:
         """Block until any in-flight async save is durable."""

@@ -272,3 +272,37 @@ def test_cli_resume_continues_training(tmp_path):
     assert third.returncode == 0, third.stdout + third.stderr
     assert "nothing to train" in third.stdout
     assert (out_dir / "model_2.pth").read_bytes() == before
+
+
+@pytest.mark.slow
+def test_cli_orbax_backend_resume(tmp_path):
+    """--ckpt_backend orbax on the LM CLI: epoch-keyed sharded saves
+    under {save_path}/orbax/, --resume auto continues the series (the
+    image CLI's semantics, test_e2e.py::test_cli_orbax_backend_resume)."""
+    pytest.importorskip("orbax.checkpoint")
+    out_dir = tmp_path / "run"
+    env = dict(os.environ, PMDT_FORCE_CPU_DEVICES="8")
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    base = [sys.executable, os.path.join(REPO, "train_lm.py"),
+            "--model", "gpt_tiny", "--batch_size", "16",
+            "--seq_len", "64", "--corpus_tokens", "12000",
+            "--ckpt_backend", "orbax", "--save_path", str(out_dir)]
+    first = subprocess.run(
+        base + ["--epochs", "2", "--save_every", "1"],
+        env=env, capture_output=True, text=True, timeout=560, cwd=REPO)
+    assert first.returncode == 0, first.stdout + first.stderr
+    assert (out_dir / "orbax" / "1").exists()
+    assert (out_dir / "orbax" / "2").exists()
+    assert not (out_dir / "model_2.pth").exists()  # orbax, not msgpack
+
+    second = subprocess.run(
+        base + ["--epochs", "3", "--resume", "auto"],
+        env=env, capture_output=True, text=True, timeout=560, cwd=REPO)
+    assert second.returncode == 0, second.stdout + second.stderr
+    assert "Resumed from" in second.stdout
+    assert "Epoch: [3]" in second.stdout
+    assert "Epoch: [1]" not in second.stdout
+    assert (out_dir / "orbax" / "3").exists()
+    rows = (out_dir / "train.log").read_text().strip().splitlines()
+    assert len(rows) == 3 and rows[2].split()[0] == "0003"

@@ -57,6 +57,16 @@ parser.add_argument('--resume', default='', type=str,
 parser.add_argument('--save_every', default=0, type=int,
                     help='also checkpoint every N epochs (0 = final '
                          'epoch only)')
+parser.add_argument('--ckpt_backend', default='msgpack',
+                    choices=['msgpack', 'orbax'],
+                    help='msgpack = single-file model_<epoch>.pth; '
+                         'orbax = sharded per-host OCDBT writes under '
+                         '{save_path}/orbax/ (multi-host scale; '
+                         '--resume takes auto or an epoch number)')
+parser.add_argument('--ckpt_async', action='store_true',
+                    help='orbax only: overlap periodic saves with '
+                         'training (final save stays durable-before-'
+                         'exit)')
 parser.add_argument('--print_freq', default=10, type=int)
 parser.add_argument('--seed', default=0, type=int)
 parser.add_argument('--corpus', default='', type=str,
@@ -174,6 +184,17 @@ def main(args):
             'fresh initial weights — pick one')
     if args.save_every < 0:
         raise SystemExit(f'--save_every must be >= 0, got {args.save_every}')
+    if args.ckpt_async and args.ckpt_backend != 'orbax':
+        raise SystemExit('--ckpt_async applies to --ckpt_backend orbax')
+    if args.ckpt_backend == 'orbax' and args.resume not in ('', 'auto'):
+        try:
+            int(args.resume)
+        except ValueError:
+            raise SystemExit(
+                f"--ckpt_backend orbax: --resume must be 'auto' or an "
+                f"epoch number (orbax checkpoints are epoch-keyed "
+                f"directories under {{save_path}}/orbax/), got "
+                f"{args.resume!r}")
     model = models.get_model(args.model, **model_kw)
     hf_params = None
     if args.hf_init:
@@ -327,12 +348,26 @@ def main(args):
         return st
 
     # --resume: same main.py semantics (auto = primary host's latest
-    # model_<epoch>.pth broadcast to everyone; resolve AFTER dist init).
-    # The template the checkpoint restores into is each branch's
+    # checkpoint broadcast to everyone; resolve AFTER dist init). The
+    # template the checkpoint restores into is each branch's
     # freshly-built state — incl. the pipe-stacked tree for pp — so the
     # round trip is structural, BEFORE any GSPMD placement.
+    ck = None
     resume_path = args.resume
-    if resume_path == 'auto':
+    resume_epoch = None
+    if args.ckpt_backend == 'orbax':
+        from pytorch_multiprocessing_distributed_tpu.train.orbax_ckpt import (
+            OrbaxCheckpointer)
+
+        ck = OrbaxCheckpointer(args.save_path, async_=args.ckpt_async)
+        if args.resume == 'auto':
+            resume_epoch = ck.latest_epoch()
+            if resume_epoch is None and dist.is_primary():
+                print(f"--resume auto: no orbax checkpoint under "
+                      f"{ck.directory}; starting fresh", flush=True)
+        elif args.resume:
+            resume_epoch = int(args.resume)
+    elif resume_path == 'auto':
         resume_path = resolve_auto_resume(args.save_path) or ''
         if not resume_path and dist.is_primary():
             print(f"--resume auto: no checkpoint under "
@@ -341,7 +376,13 @@ def main(args):
 
     def maybe_resume(st):
         nonlocal start_epoch
-        if resume_path:
+        if ck is not None and resume_epoch is not None:
+            st = jax.device_get(ck.restore(st, resume_epoch))
+            start_epoch = int(st.epoch) + 1
+            if dist.is_primary():
+                print(f"Resumed from {ck.directory}/{resume_epoch} "
+                      f"(continuing at epoch {start_epoch})", flush=True)
+        elif ck is None and resume_path:
             st = load_checkpoint(resume_path, st)
             start_epoch = int(st.epoch) + 1
             if dist.is_primary():
@@ -446,9 +487,12 @@ def main(args):
                     [epoch, vloss, math.exp(min(vloss, 20.0))])
         if (args.save_every and epoch % args.save_every == 0
                 and epoch < args.epochs):
-            # periodic checkpoint (collective gather inside; the final
-            # epoch is saved once below)
-            save_checkpoint(args.save_path, state, epoch)
+            # periodic checkpoint (collective; the final epoch is
+            # saved once below)
+            if ck is not None:
+                ck.save(state, epoch)
+            else:
+                save_checkpoint(args.save_path, state, epoch)
     if args.hf_export:
         from pytorch_multiprocessing_distributed_tpu.train.checkpoint import (
             _gather_for_host)
@@ -458,7 +502,11 @@ def main(args):
         # gather becomes a no-op pass-through
         state = _gather_for_host(state)
     if start_epoch <= args.epochs:
-        save_checkpoint(args.save_path, state, args.epochs)
+        if ck is not None:
+            ck.save(state, args.epochs)
+            ck.wait()  # final save durable before exit
+        else:
+            save_checkpoint(args.save_path, state, args.epochs)
     elif dist.is_primary():
         # resume landed past --epochs: nothing trained, and rewriting
         # model_{epochs}.pth would relabel a LATER-epoch state
@@ -522,6 +570,8 @@ def main(args):
 
                 print("sample text:", repr(detokenize(ids)), flush=True)
 
+    if ck is not None:
+        ck.close()
     dist.destroy_process_group()
 
 
