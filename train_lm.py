@@ -444,16 +444,30 @@ def main(args):
     logger = Logger(os.path.join(args.save_path, 'train.log'))
     test_logger = (Logger(os.path.join(args.save_path, 'test.log'))
                    if val_loader is not None else None)
+    from pytorch_multiprocessing_distributed_tpu.data.pipeline import (
+        prefetch_to_device)
+
+    # dp/sp single-host: double-buffered async H2D (the image Trainer's
+    # discipline) — the NEXT batch's transfer is enqueued while the
+    # current step computes. Multi-host keeps shard_batch: TokenLoader
+    # yields the GLOBAL batch on every host, which is exactly what
+    # device_put slices (prefetch's multihost path expects per-host
+    # local rows instead). tp/pp steps take the host array directly.
+    use_prefetch = (args.parallel in ('dp', 'sp')
+                    and jax.process_count() == 1)
     for epoch in range(start_epoch, args.epochs + 1):
         state = state.replace(epoch=jnp.asarray(epoch, jnp.int32))
         loader.set_epoch(epoch)
         t0, losses, seen = time.time(), 0.0, 0
-        for i, batch in enumerate(loader):
-            tok = jnp.asarray(batch)
-            if args.parallel in ('tp', 'pp'):
-                state, metrics = step(state, tok)
+        batches = (prefetch_to_device(loader, mesh) if use_prefetch
+                   else loader)
+        for i, batch in enumerate(batches):
+            if use_prefetch:
+                state, metrics = step(state, batch)
+            elif args.parallel in ('tp', 'pp'):
+                state, metrics = step(state, jnp.asarray(batch))
             else:
-                (tok_sharded,) = shard_batch((tok,), mesh)
+                (tok_sharded,) = shard_batch((jnp.asarray(batch),), mesh)
                 state, metrics = step(state, tok_sharded)
             if i % args.print_freq == 0 or i == len(loader) - 1:
                 loss = float(np.asarray(metrics['loss']))
