@@ -306,3 +306,24 @@ def test_cli_orbax_backend_resume(tmp_path):
     assert (out_dir / "orbax" / "3").exists()
     rows = (out_dir / "train.log").read_text().strip().splitlines()
     assert len(rows) == 3 and rows[2].split()[0] == "0003"
+
+
+@pytest.mark.slow
+def test_cli_keep_checkpoints_prunes_series(tmp_path):
+    """--keep_checkpoints bounds the --save_every series (msgpack
+    backend; orbax retention lives in the manager)."""
+    out_dir = tmp_path / "run"
+    env = dict(os.environ, PMDT_FORCE_CPU_DEVICES="8")
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "train_lm.py"),
+         "--model", "gpt_tiny", "--batch_size", "16", "--seq_len", "64",
+         "--corpus_tokens", "12000", "--epochs", "3",
+         "--save_every", "1", "--keep_checkpoints", "1",
+         "--save_path", str(out_dir)],
+        env=env, capture_output=True, text=True, timeout=560, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert not (out_dir / "model_1.pth").exists()  # pruned
+    assert (out_dir / "model_2.pth").exists()      # newest periodic
+    assert (out_dir / "model_3.pth").exists()      # final

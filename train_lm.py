@@ -57,6 +57,9 @@ parser.add_argument('--resume', default='', type=str,
 parser.add_argument('--save_every', default=0, type=int,
                     help='also checkpoint every N epochs (0 = final '
                          'epoch only)')
+parser.add_argument('--keep_checkpoints', default=0, type=int,
+                    help='retain only the newest K checkpoints of the '
+                         '--save_every series (0 = keep all)')
 parser.add_argument('--ckpt_backend', default='msgpack',
                     choices=['msgpack', 'orbax'],
                     help='msgpack = single-file model_<epoch>.pth; '
@@ -359,7 +362,8 @@ def main(args):
         from pytorch_multiprocessing_distributed_tpu.train.orbax_ckpt import (
             OrbaxCheckpointer)
 
-        ck = OrbaxCheckpointer(args.save_path, async_=args.ckpt_async)
+        ck = OrbaxCheckpointer(args.save_path, async_=args.ckpt_async,
+                               keep=args.keep_checkpoints or None)
         if args.resume == 'auto':
             resume_epoch = ck.latest_epoch()
             if resume_epoch is None and dist.is_primary():
@@ -504,9 +508,15 @@ def main(args):
             # periodic checkpoint (collective; the final epoch is
             # saved once below)
             if ck is not None:
-                ck.save(state, epoch)
+                ck.save(state, epoch)  # retention inside the manager
             else:
                 save_checkpoint(args.save_path, state, epoch)
+                if args.keep_checkpoints and dist.is_primary():
+                    from pytorch_multiprocessing_distributed_tpu.train.checkpoint import (
+                        prune_checkpoints)
+
+                    prune_checkpoints(args.save_path,
+                                      args.keep_checkpoints)
     if args.hf_export:
         from pytorch_multiprocessing_distributed_tpu.train.checkpoint import (
             _gather_for_host)
