@@ -151,7 +151,8 @@ def main(args):
     from pytorch_multiprocessing_distributed_tpu.parallel import (
         dist, make_mesh)
     from pytorch_multiprocessing_distributed_tpu.train.checkpoint import (
-        load_checkpoint, resolve_auto_resume, save_checkpoint)
+        load_checkpoint, prune_checkpoints, resolve_auto_resume,
+        save_checkpoint)
     from pytorch_multiprocessing_distributed_tpu.train.lm import (
         create_lm_train_state, make_lm_train_step, make_lm_train_step_tp)
     from pytorch_multiprocessing_distributed_tpu.train.optim import sgd
@@ -512,9 +513,6 @@ def main(args):
             else:
                 save_checkpoint(args.save_path, state, epoch)
                 if args.keep_checkpoints and dist.is_primary():
-                    from pytorch_multiprocessing_distributed_tpu.train.checkpoint import (
-                        prune_checkpoints)
-
                     prune_checkpoints(args.save_path,
                                       args.keep_checkpoints)
     if args.hf_export:
@@ -531,6 +529,11 @@ def main(args):
             ck.wait()  # final save durable before exit
         else:
             save_checkpoint(args.save_path, state, args.epochs)
+            # prune after EVERY save (Trainer semantics): retention
+            # means "newest K overall", identically on both backends
+            # (orbax's max_to_keep counts the final save too)
+            if args.keep_checkpoints and dist.is_primary():
+                prune_checkpoints(args.save_path, args.keep_checkpoints)
     elif dist.is_primary():
         # resume landed past --epochs: nothing trained, and rewriting
         # model_{epochs}.pth would relabel a LATER-epoch state
