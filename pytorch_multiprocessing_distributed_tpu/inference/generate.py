@@ -135,8 +135,13 @@ def _split_heads(t, h):
     return t.reshape(b, s, h, d // h)
 
 
-def _block_prefill(p, x, h, dtype, eps, cs=_no_cs, top_k=1):
-    """Full causal pass over the prompt; returns (y, k, v)."""
+def _block_prefill(p, x, h, dtype, eps, cs=_no_cs, top_k=1,
+                   kv_valid=None):
+    """Full causal pass over the prompt; returns (y, k, v).
+    ``kv_valid`` ([B, s] bool, optional): key-column validity for
+    left-padded ragged batches — pad columns never receive attention
+    mass; pad QUERIES fall back to attending (only) themselves so the
+    softmax stays finite (their outputs are never consumed)."""
     b, s, _ = x.shape
     hn = _ln(x, p["ln1"], eps).astype(dtype)
     q, k, v = jnp.split(_dense(hn, p["attn"]["wqkv"], dtype), 3, axis=-1)
@@ -150,6 +155,11 @@ def _block_prefill(p, x, h, dtype, eps, cs=_no_cs, top_k=1):
     logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                         k.astype(jnp.float32)) * scale
     mask = jnp.tril(jnp.ones((s, s), bool))
+    if kv_valid is not None:
+        mask = jnp.logical_or(
+            jnp.logical_and(mask, kv_valid[:, None, None, :]),
+            jnp.eye(s, dtype=bool)[None, None],
+        )
     probs = jax.nn.softmax(jnp.where(mask, logits, -jnp.inf), axis=-1)
     att = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
     att = att.reshape(b, s, -1).astype(dtype)
@@ -158,8 +168,10 @@ def _block_prefill(p, x, h, dtype, eps, cs=_no_cs, top_k=1):
 
 
 def _block_decode(p, x_t, k_cache, v_cache, pos, h, dtype, eps,
-                  cs=_no_cs, top_k=1):
-    """One cached step: x_t [B, 1, D]; caches [B, S, H, Dh]."""
+                  cs=_no_cs, top_k=1, kv_valid=None):
+    """One cached step: x_t [B, 1, D]; caches [B, S, H, Dh].
+    ``kv_valid`` ([B, S] bool, optional): excludes left-pad cache
+    columns from attention for ragged batches."""
     b = x_t.shape[0]
     hn = _ln(x_t, p["ln1"], eps).astype(dtype)
     q, k, v = jnp.split(_dense(hn, p["attn"]["wqkv"], dtype), 3, axis=-1)
@@ -172,9 +184,11 @@ def _block_decode(p, x_t, k_cache, v_cache, pos, h, dtype, eps,
     scale = q.shape[-1] ** -0.5
     logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                         k_cache.astype(jnp.float32)) * scale  # [B,H,1,S]
-    mask = jnp.arange(k_cache.shape[1]) <= pos
+    mask = (jnp.arange(k_cache.shape[1]) <= pos)[None, :]
+    if kv_valid is not None:
+        mask = jnp.logical_and(mask, kv_valid)
     probs = jax.nn.softmax(
-        jnp.where(mask[None, None, None, :], logits, -jnp.inf), axis=-1)
+        jnp.where(mask[:, None, None, :], logits, -jnp.inf), axis=-1)
     att = jnp.einsum("bhqk,bkhd->bqhd", probs,
                      v_cache.astype(jnp.float32))
     att = att.reshape(b, 1, -1).astype(dtype)
@@ -182,10 +196,18 @@ def _block_decode(p, x_t, k_cache, v_cache, pos, h, dtype, eps,
     return (x_t + _ffn(p, x_t, dtype, eps, top_k), k_cache, v_cache)
 
 
-def _embed(params, tokens, pos_start, dtype):
+def _embed(params, tokens, pos_start, dtype, offsets=None):
     s = tokens.shape[1]
-    pos = jax.lax.dynamic_slice_in_dim(
-        params["pos_embed"], pos_start, s, axis=0)
+    if offsets is None:
+        pos = jax.lax.dynamic_slice_in_dim(
+            params["pos_embed"], pos_start, s, axis=0)
+    else:
+        # ragged left-padded batch: row i's first REAL token sits at
+        # column offsets[i] and must get position 0; pad columns clamp
+        # to position 0 (their embeddings are never attended to)
+        ids = jnp.maximum(
+            pos_start + jnp.arange(s)[None, :] - offsets[:, None], 0)
+        pos = params["pos_embed"][ids]  # [B, s, D]
     # cast-then-add, exactly as GPT.__call__ does: under bf16,
     # bf16(a) + bf16(b) != bf16(a + b) and the drift flips tokens
     return (params["embed"][tokens].astype(dtype) + pos.astype(dtype))
@@ -240,6 +262,7 @@ def generate(
     top_p: float = 0.0,
     rng: Optional[jax.Array] = None,
     mesh: Optional[Mesh] = None,
+    prompt_lengths: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Generate ``max_new_tokens`` continuations of ``prompt``.
 
@@ -261,6 +284,15 @@ def generate(
         whose cumulative probability reaches ``top_p`` (0 = off;
         composes with ``top_k``, applied after it).
       rng: PRNGKey (required when temperature > 0).
+      prompt_lengths: optional ``[B]`` int array for RAGGED batches:
+        each row of ``prompt`` must be LEFT-padded to the common
+        length ``T`` with its real tokens in columns ``[T - L_i, T)``
+        (any pad token id works — pad columns are excluded from
+        attention and get clamped positions, so their values never
+        influence the output). Row ``i`` then generates exactly what a
+        single-row call on its unpadded prompt would (test-pinned).
+        Caller contract: ``1 <= L_i <= T`` (traced values — not
+        validated at trace time).
       mesh: optional ``Mesh`` with a ``model`` axis: attention heads,
         KV caches and the vocab dim of the head matmul are then sharded
         over it (Megatron-style TP decode, prefill AND decode). The
@@ -306,6 +338,18 @@ def generate(
             raise ValueError(
                 f"num_heads={model.num_heads} not divisible by the "
                 f"model axis size {tp}")
+    offsets = None
+    kv_valid = None
+    if prompt_lengths is not None:
+        if prompt_lengths.shape != (b,):
+            raise ValueError(
+                f"prompt_lengths must have shape ({b},), got "
+                f"{prompt_lengths.shape}")
+        offsets = (t - prompt_lengths).astype(jnp.int32)  # [B]
+        # key-column validity over the FULL cache: pad columns
+        # [0, offset) never receive attention; prompt + generated
+        # columns do
+        kv_valid = jnp.arange(s_max)[None, :] >= offsets[:, None]
     cs = _make_cs(mesh)
     dtype = model.dtype
     eps = getattr(model, "ln_eps", _LN_EPS)
@@ -321,14 +365,16 @@ def generate(
         return cs(c, None, None, None, "model", None)
 
     # ---- prefill: one vectorized causal pass, caches written [0, t)
-    x = _embed(params, prompt, 0, dtype)
+    x = _embed(params, prompt, 0, dtype, offsets)
     k_caches = cs_cache(jnp.zeros((n_layers, b, s_max, h, head_dim),
                                   dtype))
     v_caches = cs_cache(jnp.zeros((n_layers, b, s_max, h, head_dim),
                                   dtype))
     for i in range(n_layers):
         x, k, v = _block_prefill(params[f"block_{i}"], x, h, dtype,
-                                 eps, cs, moe_k)
+                                 eps, cs, moe_k,
+                                 None if kv_valid is None
+                                 else kv_valid[:, :t])
         k_caches = k_caches.at[i, :, :t].set(k.astype(dtype))
         v_caches = v_caches.at[i, :, :t].set(v.astype(dtype))
     k_caches, v_caches = cs_cache(k_caches), cs_cache(v_caches)
@@ -341,12 +387,12 @@ def generate(
     def step(carry, inp):
         tok, k_caches, v_caches = carry
         pos, key = inp
-        x_t = _embed(params, tok[:, None], pos, dtype)
+        x_t = _embed(params, tok[:, None], pos, dtype, offsets)
         new_k, new_v = [], []
         for i in range(n_layers):
             x_t, kc, vc = _block_decode(
                 params[f"block_{i}"], x_t, k_caches[i], v_caches[i],
-                pos, h, dtype, eps, cs, moe_k)
+                pos, h, dtype, eps, cs, moe_k, kv_valid)
             new_k.append(kc)
             new_v.append(vc)
         logits = _logits(params, x_t, eps, cs)[:, 0]
