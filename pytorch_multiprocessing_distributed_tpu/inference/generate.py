@@ -224,6 +224,38 @@ def _logits(params, x, eps, cs=_no_cs):
     return out
 
 
+def _prefill(model, params, prompt, s_max, *, cs=_no_cs,
+             cs_cache=None, offsets=None, kv_valid=None):
+    """One vectorized causal pass over the prompt; returns ``(x,
+    k_caches, v_caches)`` with caches ``[L, B, s_max, H, Dh]`` written
+    on ``[0, t)``. ONE copy shared by :func:`generate` and
+    :func:`beam_search` so their prefills cannot drift (dtype/eps/MoE
+    conventions all come from ``model`` here)."""
+    b, t = prompt.shape
+    dtype = model.dtype
+    eps = getattr(model, "ln_eps", _LN_EPS)
+    moe_k = getattr(model, "moe_top_k", 1)
+    h = model.num_heads
+    head_dim = model.hidden_size // h
+    n_layers = model.num_layers
+    if cs_cache is None:
+        def cs_cache(c):
+            return c
+    x = _embed(params, prompt, 0, dtype, offsets)
+    k_caches = cs_cache(jnp.zeros((n_layers, b, s_max, h, head_dim),
+                                  dtype))
+    v_caches = cs_cache(jnp.zeros((n_layers, b, s_max, h, head_dim),
+                                  dtype))
+    for i in range(n_layers):
+        x, k, v = _block_prefill(params[f"block_{i}"], x, h, dtype,
+                                 eps, cs, moe_k,
+                                 None if kv_valid is None
+                                 else kv_valid[:, :t])
+        k_caches = k_caches.at[i, :, :t].set(k.astype(dtype))
+        v_caches = v_caches.at[i, :, :t].set(v.astype(dtype))
+    return x, cs_cache(k_caches), cs_cache(v_caches)
+
+
 def _sample(logits, temperature, top_k, top_p, key):
     """[B, V] logits -> [B] tokens (greedy when temperature == 0)."""
     if temperature == 0.0:
@@ -365,19 +397,9 @@ def generate(
         return cs(c, None, None, None, "model", None)
 
     # ---- prefill: one vectorized causal pass, caches written [0, t)
-    x = _embed(params, prompt, 0, dtype, offsets)
-    k_caches = cs_cache(jnp.zeros((n_layers, b, s_max, h, head_dim),
-                                  dtype))
-    v_caches = cs_cache(jnp.zeros((n_layers, b, s_max, h, head_dim),
-                                  dtype))
-    for i in range(n_layers):
-        x, k, v = _block_prefill(params[f"block_{i}"], x, h, dtype,
-                                 eps, cs, moe_k,
-                                 None if kv_valid is None
-                                 else kv_valid[:, :t])
-        k_caches = k_caches.at[i, :, :t].set(k.astype(dtype))
-        v_caches = v_caches.at[i, :, :t].set(v.astype(dtype))
-    k_caches, v_caches = cs_cache(k_caches), cs_cache(v_caches)
+    x, k_caches, v_caches = _prefill(
+        model, params, prompt, s_max, cs=cs, cs_cache=cs_cache,
+        offsets=offsets, kv_valid=kv_valid)
     first_logits = _logits(params, x[:, -1:], eps, cs)[:, 0]  # [B, V]
 
     keys = (jax.random.split(rng, max_new_tokens) if rng is not None
@@ -411,3 +433,120 @@ def generate(
     else:
         generated = tok0[:, None]
     return jnp.concatenate([prompt, generated], axis=1)
+
+
+@partial(jax.jit, static_argnames=("model", "max_new_tokens",
+                                   "beam_size"))
+def beam_search(
+    model,
+    params,
+    prompt: jax.Array,
+    *,
+    max_new_tokens: int,
+    beam_size: int,
+) -> tuple:
+    """Beam-search decoding over the same KV-cached machinery.
+
+    Standard log-probability beam search, no length penalty (scores
+    are summed token log-probs — document-level reranking belongs to
+    the caller). ``beam_size=1`` is exactly greedy :func:`generate`,
+    and ``beam_size >= V**(max_new_tokens-1)`` is exhaustive (the
+    tiny-vocab test pins beam == brute-force argmax).
+
+    Args:
+      model: the ``GPT`` the params belong to (dense or MoE; pass the
+        dense clone of an SP model).
+      prompt: ``[B, T]`` int tokens (uniform length).
+      beam_size: beams kept per batch row.
+
+    Returns ``(tokens, scores)``: ``tokens`` ``[B, K, T +
+    max_new_tokens]`` (prompt included), ``scores`` ``[B, K]`` summed
+    log-probs, both sorted best-first along K.
+    """
+    b, t = prompt.shape
+    s_max = t + max_new_tokens
+    k_beams = beam_size
+    if max_new_tokens < 1:
+        raise ValueError(
+            f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if k_beams < 1 or k_beams > model.vocab_size:
+        raise ValueError(
+            f"beam_size must be in [1, vocab_size={model.vocab_size}], "
+            f"got {k_beams}")
+    if s_max > model.max_seq_len:
+        raise ValueError(
+            f"prompt {t} + max_new_tokens {max_new_tokens} exceeds "
+            f"max_seq_len={model.max_seq_len}")
+    if getattr(model, "seq_axis", None) is not None:
+        raise NotImplementedError(
+            "beam_search wants the dense view of an SP model — pass "
+            "model.clone(seq_axis=None)")
+    dtype = model.dtype
+    eps = getattr(model, "ln_eps", _LN_EPS)
+    moe_k = getattr(model, "moe_top_k", 1)
+    h = model.num_heads
+    n_layers = model.num_layers
+    v_size = model.vocab_size
+
+    # ---- prefill once on the B prompts (the SAME shared pass
+    # generate uses — dtype/eps/MoE conventions cannot drift)
+    x, k_caches, v_caches = _prefill(model, params, prompt, s_max)
+    logp0 = jax.nn.log_softmax(
+        _logits(params, x[:, -1:], eps)[:, 0], axis=-1)  # [B, V]
+
+    # ---- seed K beams from the top-K first tokens
+    scores, tok = jax.lax.top_k(logp0, k_beams)  # [B, K] both
+    # caches tiled per beam: [L, B*K, S, H, Dh] (row b*K + j = beam j)
+    def tile(c):
+        return jnp.repeat(c, k_beams, axis=1)
+
+    k_caches, v_caches = tile(k_caches), tile(v_caches)
+    history = jnp.zeros((b, k_beams, max_new_tokens), jnp.int32)
+    history = history.at[:, :, 0].set(tok)
+
+    def step(carry, inp):
+        tok, scores, history, k_caches, v_caches = carry
+        pos, j = inp
+        x_t = _embed(params, tok.reshape(b * k_beams, 1), pos, dtype)
+        new_k, new_v = [], []
+        for i in range(n_layers):
+            x_t, kc, vc = _block_decode(
+                params[f"block_{i}"], x_t, k_caches[i], v_caches[i],
+                pos, h, dtype, eps, _no_cs, moe_k)
+            new_k.append(kc)
+            new_v.append(vc)
+        k_caches, v_caches = jnp.stack(new_k), jnp.stack(new_v)
+        logp = jax.nn.log_softmax(
+            _logits(params, x_t, eps)[:, 0], axis=-1
+        ).reshape(b, k_beams, v_size)
+        total = scores[:, :, None] + logp  # [B, K, V]
+        scores, flat = jax.lax.top_k(
+            total.reshape(b, k_beams * v_size), k_beams)
+        beam_idx = flat // v_size  # [B, K] surviving parent beams
+        tok = flat % v_size
+
+        def reindex(buf):
+            # [L, B*K, ...] -> gather surviving parents per batch row
+            l = buf.shape[0]
+            r = buf.reshape((l, b, k_beams) + buf.shape[2:])
+            idx = beam_idx.reshape(
+                (1, b, k_beams) + (1,) * (buf.ndim - 2))
+            r = jnp.take_along_axis(r, idx, axis=2)
+            return r.reshape(buf.shape)
+
+        k_caches, v_caches = reindex(k_caches), reindex(v_caches)
+        history = jnp.take_along_axis(
+            history, beam_idx[:, :, None], axis=1)
+        history = history.at[:, :, j].set(tok)
+        return (tok, scores, history, k_caches, v_caches), None
+
+    if max_new_tokens > 1:
+        positions = jnp.arange(t, s_max - 1)
+        steps = jnp.arange(1, max_new_tokens)
+        (tok, scores, history, _, _), _ = jax.lax.scan(
+            step, (tok, scores, history, k_caches, v_caches),
+            (positions, steps))
+
+    prompt_k = jnp.broadcast_to(
+        prompt[:, None, :], (b, k_beams, t))
+    return jnp.concatenate([prompt_k, history], axis=2), scores
