@@ -301,3 +301,22 @@ def test_beam_search_k1_is_greedy_moe():
     ref = generate(model, params, tokens, max_new_tokens=5)
     np.testing.assert_array_equal(np.asarray(toks[:, 0]),
                                   np.asarray(ref))
+
+
+def test_beam_search_batch_rows_independent(gpt):
+    """B=2 x K=3: each batch row's beams equal a single-row call on
+    that prompt alone — pins the per-row parent-beam reindex (cache +
+    history gathers) against cross-row contamination."""
+    from pytorch_multiprocessing_distributed_tpu.inference import (
+        beam_search)
+
+    model, params, prompt = gpt  # [2, 12], two different prompts
+    toks, scores = beam_search(model, params, prompt,
+                               max_new_tokens=5, beam_size=3)
+    for i in range(2):
+        ti, si = beam_search(model, params, prompt[i:i + 1],
+                             max_new_tokens=5, beam_size=3)
+        np.testing.assert_array_equal(np.asarray(toks[i]),
+                                      np.asarray(ti[0]))
+        np.testing.assert_allclose(np.asarray(scores[i]),
+                                   np.asarray(si[0]), rtol=1e-6)

@@ -88,3 +88,9 @@ def test_image_guards_fire(flags, needle):
     assert proc.returncode != 0, proc.stdout
     assert needle in proc.stderr + proc.stdout, (
         flags, proc.stderr[-800:])
+
+
+def test_sample_beams_needs_sample():
+    proc = _lm("--sample_beams", "2")
+    assert proc.returncode != 0
+    assert "--sample" in proc.stderr + proc.stdout

@@ -127,8 +127,13 @@ parser.add_argument('--hf_export', action='store_true',
                          'biasless head (GPT-2 has no head-bias slot); '
                          'dense dp/sp/tp models only')
 parser.add_argument('--sample', default=0, type=int,
-                    help='after training, print N greedy-sampled tokens '
-                         '(dense dp/tp models only)')
+                    help='after training, print N decoded continuation '
+                         'tokens (any --parallel; greedy unless '
+                         '--sample_beams)')
+parser.add_argument('--sample_beams', default=0, type=int,
+                    help='> 1: decode --sample tokens with beam search '
+                         'of this width instead of greedy (prints the '
+                         'best beam)')
 
 
 def main(args):
@@ -247,6 +252,14 @@ def main(args):
     if args.val_frac and not 0.0 < args.val_frac < 1.0:
         raise SystemExit(
             f"--val_frac must be in (0, 1), got {args.val_frac}")
+    if args.sample_beams and not args.sample:
+        raise SystemExit('--sample_beams needs --sample N')
+    if args.sample_beams and not (
+            1 <= args.sample_beams <= model.vocab_size):
+        # fail BEFORE the training run, not at decode time after it
+        raise SystemExit(
+            f'--sample_beams must be in [1, vocab_size='
+            f'{model.vocab_size}], got {args.sample_beams}')
     if args.sample:
         if args.seq_len + args.sample > model.max_seq_len:
             raise SystemExit(
@@ -559,16 +572,27 @@ def main(args):
 
     if args.sample:
         from pytorch_multiprocessing_distributed_tpu.inference import (
-            generate)
+            beam_search, generate)
 
         dense = model.clone(seq_axis=None)
         prompt = jnp.asarray(tokens[: args.seq_len][None, :])
+
+        def decode(params, **kw):
+            if args.sample_beams > 1:
+                toks, _ = beam_search(dense, params, prompt,
+                                      max_new_tokens=args.sample,
+                                      beam_size=args.sample_beams)
+                return toks[:, 0]  # best beam
+            return generate(dense, params, prompt,
+                            max_new_tokens=args.sample, **kw)
+
         if (args.parallel == 'tp' and not (args.zero1 or args.fsdp)
-                and model.num_heads % deg == 0 and not args.n_experts):
+                and model.num_heads % deg == 0 and not args.n_experts
+                and args.sample_beams <= 1):
             # decode the GSPMD-sharded params where they live: TP
             # decode shards heads/KV-cache/vocab over the model axis
-            out = generate(dense, state.params, prompt,
-                           max_new_tokens=args.sample, mesh=mesh)
+            # (greedy only — beam search decodes gathered params below)
+            out = decode(state.params, mesh=mesh)
         else:
             # every other trained state decodes single-shard: sp params
             # are already the dense tree (replicated), pp restacks, MoE
@@ -586,8 +610,7 @@ def main(args):
 
                 params = unstack_pipeline_params(
                     params, model.vocab_size)
-            out = generate(dense, params, prompt,
-                           max_new_tokens=args.sample)
+            out = decode(params)
         if dist.is_primary():
             ids = np.asarray(out[0, -args.sample:]).tolist()
             print("sample:", ids)
