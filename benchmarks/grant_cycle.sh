@@ -12,11 +12,20 @@ while true; do
   echo "[cycle] grant detected $(date -u +%FT%TZ)"
   bash benchmarks/on_grant.sh
   echo "[cycle] capture finished $(date -u +%FT%TZ); committing artifacts"
-  git add benchmarks/baseline_record.json benchmarks/mfu_tune_results.json \
-      benchmarks/attention_bench_tpu.txt benchmarks/generate_bench_tpu.txt \
-      benchmarks/convergence_record.json 2>/dev/null
-  git diff --cached --quiet || git commit -q -m \
-      "TPU grant-window capture: baseline/profile/attention/decode artifacts"
+  # pathspec'd commit: operator-staged files must never be swept into
+  # the unattended capture commit. Added one by one — git add is
+  # all-or-nothing on missing pathspecs, and a window that produced
+  # only SOME artifacts must still commit those
+  artifacts="benchmarks/baseline_record.json benchmarks/mfu_tune_results.json
+      benchmarks/attention_bench_tpu.txt benchmarks/generate_bench_tpu.txt
+      benchmarks/serving_bench_tpu.txt benchmarks/convergence_record.json"
+  for a in $artifacts; do git add "$a" 2>/dev/null; done
+  # commit only the SUCCESSFULLY staged artifacts: a pathspec naming a
+  # file git has never seen aborts the whole commit (nothing lands)
+  staged=$(git diff --cached --name-only -- $artifacts)
+  [ -z "$staged" ] || git commit -q -m \
+      "TPU grant-window capture: baseline/profile/attention/decode artifacts" \
+      -- $staged
   rm -f .tpu_alive
   # patient re-probe for the next window (tpu_watch exits on success)
   bash benchmarks/tpu_watch.sh 120

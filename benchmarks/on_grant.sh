@@ -53,7 +53,21 @@ else
   tail -4 "$tmp" >&2; rm -f "$tmp"
 fi
 
+note "6b. serving throughput (continuous batching: load vs tok/s + TTFT)"
+tmp=$(mktemp)
+if $T python benchmarks/serving_bench.py > "$tmp" 2>&1; then
+  mv "$tmp" benchmarks/serving_bench_tpu.txt
+  tail -7 benchmarks/serving_bench_tpu.txt >&2
+else
+  echo "serving bench failed; keeping prior artifact" >&2
+  tail -4 "$tmp" >&2; rm -f "$tmp"
+fi
+
 note "7. cross-hardware convergence (framework on TPU vs torch on CPU)"
-$T python benchmarks/convergence.py --epochs 4 --train_size 1024
+# scaled milestones: the committed convergence_record.json records the
+# milestone-stabilized protocol — a no-decay short run must not
+# overwrite it with an unstable terminal state
+$T python benchmarks/convergence.py --epochs 6 --milestones 4,5 \
+    --train_size 1024
 
 note "done — review artifacts, then commit"

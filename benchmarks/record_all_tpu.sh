@@ -27,6 +27,10 @@ note "generate bench"
 python benchmarks/generate_bench.py > benchmarks/generate_bench_tpu.txt 2>&1
 tail -4 benchmarks/generate_bench_tpu.txt >&2
 
+note "serving bench (continuous batching: load vs tok/s + TTFT)"
+python benchmarks/serving_bench.py > benchmarks/serving_bench_tpu.txt 2>&1
+tail -7 benchmarks/serving_bench_tpu.txt >&2
+
 note "MFU tune sweep (resnet50 north star)"
 python benchmarks/mfu_tune.py --config resnet50_imagenet
 

@@ -1,0 +1,145 @@
+"""Serving-engine throughput: offered load vs tokens/sec and TTFT.
+
+Drives the continuous-batching :class:`ServingEngine` with an
+open-loop request stream (arrival times fixed in advance — the load
+does NOT slow down when the server lags, which is what "heavy traffic"
+means) at several slot counts, and reports per-point:
+
+- delivered tokens/sec (decode throughput across the run);
+- TTFT mean/p95 (submit -> first token, queueing included);
+- mean slot occupancy and queue depth (is the pool or the arrival
+  process the bottleneck?).
+
+``offered=inf`` is the closed-loop limit: every request submitted
+up front, measuring peak engine throughput. CPU-runnable (shapes clamp
+down off-TPU, same convention as ``generate_bench.py``), TPU-ready.
+
+Run: ``python benchmarks/serving_bench.py [--model gpt_small]
+[--slots 2,4,8] [--offered inf,8]``
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import benchmarks._common as _common  # noqa: E402
+
+
+def _percentile(values, q):
+    return float(np.percentile(np.asarray(values), q)) if values else 0.0
+
+
+def run_point(model, params, prompts, new_tokens, slots, offered_rps,
+              s_max):
+    from pytorch_multiprocessing_distributed_tpu.serving import (
+        ServingEngine)
+
+    engine = ServingEngine(model, params, max_slots=slots, s_max=s_max)
+    # arrival schedule: evenly spaced at the offered rate (inf = all at
+    # t=0). Open loop — lateness accumulates if the engine can't keep up
+    arrivals = ([0.0] * len(prompts) if offered_rps == float("inf")
+                else [i / offered_rps for i in range(len(prompts))])
+    t_start = time.perf_counter()
+    pending = list(zip(prompts, arrivals))
+    finished = []
+    while pending or engine.scheduler.queue_depth or engine.pool.occupancy:
+        now = time.perf_counter() - t_start
+        while pending and pending[0][1] <= now:
+            prompt, _ = pending.pop(0)
+            engine.submit(prompt, new_tokens)
+        if engine.scheduler.queue_depth or engine.pool.occupancy:
+            for request, _, done in engine.step():
+                if done:
+                    finished.append(request)
+        elif pending:
+            time.sleep(min(0.005, pending[0][1] - now))
+    wall = time.perf_counter() - t_start
+    ttfts = [r.first_token_time - r.submit_time for r in finished]
+    total_tokens = sum(len(r.tokens) for r in finished)
+    return {
+        "completed": len(finished),
+        "wall_s": wall,
+        "tokens_per_sec": total_tokens / wall,
+        "ttft_avg_ms": 1e3 * float(np.mean(ttfts)),
+        "ttft_p95_ms": 1e3 * _percentile(ttfts, 95),
+        "occupancy_avg": engine.metrics.occupancy.avg,
+        "queue_depth_avg": engine.metrics.queue_depth.avg,
+        "decode_compiles": engine.decode_step_compiles,
+    }
+
+
+def main():
+    _common.apply_platform_env()
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="gpt_small")
+    p.add_argument("--requests", default=32, type=int)
+    p.add_argument("--prompt_max", default=96, type=int,
+                   help="ragged prompt lengths drawn in "
+                        "[prompt_max//4, prompt_max]")
+    p.add_argument("--new_tokens", default=64, type=int)
+    p.add_argument("--slots", default="2,4,8", type=str)
+    p.add_argument("--offered", default="inf,8", type=str,
+                   help="offered loads in requests/sec ('inf' = all "
+                        "submitted up front)")
+    p.add_argument("--dtype", default="bfloat16",
+                   choices=["float32", "bfloat16"])
+    args = p.parse_args()
+
+    from pytorch_multiprocessing_distributed_tpu import models
+    from pytorch_multiprocessing_distributed_tpu.serving import (
+        init_params)
+
+    platform = jax.devices()[0].platform
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    if platform != "tpu":
+        args.model = "gpt_tiny"
+        args.requests = min(args.requests, 8)
+        args.prompt_max = min(args.prompt_max, 24)
+        args.new_tokens = min(args.new_tokens, 8)
+        dtype = jnp.float32
+    model = models.get_model(
+        args.model, dtype=dtype,
+        attn_impl="flash" if platform == "tpu" else "xla")
+    params = init_params(model)
+    rng = np.random.default_rng(0)
+    s_max = min(model.max_seq_len, args.prompt_max + args.new_tokens)
+    # prompts must pass static-fit admission: len + new_tokens <= s_max
+    prompt_hi = s_max - args.new_tokens
+    if prompt_hi < 1:
+        raise SystemExit(
+            f"--new_tokens {args.new_tokens} leaves no room for a "
+            f"prompt within s_max={s_max} "
+            f"(max_seq_len={model.max_seq_len})")
+    prompts = [
+        rng.integers(0, model.vocab_size,
+                     (int(rng.integers(max(1, prompt_hi // 4),
+                                       prompt_hi + 1)),)).tolist()
+        for _ in range(args.requests)]
+    print(f"# platform={platform} model={args.model} "
+          f"requests={args.requests} prompt<= {args.prompt_max} "
+          f"new={args.new_tokens} s_max={s_max}")
+
+    for slots in [int(x) for x in args.slots.split(",")]:
+        for load in args.offered.split(","):
+            rps = float("inf") if load == "inf" else float(load)
+            r = run_point(model, params, prompts, args.new_tokens,
+                          slots, rps, s_max)
+            print(f"slots={slots:3d} offered={load:>5s} req/s  "
+                  f"completed={r['completed']:3d}  "
+                  f"{r['tokens_per_sec']:9.1f} tok/s  "
+                  f"ttft avg={r['ttft_avg_ms']:8.1f} ms "
+                  f"p95={r['ttft_p95_ms']:8.1f} ms  "
+                  f"occ={r['occupancy_avg']:5.2f} "
+                  f"queue={r['queue_depth_avg']:5.2f} "
+                  f"(compiles={r['decode_compiles']})", flush=True)
+
+
+if __name__ == "__main__":
+    main()

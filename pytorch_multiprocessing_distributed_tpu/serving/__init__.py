@@ -1,0 +1,19 @@
+"""Serving: continuous-batching request engine over the KV-cache decode.
+
+The inference half of the north star ("serve heavy traffic"): a
+slot-based engine (``engine``) whose jitted decode step has ONE
+compiled signature regardless of which requests occupy the pool
+(``kv_slots``), fed by a FIFO scheduler with admission control
+(``scheduler``), loading trained checkpoints param-only (``params``).
+CLI: repo-root ``serve_lm.py``.
+"""
+
+from .engine import ServingEngine
+from .kv_slots import SlotPool
+from .params import init_params, load_params
+from .scheduler import FIFOScheduler, QueueFull, Request
+
+__all__ = [
+    "ServingEngine", "SlotPool", "FIFOScheduler", "QueueFull",
+    "Request", "init_params", "load_params",
+]

@@ -1,0 +1,419 @@
+"""Continuous-batching serving engine over the shared KV-cache decode.
+
+``inference.generate`` is a one-shot, fixed-batch program: B prompts in,
+B continuations out, everything retired together. A serving workload is
+the opposite shape — requests arrive whenever, finish whenever — and
+the naive answer (re-invoke ``generate`` per batch composition) would
+recompile or at best re-prefill constantly. This engine converts the
+same ``_prefill``/cached-attention machinery into a persistent loop with
+ONE compiled decode signature:
+
+- the KV cache is a :class:`~.kv_slots.SlotPool` — fixed
+  ``[layers, max_slots, s_max, heads, head_dim]`` arrays, per-slot
+  position counters, an active mask;
+- a joining request is prefilled ALONE (the shared
+  ``inference.generate._prefill``, right-padded to a power-of-two
+  bucket so prefill compiles per bucket, not per length), its caches
+  are spliced into a free slot, and its first token is sampled from the
+  prefill logits — exactly ``generate``'s ``tok0`` path;
+- every engine step then runs one batched decode over ALL slots with
+  per-slot positions; occupancy only changes mask *values*, so the
+  jitted step compiles exactly once for the engine's lifetime
+  (``decode_step_compiles`` pins it via
+  ``utils.compile_cache.jit_cache_size``);
+- finished slots (EOS / ``max_new_tokens``) are recycled in place —
+  stale cache columns are masked until the next tenant overwrites them
+  (see ``kv_slots`` invariants).
+
+Greedy decode through the engine is token-for-token identical to
+per-request ``generate`` calls (test-pinned, dense and MoE): same
+helpers, same dtype/eps conventions, per-slot positions in place of the
+scan counter. With ``mesh`` the caches and attention shard over the
+``model`` axis exactly like TP ``generate`` — single-host TP serving.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..inference.generate import (
+    _LN_EPS, _dense, _ffn, _ln, _logits, _make_cs, _prefill, _sample,
+    _split_heads)
+from ..utils.compile_cache import jit_cache_size
+from ..utils.metrics import ServingMetrics
+from .kv_slots import SlotPool
+from .scheduler import DONE, FIFOScheduler, Request
+
+__all__ = ["ServingEngine", "Request"]
+
+
+def _bucket(length: int, min_bucket: int, s_max: int) -> int:
+    """Smallest power-of-two >= length (floored at ``min_bucket``,
+    capped at ``s_max``): prefill compiles once per bucket instead of
+    once per prompt length."""
+    b = min_bucket
+    while b < length:
+        b *= 2
+    return min(b, s_max)
+
+
+class ServingEngine:
+    """Slot-based continuous-batching driver.
+
+    Args:
+      model: dense-view ``GPT`` (pass ``model.clone(seq_axis=None)``
+        for an SP-trained model — identical params). MoE models serve
+        with dropless routing, like ``generate``.
+      params: plain GPT param tree. For TP serving place it with
+        :func:`..inference.shard_params_for_tp_decode` first.
+      max_slots: concurrent requests decoded per step (the pool size).
+      s_max: per-slot token capacity (default ``model.max_seq_len``).
+      mesh: optional ``Mesh`` with a ``model`` axis — Megatron-style TP
+        decode, same semantics/validation as ``generate(mesh=...)``.
+      max_queue: bound on QUEUED requests (None = unbounded);
+        ``submit`` raises :class:`~.scheduler.QueueFull` beyond it.
+      temperature/top_k/top_p: sampling config, engine-wide statics
+        (0/0/0 = greedy). NOTE: greedy is the mode pinned equivalent to
+        ``generate``; sampled streams draw from a per-step key shared
+        across slots, so they are reproducible per engine run but not
+        comparable to per-request ``generate`` draws.
+      rng: PRNGKey, required when ``temperature > 0``.
+      eos_id: default stop token (per-request ``eos_id`` overrides).
+      min_bucket: smallest prefill bucket (power of two).
+    """
+
+    def __init__(self, model, params, *, max_slots: int,
+                 s_max: Optional[int] = None, mesh: Optional[Mesh] = None,
+                 max_queue: Optional[int] = None, temperature: float = 0.0,
+                 top_k: int = 0, top_p: float = 0.0,
+                 rng: Optional[jax.Array] = None,
+                 eos_id: Optional[int] = None, min_bucket: int = 16):
+        if getattr(model, "seq_axis", None) is not None:
+            raise NotImplementedError(
+                "the engine wants the dense view of an SP model — pass "
+                "model.clone(seq_axis=None) (identical params)")
+        if mesh is not None:
+            if "model" not in mesh.axis_names:
+                raise ValueError(
+                    f"TP serving needs a 'model' mesh axis, got "
+                    f"{mesh.axis_names}")
+            tp = int(mesh.shape["model"])
+            if model.num_heads % tp:
+                raise ValueError(
+                    f"num_heads={model.num_heads} not divisible by the "
+                    f"model axis size {tp}")
+        if temperature > 0.0 and rng is None:
+            raise ValueError("sampling (temperature > 0) requires rng")
+        if top_k < 0 or top_k > model.vocab_size:
+            raise ValueError(
+                f"top_k must be in [0, vocab_size={model.vocab_size}], "
+                f"got {top_k}")
+        if not 0.0 <= top_p <= 1.0:
+            raise ValueError(f"top_p must be in [0, 1], got {top_p}")
+        if min_bucket < 1:
+            raise ValueError(
+                f"min_bucket must be >= 1, got {min_bucket}")
+        self.model = model
+        self.params = params
+        self.mesh = mesh
+        self.eos_id = eos_id
+        self.min_bucket = int(min_bucket)
+        self.pool = SlotPool(model, max_slots, s_max, mesh)
+        self.scheduler = FIFOScheduler(self.pool.s_max, max_queue)
+        self.metrics = ServingMetrics()
+        self._rng = (rng if rng is not None
+                     else jnp.zeros((2,), jnp.uint32))
+        self._sampling = (float(temperature), int(top_k), float(top_p))
+        self._running: Dict[int, Request] = {}
+        self._step_idx = 0
+        self._key_idx = 0  # one fresh fold per sampled program call
+        # donation keeps one resident cache copy per step on TPU; the
+        # CPU backend lacks donation and would warn every call
+        donate_cache = (jax.default_backend() != "cpu")
+        # explicit out_shardings pin every program's outputs to the
+        # pool's own placements — otherwise GSPMD's (normalized) output
+        # sharding differs from the first call's input sharding and the
+        # second call silently specializes a second executable,
+        # breaking the compile-once guarantee on a mesh
+        if mesh is not None:
+            cache_sh = NamedSharding(
+                mesh, P(None, None, None, "model", None))
+            rep = NamedSharding(mesh, P())
+            decode_out = (rep, cache_sh, cache_sh, rep, rep)
+            insert_out = (cache_sh, cache_sh, rep, rep, rep)
+            prefill_out = (rep, cache_sh, cache_sh)
+            release_out = rep
+        else:
+            decode_out = insert_out = prefill_out = release_out = None
+        self._decode = jax.jit(
+            self._make_decode_step(), out_shardings=decode_out,
+            donate_argnums=(1, 2, 3, 4) if donate_cache else ())
+        self._prefill_jit = jax.jit(self._make_prefill(),
+                                    out_shardings=prefill_out)
+        self._insert_jit = jax.jit(
+            self._insert_fn, out_shardings=insert_out,
+            donate_argnums=(0, 1, 2, 3, 4) if donate_cache else ())
+        self._release_jit = jax.jit(
+            lambda active, slot: active.at[slot].set(False),
+            out_shardings=release_out,
+            donate_argnums=(0,) if donate_cache else ())
+
+    # ---- jitted programs ----------------------------------------------
+    def _make_decode_step(self):
+        """One masked decode step over every slot; THE one-compile
+        signature. Mirrors ``generate``'s scan body with the scalar
+        position replaced by the per-slot position vector."""
+        model = self.model
+        cs = _make_cs(self.mesh)
+        dtype = model.dtype
+        eps = getattr(model, "ln_eps", _LN_EPS)
+        moe_k = getattr(model, "moe_top_k", 1)
+        h = model.num_heads
+        n_layers = model.num_layers
+        temperature, top_k, top_p = self._sampling
+
+        def cs_cache(c):
+            return cs(c, None, None, None, "model", None)
+
+        def step(params, k_caches, v_caches, positions, last_tokens,
+                 active, key):
+            n = positions.shape[0]
+            s = k_caches.shape[2]
+            rows = jnp.arange(n)
+            # embed each slot's pending token at its own position
+            # (cast-then-add, the model's own order — see _embed)
+            pos_emb = params["pos_embed"][positions][:, None, :]
+            x_t = (params["embed"][last_tokens][:, None, :].astype(dtype)
+                   + pos_emb.astype(dtype))
+            new_k, new_v = [], []
+            for i in range(n_layers):
+                p = params[f"block_{i}"]
+                hn = _ln(x_t, p["ln1"], eps).astype(dtype)
+                q, k, v = jnp.split(
+                    _dense(hn, p["attn"]["wqkv"], dtype), 3, axis=-1)
+                q = cs(_split_heads(q, h), None, None, "model", None)
+                k = cs(_split_heads(k, h), None, None, "model", None)
+                v = cs(_split_heads(v, h), None, None, "model", None)
+                # per-slot column write: slot j's K/V lands at its own
+                # position (generate's dynamic_update_slice, vectorized)
+                k_cache = k_caches[i].at[rows, positions].set(k[:, 0])
+                v_cache = v_caches[i].at[rows, positions].set(v[:, 0])
+                scale = q.shape[-1] ** -0.5
+                logits = jnp.einsum(
+                    "bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                    k_cache.astype(jnp.float32)) * scale
+                mask = jnp.arange(s)[None, :] <= positions[:, None]
+                probs = jax.nn.softmax(
+                    jnp.where(mask[:, None, None, :], logits, -jnp.inf),
+                    axis=-1)
+                att = jnp.einsum("bhqk,bkhd->bqhd", probs,
+                                 v_cache.astype(jnp.float32))
+                att = att.reshape(n, 1, -1).astype(dtype)
+                x_t = x_t + _dense(att, p["attn"]["wo"], dtype)
+                x_t = x_t + _ffn(p, x_t, dtype, eps, moe_k)
+                new_k.append(k_cache)
+                new_v.append(v_cache)
+            logits = _logits(params, x_t, eps, cs)[:, 0]
+            nxt = _sample(logits, temperature, top_k, top_p,
+                          key).astype(jnp.int32)
+            # inactive rows freeze: position pinned (their masked write
+            # re-hits the same column), pending token unchanged
+            positions = jnp.where(active, positions + 1, positions)
+            last_tokens = jnp.where(active, nxt, last_tokens)
+            return (nxt, cs_cache(jnp.stack(new_k)),
+                    cs_cache(jnp.stack(new_v)), positions, last_tokens)
+
+        return step
+
+    def _make_prefill(self):
+        """Prefill-on-join: the SHARED ``_prefill`` pass on one
+        right-padded prompt + first-token sampling (``generate``'s
+        ``tok0``). Causality makes right-pad columns invisible to the
+        real prefix, so no masks are needed; compiles once per bucket
+        size (the prompt's padded shape)."""
+        model = self.model
+        cs = _make_cs(self.mesh)
+        eps = getattr(model, "ln_eps", _LN_EPS)
+        temperature, top_k, top_p = self._sampling
+
+        def cs_cache(c):
+            return cs(c, None, None, None, "model", None)
+
+        def prefill(params, prompt, length, key):
+            x, k_pref, v_pref = _prefill(
+                model, params, prompt, prompt.shape[1], cs=cs,
+                cs_cache=cs_cache)
+            x_last = jax.lax.dynamic_slice_in_dim(x, length - 1, 1,
+                                                  axis=1)
+            logits = _logits(params, x_last, eps, cs)[:, 0]
+            tok0 = _sample(logits, temperature, top_k, top_p, key)
+            return tok0[0].astype(jnp.int32), k_pref, v_pref
+
+        return prefill
+
+    @staticmethod
+    def _insert_fn(k_caches, v_caches, positions, last_tokens, active,
+                   k_pref, v_pref, slot, length, tok0):
+        """Splice a prefilled request into slot ``slot``: cache columns
+        ``[0, bucket)`` overwrite the previous tenant's, the position
+        counter starts at the prompt length, the pending token is the
+        prefill's first sample. Pad/stale columns beyond ``length`` are
+        masked until the decode position reaches (and overwrites) them.
+        """
+        k_caches = jax.lax.dynamic_update_slice(
+            k_caches, k_pref, (0, slot, 0, 0, 0))
+        v_caches = jax.lax.dynamic_update_slice(
+            v_caches, v_pref, (0, slot, 0, 0, 0))
+        positions = positions.at[slot].set(length)
+        last_tokens = last_tokens.at[slot].set(tok0)
+        active = active.at[slot].set(True)
+        return k_caches, v_caches, positions, last_tokens, active
+
+    # ---- compile counters ---------------------------------------------
+    @property
+    def decode_step_compiles(self) -> int:
+        """Distinct compiled decode-step programs (must stay 1)."""
+        return jit_cache_size(self._decode)
+
+    @property
+    def prefill_compiles(self) -> int:
+        """Distinct compiled prefill programs (== buckets seen)."""
+        return jit_cache_size(self._prefill_jit)
+
+    # ---- request lifecycle --------------------------------------------
+    def submit(self, prompt: Sequence[int], max_new_tokens: int, *,
+               eos_id: Optional[int] = None, uid=None) -> Request:
+        """Queue a request (FIFO). Raises ValueError when it can never
+        fit a slot, ``QueueFull`` at the queue bound."""
+        request = Request(prompt, max_new_tokens,
+                          self.eos_id if eos_id is None else eos_id,
+                          uid)
+        return self.enqueue(request)
+
+    def enqueue(self, request: Request) -> Request:
+        """Queue a pre-built :class:`Request`. ``submit_time`` is
+        stamped on the FIRST attempt and survives ``QueueFull`` retries,
+        so TTFT honestly includes backpressure wait."""
+        if request.submit_time is None:
+            request.submit_time = time.perf_counter()
+        if request.prompt and (
+                min(request.prompt) < 0
+                or max(request.prompt) >= self.model.vocab_size):
+            raise ValueError(
+                f"prompt token ids must be in [0, vocab_size="
+                f"{self.model.vocab_size})")
+        return self.scheduler.submit(request)
+
+    def _next_key(self) -> jax.Array:
+        """Per-call PRNG key (sampling only; greedy programs take the
+        constant zero key ``generate`` uses, keeping one signature)."""
+        if self._sampling[0] <= 0.0:
+            return self._rng
+        self._key_idx += 1
+        return jax.random.fold_in(self._rng, self._key_idx)
+
+    def _finished(self, request: Request, token: int) -> Optional[str]:
+        if request.eos_id is not None and token == request.eos_id:
+            return "eos"
+        if len(request.tokens) >= request.max_new_tokens:
+            return "length"
+        return None
+
+    def _complete(self, request: Request, reason: str) -> None:
+        request.finish_time = time.perf_counter()
+        self.scheduler.complete(request, reason)
+        self.metrics.record_completion()
+
+    def _admit(self) -> List[Tuple[Request, int, bool]]:
+        """Move FIFO-head requests into free slots: prefill, record
+        TTFT, splice into the pool (or retire immediately when the
+        prefill token already finishes the request)."""
+        events = []
+        pool = self.pool
+        while pool.free_slots > 0:
+            request = self.scheduler.next_to_admit()
+            if request is None:
+                break
+            length = len(request.prompt)
+            bucket = _bucket(length, self.min_bucket, pool.s_max)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :length] = request.prompt
+            key = self._next_key()
+            tok0, k_pref, v_pref = self._prefill_jit(
+                self.params, jnp.asarray(padded), jnp.int32(length), key)
+            token = int(tok0)
+            request.first_token_time = time.perf_counter()
+            self.metrics.record_first_token(
+                request.first_token_time - request.submit_time)
+            request.tokens.append(token)
+            reason = self._finished(request, token)
+            if reason is not None:
+                self._complete(request, reason)
+                events.append((request, token, True))
+                continue
+            slot = pool.acquire()
+            request.slot = slot
+            (pool.k_caches, pool.v_caches, pool.positions,
+             pool.last_tokens, pool.active) = self._insert_jit(
+                pool.k_caches, pool.v_caches, pool.positions,
+                pool.last_tokens, pool.active, k_pref, v_pref,
+                jnp.int32(slot), jnp.int32(length), tok0)
+            self._running[slot] = request
+            events.append((request, token, False))
+        return events
+
+    def step(self) -> List[Tuple[Request, int, bool]]:
+        """One engine iteration: admit into free slots, then one
+        batched decode step over the pool. Returns the step's token
+        events as ``(request, token, finished)`` tuples (admission
+        first tokens included)."""
+        events = self._admit()
+        pool = self.pool
+        if self._running:
+            key = self._next_key()
+            t0 = time.perf_counter()
+            (nxt, pool.k_caches, pool.v_caches, pool.positions,
+             pool.last_tokens) = self._decode(
+                self.params, pool.k_caches, pool.v_caches,
+                pool.positions, pool.last_tokens, pool.active, key)
+            tokens = np.asarray(nxt)  # the step's one host sync
+            dt = time.perf_counter() - t0
+            emitted = len(self._running)
+            self.metrics.record_decode_step(
+                dt, emitted, pool.occupancy, self.scheduler.queue_depth)
+            for slot, request in list(self._running.items()):
+                token = int(tokens[slot])
+                request.tokens.append(token)
+                reason = self._finished(request, token)
+                if reason is not None:
+                    self._complete(request, reason)
+                    pool.active = self._release_jit(pool.active,
+                                                    jnp.int32(slot))
+                    pool.release(slot)
+                    del self._running[slot]
+                events.append((request, token, reason is not None))
+        self._step_idx += 1
+        return events
+
+    def run(self) -> Iterable[Tuple[Request, int, bool]]:
+        """Drive ``step`` until queue and pool drain, streaming token
+        events."""
+        while self.scheduler.queue_depth or self._running:
+            yield from self.step()
+
+    def serve(self, requests: Iterable[Tuple[Sequence[int], int]]
+              ) -> List[Request]:
+        """Convenience batch API: submit ``(prompt, max_new_tokens)``
+        pairs, run to drain, return the finished ``Request`` records in
+        submission order."""
+        submitted = [self.submit(p, n) for p, n in requests]
+        for _ in self.run():
+            pass
+        assert all(r.state == DONE for r in submitted)
+        return submitted

@@ -1,0 +1,126 @@
+"""Pre-allocated KV-cache slot pool for continuous-batching decode.
+
+The static-shape discipline that makes every other jitted program in
+this framework fast (one compiled signature, ``lax.dynamic_update_slice``
+instead of growing arrays — see ``inference/generate.py``) applied to
+SERVING: requests join and leave a persistent decode loop, so the cache
+cannot be shaped per batch. Instead the pool owns fixed
+``[layers, max_slots, s_max, heads, head_dim]`` K/V arrays plus per-slot
+scalars (position counter, last sampled token, active flag), and the
+engine's jitted decode step runs over ALL slots every step with an
+active-mask — occupancy changes the mask's *values*, never any shape,
+so the step compiles exactly once (pinned via
+``utils.compile_cache.jit_cache_size``).
+
+Slot layout invariants (the correctness contract the engine's
+equivalence-with-``generate()`` pin rests on):
+
+- an ACTIVE slot holding a request with prompt length ``L`` that has
+  emitted ``g`` tokens has valid cache columns ``[0, L + g - 1)`` and
+  ``position == L + g - 1`` (the column its pending last token's K/V
+  will be written to by the next decode step);
+- attention in the decode step masks columns ``> position``, so stale
+  columns from a previous tenant (or the batched step's writes into
+  INACTIVE rows) are never read before the column is overwritten: the
+  step at position ``p`` writes column ``p`` *before* attending to
+  ``[0, p]``, exactly like ``inference.generate``'s ``_block_decode``;
+- inactive rows keep a frozen position (the masked step re-writes the
+  same column each step), so no index ever grows past ``s_max``.
+
+Host-side free-list bookkeeping lives here too (``acquire``/
+``release``); all device-array updates are functional and returned to
+the caller (the engine threads them through its jitted steps).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class SlotPool:
+    """Fixed-capacity KV-cache slots + per-slot decode state.
+
+    Args:
+      model: the ``GPT`` the caches are shaped for (layers/heads/dtype).
+      max_slots: concurrent requests held on-device. The decode step's
+        batch dimension — every step pays ``max_slots`` rows of compute
+        regardless of occupancy (the static-shape trade; size it to the
+        throughput target, not the peak queue).
+      s_max: per-slot sequence capacity (prompt + generated). Defaults
+        to ``model.max_seq_len``; admission rejects requests with
+        ``prompt_len + max_new_tokens > s_max``.
+      mesh: optional ``Mesh`` with a ``model`` axis — caches are then
+        resident head-sharded (``[L, N, S, H/tp, Dh]`` per chip), the
+        same 1/tp KV-memory win as TP ``generate``.
+    """
+
+    def __init__(self, model, max_slots: int, s_max: Optional[int] = None,
+                 mesh: Optional[Mesh] = None):
+        if max_slots < 1:
+            raise ValueError(f"max_slots must be >= 1, got {max_slots}")
+        s_max = int(s_max or model.max_seq_len)
+        if not 2 <= s_max <= model.max_seq_len:
+            raise ValueError(
+                f"s_max must be in [2, max_seq_len={model.max_seq_len}], "
+                f"got {s_max}")
+        self.model = model
+        self.max_slots = int(max_slots)
+        self.s_max = s_max
+        self.mesh = mesh
+        h = model.num_heads
+        shape = (model.num_layers, self.max_slots, s_max, h,
+                 model.hidden_size // h)
+        self.k_caches = self._cache_sharded(jnp.zeros(shape, model.dtype))
+        self.v_caches = self._cache_sharded(jnp.zeros(shape, model.dtype))
+        # per-slot decode state: next write column, pending token, live?
+        # Mesh runs commit these replicated from the START — the jitted
+        # step returns them mesh-committed, and a first call with plain
+        # uncommitted arrays would be a second compile signature
+        self.positions = self._replicated(
+            jnp.zeros((self.max_slots,), jnp.int32))
+        self.last_tokens = self._replicated(
+            jnp.zeros((self.max_slots,), jnp.int32))
+        self.active = self._replicated(jnp.zeros((self.max_slots,), bool))
+        self._free: List[int] = list(range(self.max_slots))
+
+    def _cache_sharded(self, c):
+        if self.mesh is None:
+            return c
+        return jax.device_put(
+            c, NamedSharding(self.mesh,
+                             P(None, None, None, "model", None)))
+
+    def _replicated(self, a):
+        if self.mesh is None:
+            return a
+        return jax.device_put(a, NamedSharding(self.mesh, P()))
+
+    # ---- host-side slot accounting -------------------------------------
+    @property
+    def free_slots(self) -> int:
+        return len(self._free)
+
+    @property
+    def occupancy(self) -> int:
+        return self.max_slots - len(self._free)
+
+    def acquire(self) -> int:
+        """Claim a free slot index (lowest-numbered first, so re-use is
+        deterministic and tests can pin recycling)."""
+        if not self._free:
+            raise RuntimeError("no free slots (acquire() without "
+                               "checking free_slots)")
+        return self._free.pop(0)
+
+    def release(self, slot: int) -> None:
+        """Return ``slot`` to the free list. The caller is responsible
+        for clearing the device-side active flag (the engine batches
+        that into its jitted release)."""
+        if slot in self._free or not 0 <= slot < self.max_slots:
+            raise ValueError(f"bad release of slot {slot}")
+        self._free.append(slot)
+        self._free.sort()

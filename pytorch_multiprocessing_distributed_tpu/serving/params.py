@@ -1,0 +1,83 @@
+"""Checkpoint -> serving-params loading (msgpack and Orbax backends).
+
+Training checkpoints store a full ``TrainState`` (params + optimizer
+buffers + epoch); serving needs only the param tree. Rebuilding the
+exact optimizer just to restore into a ``TrainState`` template would
+drag the whole training configuration into the serving CLI, so both
+loaders restore the ``params`` subtree alone against a template from
+``model.init`` — optimizer buffers in the checkpoint are simply never
+read.
+
+Backends mirror ``train_lm.py --ckpt_backend``:
+- ``msgpack``: a single ``model_<epoch>.pth`` written by
+  ``train.checkpoint.save_checkpoint`` (flax.serialization bytes);
+- ``orbax``: the epoch-keyed OCDBT directory tree under
+  ``{save_path}/orbax/`` written by ``train.orbax_ckpt`` (pass the
+  run's ``save_path``; the latest epoch is served unless pinned).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import serialization
+
+
+def init_params(model, seed: int = 0):
+    """Fresh random params (serving smoke runs and benchmarks — no
+    checkpoint required)."""
+    dummy = jnp.zeros((1, min(8, model.max_seq_len)), jnp.int32)
+    return model.init(jax.random.PRNGKey(seed), dummy)["params"]
+
+
+def load_params(model, path: str, backend: str = "auto",
+                epoch: Optional[int] = None):
+    """Load the param tree for ``model`` from a training checkpoint.
+
+    Args:
+      path: msgpack — the ``model_<epoch>.pth`` file; orbax — the
+        training run's ``save_path`` (parent of ``orbax/``) or the
+        ``orbax/`` directory itself.
+      backend: ``msgpack`` | ``orbax`` | ``auto`` (directories route to
+        orbax, files to msgpack).
+      epoch: orbax only — serve a specific epoch (default: latest).
+    """
+    if backend == "auto":
+        backend = "orbax" if os.path.isdir(path) else "msgpack"
+    template = init_params(model)
+    if backend == "msgpack":
+        with open(path, "rb") as f:
+            state_dict = serialization.msgpack_restore(f.read())
+        if "params" not in state_dict:
+            raise ValueError(
+                f"{path} has no 'params' subtree — not a "
+                "save_checkpoint artifact")
+        return serialization.from_state_dict(template,
+                                             state_dict["params"])
+    if backend != "orbax":
+        raise ValueError(f"unknown backend {backend!r}")
+    # restore ONLY the params subtree, template-shaped: a fabricated
+    # partial "TrainState" dict keeps Orbax's StandardRestore happy
+    # without reconstructing optimizer state
+    import orbax.checkpoint as ocp
+
+    root = path if os.path.basename(os.path.normpath(path)) == "orbax" \
+        else os.path.join(path, "orbax")
+    with ocp.CheckpointManager(os.path.abspath(root)) as manager:
+        if epoch is None:
+            epoch = manager.latest_step()
+            if epoch is None:
+                raise FileNotFoundError(f"no orbax checkpoint under {root}")
+        restored = manager.restore(
+            epoch, args=ocp.args.PyTreeRestore(
+                item={"params": template},
+                restore_args=jax.tree.map(
+                    lambda l: ocp.ArrayRestoreArgs(
+                        dtype=l.dtype, sharding=l.sharding),
+                    {"params": template}),
+                transforms={},  # drop opt_state/epoch/... silently
+            ))
+    return restored["params"]

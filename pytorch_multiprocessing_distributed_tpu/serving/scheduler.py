@@ -1,0 +1,129 @@
+"""FIFO request scheduling + admission control for the serving engine.
+
+Pure host-side bookkeeping — no device arrays, no jax — so the policy
+is unit-testable without compiling anything. The engine asks the
+scheduler which request joins next whenever a KV slot frees up
+(prefill-on-join happens in the engine, on the shared
+``inference.generate._prefill``); the scheduler owns the queue bound,
+the static-fit validation, and each request's lifecycle record (state,
+per-token timestamps for TTFT, finish reason).
+
+Admission policy is strict FIFO: requests are admitted in submission
+order, one per free slot. Because fit is validated at submission time
+against the pool's fixed ``s_max`` (static shapes — a request either
+always fits a slot or never does), the queue head can never be blocked
+by a too-large request, so FIFO has no head-of-line starvation case to
+special-case.
+"""
+
+from __future__ import annotations
+
+import itertools
+from collections import deque
+from typing import Deque, List, Optional, Sequence
+
+
+class QueueFull(RuntimeError):
+    """Raised by ``submit`` when the bounded queue is at capacity —
+    the engine's backpressure signal (callers shed load or retry)."""
+
+
+# request lifecycle states
+QUEUED = "queued"
+RUNNING = "running"
+DONE = "done"
+
+_uid_counter = itertools.count()
+
+
+class Request:
+    """One serving request and its lifecycle record.
+
+    Built by ``FIFOScheduler.submit``; fields are filled in as the
+    request moves through the engine:
+
+    - ``tokens``: generated token ids (prompt excluded), streamed in as
+      the engine emits them;
+    - ``slot``: KV slot index while RUNNING (None otherwise);
+    - ``submit_time``/``first_token_time``/``finish_time``: host
+      ``perf_counter`` stamps the engine records (TTFT =
+      ``first_token_time - submit_time``);
+    - ``finish_reason``: ``"eos"`` or ``"length"`` once DONE.
+    """
+
+    def __init__(self, prompt: Sequence[int], max_new_tokens: int,
+                 eos_id: Optional[int] = None, uid=None):
+        self.prompt = list(int(t) for t in prompt)
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_id = None if eos_id is None else int(eos_id)
+        self.uid = next(_uid_counter) if uid is None else uid
+        self.state = QUEUED
+        self.tokens: List[int] = []
+        self.slot: Optional[int] = None
+        self.submit_time: Optional[float] = None
+        self.first_token_time: Optional[float] = None
+        self.finish_time: Optional[float] = None
+        self.finish_reason: Optional[str] = None
+
+    def __repr__(self) -> str:
+        return (f"Request(uid={self.uid}, state={self.state}, "
+                f"prompt_len={len(self.prompt)}, "
+                f"generated={len(self.tokens)})")
+
+
+class FIFOScheduler:
+    """Bounded FIFO queue with static-fit admission control.
+
+    Args:
+      s_max: the pool's per-slot capacity; ``len(prompt) +
+        max_new_tokens`` must fit or submission is rejected outright
+        (ValueError — the request could NEVER run, unlike QueueFull
+        which is transient backpressure).
+      max_queue: queued-request bound (None = unbounded). Requests
+        beyond it raise :class:`QueueFull`.
+    """
+
+    def __init__(self, s_max: int, max_queue: Optional[int] = None):
+        self.s_max = int(s_max)
+        self.max_queue = None if max_queue is None else int(max_queue)
+        self._queue: Deque[Request] = deque()
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    def submit(self, request: Request) -> Request:
+        """Validate and enqueue. Raises ValueError for never-fits
+        requests, :class:`QueueFull` at the queue bound."""
+        n_prompt = len(request.prompt)
+        if n_prompt < 1:
+            raise ValueError("empty prompt")
+        if request.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got "
+                f"{request.max_new_tokens}")
+        if n_prompt + request.max_new_tokens > self.s_max:
+            raise ValueError(
+                f"prompt {n_prompt} + max_new_tokens "
+                f"{request.max_new_tokens} exceeds the slot capacity "
+                f"s_max={self.s_max}")
+        if (self.max_queue is not None
+                and len(self._queue) >= self.max_queue):
+            raise QueueFull(
+                f"queue at capacity ({self.max_queue}); resubmit later")
+        self._queue.append(request)
+        return request
+
+    def next_to_admit(self) -> Optional[Request]:
+        """Pop the FIFO head for admission (engine calls this once per
+        free slot). None when the queue is empty."""
+        if not self._queue:
+            return None
+        request = self._queue.popleft()
+        request.state = RUNNING
+        return request
+
+    def complete(self, request: Request, reason: str) -> None:
+        request.state = DONE
+        request.finish_reason = reason
+        request.slot = None

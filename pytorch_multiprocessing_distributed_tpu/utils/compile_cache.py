@@ -27,6 +27,25 @@ _DEFAULT = os.path.join(os.path.expanduser("~"), ".cache", "pmdt_xla")
 _OFF = ("0", "off", "none", "false")
 
 
+def jit_cache_size(fn) -> int:
+    """Number of distinct programs a ``jax.jit``-wrapped function has
+    traced (and hence compiled) so far — the per-function compile
+    counter the serving engine's "one decode signature" guarantee is
+    asserted against (``tests/test_serving.py``).
+
+    A slot-based continuous-batching engine exists to keep this at 1:
+    requests joining and leaving must never change the jitted decode
+    step's (shape, dtype, static-arg) signature. Returns -1 when the
+    counter is unavailable (not a jitted function, or a jax without
+    ``_cache_size``) so callers can skip the assertion rather than
+    crash.
+    """
+    try:
+        return int(fn._cache_size())
+    except Exception:  # noqa: BLE001 — counter is diagnostic-only
+        return -1
+
+
 def enable_compilation_cache(
     path: Optional[str] = None, platform_hint: Optional[str] = None,
 ) -> Optional[str]:

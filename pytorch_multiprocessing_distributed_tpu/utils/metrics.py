@@ -1,11 +1,17 @@
-"""Classification metrics as pure JAX functions.
+"""Training/eval metrics (pure JAX) + serving metrics (host meters).
 
-Behavioral parity target: ``accuracy`` in reference ``utils.py:64-77``:
-returns ``(precision@1 as a percentage, per-sample correctness mask)``
-computed via top-k prediction sets. Here the computation is a pure jittable
-function of ``(logits, targets)`` so it can live *inside* the compiled
-train step (no host round-trip per batch, unlike the reference's
-``.item()`` calls at ``main.py:113-115``).
+Behavioral parity target for the classification half: ``accuracy`` in
+reference ``utils.py:64-77``: returns ``(precision@1 as a percentage,
+per-sample correctness mask)`` computed via top-k prediction sets. Here
+the computation is a pure jittable function of ``(logits, targets)`` so
+it can live *inside* the compiled train step (no host round-trip per
+batch, unlike the reference's ``.item()`` calls at ``main.py:113-115``).
+
+:class:`ServingMetrics` is the inference-side counterpart: the serving
+engine's per-request latency (TTFT) and per-step throughput/occupancy
+aggregation. Host-side by necessity — wall-clock spans host scheduling,
+not just device compute — built on the same ``AverageMeter`` the
+training loops report through.
 """
 
 from __future__ import annotations
@@ -14,6 +20,8 @@ from typing import Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+
+from .meters import AverageMeter
 
 
 def topk_accuracy(
@@ -67,3 +75,63 @@ def correct_count(logits: jax.Array, targets: jax.Array) -> jax.Array:
     """
     pred = jnp.argmax(logits, axis=-1)
     return jnp.sum((pred == targets).astype(jnp.int32))
+
+
+class ServingMetrics:
+    """Aggregates the serving engine's operational metrics.
+
+    - ``ttft``: seconds from submit to first token (the prefill
+      completes it), per request;
+    - ``decode_step``: wall seconds per batched decode step;
+    - ``occupancy``: live slots at each decode step (the utilization
+      the slot count should be tuned against);
+    - ``queue_depth``: queued requests at each decode step (sustained
+      > 0 means the pool, not the arrival rate, is the bottleneck);
+    - token/request counters for end-to-end tokens/sec.
+
+    All meters are host-side ``AverageMeter``s; ``snapshot()`` flattens
+    them into the plain dict the CLI prints and the benchmark records.
+    """
+
+    def __init__(self) -> None:
+        self.ttft = AverageMeter()
+        self.decode_step = AverageMeter()
+        self.occupancy = AverageMeter()
+        self.queue_depth = AverageMeter()
+        self.tokens_generated = 0
+        self.requests_completed = 0
+        self._elapsed = 0.0
+        self._occupancy_max = 0
+
+    def record_first_token(self, ttft_seconds: float) -> None:
+        self.ttft.update(ttft_seconds)
+        self.tokens_generated += 1
+
+    def record_decode_step(self, seconds: float, tokens: int,
+                           occupancy: int, queue_depth: int) -> None:
+        self.decode_step.update(seconds)
+        self.occupancy.update(occupancy)
+        self._occupancy_max = max(self._occupancy_max, occupancy)
+        self.queue_depth.update(queue_depth)
+        self.tokens_generated += tokens
+        self._elapsed += seconds
+
+    def record_completion(self) -> None:
+        self.requests_completed += 1
+
+    def snapshot(self) -> dict:
+        decode_tps = (0.0 if self._elapsed == 0 else
+                      (self.tokens_generated - self.ttft.count)
+                      / self._elapsed)
+        return {
+            "requests_completed": self.requests_completed,
+            "tokens_generated": self.tokens_generated,
+            "ttft_avg_s": self.ttft.avg,
+            "ttft_last_s": self.ttft.val,
+            "decode_step_avg_s": self.decode_step.avg,
+            "decode_tokens_per_sec": decode_tps,
+            "occupancy_avg": self.occupancy.avg,
+            "occupancy_max": self._occupancy_max,
+            "queue_depth_avg": self.queue_depth.avg,
+            "decode_steps": self.decode_step.count,
+        }

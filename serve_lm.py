@@ -1,0 +1,239 @@
+"""Continuous-batching LM serving CLI — the inference counterpart of
+``train_lm.py``.
+
+Loads a trained GPT checkpoint (msgpack ``model_<epoch>.pth`` or an
+Orbax run directory — the same backends ``train_lm.py`` writes) and
+serves a request stream through the slot-based
+:class:`~pytorch_multiprocessing_distributed_tpu.serving.ServingEngine`:
+requests join a persistent decode loop as KV slots free up, the jitted
+decode step keeps ONE compiled signature throughout, and per-request
+tokens stream to stdout as they are emitted.
+
+Request sources (first match wins):
+  --requests FILE   JSON Lines, one request per line:
+                      {"prompt": [ids...], "max_new_tokens": 16}
+                    or {"text": "byte-level prompt", ...} (ids 0..255,
+                    matching train_lm.py's text tokenizer)
+  --stdin           one prompt per line, byte-level tokens
+  --synthetic N     N deterministic Zipf prompts (default; no assets
+                    needed — smoke runs and benchmarks)
+
+Examples (CPU mesh):
+  PMDT_FORCE_CPU_DEVICES=8 python serve_lm.py --model gpt_tiny \\
+      --random_init --synthetic 8 --max_slots 4 --max_new_tokens 16
+  python serve_lm.py --model gpt_tiny --ckpt lm_run/model_2.pth \\
+      --requests reqs.jsonl --max_slots 8 --tp 2 --metrics_out m.json
+"""
+
+import argparse
+import json
+import sys
+
+from pytorch_multiprocessing_distributed_tpu.utils.compile_cache import (
+    enable_compilation_cache)
+
+parser = argparse.ArgumentParser(
+    description="TPU-native continuous-batching LM serving")
+parser.add_argument('--model', default='gpt_tiny', type=str,
+                    help='gpt_tiny | gpt_small | gpt_medium')
+parser.add_argument('--ckpt', default='', type=str,
+                    help='msgpack model_<epoch>.pth file, or an orbax '
+                         'run directory (train_lm.py --save_path)')
+parser.add_argument('--ckpt_backend', default='auto',
+                    choices=['auto', 'msgpack', 'orbax'])
+parser.add_argument('--ckpt_epoch', default=None, type=int,
+                    help='orbax only: serve a specific epoch '
+                         '(default latest)')
+parser.add_argument('--random_init', action='store_true',
+                    help='serve fresh random params (smoke/benchmark '
+                         'runs; mutually exclusive with --ckpt)')
+parser.add_argument('--max_slots', default=4, type=int,
+                    help='concurrent requests decoded per step (the '
+                         'KV slot pool size)')
+parser.add_argument('--s_max', default=0, type=int,
+                    help='per-slot token capacity (prompt + generated; '
+                         '0 = model.max_seq_len)')
+parser.add_argument('--max_queue', default=0, type=int,
+                    help='queued-request bound; submissions beyond it '
+                         'are REJECTED (0 = unbounded)')
+parser.add_argument('--max_new_tokens', default=32, type=int,
+                    help='default per-request budget (jsonl requests '
+                         'override per line)')
+parser.add_argument('--eos', default=-1, type=int,
+                    help='stop token id (-1 = none; byte-level text '
+                         'corpora use 256 as the doc separator)')
+parser.add_argument('--tp', default=1, type=int,
+                    help='model-axis size: heads/KV-slots/vocab head '
+                         'sharded for single-host TP serving')
+parser.add_argument('--temperature', default=0.0, type=float)
+parser.add_argument('--top_k', default=0, type=int)
+parser.add_argument('--top_p', default=0.0, type=float)
+parser.add_argument('--seed', default=0, type=int)
+parser.add_argument('--dtype', default='float32',
+                    choices=['float32', 'bfloat16'])
+parser.add_argument('--requests', default='', type=str,
+                    help='JSON Lines request file')
+parser.add_argument('--stdin', action='store_true',
+                    help='read one byte-level prompt per stdin line')
+parser.add_argument('--synthetic', default=0, type=int,
+                    help='serve N synthetic Zipf prompts (default 8 '
+                         'when no other source is given)')
+parser.add_argument('--metrics_out', default='', type=str,
+                    help='write the final metrics snapshot as JSON')
+parser.add_argument('--quiet', action='store_true',
+                    help='suppress per-token streaming lines')
+
+
+def _load_requests(args, vocab_size, skipped):
+    """Yield (prompt_ids, max_new_tokens) from the selected source;
+    malformed jsonl lines are appended to ``skipped`` (one bad line
+    must not kill the requests already being served)."""
+    if args.requests:
+        with open(args.requests) as f:
+            for lineno, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    obj = json.loads(line)
+                    if "prompt" in obj:
+                        ids = [int(t) for t in obj["prompt"]]
+                    elif "text" in obj:
+                        ids = [min(b, vocab_size - 1)
+                               for b in obj["text"].encode("utf-8")]
+                    else:
+                        raise ValueError("needs 'prompt' or 'text'")
+                    max_new = int(obj.get("max_new_tokens",
+                                          args.max_new_tokens))
+                except (ValueError, TypeError, AttributeError) as e:
+                    skipped.append(f"line {lineno}: {e}")
+                    continue
+                yield ids, max_new
+    elif args.stdin:
+        for line in sys.stdin:
+            line = line.rstrip("\n")
+            if line:
+                yield ([min(b, vocab_size - 1)
+                        for b in line.encode("utf-8")],
+                       args.max_new_tokens)
+    else:
+        import numpy as np
+
+        n = args.synthetic or 8
+        rng = np.random.default_rng(args.seed)
+        for i in range(n):
+            length = int(rng.integers(4, 24))
+            yield (rng.integers(0, vocab_size, (length,)).tolist(),
+                   args.max_new_tokens)
+
+
+def main():
+    args = parser.parse_args()
+    if args.ckpt and args.random_init:
+        raise SystemExit("--ckpt and --random_init are mutually "
+                         "exclusive")
+    if not args.ckpt and not args.random_init:
+        raise SystemExit("pass --ckpt PATH (trained params) or "
+                         "--random_init (smoke run)")
+    from pytorch_multiprocessing_distributed_tpu.utils.hostenv import (
+        force_cpu_devices_from_env)
+
+    force_cpu_devices_from_env()
+    enable_compilation_cache()
+
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_multiprocessing_distributed_tpu import models
+    from pytorch_multiprocessing_distributed_tpu.inference import (
+        shard_params_for_tp_decode)
+    from pytorch_multiprocessing_distributed_tpu.parallel import make_mesh
+    from pytorch_multiprocessing_distributed_tpu.serving import (
+        QueueFull, Request, ServingEngine, init_params, load_params)
+
+    dtype = jnp.bfloat16 if args.dtype == 'bfloat16' else jnp.float32
+    platform = jax.devices()[0].platform
+    model = models.get_model(
+        args.model, dtype=dtype,
+        attn_impl="flash" if platform == "tpu" else "xla")
+    if args.random_init:
+        params = init_params(model, args.seed)
+    else:
+        params = load_params(model, args.ckpt, args.ckpt_backend,
+                             args.ckpt_epoch)
+    mesh = None
+    if args.tp > 1:
+        n_dev = len(jax.devices())
+        if n_dev % args.tp:
+            raise SystemExit(
+                f"--tp {args.tp} does not divide {n_dev} devices (CPU "
+                f"runs: PMDT_FORCE_CPU_DEVICES=8)")
+        mesh = make_mesh(n_dev // args.tp, args.tp)
+        params = shard_params_for_tp_decode(params, mesh)
+
+    engine = ServingEngine(
+        model, params,
+        max_slots=args.max_slots,
+        s_max=args.s_max or None,
+        mesh=mesh,
+        max_queue=args.max_queue or None,
+        temperature=args.temperature, top_k=args.top_k,
+        top_p=args.top_p,
+        rng=(jax.random.PRNGKey(args.seed)
+             if args.temperature > 0 else None),
+        eos_id=None if args.eos < 0 else args.eos)
+
+    def emit(events):
+        if args.quiet:
+            return
+        for request, token, finished in events:
+            print(f"req={request.uid} tok={token}"
+                  + (f" done({request.finish_reason})" if finished
+                     else ""),
+                  flush=True)
+            if finished:
+                print(f"req={request.uid} tokens={request.tokens}",
+                      flush=True)
+
+    rejected = 0
+    skipped = []
+    for prompt, max_new in _load_requests(args, model.vocab_size,
+                                          skipped):
+        request = Request(prompt, max_new, engine.eos_id)
+        while True:
+            try:
+                engine.enqueue(request)
+                break
+            except QueueFull:
+                # finite source + bounded queue = backpressure, not
+                # load shedding: drain a step, then re-enqueue the
+                # SAME request (its submit_time — and so its TTFT —
+                # keeps the first attempt's stamp)
+                emit(engine.step())
+            except ValueError as e:
+                rejected += 1
+                print(f"rejected: {e}", file=sys.stderr)
+                break
+        if args.stdin:
+            # online source: serve while the producer is still typing
+            # (an offline file bulk-admits and drains below instead)
+            emit(engine.step())
+
+    for event in engine.run():
+        emit([event])
+    for msg in skipped:
+        print(f"rejected: {msg}", file=sys.stderr)
+    rejected += len(skipped)
+
+    snap = engine.metrics.snapshot()
+    snap["rejected"] = rejected
+    snap["decode_step_compiles"] = engine.decode_step_compiles
+    snap["prefill_compiles"] = engine.prefill_compiles
+    print("metrics: " + json.dumps(snap, sort_keys=True), flush=True)
+    if args.metrics_out:
+        with open(args.metrics_out, "w") as f:
+            json.dump(snap, f, indent=2, sort_keys=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,71 @@
+"""serve_lm.py CLI: request stream in, streamed tokens + metrics out."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _serve(tmp_path, *flags, stdin=None):
+    env = dict(os.environ, PMDT_FORCE_CPU_DEVICES="8")
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "serve_lm.py"),
+         "--model", "gpt_tiny", "--s_max", "64", *flags],
+        env=env, capture_output=True, text=True, timeout=560, cwd=REPO,
+        input=stdin,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    return proc.stdout
+
+
+@pytest.mark.slow
+def test_cli_serves_jsonl_requests(tmp_path):
+    reqs = tmp_path / "reqs.jsonl"
+    reqs.write_text(
+        json.dumps({"prompt": [5, 9, 2, 41], "max_new_tokens": 3}) + "\n"
+        + json.dumps({"text": "hello", "max_new_tokens": 5}) + "\n")
+    metrics_path = tmp_path / "metrics.json"
+    out = _serve(tmp_path, "--random_init", "--requests", str(reqs),
+                 "--max_slots", "2", "--metrics_out", str(metrics_path))
+    assert "done(length)" in out
+    assert "metrics:" in out
+    snap = json.loads(metrics_path.read_text())
+    assert snap["requests_completed"] == 2
+    assert snap["tokens_generated"] == 8
+    assert snap["decode_step_compiles"] == 1
+    assert snap["rejected"] == 0
+
+
+@pytest.mark.slow
+def test_cli_serves_trained_checkpoint(tmp_path):
+    """Checkpoint handoff: a training-format model_<epoch>.pth (full
+    TrainState, optimizer buffers included) served through the CLI's
+    msgpack param-only load path."""
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_multiprocessing_distributed_tpu import models
+    from pytorch_multiprocessing_distributed_tpu.serving import (
+        init_params)
+    from pytorch_multiprocessing_distributed_tpu.train.checkpoint import (
+        save_checkpoint)
+    from pytorch_multiprocessing_distributed_tpu.train.state import (
+        TrainState)
+
+    model = models.get_model("gpt_tiny", attn_impl="xla")
+    params = init_params(model, 5)
+    state = TrainState(
+        params=params, batch_stats={},
+        opt_state={"m": jax.tree.map(jnp.zeros_like, params)},
+        epoch=jnp.ones((), jnp.int32))
+    path = save_checkpoint(str(tmp_path), state, 1)
+    out = _serve(tmp_path, "--ckpt", path,
+                 "--synthetic", "3", "--max_slots", "2",
+                 "--max_new_tokens", "4")
+    assert out.count("done(length)") == 3

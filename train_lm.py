@@ -588,10 +588,13 @@ def main(args):
 
         if (args.parallel == 'tp' and not (args.zero1 or args.fsdp)
                 and model.num_heads % deg == 0 and not args.n_experts
-                and args.sample_beams <= 1):
+                and args.sample_beams <= 1
+                and jax.process_count() == 1):
             # decode the GSPMD-sharded params where they live: TP
             # decode shards heads/KV-cache/vocab over the model axis
-            # (greedy only — beam search decodes gathered params below)
+            # (greedy only — beam search decodes gathered params below;
+            # multi-host TP output spans non-addressable shards, so it
+            # takes the _gather_for_host branch like every other case)
             out = decode(state.params, mesh=mesh)
         else:
             # every other trained state decodes single-shard: sp params
