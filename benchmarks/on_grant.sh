@@ -53,11 +53,12 @@ else
   tail -4 "$tmp" >&2; rm -f "$tmp"
 fi
 
-note "6b. serving throughput (continuous batching: load vs tok/s + TTFT)"
+note "6b. serving throughput (load sweep + length-bucket sweep)"
 tmp=$(mktemp)
-if $T python benchmarks/serving_bench.py > "$tmp" 2>&1; then
+if $T python benchmarks/serving_bench.py \
+    --json_out benchmarks/serving_bench_tpu.json > "$tmp" 2>&1; then
   mv "$tmp" benchmarks/serving_bench_tpu.txt
-  tail -7 benchmarks/serving_bench_tpu.txt >&2
+  tail -14 benchmarks/serving_bench_tpu.txt >&2
 else
   echo "serving bench failed; keeping prior artifact" >&2
   tail -4 "$tmp" >&2; rm -f "$tmp"

@@ -6,6 +6,7 @@
 #   benchmarks/attention_bench_tpu.txt (flash vs XLA, fwd+bwd, causal +
 #                                      non-causal — backs COVERAGE.md)
 #   benchmarks/generate_bench_tpu.txt  (decode tokens/sec)
+#   benchmarks/serving_bench_tpu.json  (load + length-bucket sweeps)
 #   benchmarks/mfu_tune_results.json   (resnet50 flag/batch sweep)
 #   benchmarks/convergence_record.json (framework-on-TPU vs torch-CPU)
 # Prints a section header per step; steps are independent — a failure
@@ -27,9 +28,11 @@ note "generate bench"
 python benchmarks/generate_bench.py > benchmarks/generate_bench_tpu.txt 2>&1
 tail -4 benchmarks/generate_bench_tpu.txt >&2
 
-note "serving bench (continuous batching: load vs tok/s + TTFT)"
-python benchmarks/serving_bench.py > benchmarks/serving_bench_tpu.txt 2>&1
-tail -7 benchmarks/serving_bench_tpu.txt >&2
+note "serving bench (load sweep + length-distribution/bucket sweep)"
+python benchmarks/serving_bench.py \
+    --json_out benchmarks/serving_bench_tpu.json \
+    > benchmarks/serving_bench_tpu.txt 2>&1
+tail -14 benchmarks/serving_bench_tpu.txt >&2
 
 note "MFU tune sweep (resnet50 north star)"
 python benchmarks/mfu_tune.py --config resnet50_imagenet

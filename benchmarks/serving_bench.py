@@ -1,24 +1,36 @@
-"""Serving-engine throughput: offered load vs tokens/sec and TTFT.
+"""Serving-engine throughput: offered load, and sequence-length cost.
 
-Drives the continuous-batching :class:`ServingEngine` with an
-open-loop request stream (arrival times fixed in advance — the load
-does NOT slow down when the server lags, which is what "heavy traffic"
-means) at several slot counts, and reports per-point:
+Two sweeps over the continuous-batching :class:`ServingEngine`:
 
-- delivered tokens/sec (decode throughput across the run);
-- TTFT mean/p95 (submit -> first token, queueing included);
-- mean slot occupancy and queue depth (is the pool or the arrival
-  process the bottleneck?).
+1. **Load sweep** (``--sweep load``, the original): an open-loop
+   request stream (arrival times fixed in advance — the load does NOT
+   slow down when the server lags, which is what "heavy traffic"
+   means) at several slot counts; per point: delivered tokens/sec,
+   TTFT mean/p95 (submit -> first token, queueing included), queue
+   wait p95, mean occupancy and queue depth.
+
+2. **Length sweep** (``--sweep length``): short / long / mixed prompt
+   length distributions, each served twice — length-bucketed decode
+   (``decode_buckets=auto``) vs the full-``s_max`` window
+   (``decode_buckets=off``, the pre-bucketing engine). The point of
+   record: ``decode_step_avg_s`` tracking ``decode_window_avg``
+   instead of staying flat at ``s_max`` — serving cost following the
+   ACTIVE sequences. Chunked prefill is exercised on the long/mixed
+   distributions (``--prefill_chunk``).
 
 ``offered=inf`` is the closed-loop limit: every request submitted
 up front, measuring peak engine throughput. CPU-runnable (shapes clamp
 down off-TPU, same convention as ``generate_bench.py``), TPU-ready.
+``--json_out`` records every point (plus the compiled window set per
+engine) for the round's evidence JSON.
 
 Run: ``python benchmarks/serving_bench.py [--model gpt_small]
-[--slots 2,4,8] [--offered inf,8]``
+[--sweep load,length] [--slots 2,4,8] [--offered inf,8]
+[--json_out benchmarks/serving_bench_tpu.json]``
 """
 
 import argparse
+import json
 import os
 import sys
 import time
@@ -37,11 +49,12 @@ def _percentile(values, q):
 
 
 def run_point(model, params, prompts, new_tokens, slots, offered_rps,
-              s_max):
+              s_max, **engine_kwargs):
     from pytorch_multiprocessing_distributed_tpu.serving import (
         ServingEngine)
 
-    engine = ServingEngine(model, params, max_slots=slots, s_max=s_max)
+    engine = ServingEngine(model, params, max_slots=slots, s_max=s_max,
+                           **engine_kwargs)
     # arrival schedule: evenly spaced at the offered rate (inf = all at
     # t=0). Open loop — lateness accumulates if the engine can't keep up
     arrivals = ([0.0] * len(prompts) if offered_rps == float("inf")
@@ -49,12 +62,12 @@ def run_point(model, params, prompts, new_tokens, slots, offered_rps,
     t_start = time.perf_counter()
     pending = list(zip(prompts, arrivals))
     finished = []
-    while pending or engine.scheduler.queue_depth or engine.pool.occupancy:
+    while pending or engine.in_flight:
         now = time.perf_counter() - t_start
         while pending and pending[0][1] <= now:
             prompt, _ = pending.pop(0)
             engine.submit(prompt, new_tokens)
-        if engine.scheduler.queue_depth or engine.pool.occupancy:
+        if engine.in_flight:
             for request, _, done in engine.step():
                 if done:
                     finished.append(request)
@@ -62,17 +75,69 @@ def run_point(model, params, prompts, new_tokens, slots, offered_rps,
             time.sleep(min(0.005, pending[0][1] - now))
     wall = time.perf_counter() - t_start
     ttfts = [r.first_token_time - r.submit_time for r in finished]
+    waits = [r.admit_time - r.submit_time for r in finished]
     total_tokens = sum(len(r.tokens) for r in finished)
+    snap = engine.metrics.snapshot()
     return {
         "completed": len(finished),
         "wall_s": wall,
         "tokens_per_sec": total_tokens / wall,
         "ttft_avg_ms": 1e3 * float(np.mean(ttfts)),
         "ttft_p95_ms": 1e3 * _percentile(ttfts, 95),
+        "queue_wait_p95_ms": 1e3 * _percentile(waits, 95),
+        "decode_step_avg_s": snap["decode_step_avg_s"],
+        "decode_window_avg": snap["decode_window_avg"],
         "occupancy_avg": engine.metrics.occupancy.avg,
         "queue_depth_avg": engine.metrics.queue_depth.avg,
         "decode_compiles": engine.decode_step_compiles,
+        "decode_windows": list(engine.decode_windows),
     }
+
+
+def _draw_lengths(rng, dist, n, lo, hi):
+    """Prompt lengths for one distribution family. ``short`` exercises
+    the small decode buckets, ``long`` pins near ``s_max``, ``mixed``
+    interleaves both — the case where per-step bucketing (cost follows
+    the longest ACTIVE sequence as long requests retire) shows up."""
+    short = (max(1, lo), max(1, hi // 4))
+    long_ = (max(1, (3 * hi) // 4), hi)
+    if dist == "short":
+        bands = [short] * n
+    elif dist == "long":
+        bands = [long_] * n
+    else:  # mixed: alternate so both kinds are resident together
+        bands = [short if i % 2 == 0 else long_ for i in range(n)]
+    return [int(rng.integers(a, b + 1)) for a, b in bands]
+
+
+def run_length_sweep(model, params, args, s_max, prompt_hi, rng):
+    """short/long/mixed x (bucketed | full-window) grid; the JSON
+    evidence that decode step time scales with the active bucket."""
+    results = []
+    chunk = args.prefill_chunk or None
+    for dist in args.len_dist.split(","):
+        lengths = _draw_lengths(rng, dist, args.requests,
+                                prompt_hi // 8, prompt_hi)
+        prompts = [rng.integers(0, model.vocab_size, (n,)).tolist()
+                   for n in lengths]
+        for label, buckets in (("auto", None), ("off", ())):
+            r = run_point(model, params, prompts, args.new_tokens,
+                          int(args.slots.split(",")[0]), float("inf"),
+                          s_max, decode_buckets=buckets,
+                          prefill_chunk=chunk)
+            r.update(dist=dist, buckets=label,
+                     prompt_len_min=min(lengths),
+                     prompt_len_max=max(lengths),
+                     prefill_chunk=chunk or 0)
+            results.append(r)
+            print(f"dist={dist:6s} buckets={label:4s}  "
+                  f"{r['tokens_per_sec']:9.1f} tok/s  "
+                  f"step={1e3 * r['decode_step_avg_s']:7.2f} ms  "
+                  f"window={r['decode_window_avg']:6.1f}/{s_max}  "
+                  f"ttft p95={r['ttft_p95_ms']:8.1f} ms  "
+                  f"(compiles={r['decode_compiles']} "
+                  f"windows={r['decode_windows']})", flush=True)
+    return results
 
 
 def main():
@@ -88,6 +153,15 @@ def main():
     p.add_argument("--offered", default="inf,8", type=str,
                    help="offered loads in requests/sec ('inf' = all "
                         "submitted up front)")
+    p.add_argument("--sweep", default="load,length", type=str,
+                   help="which sweeps to run: load, length, or both")
+    p.add_argument("--len_dist", default="short,long,mixed", type=str,
+                   help="length-sweep prompt distributions")
+    p.add_argument("--prefill_chunk", default=32, type=int,
+                   help="length sweep: admit prompts in chunks of N "
+                        "(0 = whole-prompt)")
+    p.add_argument("--json_out", default="", type=str,
+                   help="record every sweep point as JSON")
     p.add_argument("--dtype", default="bfloat16",
                    choices=["float32", "bfloat16"])
     args = p.parse_args()
@@ -103,6 +177,7 @@ def main():
         args.requests = min(args.requests, 8)
         args.prompt_max = min(args.prompt_max, 24)
         args.new_tokens = min(args.new_tokens, 8)
+        args.prefill_chunk = min(args.prefill_chunk, 8)
         dtype = jnp.float32
     model = models.get_model(
         args.model, dtype=dtype,
@@ -117,28 +192,45 @@ def main():
             f"--new_tokens {args.new_tokens} leaves no room for a "
             f"prompt within s_max={s_max} "
             f"(max_seq_len={model.max_seq_len})")
-    prompts = [
-        rng.integers(0, model.vocab_size,
-                     (int(rng.integers(max(1, prompt_hi // 4),
-                                       prompt_hi + 1)),)).tolist()
-        for _ in range(args.requests)]
     print(f"# platform={platform} model={args.model} "
           f"requests={args.requests} prompt<= {args.prompt_max} "
           f"new={args.new_tokens} s_max={s_max}")
 
-    for slots in [int(x) for x in args.slots.split(",")]:
-        for load in args.offered.split(","):
-            rps = float("inf") if load == "inf" else float(load)
-            r = run_point(model, params, prompts, args.new_tokens,
-                          slots, rps, s_max)
-            print(f"slots={slots:3d} offered={load:>5s} req/s  "
-                  f"completed={r['completed']:3d}  "
-                  f"{r['tokens_per_sec']:9.1f} tok/s  "
-                  f"ttft avg={r['ttft_avg_ms']:8.1f} ms "
-                  f"p95={r['ttft_p95_ms']:8.1f} ms  "
-                  f"occ={r['occupancy_avg']:5.2f} "
-                  f"queue={r['queue_depth_avg']:5.2f} "
-                  f"(compiles={r['decode_compiles']})", flush=True)
+    record = {"platform": platform, "model": args.model,
+              "requests": args.requests, "new_tokens": args.new_tokens,
+              "s_max": s_max, "load_sweep": [], "length_sweep": []}
+    sweeps = args.sweep.split(",")
+
+    if "load" in sweeps:
+        prompts = [
+            rng.integers(0, model.vocab_size,
+                         (int(rng.integers(max(1, prompt_hi // 4),
+                                           prompt_hi + 1)),)).tolist()
+            for _ in range(args.requests)]
+        for slots in [int(x) for x in args.slots.split(",")]:
+            for load in args.offered.split(","):
+                rps = float("inf") if load == "inf" else float(load)
+                r = run_point(model, params, prompts, args.new_tokens,
+                              slots, rps, s_max)
+                r.update(slots=slots, offered=load)
+                record["load_sweep"].append(r)
+                print(f"slots={slots:3d} offered={load:>5s} req/s  "
+                      f"completed={r['completed']:3d}  "
+                      f"{r['tokens_per_sec']:9.1f} tok/s  "
+                      f"ttft avg={r['ttft_avg_ms']:8.1f} ms "
+                      f"p95={r['ttft_p95_ms']:8.1f} ms  "
+                      f"occ={r['occupancy_avg']:5.2f} "
+                      f"queue={r['queue_depth_avg']:5.2f} "
+                      f"(compiles={r['decode_compiles']})", flush=True)
+
+    if "length" in sweeps:
+        record["length_sweep"] = run_length_sweep(
+            model, params, args, s_max, prompt_hi, rng)
+
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(record, f, indent=2, sort_keys=True)
+        print(f"# wrote {args.json_out}", flush=True)
 
 
 if __name__ == "__main__":

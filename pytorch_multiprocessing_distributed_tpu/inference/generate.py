@@ -25,6 +25,9 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..ops.pallas.decode_attention import (decode_attention,
+                                           xla_decode_attention)
+
 # flax-default fallback for models predating the ln_eps field; every
 # helper takes eps EXPLICITLY (a forgotten argument must TypeError,
 # not silently run 1e-6 on a GPT-2 checkpoint)
@@ -181,19 +184,104 @@ def _block_decode(p, x_t, k_cache, v_cache, pos, h, dtype, eps,
     v = cs(v, None, None, "model", None)
     k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
     v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
-    scale = q.shape[-1] ** -0.5
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                        k_cache.astype(jnp.float32)) * scale  # [B,H,1,S]
     mask = (jnp.arange(k_cache.shape[1]) <= pos)[None, :]
     if kv_valid is not None:
         mask = jnp.logical_and(mask, kv_valid)
-    probs = jax.nn.softmax(
-        jnp.where(mask[:, None, None, :], logits, -jnp.inf), axis=-1)
-    att = jnp.einsum("bhqk,bkhd->bqhd", probs,
-                     v_cache.astype(jnp.float32))
+    att = xla_decode_attention(q, k_cache, v_cache, mask)
     att = att.reshape(b, 1, -1).astype(dtype)
     x_t = x_t + _dense(att, p["attn"]["wo"], dtype)
     return (x_t + _ffn(p, x_t, dtype, eps, top_k), k_cache, v_cache)
+
+
+def _block_decode_slots(p, x_t, k_cache, v_cache, positions, h, dtype,
+                        eps, cs=_no_cs, top_k=1, window=None,
+                        attn_impl="xla", block_k=256, interpret=None):
+    """Vector-position variant of :func:`_block_decode` — the serving
+    engine's decode body. Each row (slot) writes its pending token's
+    K/V at its OWN position, then attends over the cache prefix
+    ``[0, window)`` (a STATIC slice: the engine picks ``window`` as the
+    power-of-two bucket covering the longest active sequence, so the
+    attention cost tracks real occupancy while the compiled-shape set
+    stays bounded). ``window=None`` (or >= the cache) is the original
+    full-``s_max`` step — the token-exactness reference.
+
+    Writes always go to the FULL cache (an inactive row's frozen
+    position may lie beyond the window; re-hitting its own column is
+    the documented freeze behavior), only the attention reads are
+    windowed. ``attn_impl`` selects the fused flash-decode kernel or
+    the XLA reference (:mod:`...ops.pallas.decode_attention`).
+    """
+    n = x_t.shape[0]
+    rows = jnp.arange(n)
+    hn = _ln(x_t, p["ln1"], eps).astype(dtype)
+    q, k, v = jnp.split(_dense(hn, p["attn"]["wqkv"], dtype), 3, axis=-1)
+    q = cs(_split_heads(q, h), None, None, "model", None)
+    k = cs(_split_heads(k, h), None, None, "model", None)
+    v = cs(_split_heads(v, h), None, None, "model", None)
+    # per-slot column write: slot j's K/V lands at its own position
+    # (generate's dynamic_update_slice, vectorized)
+    k_cache = k_cache.at[rows, positions].set(k[:, 0])
+    v_cache = v_cache.at[rows, positions].set(v[:, 0])
+    if window is not None and window < k_cache.shape[1]:
+        k_win = jax.lax.slice_in_dim(k_cache, 0, window, axis=1)
+        v_win = jax.lax.slice_in_dim(v_cache, 0, window, axis=1)
+    else:
+        k_win, v_win = k_cache, v_cache
+    att = decode_attention(q, k_win, v_win, positions, impl=attn_impl,
+                           block_k=block_k, interpret=interpret)
+    att = att.reshape(n, 1, -1).astype(dtype)
+    x_t = x_t + _dense(att, p["attn"]["wo"], dtype)
+    return (x_t + _ffn(p, x_t, dtype, eps, top_k), k_cache, v_cache)
+
+
+def _block_chunk_prefill(p, x, k_cache, v_cache, start, h, dtype, eps,
+                         cs=_no_cs, top_k=1):
+    """One chunk of an incremental prefill: ``x`` [B, C, D] holds the
+    prompt tokens at absolute positions ``[start, start + C)``;
+    ``k_cache``/``v_cache`` [B, W, H, Dh] already hold the prefix
+    columns ``[0, start)`` from earlier chunks. Writes this chunk's K/V
+    at ``[start, start + C)`` and attends row ``r`` to columns
+    ``[0, start + r]`` — exactly the causal set the one-shot
+    :func:`_block_prefill` gives that token, so chunked and whole-prompt
+    prefill are token-equivalent. Right-pad rows of a final partial
+    chunk write garbage beyond the prompt length; those columns stay
+    masked until the decode loop overwrites them (the standard stale-
+    column invariant)."""
+    b, c, _ = x.shape
+    hn = _ln(x, p["ln1"], eps).astype(dtype)
+    q, k, v = jnp.split(_dense(hn, p["attn"]["wqkv"], dtype), 3, axis=-1)
+    q = cs(_split_heads(q, h), None, None, "model", None)
+    k = cs(_split_heads(k, h), None, None, "model", None)
+    v = cs(_split_heads(v, h), None, None, "model", None)
+    k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, start, 0, 0))
+    v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, start, 0, 0))
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k_cache.astype(jnp.float32)) * scale  # [B,H,C,W]
+    w = k_cache.shape[1]
+    mask = (jnp.arange(w)[None, :]
+            <= start + jnp.arange(c)[:, None])  # [C, W]
+    probs = jax.nn.softmax(
+        jnp.where(mask[None, None], logits, -jnp.inf), axis=-1)
+    att = jnp.einsum("bhqk,bkhd->bqhd", probs,
+                     v_cache.astype(jnp.float32))
+    att = att.reshape(b, c, -1).astype(dtype)
+    x = x + _dense(att, p["attn"]["wo"], dtype)
+    return x + _ffn(p, x, dtype, eps, top_k), k_cache, v_cache
+
+
+def _embed_at(params, tokens, start, dtype):
+    """Embed ``tokens`` [B, C] at absolute positions ``start + r``
+    (traced ``start``), clamping position ids into the table — pad rows
+    past the prompt may sit beyond ``max_seq_len``; their (clamped)
+    embeddings are never attended to. The one-shot paths use
+    :func:`_embed`'s ``dynamic_slice`` instead, whose own clamping
+    would SHIFT valid rows near the table edge."""
+    c = tokens.shape[1]
+    ids = jnp.clip(start + jnp.arange(c)[None, :], 0,
+                   params["pos_embed"].shape[0] - 1)
+    pos = params["pos_embed"][ids]  # [1, C, D] (B=1 broadcast)
+    return (params["embed"][tokens].astype(dtype) + pos.astype(dtype))
 
 
 def _embed(params, tokens, pos_start, dtype, offsets=None):

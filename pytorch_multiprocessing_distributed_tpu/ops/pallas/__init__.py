@@ -7,6 +7,10 @@ kernels cover the spots where hand scheduling buys something XLA can't:
 - :mod:`.flash_attention` — blockwise attention that never materializes
   the [S, S] logits in HBM (long-context support; XLA's dot+softmax+dot
   materializes logits).
+- :mod:`.decode_attention` — flash-decode: the serving engine's
+  one-query-per-slot cached attention step, K/V streamed once through
+  VMEM with an online softmax and a per-slot position gate (cost tracks
+  each slot's true length, not the window).
 - :mod:`.fused_update` — single-pass SGD(momentum, nesterov, wd) update:
   one read of (param, grad, buf), one write of (param, buf), aliased
   in-place in HBM.
@@ -18,6 +22,8 @@ All kernels run compiled on TPU and under ``interpret=True`` on CPU (the
 test path; auto-selected when the backend is not TPU).
 """
 
+from .decode_attention import (  # noqa: F401
+    decode_attention, xla_decode_attention)
 from .flash_attention import flash_attention  # noqa: F401
 from .fused_update import fused_sgd_apply, sgd_pallas  # noqa: F401
 from .ring_allreduce import ring_all_reduce  # noqa: F401

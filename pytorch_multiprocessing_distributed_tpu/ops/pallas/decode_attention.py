@@ -1,0 +1,200 @@
+"""Flash-decode attention: one cached step over a KV window, fused.
+
+The serving engine's decode step is the textbook bandwidth-bound
+workload: ONE query token per slot attending over every cached column.
+XLA's dot+softmax+dot materializes the ``[B, H, 1, S]`` logit row in
+HBM twice (once for the softmax read-back, once for the PV matmul);
+this kernel streams K/V blocks through VMEM exactly once, folding each
+block into an online-softmax recurrence (running max / denominator /
+unnormalized accumulator — the same recurrence as
+:mod:`.flash_attention`, degenerate q-block of 1), so HBM traffic is
+the single K/V read the step fundamentally owes.
+
+Per-slot positions ride in SMEM: block ``kb`` is folded only when
+``kb * block_k <= position`` — a slot at position p pays for
+``ceil((p+1)/block_k)`` blocks, not ``S/block_k``, which is what makes
+the engine's length-bucketed window *and* this kernel compose (the
+bucket bounds the grid, the position gate bounds the work inside it).
+
+Matmuls stay in the input dtype (bf16 hits the MXU's native rate),
+accumulation is f32, outputs are f32 (the engine casts back to model
+dtype after the residual add, matching the XLA path's dtypes exactly).
+
+``impl="xla"`` is the reference fallback — the exact einsum/softmax
+math the engine shipped with (and ``inference.generate`` still uses),
+kept here so both paths live side by side and the equivalence test has
+a single seam. CPU tier-1 exercises the kernel via Pallas interpret
+mode (auto-selected off-TPU, same convention as every kernel in this
+package).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .flash_attention import NEG_INF
+
+
+def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, acc, m_scr,
+                   l_scr, *, scale, block_k):
+    """One (slot*head, k-block) grid cell; k is the innermost axis so
+    the softmax state lives in VMEM scratch across the K/V stream."""
+    kb = pl.program_id(1)
+    n_k = pl.num_programs(1)
+
+    @pl.when(kb == 0)
+    def _():
+        acc[:] = jnp.zeros_like(acc)
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    pos = pos_ref[0]
+
+    # whole block beyond the slot's position -> nothing to fold (this,
+    # not the grid, is what makes cost track each slot's true length)
+    @pl.when(kb * block_k <= pos)
+    def _():
+        q = q_ref[0]          # [1, d]
+        kblk = k_ref[0]       # [bk, d]
+        vblk = v_ref[0]
+        s = jnp.dot(q, kblk.T,
+                    preferred_element_type=jnp.float32) * scale  # [1, bk]
+        col = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1)
+        s = jnp.where(col <= pos, s, NEG_INF)
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        m_scr[:] = m_new
+        l_scr[:] = l_scr[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc[:] = acc[:] * corr + jnp.dot(
+            p.astype(vblk.dtype), vblk,
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kb == n_k - 1)
+    def _():
+        o_ref[0] = acc[:] / jnp.maximum(l_scr[:], 1e-30)
+
+
+def _pallas_decode(q, k, v, positions, scale, block_k, interpret):
+    """q [B, 1, H, Dh]; k/v [B, S, H, Dh]; positions [B] -> f32
+    [B, 1, H, Dh]. Heads merge into the grid's batch axis (one
+    (slot, head) pair per row program), K/V stream blockwise."""
+    b, _, h, d = q.shape
+    s = k.shape[1]
+    block_k = max(8, min(block_k, ((s + 7) // 8) * 8))
+    pad = (-s) % block_k
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    n_k = k.shape[1] // block_k
+
+    def merge(x):  # [B, S, H, Dh] -> [B*H, S, Dh]
+        return jnp.moveaxis(x, 2, 1).reshape(b * h, x.shape[1], d)
+
+    q3 = merge(q)                      # [B*H, 1, Dh]
+    k3, v3 = merge(k), merge(v)
+    # one position scalar per (slot, head) row program
+    pos_bh = jnp.repeat(positions.astype(jnp.int32), h)
+
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, scale=scale, block_k=block_k),
+        grid=(b * h, n_k),
+        in_specs=[
+            pl.BlockSpec((1,), lambda i, kb: (i,),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, d), lambda i, kb: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda i, kb: (i, kb, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda i, kb: (i, kb, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1, d), lambda i, kb: (i, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b * h, 1, d), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((1, d), jnp.float32),   # output accumulator
+            pltpu.VMEM((1, 1), jnp.float32),   # running max
+            pltpu.VMEM((1, 1), jnp.float32),   # running denominator
+        ],
+        interpret=interpret,
+    )(pos_bh, q3, k3, v3)
+    return jnp.moveaxis(out.reshape(b, h, 1, d), 1, 2)  # [B, 1, H, Dh]
+
+
+def xla_decode_attention(q, k, v, mask):
+    """The reference math (bit-identical to the engine's original
+    inline einsums and ``inference.generate._block_decode``): f32
+    logits, masked softmax, f32 PV. ``mask``: [B, S] key validity."""
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    probs = jax.nn.softmax(
+        jnp.where(mask[:, None, None, :], logits, -jnp.inf), axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+
+
+def decode_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    positions: Optional[jax.Array] = None,
+    *,
+    mask: Optional[jax.Array] = None,
+    impl: str = "auto",
+    block_k: int = 256,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Single-step cached attention over a KV window.
+
+    Args:
+      q: ``[B, 1, H, Dh]`` — one pending query token per slot.
+      k, v: ``[B, S, H, Dh]`` KV window (the engine passes the
+        length-bucketed prefix slice of its slot caches).
+      positions: ``[B]`` int — slot ``b`` attends columns
+        ``[0, positions[b]]`` inclusive. Required for the Pallas path;
+        the XLA path derives ``mask`` from it when ``mask`` is None.
+      mask: ``[B, S]`` bool key validity (XLA path only) — lets ragged
+        ``generate`` compose its pad-column mask in.
+      impl: ``"pallas"`` | ``"xla"`` | ``"auto"`` (pallas on real TPU,
+        xla elsewhere — the serving engine overrides to exercise the
+        kernel in interpret mode on CPU tests).
+      block_k: K/V block streamed per grid step (pallas path).
+      interpret: force Pallas interpret mode; default auto (interpret
+        everywhere except real TPU).
+
+    Returns ``[B, 1, H, Dh]`` f32 attention output (caller casts).
+    """
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if impl == "pallas":
+        if positions is None:
+            raise ValueError("the pallas decode path needs positions")
+        if mask is not None:
+            raise ValueError(
+                "mask composes only with impl='xla' (the pallas kernel "
+                "masks from positions)")
+        if interpret is None:
+            from . import default_interpret
+
+            interpret = default_interpret()
+        scale = q.shape[-1] ** -0.5
+        return _pallas_decode(q, k, v, positions, scale, int(block_k),
+                              bool(interpret))
+    if impl != "xla":
+        raise ValueError(
+            f"impl must be 'pallas', 'xla' or 'auto', got {impl!r}")
+    if mask is None:
+        if positions is None:
+            raise ValueError("xla path needs positions or mask")
+        mask = (jnp.arange(k.shape[1])[None, :]
+                <= positions[:, None])
+    return xla_decode_attention(q, k, v, mask)

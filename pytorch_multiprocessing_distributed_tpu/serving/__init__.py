@@ -1,19 +1,24 @@
 """Serving: continuous-batching request engine over the KV-cache decode.
 
 The inference half of the north star ("serve heavy traffic"): a
-slot-based engine (``engine``) whose jitted decode step has ONE
-compiled signature regardless of which requests occupy the pool
-(``kv_slots``), fed by a FIFO scheduler with admission control
-(``scheduler``), loading trained checkpoints param-only (``params``).
-CLI: repo-root ``serve_lm.py``.
+slot-based engine (``engine``) whose jitted decode step keeps a SMALL
+FIXED compiled-program set — one per length bucket, never per batch
+composition — with per-step attention cost tracking the longest
+ACTIVE sequence instead of the cache capacity (``kv_slots``), prompts
+admitted whole or in fixed-size chunks interleaved with decode
+(``scheduler.PrefillPlan``), fed by a FIFO scheduler with admission
+control (``scheduler``), loading trained checkpoints param-only
+(``params``). CLI: repo-root ``serve_lm.py``.
 """
 
 from .engine import ServingEngine
 from .kv_slots import SlotPool
 from .params import init_params, load_params
-from .scheduler import FIFOScheduler, QueueFull, Request
+from .scheduler import (FIFOScheduler, PrefillPlan, QueueFull, Request,
+                        bucket_length)
 
 __all__ = [
-    "ServingEngine", "SlotPool", "FIFOScheduler", "QueueFull",
-    "Request", "init_params", "load_params",
+    "ServingEngine", "SlotPool", "FIFOScheduler", "PrefillPlan",
+    "QueueFull", "Request", "bucket_length", "init_params",
+    "load_params",
 ]

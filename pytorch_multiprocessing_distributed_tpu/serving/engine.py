@@ -5,31 +5,49 @@ B continuations out, everything retired together. A serving workload is
 the opposite shape — requests arrive whenever, finish whenever — and
 the naive answer (re-invoke ``generate`` per batch composition) would
 recompile or at best re-prefill constantly. This engine converts the
-same ``_prefill``/cached-attention machinery into a persistent loop with
-ONE compiled decode signature:
+same ``_prefill``/cached-attention machinery into a persistent loop
+whose compiled-program set is SMALL and FIXED, and whose per-step cost
+tracks the work actually resident:
 
 - the KV cache is a :class:`~.kv_slots.SlotPool` — fixed
   ``[layers, max_slots, s_max, heads, head_dim]`` arrays, per-slot
   position counters, an active mask;
-- a joining request is prefilled ALONE (the shared
-  ``inference.generate._prefill``, right-padded to a power-of-two
-  bucket so prefill compiles per bucket, not per length), its caches
-  are spliced into a free slot, and its first token is sampled from the
-  prefill logits — exactly ``generate``'s ``tok0`` path;
-- every engine step then runs one batched decode over ALL slots with
-  per-slot positions; occupancy only changes mask *values*, so the
-  jitted step compiles exactly once for the engine's lifetime
-  (``decode_step_compiles`` pins it via
-  ``utils.compile_cache.jit_cache_size``);
+- **length-bucketed decode**: each step attends over the cache prefix
+  ``[0, W)`` where ``W`` is the smallest configured bucket covering the
+  longest ACTIVE sequence (tracked host-side by the pool, no device
+  sync). ``W`` is a jit-static, so the decode step compiles once per
+  bucket — a bounded ladder (``decode_buckets``), pinned via
+  ``utils.compile_cache.jit_cache_size``/``jit_cache_keys`` — and a
+  pool full of short sequences no longer pays ``s_max`` attention
+  reads per token. Token-exact with the full-window step: the windowed
+  columns are exactly the unmasked ones;
+- **prefill-on-join**, whole-prompt or chunked. Whole-prompt: the
+  shared ``inference.generate._prefill`` on one right-padded prompt
+  (compiles per power-of-two bucket), its caches spliced into a free
+  slot, first token sampled from the prefill logits — exactly
+  ``generate``'s ``tok0`` path. **Chunked** (``prefill_chunk=N``): the
+  prompt runs through a fixed-shape ``[1, N]`` incremental-prefill
+  program, ONE chunk per engine step, interleaved with the resident
+  decode — no resident request ever stalls longer than one chunk's
+  latency for its next token (the TTFT head-of-line fix), and the
+  chunk program compiles once per ``(chunk, width)`` pair
+  (:class:`~.scheduler.PrefillPlan`);
+- decode attention runs through the fused flash-decode kernel
+  (:mod:`...ops.pallas.decode_attention` — bf16 MXU matmuls, f32
+  online-softmax accumulation, per-slot position gate) on TPU, the
+  bit-identical XLA reference elsewhere; CPU tests pin the kernel in
+  interpret mode;
 - finished slots (EOS / ``max_new_tokens``) are recycled in place —
   stale cache columns are masked until the next tenant overwrites them
   (see ``kv_slots`` invariants).
 
 Greedy decode through the engine is token-for-token identical to
-per-request ``generate`` calls (test-pinned, dense and MoE): same
-helpers, same dtype/eps conventions, per-slot positions in place of the
-scan counter. With ``mesh`` the caches and attention shard over the
-``model`` axis exactly like TP ``generate`` — single-host TP serving.
+per-request ``generate`` calls (test-pinned, dense and MoE, bucketed
+and chunked): same helpers, same dtype/eps conventions, per-slot
+positions in place of the scan counter. With ``mesh`` the caches and
+attention shard over the ``model`` axis exactly like TP ``generate`` —
+single-host TP serving (XLA attention path; the Pallas kernel is
+single-shard).
 """
 
 from __future__ import annotations
@@ -43,24 +61,30 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..inference.generate import (
-    _LN_EPS, _dense, _ffn, _ln, _logits, _make_cs, _prefill, _sample,
-    _split_heads)
-from ..utils.compile_cache import jit_cache_size
+    _LN_EPS, _block_chunk_prefill, _block_decode_slots, _embed_at,
+    _logits, _make_cs, _prefill, _sample)
+from ..utils.compile_cache import (jit_cache_keys, jit_cache_size,
+                                   record_jit_key)
 from ..utils.metrics import ServingMetrics
 from .kv_slots import SlotPool
-from .scheduler import DONE, FIFOScheduler, Request
+from .scheduler import (DONE, FIFOScheduler, PrefillPlan, Request,
+                        bucket_length)
 
 __all__ = ["ServingEngine", "Request"]
 
 
-def _bucket(length: int, min_bucket: int, s_max: int) -> int:
-    """Smallest power-of-two >= length (floored at ``min_bucket``,
-    capped at ``s_max``): prefill compiles once per bucket instead of
-    once per prompt length."""
-    b = min_bucket
-    while b < length:
-        b *= 2
-    return min(b, s_max)
+class _PendingPrefill:
+    """Host-side state of the one request currently mid-chunked-prefill:
+    its chunk plan plus the standalone caches the chunks accumulate
+    into (spliced into a pool slot after the last chunk)."""
+
+    __slots__ = ("request", "plan", "k_pref", "v_pref")
+
+    def __init__(self, request, plan, k_pref, v_pref):
+        self.request = request
+        self.plan = plan
+        self.k_pref = k_pref
+        self.v_pref = v_pref
 
 
 class ServingEngine:
@@ -81,11 +105,30 @@ class ServingEngine:
       temperature/top_k/top_p: sampling config, engine-wide statics
         (0/0/0 = greedy). NOTE: greedy is the mode pinned equivalent to
         ``generate``; sampled streams draw from a per-step key shared
-        across slots, so they are reproducible per engine run but not
-        comparable to per-request ``generate`` draws.
+        across slots, so they are reproducible per engine run (at fixed
+        ``prefill_chunk``) but not comparable to per-request
+        ``generate`` draws.
       rng: PRNGKey, required when ``temperature > 0``.
       eos_id: default stop token (per-request ``eos_id`` overrides).
-      min_bucket: smallest prefill bucket (power of two).
+      min_bucket: smallest prefill bucket AND the decode-bucket
+        ladder's first rung (power of two).
+      decode_buckets: attention-window ladder for bucketed decode.
+        None (default) = powers of two from ``min_bucket`` up to
+        ``s_max``; an explicit ascending sequence pins the ladder
+        (``s_max`` is appended if absent); an EMPTY sequence disables
+        bucketing — every step attends the full ``s_max`` window, the
+        PR-1 behavior the bench uses as its baseline. The decode step
+        compiles once per bucket the traffic actually touches, never
+        more than ``len(decode_buckets)`` programs.
+      prefill_chunk: admit prompts through fixed-size chunks of this
+        many tokens, one chunk per engine step, instead of one
+        whole-prompt call (None = whole-prompt). Bounds every resident
+        request's between-token stall to one chunk's latency.
+      decode_attn: ``"pallas"`` | ``"xla"`` | ``"auto"`` — decode-step
+        attention implementation (auto: the fused kernel on single-
+        shard TPU, XLA elsewhere; ``"pallas"`` with a mesh is
+        rejected).
+      decode_block_k: K/V block size the Pallas decode kernel streams.
     """
 
     def __init__(self, model, params, *, max_slots: int,
@@ -93,7 +136,10 @@ class ServingEngine:
                  max_queue: Optional[int] = None, temperature: float = 0.0,
                  top_k: int = 0, top_p: float = 0.0,
                  rng: Optional[jax.Array] = None,
-                 eos_id: Optional[int] = None, min_bucket: int = 16):
+                 eos_id: Optional[int] = None, min_bucket: int = 16,
+                 decode_buckets: Optional[Sequence[int]] = None,
+                 prefill_chunk: Optional[int] = None,
+                 decode_attn: str = "auto", decode_block_k: int = 256):
         if getattr(model, "seq_axis", None) is not None:
             raise NotImplementedError(
                 "the engine wants the dense view of an SP model — pass "
@@ -119,6 +165,17 @@ class ServingEngine:
         if min_bucket < 1:
             raise ValueError(
                 f"min_bucket must be >= 1, got {min_bucket}")
+        if decode_attn not in ("auto", "xla", "pallas"):
+            raise ValueError(
+                f"decode_attn must be 'auto', 'xla' or 'pallas', got "
+                f"{decode_attn!r}")
+        if decode_attn == "pallas" and mesh is not None:
+            raise ValueError(
+                "decode_attn='pallas' is single-shard; TP serving "
+                "(mesh) uses the XLA attention path")
+        if prefill_chunk is not None and prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1, got {prefill_chunk}")
         self.model = model
         self.params = params
         self.mesh = mesh
@@ -131,6 +188,16 @@ class ServingEngine:
                      else jnp.zeros((2,), jnp.uint32))
         self._sampling = (float(temperature), int(top_k), float(top_p))
         self._running: Dict[int, Request] = {}
+        self._pending: Optional[_PendingPrefill] = None
+        self._prefill_chunk = (None if prefill_chunk is None
+                               else int(prefill_chunk))
+        self._buckets = self._build_buckets(decode_buckets)
+        if decode_attn == "auto":
+            decode_attn = ("pallas" if (mesh is None and
+                                        jax.default_backend() == "tpu")
+                           else "xla")
+        self._attn_impl = decode_attn
+        self._decode_block_k = int(decode_block_k)
         self._step_idx = 0
         self._key_idx = 0  # one fresh fold per sampled program call
         # donation keeps one resident cache copy per step on TPU; the
@@ -140,7 +207,7 @@ class ServingEngine:
         # pool's own placements — otherwise GSPMD's (normalized) output
         # sharding differs from the first call's input sharding and the
         # second call silently specializes a second executable,
-        # breaking the compile-once guarantee on a mesh
+        # breaking the bucketed compile budget on a mesh
         if mesh is not None:
             cache_sh = NamedSharding(
                 mesh, P(None, None, None, "model", None))
@@ -148,14 +215,23 @@ class ServingEngine:
             decode_out = (rep, cache_sh, cache_sh, rep, rep)
             insert_out = (cache_sh, cache_sh, rep, rep, rep)
             prefill_out = (rep, cache_sh, cache_sh)
+            chunk_out = (rep, cache_sh, cache_sh)
             release_out = rep
+            tok0_out = rep
         else:
-            decode_out = insert_out = prefill_out = release_out = None
+            decode_out = insert_out = prefill_out = None
+            chunk_out = release_out = tok0_out = None
         self._decode = jax.jit(
             self._make_decode_step(), out_shardings=decode_out,
+            static_argnames=("window",),
             donate_argnums=(1, 2, 3, 4) if donate_cache else ())
         self._prefill_jit = jax.jit(self._make_prefill(),
                                     out_shardings=prefill_out)
+        self._chunk_jit = jax.jit(
+            self._make_chunk_prefill(), out_shardings=chunk_out,
+            donate_argnums=(1, 2) if donate_cache else ())
+        self._tok0_jit = jax.jit(self._make_tok0(),
+                                 out_shardings=tok0_out)
         self._insert_jit = jax.jit(
             self._insert_fn, out_shardings=insert_out,
             donate_argnums=(0, 1, 2, 3, 4) if donate_cache else ())
@@ -164,11 +240,35 @@ class ServingEngine:
             out_shardings=release_out,
             donate_argnums=(0,) if donate_cache else ())
 
+    def _build_buckets(self, decode_buckets) -> Tuple[int, ...]:
+        """Normalize the decode-window ladder: ascending, capped by and
+        terminating at ``s_max`` (the fallback window every request
+        fits by admission control)."""
+        s_max = self.pool.s_max
+        if decode_buckets is None:
+            ladder = []
+            b = self.min_bucket
+            while b < s_max:
+                ladder.append(b)
+                b *= 2
+            ladder.append(s_max)
+            return tuple(ladder)
+        ladder = sorted({int(b) for b in decode_buckets})
+        if ladder and ladder[0] < 1:
+            raise ValueError(
+                f"decode_buckets must be >= 1, got {ladder[0]}")
+        ladder = [b for b in ladder if b <= s_max]
+        if not ladder or ladder[-1] != s_max:
+            ladder.append(s_max)
+        return tuple(ladder)
+
     # ---- jitted programs ----------------------------------------------
     def _make_decode_step(self):
-        """One masked decode step over every slot; THE one-compile
-        signature. Mirrors ``generate``'s scan body with the scalar
-        position replaced by the per-slot position vector."""
+        """One masked decode step over every slot. ``window`` is the
+        jit-static attention prefix — the bucketed-compile signature;
+        the body is the SHARED ``inference.generate._block_decode_slots``
+        (generate's scan body with the scalar position replaced by the
+        per-slot position vector)."""
         model = self.model
         cs = _make_cs(self.mesh)
         dtype = model.dtype
@@ -177,15 +277,15 @@ class ServingEngine:
         h = model.num_heads
         n_layers = model.num_layers
         temperature, top_k, top_p = self._sampling
+        attn_impl = self._attn_impl
+        block_k = self._decode_block_k
 
         def cs_cache(c):
             return cs(c, None, None, None, "model", None)
 
         def step(params, k_caches, v_caches, positions, last_tokens,
-                 active, key):
+                 active, key, *, window):
             n = positions.shape[0]
-            s = k_caches.shape[2]
-            rows = jnp.arange(n)
             # embed each slot's pending token at its own position
             # (cast-then-add, the model's own order — see _embed)
             pos_emb = params["pos_embed"][positions][:, None, :]
@@ -193,32 +293,12 @@ class ServingEngine:
                    + pos_emb.astype(dtype))
             new_k, new_v = [], []
             for i in range(n_layers):
-                p = params[f"block_{i}"]
-                hn = _ln(x_t, p["ln1"], eps).astype(dtype)
-                q, k, v = jnp.split(
-                    _dense(hn, p["attn"]["wqkv"], dtype), 3, axis=-1)
-                q = cs(_split_heads(q, h), None, None, "model", None)
-                k = cs(_split_heads(k, h), None, None, "model", None)
-                v = cs(_split_heads(v, h), None, None, "model", None)
-                # per-slot column write: slot j's K/V lands at its own
-                # position (generate's dynamic_update_slice, vectorized)
-                k_cache = k_caches[i].at[rows, positions].set(k[:, 0])
-                v_cache = v_caches[i].at[rows, positions].set(v[:, 0])
-                scale = q.shape[-1] ** -0.5
-                logits = jnp.einsum(
-                    "bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                    k_cache.astype(jnp.float32)) * scale
-                mask = jnp.arange(s)[None, :] <= positions[:, None]
-                probs = jax.nn.softmax(
-                    jnp.where(mask[:, None, None, :], logits, -jnp.inf),
-                    axis=-1)
-                att = jnp.einsum("bhqk,bkhd->bqhd", probs,
-                                 v_cache.astype(jnp.float32))
-                att = att.reshape(n, 1, -1).astype(dtype)
-                x_t = x_t + _dense(att, p["attn"]["wo"], dtype)
-                x_t = x_t + _ffn(p, x_t, dtype, eps, moe_k)
-                new_k.append(k_cache)
-                new_v.append(v_cache)
+                x_t, kc, vc = _block_decode_slots(
+                    params[f"block_{i}"], x_t, k_caches[i], v_caches[i],
+                    positions, h, dtype, eps, cs, moe_k, window=window,
+                    attn_impl=attn_impl, block_k=block_k)
+                new_k.append(kc)
+                new_v.append(vc)
             logits = _logits(params, x_t, eps, cs)[:, 0]
             nxt = _sample(logits, temperature, top_k, top_p,
                           key).astype(jnp.int32)
@@ -232,8 +312,8 @@ class ServingEngine:
         return step
 
     def _make_prefill(self):
-        """Prefill-on-join: the SHARED ``_prefill`` pass on one
-        right-padded prompt + first-token sampling (``generate``'s
+        """Whole-prompt prefill-on-join: the SHARED ``_prefill`` pass on
+        one right-padded prompt + first-token sampling (``generate``'s
         ``tok0``). Causality makes right-pad columns invisible to the
         real prefix, so no masks are needed; compiles once per bucket
         size (the prompt's padded shape)."""
@@ -257,6 +337,53 @@ class ServingEngine:
 
         return prefill
 
+    def _make_chunk_prefill(self):
+        """One ``[1, chunk]`` slice of an incremental prefill: writes
+        the chunk's K/V at ``[start, start+chunk)`` into the standalone
+        prefill cache and attends each token to its causal prefix
+        (``inference.generate._block_chunk_prefill``). ONE static shape
+        per (chunk, cache-width) pair regardless of prompt length or
+        chunk index — ``start`` is traced."""
+        model = self.model
+        cs = _make_cs(self.mesh)
+        dtype = model.dtype
+        eps = getattr(model, "ln_eps", _LN_EPS)
+        moe_k = getattr(model, "moe_top_k", 1)
+        h = model.num_heads
+        n_layers = model.num_layers
+
+        def cs_cache(c):
+            return cs(c, None, None, None, "model", None)
+
+        def chunk(params, k_pref, v_pref, tokens, start):
+            x = _embed_at(params, tokens, start, dtype)
+            new_k, new_v = [], []
+            for i in range(n_layers):
+                x, kc, vc = _block_chunk_prefill(
+                    params[f"block_{i}"], x, k_pref[i], v_pref[i],
+                    start, h, dtype, eps, cs, moe_k)
+                new_k.append(kc)
+                new_v.append(vc)
+            return (x, cs_cache(jnp.stack(new_k)),
+                    cs_cache(jnp.stack(new_v)))
+
+        return chunk
+
+    def _make_tok0(self):
+        """First-token sampling off the final chunk's activations —
+        ``generate``'s ``tok0`` math on a dynamic within-chunk index."""
+        cs = _make_cs(self.mesh)
+        eps = getattr(self.model, "ln_eps", _LN_EPS)
+        temperature, top_k, top_p = self._sampling
+
+        def tok0_fn(params, x, idx, key):
+            x_last = jax.lax.dynamic_slice_in_dim(x, idx, 1, axis=1)
+            logits = _logits(params, x_last, eps, cs)[:, 0]
+            tok = _sample(logits, temperature, top_k, top_p, key)
+            return tok[0].astype(jnp.int32)
+
+        return tok0_fn
+
     @staticmethod
     def _insert_fn(k_caches, v_caches, positions, last_tokens, active,
                    k_pref, v_pref, slot, length, tok0):
@@ -265,7 +392,14 @@ class ServingEngine:
         counter starts at the prompt length, the pending token is the
         prefill's first sample. Pad/stale columns beyond ``length`` are
         masked until the decode position reaches (and overwrites) them.
+        A chunk-plan cache may be up to ``chunk - 1`` pad columns wider
+        than ``s_max``; the overshoot is sliced off here (valid columns
+        end at the prompt length, which admission bounds by ``s_max``).
         """
+        s_max = k_caches.shape[2]
+        if k_pref.shape[2] > s_max:
+            k_pref = jax.lax.slice_in_dim(k_pref, 0, s_max, axis=2)
+            v_pref = jax.lax.slice_in_dim(v_pref, 0, s_max, axis=2)
         k_caches = jax.lax.dynamic_update_slice(
             k_caches, k_pref, (0, slot, 0, 0, 0))
         v_caches = jax.lax.dynamic_update_slice(
@@ -278,13 +412,33 @@ class ServingEngine:
     # ---- compile counters ---------------------------------------------
     @property
     def decode_step_compiles(self) -> int:
-        """Distinct compiled decode-step programs (must stay 1)."""
+        """Distinct compiled decode-step programs (<= the bucket
+        ladder's length; == the buckets the traffic touched)."""
         return jit_cache_size(self._decode)
 
     @property
+    def decode_windows(self) -> Tuple[int, ...]:
+        """The window buckets that actually compiled, in first-use
+        order (``compile_cache.jit_cache_keys``)."""
+        return tuple(w for tag, w in jit_cache_keys(self._decode)
+                     if tag == "decode")
+
+    @property
+    def decode_buckets(self) -> Tuple[int, ...]:
+        """The configured window ladder (ends at ``s_max``)."""
+        return self._buckets
+
+    @property
     def prefill_compiles(self) -> int:
-        """Distinct compiled prefill programs (== buckets seen)."""
+        """Distinct compiled whole-prompt prefill programs (== buckets
+        seen)."""
         return jit_cache_size(self._prefill_jit)
+
+    @property
+    def chunk_prefill_compiles(self) -> int:
+        """Distinct compiled chunk-prefill programs (== (chunk, width)
+        pairs seen)."""
+        return jit_cache_size(self._chunk_jit)
 
     # ---- request lifecycle --------------------------------------------
     def submit(self, prompt: Sequence[int], max_new_tokens: int, *,
@@ -330,63 +484,152 @@ class ServingEngine:
         self.scheduler.complete(request, reason)
         self.metrics.record_completion()
 
+    def _pop_admission(self) -> Optional[Request]:
+        """FIFO head into prefill: stamp admission (the queue-wait half
+        of TTFT) the moment its prefill work is about to start."""
+        request = self.scheduler.next_to_admit()
+        if request is not None:
+            request.admit_time = time.perf_counter()
+            self.metrics.record_admission(
+                request.admit_time - request.submit_time)
+        return request
+
+    def _first_token(self, request: Request, token: int,
+                     events: List) -> Optional[int]:
+        """Shared tail of both prefill paths: stamp TTFT, record the
+        token, retire an already-finished request or acquire its slot
+        (returned; None = retired)."""
+        request.first_token_time = time.perf_counter()
+        self.metrics.record_first_token(
+            request.first_token_time - request.submit_time)
+        request.tokens.append(token)
+        reason = self._finished(request, token)
+        if reason is not None:
+            self._complete(request, reason)
+            events.append((request, token, True))
+            return None
+        slot = self.pool.acquire()
+        request.slot = slot
+        self._running[slot] = request
+        events.append((request, token, False))
+        return slot
+
     def _admit(self) -> List[Tuple[Request, int, bool]]:
-        """Move FIFO-head requests into free slots: prefill, record
-        TTFT, splice into the pool (or retire immediately when the
-        prefill token already finishes the request)."""
-        events = []
+        """Move FIFO-head requests toward slots. Whole-prompt mode
+        fills every free slot with one prefill call each; chunked mode
+        advances the single in-flight :class:`PrefillPlan` by EXACTLY
+        one chunk (the bounded stall the mode exists for) and splices
+        on the final chunk."""
+        if self._prefill_chunk is None:
+            return self._admit_whole()
+        return self._admit_chunked()
+
+    def _admit_whole(self) -> List[Tuple[Request, int, bool]]:
+        events: List[Tuple[Request, int, bool]] = []
         pool = self.pool
         while pool.free_slots > 0:
-            request = self.scheduler.next_to_admit()
+            request = self._pop_admission()
             if request is None:
                 break
             length = len(request.prompt)
-            bucket = _bucket(length, self.min_bucket, pool.s_max)
+            bucket = bucket_length(length, self.min_bucket, pool.s_max)
             padded = np.zeros((1, bucket), np.int32)
             padded[0, :length] = request.prompt
             key = self._next_key()
             tok0, k_pref, v_pref = self._prefill_jit(
                 self.params, jnp.asarray(padded), jnp.int32(length), key)
-            token = int(tok0)
-            request.first_token_time = time.perf_counter()
-            self.metrics.record_first_token(
-                request.first_token_time - request.submit_time)
-            request.tokens.append(token)
-            reason = self._finished(request, token)
-            if reason is not None:
-                self._complete(request, reason)
-                events.append((request, token, True))
+            record_jit_key(self._prefill_jit, ("prefill", bucket))
+            slot = self._first_token(request, int(tok0), events)
+            if slot is None:
                 continue
-            slot = pool.acquire()
-            request.slot = slot
             (pool.k_caches, pool.v_caches, pool.positions,
              pool.last_tokens, pool.active) = self._insert_jit(
                 pool.k_caches, pool.v_caches, pool.positions,
                 pool.last_tokens, pool.active, k_pref, v_pref,
                 jnp.int32(slot), jnp.int32(length), tok0)
-            self._running[slot] = request
-            events.append((request, token, False))
+            pool.note_insert(slot, length)
         return events
 
+    def _admit_chunked(self) -> List[Tuple[Request, int, bool]]:
+        events: List[Tuple[Request, int, bool]] = []
+        pool = self.pool
+        if self._pending is None and pool.free_slots > 0:
+            request = self._pop_admission()
+            if request is not None:
+                plan = PrefillPlan(request, self._prefill_chunk,
+                                   self.min_bucket, pool.s_max)
+                model = self.model
+                shape = (model.num_layers, 1, plan.width,
+                         model.num_heads,
+                         model.hidden_size // model.num_heads)
+                zeros = jnp.zeros(shape, model.dtype)
+                self._pending = _PendingPrefill(
+                    request, plan, pool._cache_sharded(zeros),
+                    pool._cache_sharded(jnp.zeros(shape, model.dtype)))
+        pend = self._pending
+        if pend is None:
+            return events
+        start, valid, is_last = pend.plan.next_chunk()
+        chunk = pend.plan.chunk
+        padded = np.zeros((1, chunk), np.int32)
+        padded[0, :valid] = pend.request.prompt[start:start + valid]
+        x, pend.k_pref, pend.v_pref = self._chunk_jit(
+            self.params, pend.k_pref, pend.v_pref,
+            jnp.asarray(padded), jnp.int32(start))
+        record_jit_key(self._chunk_jit,
+                       ("prefill_chunk", chunk, pend.plan.width))
+        if not is_last:
+            return events
+        self._pending = None
+        key = self._next_key()
+        tok0 = self._tok0_jit(self.params, x,
+                              jnp.int32(pend.plan.length - 1 - start),
+                              key)
+        slot = self._first_token(pend.request, int(tok0), events)
+        if slot is None:
+            return events
+        (pool.k_caches, pool.v_caches, pool.positions,
+         pool.last_tokens, pool.active) = self._insert_jit(
+            pool.k_caches, pool.v_caches, pool.positions,
+            pool.last_tokens, pool.active, pend.k_pref, pend.v_pref,
+            jnp.int32(slot), jnp.int32(pend.plan.length), tok0)
+        pool.note_insert(slot, pend.plan.length)
+        return events
+
+    def _pick_window(self) -> int:
+        """Smallest configured bucket covering the longest ACTIVE
+        sequence's next write (host-mirrored — no device sync)."""
+        need = self.pool.max_active_pos + 1
+        for b in self._buckets:
+            if b >= need:
+                return b
+        return self._buckets[-1]
+
     def step(self) -> List[Tuple[Request, int, bool]]:
-        """One engine iteration: admit into free slots, then one
-        batched decode step over the pool. Returns the step's token
+        """One engine iteration: admit (a whole prompt per free slot,
+        or one chunk), then one batched decode step over the pool at
+        the active-length bucket window. Returns the step's token
         events as ``(request, token, finished)`` tuples (admission
         first tokens included)."""
         events = self._admit()
         pool = self.pool
         if self._running:
             key = self._next_key()
+            window = self._pick_window()
             t0 = time.perf_counter()
             (nxt, pool.k_caches, pool.v_caches, pool.positions,
              pool.last_tokens) = self._decode(
                 self.params, pool.k_caches, pool.v_caches,
-                pool.positions, pool.last_tokens, pool.active, key)
+                pool.positions, pool.last_tokens, pool.active, key,
+                window=window)
+            record_jit_key(self._decode, ("decode", window))
+            pool.note_advance()
             tokens = np.asarray(nxt)  # the step's one host sync
             dt = time.perf_counter() - t0
             emitted = len(self._running)
             self.metrics.record_decode_step(
-                dt, emitted, pool.occupancy, self.scheduler.queue_depth)
+                dt, emitted, pool.occupancy, self.scheduler.queue_depth,
+                window)
             for slot, request in list(self._running.items()):
                 token = int(tokens[slot])
                 request.tokens.append(token)
@@ -401,10 +644,17 @@ class ServingEngine:
         self._step_idx += 1
         return events
 
+    @property
+    def in_flight(self) -> int:
+        """Requests somewhere in the engine: queued, mid-chunked-
+        prefill, or decoding (drive loops should drain until 0)."""
+        return (self.scheduler.queue_depth + len(self._running)
+                + (1 if self._pending is not None else 0))
+
     def run(self) -> Iterable[Tuple[Request, int, bool]]:
-        """Drive ``step`` until queue and pool drain, streaming token
-        events."""
-        while self.scheduler.queue_depth or self._running:
+        """Drive ``step`` until queue, pending prefill and pool drain,
+        streaming token events."""
+        while self.in_flight:
             yield from self.step()
 
     def serve(self, requests: Iterable[Tuple[Sequence[int], int]]

@@ -30,6 +30,16 @@ equivalence-with-``generate()`` pin rests on):
 Host-side free-list bookkeeping lives here too (``acquire``/
 ``release``); all device-array updates are functional and returned to
 the caller (the engine threads them through its jitted steps).
+
+The pool also mirrors each ACTIVE slot's position counter on the host
+(``note_insert``/``note_advance``, read via ``max_active_pos``): the
+engine's length-bucketed decode picks its attention window from the
+longest *active* sequence BEFORE launching the step, and a device
+read-back of the position vector there would serialize every step on a
+host sync. The mirror is exact by construction — it applies the same
+two updates the jitted step applies (set on insert, +1 per decode for
+active rows) — and inactive slots are excluded, so a long-finished
+tenant never inflates the window.
 """
 
 from __future__ import annotations
@@ -86,6 +96,10 @@ class SlotPool:
             jnp.zeros((self.max_slots,), jnp.int32))
         self.active = self._replicated(jnp.zeros((self.max_slots,), bool))
         self._free: List[int] = list(range(self.max_slots))
+        # host mirror of the device position/active state (see module
+        # docstring): feeds the engine's decode-window choice sync-free
+        self._positions_host: List[int] = [0] * self.max_slots
+        self._active_host: List[bool] = [False] * self.max_slots
 
     def _cache_sharded(self, c):
         if self.mesh is None:
@@ -124,3 +138,27 @@ class SlotPool:
             raise ValueError(f"bad release of slot {slot}")
         self._free.append(slot)
         self._free.sort()
+        self._active_host[slot] = False
+
+    # ---- host position mirror (decode-window tracking) -----------------
+    def note_insert(self, slot: int, position: int) -> None:
+        """Record a freshly spliced tenant: its next decode write lands
+        at ``position`` (= prompt length, per the slot invariants)."""
+        self._positions_host[slot] = int(position)
+        self._active_host[slot] = True
+
+    def note_advance(self) -> None:
+        """Mirror one decode step: every ACTIVE slot's position moved
+        +1 on device (inactive rows stay frozen there too)."""
+        for i, live in enumerate(self._active_host):
+            if live:
+                self._positions_host[i] += 1
+
+    @property
+    def max_active_pos(self) -> int:
+        """Highest position any ACTIVE slot will write this step — the
+        high-water mark the decode window must cover. -1 when idle."""
+        return max(
+            (p for p, live in zip(self._positions_host,
+                                  self._active_host) if live),
+            default=-1)

@@ -14,13 +14,75 @@ against the pool's fixed ``s_max`` (static shapes — a request either
 always fits a slot or never does), the queue head can never be blocked
 by a too-large request, so FIFO has no head-of-line starvation case to
 special-case.
+
+Chunk admission (:class:`PrefillPlan`) is the scheduler's other
+static-shape decision: a joining prompt is split into fixed-size
+chunks over a bucket-padded width, so the engine's chunked-prefill
+program compiles once per ``(chunk, width)`` pair — never per prompt
+length — and the engine can interleave one chunk per step with the
+resident decode (bounding every resident request's stall to one
+chunk's latency instead of a whole prompt's).
 """
 
 from __future__ import annotations
 
 import itertools
 from collections import deque
-from typing import Deque, List, Optional, Sequence
+from typing import Deque, List, Optional, Sequence, Tuple
+
+
+def bucket_length(length: int, min_bucket: int, s_max: int) -> int:
+    """Smallest power-of-two >= ``length`` (floored at ``min_bucket``,
+    capped at ``s_max``): the static-shape family prefill compiles
+    over — once per bucket, not once per prompt length."""
+    b = min_bucket
+    while b < length:
+        b *= 2
+    return min(b, s_max)
+
+
+class PrefillPlan:
+    """Chunk schedule for one joining prompt.
+
+    The prompt (length ``L``) is prefilled into a standalone cache of
+    ``width`` columns — its length bucket rounded UP to a whole number
+    of ``chunk``-sized pieces, so every chunk call has the same static
+    shape ``[1, chunk]`` against the same cache width. ``width`` may
+    overshoot ``s_max`` by up to ``chunk - 1`` pad columns; the
+    engine's splice slices back to ``s_max`` (only ever dropping pad —
+    valid columns are ``[0, L)`` and admission guarantees
+    ``L < s_max``).
+
+    ``starts`` are the chunk offsets ``0, chunk, 2*chunk, ...``; the
+    final chunk is right-padded to ``chunk`` by the engine (pad columns
+    land beyond ``L`` where the decode mask — and later overwrites —
+    keep them invisible, the same invariant stale tenant columns rely
+    on).
+    """
+
+    def __init__(self, request: "Request", chunk: int, min_bucket: int,
+                 s_max: int):
+        if chunk < 1:
+            raise ValueError(f"chunk must be >= 1, got {chunk}")
+        length = len(request.prompt)
+        self.request = request
+        self.chunk = int(chunk)
+        self.length = length
+        bucket = bucket_length(length, min_bucket, s_max)
+        self.width = -(-bucket // chunk) * chunk
+        self.starts: Tuple[int, ...] = tuple(range(0, length, chunk))
+        self._next = 0
+
+    @property
+    def done(self) -> bool:
+        return self._next >= len(self.starts)
+
+    def next_chunk(self) -> Tuple[int, int, bool]:
+        """Claim the next chunk: ``(start, valid_len, is_last)``."""
+        start = self.starts[self._next]
+        self._next += 1
+        return (start, min(self.chunk, self.length - start),
+                self._next >= len(self.starts))
 
 
 class QueueFull(RuntimeError):
@@ -45,9 +107,11 @@ class Request:
     - ``tokens``: generated token ids (prompt excluded), streamed in as
       the engine emits them;
     - ``slot``: KV slot index while RUNNING (None otherwise);
-    - ``submit_time``/``first_token_time``/``finish_time``: host
-      ``perf_counter`` stamps the engine records (TTFT =
-      ``first_token_time - submit_time``);
+    - ``submit_time``/``admit_time``/``first_token_time``/
+      ``finish_time``: host ``perf_counter`` stamps the engine records
+      (TTFT = ``first_token_time - submit_time``, queue wait =
+      ``admit_time - submit_time`` — TTFT deliberately INCLUDES the
+      queue wait; the two stats split where the latency came from);
     - ``finish_reason``: ``"eos"`` or ``"length"`` once DONE.
     """
 
@@ -61,6 +125,7 @@ class Request:
         self.tokens: List[int] = []
         self.slot: Optional[int] = None
         self.submit_time: Optional[float] = None
+        self.admit_time: Optional[float] = None
         self.first_token_time: Optional[float] = None
         self.finish_time: Optional[float] = None
         self.finish_reason: Optional[str] = None

@@ -21,7 +21,8 @@ driver); this is the XLA-native equivalent of "warm starts".
 from __future__ import annotations
 
 import os
-from typing import Optional
+import weakref
+from typing import Optional, Tuple
 
 _DEFAULT = os.path.join(os.path.expanduser("~"), ".cache", "pmdt_xla")
 _OFF = ("0", "off", "none", "false")
@@ -44,6 +45,71 @@ def jit_cache_size(fn) -> int:
         return int(fn._cache_size())
     except Exception:  # noqa: BLE001 — counter is diagnostic-only
         return -1
+
+
+# ---- per-function compile-key log ------------------------------------
+# jax's trace cache exposes a SIZE (``_cache_size``) but not its keys,
+# so "how many programs" is answerable and "WHICH shapes" is not. The
+# serving engine's length-bucketed decode needs the latter: its
+# acceptance test pins not just "compiles <= len(buckets)" but that the
+# compiled set is exactly the buckets the traffic touched. Call sites
+# that own a jitted function call :func:`record_jit_key` right after
+# each invocation with a descriptive key (e.g. ``("decode", window)``);
+# the key is logged iff the trace cache grew during that call.
+_jit_keys: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+# fallback for non-weakrefable callables, keyed by id. Each tracked fn
+# is also pinned with a STRONG reference deliberately — ids are only
+# unique among live objects, so the pin is what stops a recycled id
+# from inheriting a dead function's key log / size baseline. The leak
+# is bounded by the number of distinct tracked jits (a handful per
+# engine) and only exists on jax builds whose jit wrapper refuses
+# weakrefs.
+_jit_keys_by_id: dict = {}
+_jit_pins: list = []
+
+
+def _key_slot(fn):
+    try:
+        return _jit_keys.setdefault(fn, [0, []])
+    except TypeError:  # fn doesn't support weakrefs
+        slot = _jit_keys_by_id.get(id(fn))
+        if slot is None:
+            slot = _jit_keys_by_id[id(fn)] = [0, []]
+            _jit_pins.append(fn)
+        return slot
+
+
+def record_jit_key(fn, key) -> bool:
+    """Attribute ``fn``'s newest compiled program(s) to ``key``.
+
+    Call immediately after invoking the jitted ``fn``: if its trace
+    cache grew since the previous ``record_jit_key`` call, ``key`` is
+    appended to the function's key log (once per growth — an unchanged
+    cache size records nothing, so steady-state calls are free).
+    Returns True when a (re)trace was detected. With a jax whose
+    ``_cache_size`` counter is unavailable, falls back to logging each
+    distinct key once (an upper-bound approximation).
+    """
+    slot = _key_slot(fn)
+    size = jit_cache_size(fn)
+    if size < 0:
+        if key not in slot[1]:
+            slot[1].append(key)
+            return True
+        return False
+    if size > slot[0]:
+        slot[0] = size
+        slot[1].append(key)
+        return True
+    slot[0] = size
+    return False
+
+
+def jit_cache_keys(fn) -> Tuple:
+    """Keys recorded (in first-compile order) for ``fn`` via
+    :func:`record_jit_key` — the answer to *which* bucket shapes
+    compiled, where :func:`jit_cache_size` only answers how many."""
+    return tuple(_key_slot(fn)[1])
 
 
 def enable_compilation_cache(

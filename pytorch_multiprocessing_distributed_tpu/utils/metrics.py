@@ -80,9 +80,18 @@ def correct_count(logits: jax.Array, targets: jax.Array) -> jax.Array:
 class ServingMetrics:
     """Aggregates the serving engine's operational metrics.
 
-    - ``ttft``: seconds from submit to first token (the prefill
-      completes it), per request;
+    - ``ttft``: seconds from SUBMIT to first token, per request — the
+      user-visible latency, so it deliberately includes time spent
+      queued behind other requests, not just prefill compute;
+    - ``queue_wait``: seconds from submit to admission (the moment
+      prefill work starts), per request — ``ttft - queue_wait`` is the
+      prefill-side latency, so the pair splits "the pool was busy"
+      from "the prompt was long" when tuning slot counts;
     - ``decode_step``: wall seconds per batched decode step;
+    - ``decode_window``: the attention window (in cache columns) each
+      decode step ran over — under length-bucketed decode this tracks
+      the longest ACTIVE sequence's bucket, and the bench plots step
+      time against it;
     - ``occupancy``: live slots at each decode step (the utilization
       the slot count should be tuned against);
     - ``queue_depth``: queued requests at each decode step (sustained
@@ -95,21 +104,34 @@ class ServingMetrics:
 
     def __init__(self) -> None:
         self.ttft = AverageMeter()
+        self.queue_wait = AverageMeter()
         self.decode_step = AverageMeter()
+        self.decode_window = AverageMeter()
         self.occupancy = AverageMeter()
         self.queue_depth = AverageMeter()
         self.tokens_generated = 0
         self.requests_completed = 0
         self._elapsed = 0.0
         self._occupancy_max = 0
+        self._queue_wait_max = 0.0
 
     def record_first_token(self, ttft_seconds: float) -> None:
         self.ttft.update(ttft_seconds)
         self.tokens_generated += 1
 
+    def record_admission(self, queue_wait_seconds: float) -> None:
+        """Stamp when a request leaves the queue and its prefill work
+        begins — the queue-wait half of TTFT."""
+        self.queue_wait.update(queue_wait_seconds)
+        self._queue_wait_max = max(self._queue_wait_max,
+                                   queue_wait_seconds)
+
     def record_decode_step(self, seconds: float, tokens: int,
-                           occupancy: int, queue_depth: int) -> None:
+                           occupancy: int, queue_depth: int,
+                           window: int = 0) -> None:
         self.decode_step.update(seconds)
+        if window:
+            self.decode_window.update(window)
         self.occupancy.update(occupancy)
         self._occupancy_max = max(self._occupancy_max, occupancy)
         self.queue_depth.update(queue_depth)
@@ -128,7 +150,10 @@ class ServingMetrics:
             "tokens_generated": self.tokens_generated,
             "ttft_avg_s": self.ttft.avg,
             "ttft_last_s": self.ttft.val,
+            "queue_wait_avg_s": self.queue_wait.avg,
+            "queue_wait_max_s": self._queue_wait_max,
             "decode_step_avg_s": self.decode_step.avg,
+            "decode_window_avg": self.decode_window.avg,
             "decode_tokens_per_sec": decode_tps,
             "occupancy_avg": self.occupancy.avg,
             "occupancy_max": self._occupancy_max,

@@ -6,8 +6,11 @@ Orbax run directory — the same backends ``train_lm.py`` writes) and
 serves a request stream through the slot-based
 :class:`~pytorch_multiprocessing_distributed_tpu.serving.ServingEngine`:
 requests join a persistent decode loop as KV slots free up, the jitted
-decode step keeps ONE compiled signature throughout, and per-request
-tokens stream to stdout as they are emitted.
+decode step compiles once per length bucket (``--decode_buckets`` —
+step cost tracks the longest ACTIVE sequence, not ``--s_max``), long
+prompts can prefill in fixed chunks interleaved with decode
+(``--prefill_chunk`` — no resident request stalls longer than one
+chunk), and per-request tokens stream to stdout as they are emitted.
 
 Request sources (first match wins):
   --requests FILE   JSON Lines, one request per line:
@@ -56,6 +59,25 @@ parser.add_argument('--s_max', default=0, type=int,
 parser.add_argument('--max_queue', default=0, type=int,
                     help='queued-request bound; submissions beyond it '
                          'are REJECTED (0 = unbounded)')
+parser.add_argument('--decode_buckets', default='auto', type=str,
+                    help="decode attention-window ladder: 'auto' "
+                         "(powers of two up to s_max), 'off' (always "
+                         "the full s_max window — the pre-bucketing "
+                         "behavior), or explicit sizes '64,128,512'. "
+                         "One decode compile per bucket touched; step "
+                         "cost tracks the longest ACTIVE sequence's "
+                         "bucket instead of s_max")
+parser.add_argument('--prefill_chunk', default=0, type=int,
+                    help='admit prompts in fixed chunks of N tokens, '
+                         'one chunk per engine step interleaved with '
+                         'decode — bounds every resident request\'s '
+                         'stall to one chunk (0 = whole-prompt '
+                         'prefill-on-join)')
+parser.add_argument('--decode_attn', default='auto',
+                    choices=['auto', 'xla', 'pallas'],
+                    help='decode-step attention: fused flash-decode '
+                         'Pallas kernel or the XLA reference (auto = '
+                         'pallas on single-shard TPU, xla elsewhere)')
 parser.add_argument('--max_new_tokens', default=32, type=int,
                     help='default per-request budget (jsonl requests '
                          'override per line)')
@@ -171,6 +193,13 @@ def main():
         mesh = make_mesh(n_dev // args.tp, args.tp)
         params = shard_params_for_tp_decode(params, mesh)
 
+    if args.decode_buckets == 'auto':
+        decode_buckets = None
+    elif args.decode_buckets == 'off':
+        decode_buckets = ()
+    else:
+        decode_buckets = [int(b) for b in args.decode_buckets.split(',')]
+
     engine = ServingEngine(
         model, params,
         max_slots=args.max_slots,
@@ -181,7 +210,10 @@ def main():
         top_p=args.top_p,
         rng=(jax.random.PRNGKey(args.seed)
              if args.temperature > 0 else None),
-        eos_id=None if args.eos < 0 else args.eos)
+        eos_id=None if args.eos < 0 else args.eos,
+        decode_buckets=decode_buckets,
+        prefill_chunk=args.prefill_chunk or None,
+        decode_attn=args.decode_attn)
 
     def emit(events):
         if args.quiet:
@@ -228,7 +260,10 @@ def main():
     snap = engine.metrics.snapshot()
     snap["rejected"] = rejected
     snap["decode_step_compiles"] = engine.decode_step_compiles
+    snap["decode_buckets"] = list(engine.decode_buckets)
+    snap["decode_windows"] = list(engine.decode_windows)
     snap["prefill_compiles"] = engine.prefill_compiles
+    snap["chunk_prefill_compiles"] = engine.chunk_prefill_compiles
     print("metrics: " + json.dumps(snap, sort_keys=True), flush=True)
     if args.metrics_out:
         with open(args.metrics_out, "w") as f:

@@ -47,3 +47,28 @@ def test_cache_writes_compiled_executables(tmp_path, monkeypatch):
         assert entries, "compile cache directory stayed empty"
     finally:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+
+
+def test_jit_cache_keys_tracks_static_shapes():
+    """record_jit_key attributes each fresh trace (new static arg /
+    new shape) to the caller's key; steady-state calls record nothing.
+    This is what lets the serving tests pin WHICH decode windows
+    compiled, not just how many."""
+    from pytorch_multiprocessing_distributed_tpu.utils.compile_cache import (
+        jit_cache_keys, jit_cache_size, record_jit_key)
+
+    from functools import partial
+
+    @partial(jax.jit, static_argnames=("window",))
+    def f(x, *, window):
+        return x[:window].sum()
+
+    x = jnp.arange(8.0)
+    f(x, window=4)
+    assert record_jit_key(f, ("decode", 4))
+    f(x, window=4)
+    assert not record_jit_key(f, ("decode", 4))  # cache hit: no entry
+    f(x, window=8)
+    assert record_jit_key(f, ("decode", 8))
+    assert jit_cache_keys(f) == (("decode", 4), ("decode", 8))
+    assert jit_cache_size(f) == 2

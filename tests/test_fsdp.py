@@ -28,6 +28,11 @@ from pytorch_multiprocessing_distributed_tpu.train.step import (
 )
 
 
+# tier-1 window: heaviest suite — runs in the full (slow) tier,
+# outside the 870s '-m not slow' gate (FSDP trajectory equivalence: full sharded train-step compiles)
+pytestmark = pytest.mark.slow
+
+
 @pytest.fixture(scope="module")
 def setup():
     mesh = make_mesh()  # 8-way data parallel

@@ -64,6 +64,7 @@ def test_sampling_reproducible_and_key_sensitive(gpt):
     np.testing.assert_array_equal(np.asarray(d), np.asarray(ref))
 
 
+@pytest.mark.slow  # tier-1 window: heavy decode compile; core greedy/TP/ragged stay in-gate
 def test_bf16_greedy_matches_full_forward_decode():
     """bf16 is the TPU default: the cached path must track the model's
     own bf16 forward token for token (cast-then-add embed order, fast
@@ -91,6 +92,7 @@ def test_validation(gpt):
         generate(sp, params, prompt, max_new_tokens=2)
 
 
+@pytest.mark.slow  # tier-1 window: heavy decode compile; core greedy/TP/ragged stay in-gate
 def test_moe_greedy_matches_full_forward_decode():
     """MoE decode (dropless top-k routing) emits EXACTLY the tokens
     repeated full forwards produce when the training forward's
@@ -150,6 +152,7 @@ def test_tp_decode_validation(gpt):
         generate(model, params, prompt, max_new_tokens=2, mesh=bad)
 
 
+@pytest.mark.slow  # tier-1 window: heavy decode compile; core greedy/TP/ragged stay in-gate
 def test_tp_decode_moe_matches_single_shard():
     """MoE + TP decode: expert MLP weights shard on their trailing dim
     like every other kernel (tp_param_spec); routed decode stays
@@ -171,6 +174,7 @@ def test_tp_decode_moe_matches_single_shard():
     np.testing.assert_array_equal(np.asarray(single), np.asarray(tp))
 
 
+@pytest.mark.slow  # tier-1 window: heavy decode compile; core greedy/TP/ragged stay in-gate
 def test_top_p_nucleus_semantics(gpt):
     """top_p=1.0 keeps the full distribution (identical draw to plain
     sampling under the same key); a tiny top_p collapses to greedy;
@@ -244,6 +248,7 @@ def test_beam_search_k1_is_greedy(gpt):
                                   np.asarray(ref))
 
 
+@pytest.mark.slow  # tier-1 window: heavy decode compile; core greedy/TP/ragged stay in-gate
 def test_beam_search_exhaustive_tiny_vocab():
     """beam_size = V at depth 2 IS exhaustive: the best beam must be
     the true argmax sequence over all V^2 continuations (brute-forced
@@ -283,6 +288,7 @@ def test_beam_search_exhaustive_tiny_vocab():
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow  # tier-1 window: heavy decode compile; core greedy/TP/ragged stay in-gate
 def test_beam_search_k1_is_greedy_moe():
     """beam=1 == greedy on a GShard (top-2) MoE model: pins that beam
     search shares generate's exact prefill conventions (the moe_top_k
@@ -303,6 +309,7 @@ def test_beam_search_k1_is_greedy_moe():
                                   np.asarray(ref))
 
 
+@pytest.mark.slow  # tier-1 window: heavy decode compile; core greedy/TP/ragged stay in-gate
 def test_beam_search_batch_rows_independent(gpt):
     """B=2 x K=3: each batch row's beams equal a single-row call on
     that prompt alone — pins the per-row parent-beam reindex (cache +

@@ -27,6 +27,9 @@ from pytorch_multiprocessing_distributed_tpu.train.lm import (
 )
 from pytorch_multiprocessing_distributed_tpu.train.optim import sgd
 from pytorch_multiprocessing_distributed_tpu.train.state import TrainState
+# tier-1 window: heaviest suite — runs with the full (slow) tier, not the 870s '-m not slow' gate
+# (pipelined-GPT trajectory parity: per-stage compiles)
+pytestmark = pytest.mark.slow
 
 
 def _tokens(batch=16, seq=32):

@@ -27,6 +27,11 @@ from pytorch_multiprocessing_distributed_tpu.train.step import (
 )
 
 
+# tier-1 window: heaviest suite — runs in the full (slow) tier,
+# outside the 870s '-m not slow' gate (microbatch-equivalence trajectories: full train-step compiles)
+pytestmark = pytest.mark.slow
+
+
 def _batch(rng, n=32, size=32, classes=10):
     x = jnp.asarray(rng.normal(size=(n, size, size, 3)), jnp.float32)
     y = jnp.asarray(rng.integers(0, classes, (n,)))

@@ -22,6 +22,11 @@ from pytorch_multiprocessing_distributed_tpu.train.step import (
 )
 
 
+# tier-1 window: heaviest suite — runs in the full (slow) tier,
+# outside the 870s '-m not slow' gate (held-out eval epochs: full LM train loops)
+pytestmark = pytest.mark.slow
+
+
 def _setup(**model_kw):
     model = models.get_model("gpt_tiny", **model_kw)
     tokens = jnp.asarray(

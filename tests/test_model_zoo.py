@@ -13,6 +13,9 @@ import pytorch_multiprocessing_distributed_tpu.models.vgg  # noqa: F401
 import pytorch_multiprocessing_distributed_tpu.models.densenet  # noqa: F401
 import pytorch_multiprocessing_distributed_tpu.models.vit  # noqa: F401
 import pytorch_multiprocessing_distributed_tpu.models.convnext  # noqa: F401
+# tier-1 window: heaviest suite — runs with the full (slow) tier, not the 870s '-m not slow' gate
+# (whole-model compiles on the CPU mesh)
+pytestmark = pytest.mark.slow
 
 
 @pytest.mark.slow  # whole-model compiles on the CPU mesh, ~40-90s each

@@ -9,12 +9,17 @@ its own stage's params.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import Mesh, PartitionSpec as P
 
 from pytorch_multiprocessing_distributed_tpu.parallel.pipeline import (
     pipeline_1f1b,
     pipeline_apply,
 )
+
+# tier-1 window: heaviest suite — runs in the full (slow) tier,
+# outside the 870s '-m not slow' gate (GPipe schedule sweeps (shard_map))
+pytestmark = pytest.mark.slow
 
 STAGES, M, MB, DIM = 4, 8, 4, 16  # stages, microbatches, microbatch, width
 

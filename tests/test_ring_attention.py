@@ -12,6 +12,11 @@ from pytorch_multiprocessing_distributed_tpu.parallel.ring_attention import (
 )
 
 
+# tier-1 window: heaviest suite — runs in the full (slow) tier,
+# outside the 870s '-m not slow' gate (ring attention hops (shard_map))
+pytestmark = pytest.mark.slow
+
+
 def full_attention(q, k, v):
     scale = q.shape[-1] ** -0.5
     logits = jnp.einsum("bqhc,bkhc->bhqk", q, k) * scale

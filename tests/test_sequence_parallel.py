@@ -17,11 +17,16 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import Mesh, PartitionSpec as P
 
 from pytorch_multiprocessing_distributed_tpu.parallel.ring_attention import (
     ring_attention,
 )
+
+# tier-1 window: heaviest suite — runs in the full (slow) tier,
+# outside the 870s '-m not slow' gate (ring-SP trajectory parity (shard_map))
+pytestmark = pytest.mark.slow
 
 B, S, H, DH = 2, 32, 2, 8  # batch, seq, heads, head_dim
 D = H * DH

@@ -1,5 +1,6 @@
-"""Continuous-batching serving engine: equivalence with generate(),
-one compiled decode signature, admission control, slot recycling."""
+"""Continuous-batching serving engine: equivalence with generate()
+(bucketed decode, chunked prefill, Pallas flash-decode), bounded
+decode-compile budget, admission control, slot recycling."""
 
 import jax
 import jax.numpy as jnp
@@ -9,8 +10,8 @@ import pytest
 from pytorch_multiprocessing_distributed_tpu import models
 from pytorch_multiprocessing_distributed_tpu.inference import generate
 from pytorch_multiprocessing_distributed_tpu.serving import (
-    FIFOScheduler, QueueFull, Request, ServingEngine, init_params,
-    load_params)
+    FIFOScheduler, PrefillPlan, QueueFull, Request, ServingEngine,
+    bucket_length, init_params, load_params)
 
 
 def _tiny(**kw):
@@ -41,11 +42,14 @@ def _ref_tail(model, params, prompt, n):
 def test_engine_matches_generate_ragged(served):
     """The acceptance pin: >= 3 concurrently-admitted ragged requests
     (5 total through 3 slots, so requests join as others leave) decode
-    greedily to EXACTLY the per-request generate() tokens, and the
-    jitted decode step compiles ONCE across all the joins/leaves."""
+    greedily to EXACTLY the per-request generate() tokens, with the
+    decode-compile count equal to the window buckets the traffic
+    touched — and NO new compile when the same lengths join/leave
+    again."""
     model, params, prompts = served
     engine = ServingEngine(model, params, max_slots=3, s_max=32,
                            min_bucket=8)
+    assert engine.decode_buckets == (8, 16, 32)
     finished = engine.serve([(p, 4) for p in prompts])
     assert len(finished) == 5
     for request, prompt in zip(finished, prompts):
@@ -54,32 +58,42 @@ def test_engine_matches_generate_ragged(served):
             _ref_tail(model, params, prompt, 4),
             err_msg=f"prompt len {len(prompt)}")
         assert request.finish_reason == "length"
-    # the compile-once guarantee, via the compile_cache counter
-    assert engine.decode_step_compiles == 1
+    # the bucketed compile budget, via the compile_cache counter/keys:
+    # exactly one program per distinct window, windows from the ladder
+    windows = engine.decode_windows
+    assert engine.decode_step_compiles == len(set(windows))
+    assert set(windows) <= set(engine.decode_buckets)
     # prompts padded to buckets 8, 8, 16, 8, 16 -> exactly 2 prefills
+    assert engine.prefill_compiles == 2
+    # join/leave churn over the SAME length mix: zero fresh traces
+    engine.serve([(p, 4) for p in prompts])
+    assert engine.decode_step_compiles == len(set(windows))
     assert engine.prefill_compiles == 2
 
 
 def test_engine_matches_generate_moe(served):
-    """Same pin on a GShard (top-2) MoE model: the engine's decode
-    shares generate's dropless routing conventions."""
+    """Same pin on a GShard (top-2) MoE model, admitted through
+    CHUNKED prefill: the engine's decode shares generate's dropless
+    routing conventions and the chunk pass routes identically to the
+    one-shot prompt pass."""
     _, _, prompts = served
     model = _tiny(n_experts=2, moe_top_k=2, moe_capacity_factor=2.0)
     params = init_params(model, 2)
     engine = ServingEngine(model, params, max_slots=2, s_max=32,
-                           min_bucket=8)
+                           min_bucket=8, prefill_chunk=4)
     finished = engine.serve([(p, 4) for p in prompts[:3]])
     for request, prompt in zip(finished, prompts):
         np.testing.assert_array_equal(
             np.asarray(request.tokens),
             _ref_tail(model, params, prompt, 4))
-    assert engine.decode_step_compiles == 1
+    assert engine.decode_step_compiles == len(set(engine.decode_windows))
 
 
 def test_tp_serving_matches_single_shard(served):
     """TP serving (slots + heads + vocab sharded over the 'model'
-    axis): same tokens as the unsharded engine/generate, still one
-    decode compile (out_shardings pin the steady-state signature)."""
+    axis) with CHUNKED prefill: same tokens as the unsharded
+    engine/generate, decode compiles bounded by the buckets touched
+    (out_shardings pin the steady-state signature per window)."""
     from pytorch_multiprocessing_distributed_tpu.inference import (
         shard_params_for_tp_decode)
     from pytorch_multiprocessing_distributed_tpu.parallel import make_mesh
@@ -88,13 +102,119 @@ def test_tp_serving_matches_single_shard(served):
     mesh = make_mesh(4, 2)  # _tiny has 2 heads
     tp_params = shard_params_for_tp_decode(params, mesh)
     engine = ServingEngine(model, tp_params, max_slots=2, s_max=32,
-                           mesh=mesh, min_bucket=8)
+                           mesh=mesh, min_bucket=8, prefill_chunk=4)
     finished = engine.serve([(p, 4) for p in prompts[:3]])
     for request, prompt in zip(finished, prompts):
         np.testing.assert_array_equal(
             np.asarray(request.tokens),
             _ref_tail(model, params, prompt, 4))
+    windows = set(engine.decode_windows)
+    assert engine.decode_step_compiles == len(windows)
+    # join/leave churn on a mesh must not respecialize any window
+    engine.serve([(p, 4) for p in prompts[:3]])
+    assert engine.decode_step_compiles == len(windows)
+
+
+def test_chunked_prefill_matches_one_shot(served):
+    """Chunked admission (chunk=5, so every prompt splits unevenly) is
+    token-exact with the whole-prompt engine AND with generate(), and
+    the chunk program compiles once per (chunk, width) pair — never
+    per prompt length or chunk index."""
+    model, params, prompts = served
+    one_shot = ServingEngine(model, params, max_slots=2, s_max=32,
+                             min_bucket=8)
+    chunked = ServingEngine(model, params, max_slots=2, s_max=32,
+                            min_bucket=8, prefill_chunk=5)
+    ref = one_shot.serve([(p, 4) for p in prompts[:3]])
+    got = chunked.serve([(p, 4) for p in prompts[:3]])
+    for a, b, prompt in zip(got, ref, prompts):
+        np.testing.assert_array_equal(
+            np.asarray(a.tokens), np.asarray(b.tokens),
+            err_msg=f"prompt len {len(prompt)}")
+        np.testing.assert_array_equal(
+            np.asarray(a.tokens), _ref_tail(model, params, prompt, 4))
+    # prompts 3, 7, 12 -> buckets 8, 8, 16 -> widths 10, 10, 20:
+    # exactly two (chunk=5, width) shapes, zero whole-prompt prefills
+    assert chunked.chunk_prefill_compiles == 2
+    assert chunked.prefill_compiles == 0
+    assert one_shot.chunk_prefill_compiles == 0
+
+
+def test_bucketed_decode_crosses_boundary(served):
+    """One request decoding across a window-bucket boundary (positions
+    14..21 cross 16): tokens stay exactly generate()'s, and the
+    compiled windows are exactly the two buckets the stream touched
+    (jit_cache_keys, not just the count)."""
+    model, params, _ = served
+    rng = np.random.default_rng(7)
+    prompt = rng.integers(0, model.vocab_size, (14,))
+    engine = ServingEngine(model, params, max_slots=1, s_max=32,
+                           min_bucket=8)
+    (request,) = engine.serve([(prompt, 8)])
+    np.testing.assert_array_equal(
+        np.asarray(request.tokens),
+        _ref_tail(model, params, prompt, 8))
+    assert engine.decode_windows == (16, 32)
+    assert engine.decode_step_compiles == 2
+
+
+def test_pallas_decode_engine(served):
+    """The fused flash-decode kernel (interpret mode on CPU) through
+    the full engine: same greedy tokens as generate()'s XLA path."""
+    model, params, prompts = served
+    engine = ServingEngine(model, params, max_slots=2, s_max=32,
+                           min_bucket=8, decode_attn="pallas",
+                           decode_block_k=8)
+    finished = engine.serve([(p, 4) for p in prompts[:2]])
+    for request, prompt in zip(finished, prompts):
+        np.testing.assert_array_equal(
+            np.asarray(request.tokens),
+            _ref_tail(model, params, prompt, 4))
+
+
+def test_full_window_mode(served):
+    """decode_buckets=() is the pre-bucketing engine: every step runs
+    the full s_max window, one decode compile total."""
+    model, params, prompts = served
+    engine = ServingEngine(model, params, max_slots=2, s_max=32,
+                           min_bucket=8, decode_buckets=())
+    finished = engine.serve([(p, 4) for p in prompts[:2]])
+    for request, prompt in zip(finished, prompts):
+        np.testing.assert_array_equal(
+            np.asarray(request.tokens),
+            _ref_tail(model, params, prompt, 4))
+    assert engine.decode_buckets == (32,)
+    assert engine.decode_windows == (32,)
     assert engine.decode_step_compiles == 1
+
+
+def test_prefill_plan_unit():
+    """Pure host-side chunk planning: boundaries, final-partial chunk,
+    bucket-rounded width, and the (chunk, width) compile key space."""
+    plan = PrefillPlan(Request(list(range(12)), 4), chunk=5,
+                       min_bucket=8, s_max=32)
+    assert plan.width == 20          # bucket(12)=16 -> ceil to 5s
+    assert plan.starts == (0, 5, 10)
+    chunks = []
+    while not plan.done:
+        chunks.append(plan.next_chunk())
+    assert chunks == [(0, 5, False), (5, 5, False), (10, 2, True)]
+    # single-chunk prompt
+    plan = PrefillPlan(Request([1, 2], 1), chunk=8, min_bucket=8,
+                       s_max=32)
+    assert plan.starts == (0,)
+    assert plan.next_chunk() == (0, 2, True)
+    assert plan.done
+    # width never undershoots the prompt even when the bucket cap
+    # (s_max) is not a chunk multiple
+    plan = PrefillPlan(Request(list(range(29)), 1), chunk=8,
+                       min_bucket=8, s_max=30)
+    assert plan.width == 32 and plan.width >= 29
+    with pytest.raises(ValueError, match="chunk"):
+        PrefillPlan(Request([1], 1), chunk=0, min_bucket=8, s_max=32)
+    assert bucket_length(3, 8, 32) == 8
+    assert bucket_length(9, 8, 32) == 16
+    assert bucket_length(31, 8, 32) == 32
 
 
 def test_eos_stops_early(served):
@@ -163,11 +283,21 @@ def test_serving_metrics(served):
     model, params, prompts = served
     engine = ServingEngine(model, params, max_slots=2, s_max=32,
                            min_bucket=8)
-    engine.serve([(p, 3) for p in prompts[:3]])
+    submitted = engine.serve([(p, 3) for p in prompts[:3]])
     snap = engine.metrics.snapshot()
     assert snap["requests_completed"] == 3
     assert snap["tokens_generated"] == 9
     assert snap["ttft_avg_s"] > 0
+    # queue wait is the submit->admission half of TTFT: present for
+    # every request, bounded above by its TTFT, stamped in between
+    assert snap["queue_wait_avg_s"] >= 0
+    assert snap["queue_wait_avg_s"] <= snap["ttft_avg_s"]
+    assert snap["queue_wait_max_s"] >= snap["queue_wait_avg_s"]
+    for request in submitted:
+        assert (request.submit_time <= request.admit_time
+                <= request.first_token_time)
+    # bucketed decode records the window each step ran over
+    assert 0 < snap["decode_window_avg"] <= 32
     assert 0 < snap["occupancy_avg"] <= 2
     assert snap["occupancy_max"] == 2
     assert snap["decode_steps"] > 0
@@ -266,9 +396,22 @@ def test_engine_validation(served):
         ServingEngine(model, params, max_slots=1, top_p=1.5)
     with pytest.raises(ValueError, match="s_max"):
         ServingEngine(model, params, max_slots=1, s_max=1000)
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        ServingEngine(model, params, max_slots=1, prefill_chunk=0)
+    with pytest.raises(ValueError, match="decode_attn"):
+        ServingEngine(model, params, max_slots=1, decode_attn="cuda")
+    with pytest.raises(ValueError, match="decode_buckets"):
+        ServingEngine(model, params, max_slots=1, decode_buckets=[0, 8])
+    # ladder normalization: dedupe/sort, cap at s_max, append s_max
+    eng = ServingEngine(model, params, max_slots=1, s_max=32,
+                        decode_buckets=[16, 8, 16, 64])
+    assert eng.decode_buckets == (8, 16, 32)
     sp = _tiny(seq_axis="seq")
     with pytest.raises(NotImplementedError, match="seq_axis"):
         ServingEngine(sp, params, max_slots=1)
     from pytorch_multiprocessing_distributed_tpu.parallel import make_mesh
     with pytest.raises(ValueError, match="num_heads"):
         ServingEngine(model, params, max_slots=1, mesh=make_mesh(1, 8))
+    with pytest.raises(ValueError, match="single-shard"):
+        ServingEngine(model, params, max_slots=1, mesh=make_mesh(4, 2),
+                      decode_attn="pallas")

@@ -11,6 +11,9 @@ import subprocess
 import sys
 
 import pytest
+# tier-1 window: heaviest suite — runs with the full (slow) tier, not the 870s '-m not slow' gate
+# (one CLI subprocess (~8s of jax import) per guard cell)
+pytestmark = pytest.mark.slow
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 

@@ -31,6 +31,9 @@ from pytorch_multiprocessing_distributed_tpu.train import (
 )
 from pytorch_multiprocessing_distributed_tpu.train.optim import sgd
 from pytorch_multiprocessing_distributed_tpu.train.step import shard_batch
+# tier-1 window: heaviest suite — runs with the full (slow) tier, not the 870s '-m not slow' gate
+# (TP/ZeRO train-step sweeps: one GSPMD compile per config)
+pytestmark = pytest.mark.slow
 
 
 def _batch(n=16, classes=10, seed=0):

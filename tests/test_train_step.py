@@ -20,6 +20,9 @@ from pytorch_multiprocessing_distributed_tpu.train import (
 )
 from pytorch_multiprocessing_distributed_tpu.train.optim import sgd
 from pytorch_multiprocessing_distributed_tpu.train.step import shard_batch
+# tier-1 window: heaviest suite — runs with the full (slow) tier, not the 870s '-m not slow' gate
+# (DP/remat trajectory parity: full train-step compiles)
+pytestmark = pytest.mark.slow
 
 
 def _tiny_model(bn_axis="data"):

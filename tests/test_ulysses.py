@@ -19,6 +19,11 @@ from pytorch_multiprocessing_distributed_tpu.parallel import (
     ulysses_attention,
 )
 
+
+# tier-1 window: heaviest suite — runs in the full (slow) tier,
+# outside the 870s '-m not slow' gate (all-to-all SP sweeps (shard_map))
+pytestmark = pytest.mark.slow
+
 B, S, H, D = 2, 32, 4, 8
 N_SHARD = 4
 
