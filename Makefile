@@ -3,7 +3,13 @@
 
 PYTEST_ENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 
-.PHONY: test test-fast dryrun bench bench-cpu store clean
+.PHONY: test test-fast lint dryrun bench bench-cpu store clean
+
+# graftlint: AST-only jit-hygiene gate (no jax import, milliseconds).
+# Exit 1 on any non-baselined finding; the tier-1 suite and
+# benchmarks/on_grant.sh enforce the same gate.
+lint:
+	python -m pytorch_multiprocessing_distributed_tpu.analysis.lint
 
 # full suite on the virtual 8-device CPU mesh (incl. slow e2e CLI runs)
 test:

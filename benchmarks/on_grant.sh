@@ -13,6 +13,15 @@ cd "$(dirname "$0")/.." || exit 1
 note() { echo "=== $* ($(date -u +%T))" >&2; }
 T="timeout -k 30 2700"
 
+note "0. graftlint gate (jit-hygiene static analysis — AST-only, instant)"
+# A red lint gate means a hot path may host-sync or recompile per step;
+# TPU numbers captured in that state are not evidence. Refuse the window.
+if ! timeout -k 10 120 python -m pytorch_multiprocessing_distributed_tpu.analysis.lint; then
+  echo "graftlint gate RED — fix findings (or baseline them with a" >&2
+  echo "justification) before burning TPU time; see 'make lint'" >&2
+  exit 1
+fi
+
 note "1. baselines still missing/legacy (need-first order)"
 $T python benchmarks/record_baselines.py --missing
 

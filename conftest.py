@@ -28,3 +28,19 @@ if not os.environ.get("PMDT_TEST_ON_TPU"):
     jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(__file__))
+
+# version-skew shim: tests call jax.shard_map directly (current-jax
+# idiom); on a 0.4.x container the alias resolves to
+# jax.experimental.shard_map.shard_map with check_vma -> check_rep
+# (utils/compat.py). Additive only — a real jax.shard_map wins.
+from pytorch_multiprocessing_distributed_tpu.utils.compat import (  # noqa: E402
+    install_shard_map_alias)
+
+install_shard_map_alias()
+
+# runtime jit-hygiene sentinels as suite-wide fixtures
+# (transfer_sentinel / recompile_sentinel — tests/test_sentinels.py
+# pins them on the train step, generate() and the serving engine)
+pytest_plugins = (
+    "pytorch_multiprocessing_distributed_tpu.analysis.sentinels",
+)

@@ -24,7 +24,11 @@ parser = argparse.ArgumentParser(description="Confidence Aware Learning")
 parser.add_argument('--batch_size', default=64, type=int, help='Batch size')
 parser.add_argument('--epochs', default=20, type=int, help='Total number of epochs to run')
 parser.add_argument('--model', default='res', type=str, help='Models name to use [res, dense, vgg]')
-parser.add_argument('--save_path', default='./test/', type=str, help='Savefiles directory')
+parser.add_argument('--save_path', default='./test/', type=str,
+                    help='Savefiles directory: logs, checkpoints, plots AND a\n'
+                         'main.py snapshot land here (run_model). The default\n'
+                         './test/ is a run artifact, gitignored — not the\n'
+                         'tests/ suite')
 parser.add_argument('--gpu', default='7', type=str, help='GPU id to use')
 parser.add_argument('--print-freq', '-p', default=10, type=int, metavar='N', help='print frequency (default: 10)')
 parser.add_argument('--world_size', default=2, type=int, help='Gpu use number')

@@ -27,6 +27,20 @@ rank-0 logging/checkpoint/plot artifacts, and training semantics.
 
 __version__ = "0.4.0"
 
-from . import utils  # noqa: F401
+
+def __getattr__(name):
+    # Lazy submodule access (PEP 562): ``pmdt.utils`` works as before,
+    # but importing the bare package no longer drags in jax — the
+    # graftlint CLI (``python -m ...analysis.lint``) is AST-only and
+    # must stay import-light so the lint gate costs milliseconds.
+    if name == "utils":
+        # importlib, not ``from . import utils``: the from-import form
+        # consults this very __getattr__ mid-import and recurses
+        import importlib
+
+        return importlib.import_module(".utils", __name__)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
+
 
 # Short alias:  import pytorch_multiprocessing_distributed_tpu as pmdt

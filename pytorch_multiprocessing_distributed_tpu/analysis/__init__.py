@@ -1,0 +1,24 @@
+"""graftlint — JAX/TPU jit-hygiene static analysis for this codebase.
+
+The paper's core obligation is that every hot path stays inside XLA:
+no stray host sync, Python side effect, or silent recompile in the
+step and decode loops. PR 1/2 grew the *runtime* enforcement hooks
+(``utils.compile_cache`` compile counters, the one-compile serving
+decode); this package makes the discipline *machine-checked on every
+PR*:
+
+- :mod:`.rules` — the AST rule engine (pure ``ast``, NO jax import:
+  the tier-1 lint gate must cost milliseconds, not a backend bring-up);
+- :mod:`.lint` — CLI / JSON output / per-line suppressions /
+  committed-baseline workflow (``python -m
+  pytorch_multiprocessing_distributed_tpu.analysis.lint``);
+- :mod:`.sentinels` — the runtime complement: ``jax.transfer_guard``
+  context managers and recompile-budget assertions built on
+  ``utils.compile_cache``, pinned in tests on the three hottest paths
+  (train step, ``generate()`` decode, serving engine step).
+
+Rule IDs are stable (``GL1xx``) — suppression comments and the
+baseline file refer to them.
+"""
+
+from .rules import RULES, Finding, analyze_files  # noqa: F401

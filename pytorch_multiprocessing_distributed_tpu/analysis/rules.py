@@ -1,0 +1,883 @@
+"""graftlint rule engine: pure-AST jit-hygiene analysis.
+
+The linter answers one question per rule: *could this line knock a hot
+path out of XLA?* — a host sync mid-step, a Python side effect baked
+into a trace, a silent recompile per iteration. The hard part is
+scoping: ``print`` in the trainer's host loop is fine, ``print`` in the
+jitted step body is a trace-time landmine. So the engine first infers
+which functions are **jit-scoped** (traced by jax), then applies the
+line rules only inside those.
+
+Jit-scope inference (two passes over the whole linted file set):
+
+1. per-file: parse, track import aliases, index every function (incl.
+   nested and methods), and mark *roots* — functions decorated with or
+   passed to ``jax.jit`` / ``shard_map`` / ``pmap`` / ``vmap`` /
+   ``grad`` / ``checkpoint`` / ``lax.scan``-family wrappers. A wrapper
+   whose argument is a *call* of a local function (the factory idiom
+   this codebase uses everywhere: ``jax.jit(self._make_decode_step())``,
+   ``jax.shard_map(_train_body(...))``) marks the factory's *nested*
+   functions as traced — the factory body itself runs at build time.
+2. global: propagate scope through the call graph — a traced function's
+   callees are traced too, resolved through module-level names and
+   intra-package ``from``-imports (``serving.engine`` calling
+   ``inference.generate._block_decode_slots`` is resolved across
+   files).
+
+This is deliberately static and approximate: no jax import, no
+execution, milliseconds over the whole package. Known limits are
+documented per rule; escape hatches are per-line suppressions and the
+committed baseline (see :mod:`.lint`).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+RULES: Dict[str, str] = {
+    "GL000": "file does not parse (syntax error)",
+    "GL101": "host sync inside jit-traced code (.item(), float()/int() on "
+             "a traced value, np.asarray/np.array, jax.device_get, "
+             "block_until_ready)",
+    "GL102": "print/logging side effect inside jit-traced code (runs at "
+             "trace time only, or crashes on tracers — use "
+             "jax.debug.print)",
+    "GL103": "wall clock or host RNG inside jit-traced code (time.*, "
+             "stdlib random.*, np.random.* — baked in at trace time; use "
+             "jax.random)",
+    "GL104": "mutation of enclosing-scope state inside jit-traced code "
+             "(global/nonlocal or captured-container mutation — silent "
+             "under jit: runs once at trace time)",
+    "GL105": "jax.jit constructed inside a loop body (a fresh jit wrapper "
+             "per iteration retraces/recompiles every time — hoist it)",
+    "GL106": "Python branch on a traced argument of a jitted function "
+             "(TracerBoolConversionError, or a recompile per value if "
+             "made static — use lax.cond/lax.select or static_argnames)",
+    "GL107": "mutable (unhashable) default on a static jit argument "
+             "(TypeError at call time, or identity-keyed retraces)",
+    "GL108": "train-step-shaped jit (state in, updated state out) without "
+             "donate_argnums — the old state stays resident, doubling "
+             "state HBM",
+    "GL109": "PartitionSpec axis name not declared by any mesh in the "
+             "linted files (typo'd axis names fail far from here, at "
+             "sharding time)",
+}
+
+# wrappers that COMPILE (jit family) — GL105/106/107/108 anchor on these
+_JIT_DOTTED = {
+    "jax.jit", "jax.pjit", "jax.experimental.pjit.pjit",
+}
+# wrappers that TRACE their function argument(s)
+_TRACE_DOTTED = _JIT_DOTTED | {
+    "jax.shard_map", "jax.experimental.shard_map.shard_map",
+    "jax.pmap", "jax.vmap", "jax.grad", "jax.value_and_grad",
+    "jax.checkpoint", "jax.remat",
+    "jax.lax.scan", "jax.lax.cond", "jax.lax.while_loop",
+    "jax.lax.fori_loop", "jax.lax.map", "jax.lax.switch",
+}
+_TIME_ATTRS = {"time", "perf_counter", "monotonic", "process_time",
+               "sleep", "time_ns", "perf_counter_ns", "monotonic_ns"}
+_LOG_ATTRS = {"debug", "info", "warning", "warn", "error", "critical",
+              "exception", "log"}
+_LOG_BASES = {"logger", "log", "LOG", "logging"}
+_MUTATORS = {"append", "extend", "insert", "add", "update", "pop",
+             "setdefault", "remove", "discard", "clear", "popitem"}
+# Pallas kernel refs: subscript-STORES to `*_ref` names are the Pallas
+# memory model (o_ref[...] = acc), not a Python side effect
+_REF_NAME = re.compile(r"(^|_)refs?$")
+_STATIC_ATTRS = {"shape", "ndim", "size", "dtype", "sharding"}
+_AXIS_KWARGS = {"axis_name", "seq_axis", "pipe_axis", "bn_axis"}
+_STATE_PARAMS = {"state", "train_state"}
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+@dataclass
+class _Func:
+    node: ast.AST  # FunctionDef | AsyncFunctionDef
+    file: "_File"
+    qual: str
+    parent: Optional["_Func"]
+    params: List[str] = field(default_factory=list)
+    pos_params: List[str] = field(default_factory=list)
+    calls: Set[str] = field(default_factory=set)
+    nested: Dict[str, "_Func"] = field(default_factory=dict)
+    jit_scoped: bool = False
+    # direct jit root: (statics, donate_seen, site_line) — only set when
+    # the function NAME is wrapped/decorated by jax.jit itself, so its
+    # static_argnames/argnums are knowable (GL106/107/108 need this)
+    root_statics: Optional[Set[str]] = None
+    root_donate: bool = False
+    root_line: int = 0
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+
+class _File:
+    def __init__(self, path: str, modkey: Tuple[str, ...], tree: ast.AST,
+                 lines: List[str]):
+        self.path = path
+        self.modkey = modkey
+        self.tree = tree
+        self.lines = lines
+        self.origins: Dict[str, str] = {}  # local name -> dotted origin
+        # local name -> (modkey, original name) for intra-package imports
+        self.pkg_imports: Dict[str, Tuple[Tuple[str, ...], str]] = {}
+        self.funcs: List[_Func] = []
+        self.by_name: Dict[str, _Func] = {}  # module+method level defs
+        self.owner: Dict[int, Optional[_Func]] = {}  # id(node) -> func
+
+
+def _dotted(expr: ast.AST, file: _File) -> Optional[str]:
+    """Resolve an expression to a dotted origin path: ``np.asarray`` ->
+    ``numpy.asarray`` (through import aliases), bare names through
+    ``from x import y`` origins. None when not a name/attribute chain."""
+    parts: List[str] = []
+    node = expr
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    base = file.origins.get(node.id, node.id)
+    parts.append(base)
+    return ".".join(reversed(parts))
+
+
+def _iter_own(func_node: ast.AST):
+    """Yield every node lexically in ``func_node``'s body but not inside
+    a nested def/class (those have their own _Func entries)."""
+    stack = list(ast.iter_child_nodes(func_node))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _const_str_seq(node: ast.AST) -> Optional[List[str]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        out = []
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                out.append(el.value)
+            else:
+                return None
+        return out
+    return None
+
+
+def _const_int_seq(node: ast.AST) -> Optional[List[int]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, int):
+                out.append(el.value)
+            else:
+                return None
+        return out
+    return None
+
+
+def _modkey_for(path: str, root_parent: Optional[str]) -> Tuple[str, ...]:
+    import os
+
+    if root_parent:
+        rel = os.path.relpath(os.path.abspath(path),
+                              os.path.abspath(root_parent))
+    else:
+        rel = os.path.basename(path)
+    parts = rel.replace("\\", "/").split("/")
+    if parts and parts[-1].endswith(".py"):
+        parts[-1] = parts[-1][:-3]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return tuple(p for p in parts if p and p != ".")
+
+
+# --------------------------------------------------------------- pass 1
+
+def _collect_file(path: str, src: str, modkey: Tuple[str, ...]) -> _File:
+    tree = ast.parse(src, filename=path)
+    f = _File(path, modkey, tree, src.splitlines())
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                f.origins[alias.asname or alias.name.split(".")[0]] = (
+                    alias.name if alias.asname else alias.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                base = modkey[:-node.level] if node.level <= len(modkey) \
+                    else ()
+                mod = base + tuple((node.module or "").split(".")
+                                   if node.module else ())
+                mod = tuple(p for p in mod if p)
+                for alias in node.names:
+                    f.pkg_imports[alias.asname or alias.name] = (
+                        mod, alias.name)
+                    f.origins[alias.asname or alias.name] = ".".join(
+                        mod + (alias.name,))
+            else:
+                mod = node.module or ""
+                for alias in node.names:
+                    f.origins[alias.asname or alias.name] = (
+                        f"{mod}.{alias.name}" if mod else alias.name)
+                    if mod:
+                        f.pkg_imports[alias.asname or alias.name] = (
+                            tuple(mod.split(".")), alias.name)
+
+    # function index with lexical parents
+    def index(node: ast.AST, parent: Optional[_Func], prefix: str):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{child.name}"
+                fn = _Func(child, f, qual, parent)
+                a = child.args
+                fn.pos_params = [x.arg for x in a.posonlyargs + a.args]
+                fn.params = list(fn.pos_params) + \
+                    [x.arg for x in a.kwonlyargs]
+                f.funcs.append(fn)
+                if parent is None:
+                    f.by_name.setdefault(child.name, fn)
+                else:
+                    parent.nested[child.name] = fn
+                index(child, fn, qual + ".")
+            elif isinstance(child, ast.ClassDef):
+                # methods register at module visibility by simple name
+                # (resolves the ``jax.jit(self._insert_fn)`` idiom)
+                index(child, parent, f"{prefix}{child.name}.")
+            else:
+                index(child, parent, prefix)
+
+    index(tree, None, "")
+    # methods (parent None but nested in classes) land in by_name via
+    # the parent-None branch above; also make every top-level-class
+    # method resolvable
+    for fn in f.funcs:
+        if fn.parent is None:
+            f.by_name.setdefault(fn.name, fn)
+
+    # per-func call sets (own body only)
+    for fn in f.funcs:
+        for node in _iter_own(fn.node):
+            if isinstance(node, ast.Call):
+                if isinstance(node.func, ast.Name):
+                    fn.calls.add(node.func.id)
+                elif (isinstance(node.func, ast.Attribute)
+                      and isinstance(node.func.value, ast.Name)
+                      and node.func.value.id in ("self", "cls")):
+                    fn.calls.add(node.func.attr)
+    return f
+
+
+# ------------------------------------------------------- root detection
+
+def _is_trace_wrapper(dotted: Optional[str]) -> bool:
+    if not dotted:
+        return False
+    return (dotted in _TRACE_DOTTED
+            or dotted.endswith(".compat.shard_map"))
+
+
+def _is_jit(dotted: Optional[str]) -> bool:
+    return dotted in _JIT_DOTTED
+
+
+def _resolve_local(file: _File, name: str,
+                   scope: Optional[_Func]) -> Optional[_Func]:
+    fn = scope
+    while fn is not None:
+        if name in fn.nested:
+            return fn.nested[name]
+        fn = fn.parent
+    return file.by_name.get(name)
+
+
+def _descendants(fn: _Func) -> List[_Func]:
+    out = []
+    stack = list(fn.nested.values())
+    while stack:
+        x = stack.pop()
+        out.append(x)
+        stack.extend(x.nested.values())
+    return out
+
+
+def _jit_statics(call_kwargs, target: Optional[_Func]) -> Set[str]:
+    statics: Set[str] = set()
+    for kw in call_kwargs:
+        if kw.arg == "static_argnames":
+            names = _const_str_seq(kw.value)
+            if names:
+                statics.update(names)
+        elif kw.arg == "static_argnums" and target is not None:
+            nums = _const_int_seq(kw.value)
+            if nums:
+                for i in nums:
+                    if 0 <= i < len(target.pos_params):
+                        statics.add(target.pos_params[i])
+    return statics
+
+
+def _donate_seen(call_kwargs) -> bool:
+    return any(kw.arg in ("donate_argnums", "donate_argnames")
+               for kw in call_kwargs)
+
+
+def _mark_root(target: _Func, statics: Set[str], donate: bool, line: int):
+    target.jit_scoped = True
+    if target.root_statics is None:
+        target.root_statics = statics
+        target.root_donate = donate
+        target.root_line = line
+
+
+def _scan_roots(files: Sequence[_File], index) -> List[_Func]:
+    """Find every jit/trace root; returns the seed list for the global
+    closure. ``index[(modkey, name)]`` resolves cross-file targets."""
+    seeds: List[_Func] = []
+
+    def resolve_arg(file: _File, scope: Optional[_Func], arg: ast.AST,
+                    *, factories: bool = True) -> List[_Func]:
+        """Functions a wrapper argument refers to. A direct Name/self
+        attr resolves to its def; a Call of a local def is the factory
+        idiom — the factory's nested defs are the traced ones."""
+        if isinstance(arg, ast.Name):
+            t = _resolve_local(file, arg.id, scope)
+            if t is None and arg.id in file.pkg_imports:
+                t = index.get(file.pkg_imports[arg.id])
+            return [t] if t is not None else []
+        if (isinstance(arg, ast.Attribute)
+                and isinstance(arg.value, ast.Name)
+                and arg.value.id in ("self", "cls")):
+            t = file.by_name.get(arg.attr)
+            return [t] if t is not None else []
+        if factories and isinstance(arg, ast.Call):
+            inner = resolve_arg(file, scope, arg.func, factories=False)
+            out: List[_Func] = []
+            for fac in inner:
+                out.extend(_descendants(fac))
+            return out
+        return []
+
+    for file in files:
+        # decorators
+        for fn in file.funcs:
+            for dec in fn.node.decorator_list:
+                d = _dotted(dec, file)
+                if _is_trace_wrapper(d):
+                    if _is_jit(d):
+                        _mark_root(fn, set(), False, fn.node.lineno)
+                    fn.jit_scoped = True
+                    seeds.append(fn)
+                elif isinstance(dec, ast.Call):
+                    dc = _dotted(dec.func, file)
+                    if _is_jit(dc):
+                        _mark_root(fn, _jit_statics(dec.keywords, fn),
+                                   _donate_seen(dec.keywords),
+                                   fn.node.lineno)
+                        seeds.append(fn)
+                    elif (dc == "functools.partial" and dec.args
+                          and _is_jit(_dotted(dec.args[0], file))):
+                        _mark_root(fn, _jit_statics(dec.keywords, fn),
+                                   _donate_seen(dec.keywords),
+                                   fn.node.lineno)
+                        seeds.append(fn)
+                    elif _is_trace_wrapper(dc):
+                        fn.jit_scoped = True
+                        seeds.append(fn)
+        # wrapper call sites
+        for node in ast.walk(file.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func, file)
+            if not _is_trace_wrapper(d):
+                continue
+            scope = file.owner.get(id(node))
+            func_args = node.args
+            if d and d.endswith(("scan", "while_loop", "fori_loop",
+                                 "cond", "switch", "map")):
+                candidates = func_args  # body position varies — take all
+            else:
+                candidates = func_args[:1]
+            for arg in candidates:
+                for target in resolve_arg(file, scope, arg):
+                    if (_is_jit(d) and isinstance(
+                            arg, (ast.Name, ast.Attribute))):
+                        _mark_root(target,
+                                   _jit_statics(node.keywords, target),
+                                   _donate_seen(node.keywords),
+                                   node.lineno)
+                    target.jit_scoped = True
+                    seeds.append(target)
+    return seeds
+
+
+def _fill_owners(file: _File):
+    def walk(node: ast.AST, owner: Optional[_Func]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fn = next((x for x in file.funcs if x.node is child), None)
+                file.owner[id(child)] = owner
+                walk(child, fn)
+            else:
+                file.owner[id(child)] = owner
+                walk(child, owner)
+
+    walk(file.tree, None)
+
+
+def _propagate(files: Sequence[_File], index, seeds: List[_Func]):
+    """Call-graph closure: a traced function's callees are traced."""
+    work = list(seeds)
+    while work:
+        fn = work.pop()
+        for name in fn.calls:
+            t = _resolve_local(fn.file, name, fn)
+            if t is None and name in fn.file.pkg_imports:
+                t = index.get(fn.file.pkg_imports[name])
+            if t is not None and not t.jit_scoped:
+                t.jit_scoped = True
+                work.append(t)
+
+
+# --------------------------------------------------------------- rules
+
+def _is_shape_static(expr: ast.AST) -> bool:
+    """True when the expression is trace-time static by construction:
+    a constant, a len() call, or anything reading .shape/.ndim/etc."""
+    if isinstance(expr, ast.Constant):
+        return True
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+            return True
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                and node.func.id == "len"):
+            return True
+    return not any(isinstance(n, (ast.Name, ast.Subscript, ast.Call))
+                   for n in ast.walk(expr))
+
+
+def _local_names(fn: _Func) -> Set[str]:
+    names = set(fn.params)
+    a = fn.node.args
+    if a.vararg:
+        names.add(a.vararg.arg)
+    if a.kwarg:
+        names.add(a.kwarg.arg)
+    for node in _iter_own(fn.node):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            names.add(node.id)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            for t in ast.walk(node.target):
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+        elif isinstance(node, ast.withitem) and node.optional_vars:
+            for t in ast.walk(node.optional_vars):
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+        elif isinstance(node, ast.NamedExpr) and isinstance(
+                node.target, ast.Name):
+            names.add(node.target.id)
+    names.update(fn.nested)
+    return names
+
+
+def _traced_names_in_test(test: ast.AST, traced: Set[str]) -> List[str]:
+    """Names from ``traced`` whose VALUE the test depends on — skipping
+    is/is-not None checks, .shape/.ndim/.dtype reads, isinstance, len."""
+    hits: List[str] = []
+
+    def visit(node: ast.AST):
+        if isinstance(node, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+            return
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return
+            visit(node.value)
+            return
+        if isinstance(node, ast.Call):
+            d = node.func
+            if isinstance(d, ast.Name) and d.id in ("isinstance", "len",
+                                                    "getattr", "hasattr"):
+                return
+            for a in node.args:
+                visit(a)
+            return
+        if isinstance(node, ast.Name):
+            if node.id in traced:
+                hits.append(node.id)
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    visit(test)
+    return hits
+
+
+def _check_jit_scoped_body(fn: _Func, out: List[Finding]):
+    file = fn.file
+    path = file.path
+
+    def add(node, rule, msg):
+        out.append(Finding(path, node.lineno, node.col_offset, rule, msg))
+
+    locals_ = None  # computed lazily for GL104
+    for node in _iter_own(fn.node):
+        if isinstance(node, (ast.Global, ast.Nonlocal)):
+            kind = "global" if isinstance(node, ast.Global) else "nonlocal"
+            add(node, "GL104",
+                f"{kind} statement in jit-traced `{fn.qual}` — the "
+                "rebinding happens once at trace time, not per step")
+            continue
+        if isinstance(node, ast.Call):
+            d = _dotted(node.func, file)
+            # ---- GL101: host syncs
+            if isinstance(node.func, ast.Attribute):
+                if node.func.attr == "item" and not node.args:
+                    add(node, "GL101",
+                        f".item() in jit-traced `{fn.qual}` forces a "
+                        "device->host sync (trace error under jit)")
+                    continue
+                if node.func.attr == "block_until_ready":
+                    add(node, "GL101",
+                        f".block_until_ready() in jit-traced `{fn.qual}`"
+                        " — a host sync; jit output is already async")
+                    continue
+            if d in ("jax.device_get", "jax.block_until_ready"):
+                add(node, "GL101",
+                    f"{d} in jit-traced `{fn.qual}` forces a device->"
+                    "host sync")
+                continue
+            if d in ("numpy.asarray", "numpy.array"):
+                add(node, "GL101",
+                    f"{d.replace('numpy', 'np')} in jit-traced "
+                    f"`{fn.qual}` materializes on host (use jnp, or "
+                    "hoist to the caller)")
+                continue
+            if (isinstance(node.func, ast.Name)
+                    and node.func.id in ("float", "int", "bool")
+                    and len(node.args) == 1 and not node.keywords
+                    and not _is_shape_static(node.args[0])):
+                arg = node.args[0]
+                # a bare Name is only knowably traced when it is a
+                # non-static param of a DIRECT jit root; in closure-
+                # propagated functions plain names are usually Python
+                # config captured at build time (e.g. int(block_k))
+                name_traced = (
+                    isinstance(arg, ast.Name)
+                    and fn.root_statics is not None
+                    and arg.id in set(fn.params) - fn.root_statics)
+                if name_traced or not isinstance(arg, ast.Name):
+                    add(node, "GL101",
+                        f"{node.func.id}() on a traced value in "
+                        f"`{fn.qual}` is a host sync "
+                        "(ConcretizationTypeError under jit)")
+                    continue
+            # ---- GL102: print / logging
+            if isinstance(node.func, ast.Name) and node.func.id == "print":
+                add(node, "GL102",
+                    f"print() in jit-traced `{fn.qual}` fires at trace "
+                    "time only — use jax.debug.print")
+                continue
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _LOG_ATTRS
+                    and isinstance(node.func.value, ast.Name)
+                    and (node.func.value.id in _LOG_BASES
+                         or (file.origins.get(node.func.value.id, "")
+                             .split(".")[0] == "logging"))):
+                add(node, "GL102",
+                    f"logging call in jit-traced `{fn.qual}` fires at "
+                    "trace time only — use jax.debug.print")
+                continue
+            # ---- GL103: wall clock / host RNG
+            if d:
+                root = d.split(".")[0]
+                if root == "time" and d.split(".")[-1] in _TIME_ATTRS:
+                    add(node, "GL103",
+                        f"{d} in jit-traced `{fn.qual}` is baked in as "
+                        "a constant at trace time")
+                    continue
+                if root == "random" and any(
+                        v == "random" or v.startswith("random.")
+                        for v in file.origins.values()):
+                    # d is already alias-resolved ("import random as
+                    # rnd" and "from random import randint" both land
+                    # here); the origins scan rules out a mere local
+                    # variable that happens to be NAMED random
+                    add(node, "GL103",
+                        f"stdlib {d} in jit-traced `{fn.qual}` draws "
+                        "once at trace time — use jax.random")
+                    continue
+                if d.startswith("numpy.random."):
+                    add(node, "GL103",
+                        f"np.random in jit-traced `{fn.qual}` draws "
+                        "once at trace time — use jax.random")
+                    continue
+            continue
+        # ---- GL104: captured-container mutation. Only BARE statement
+        # calls (result discarded) — a used return value means a
+        # functional API like optimizer.update(grads, ...), not a
+        # container mutation (dict.update/list.append return None).
+        if (isinstance(node, ast.Expr)
+                and isinstance(node.value, ast.Call)
+                and isinstance(node.value.func, ast.Attribute)
+                and node.value.func.attr in _MUTATORS
+                and isinstance(node.value.func.value, ast.Name)
+                and node.value.func.value.id not in ("self", "cls")):
+            call = node.value
+            if locals_ is None:
+                locals_ = _local_names(fn)
+            if call.func.value.id not in locals_:
+                add(call, "GL104",
+                    f"`{call.func.value.id}.{call.func.attr}(...)` "
+                    f"in jit-traced `{fn.qual}` mutates enclosing-"
+                    "scope state once at trace time, not per step")
+            continue
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                if (isinstance(t, ast.Subscript)
+                        and isinstance(t.value, ast.Name)
+                        and not _REF_NAME.search(t.value.id)):
+                    if locals_ is None:
+                        locals_ = _local_names(fn)
+                    if t.value.id not in locals_ | {"self", "cls"}:
+                        add(node, "GL104",
+                            f"subscript-assign to captured "
+                            f"`{t.value.id}` in jit-traced `{fn.qual}` "
+                            "mutates enclosing-scope state at trace "
+                            "time")
+
+
+def _check_traced_branches(fn: _Func, out: List[Finding]):
+    """GL106 — only on DIRECT jit roots, whose static_argnames/argnums
+    are parseable (closure-propagated functions receive values whose
+    staticness is unknowable statically: skipping them keeps the rule
+    high-precision)."""
+    if fn.root_statics is None:
+        return
+    traced = set(fn.params) - fn.root_statics - {"self", "cls"}
+    if not traced:
+        return
+    for node in _iter_own(fn.node):
+        if isinstance(node, (ast.If, ast.While, ast.IfExp, ast.Assert)):
+            test = node.test
+            hits = _traced_names_in_test(test, traced)
+            if hits:
+                out.append(Finding(
+                    fn.file.path, node.lineno, node.col_offset, "GL106",
+                    f"branch on traced argument(s) {sorted(set(hits))} "
+                    f"of jitted `{fn.qual}` — TracerBoolConversionError "
+                    "at trace time (use lax.cond/lax.select, or declare "
+                    "the arg in static_argnames)"))
+
+
+def _check_static_defaults(fn: _Func, out: List[Finding]):
+    """GL107: a static jit arg whose default is a mutable literal."""
+    if fn.root_statics is None or not fn.root_statics:
+        return
+    a = fn.node.args
+    pos = a.posonlyargs + a.args
+    defaults: Dict[str, ast.AST] = {}
+    for arg, dflt in zip(pos[len(pos) - len(a.defaults):], a.defaults):
+        defaults[arg.arg] = dflt
+    for arg, dflt in zip(a.kwonlyargs, a.kw_defaults):
+        if dflt is not None:
+            defaults[arg.arg] = dflt
+    for name in sorted(fn.root_statics):
+        dflt = defaults.get(name)
+        if isinstance(dflt, (ast.List, ast.Dict, ast.Set)) or (
+                isinstance(dflt, ast.Call)
+                and isinstance(dflt.func, ast.Name)
+                and dflt.func.id in ("list", "dict", "set")):
+            out.append(Finding(
+                fn.file.path, fn.node.lineno, fn.node.col_offset, "GL107",
+                f"static jit argument `{name}` of `{fn.qual}` has a "
+                "mutable (unhashable) default — jit statics must hash "
+                "(use a tuple / frozenset / None)"))
+
+
+def _check_missing_donate(fn: _Func, out: List[Finding]):
+    """GL108: jitted state-in/state-out function without donation."""
+    if fn.root_statics is None or fn.root_donate:
+        return
+    params = [p for p in fn.params if p not in ("self", "cls")]
+    if not params or params[0] not in _STATE_PARAMS:
+        return
+    state = params[0]
+    replaces = any(
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "replace"
+        and isinstance(node.func.value, ast.Name)
+        and node.func.value.id == state
+        for node in _iter_own(fn.node))
+    if replaces:
+        out.append(Finding(
+            fn.file.path, fn.root_line, 0, "GL108",
+            f"jit of `{fn.qual}` takes `{state}` and returns an updated "
+            "copy but declares no donate_argnums — the old state stays "
+            "resident, doubling state HBM (donate_argnums=(0,))"))
+
+
+def _check_jit_in_loop(file: _File, out: List[Finding]):
+    """GL105: jax.jit(...) lexically inside a for/while body."""
+    loops: List[ast.AST] = [n for n in ast.walk(file.tree)
+                            if isinstance(n, (ast.For, ast.AsyncFor,
+                                              ast.While))]
+    for loop in loops:
+        stack = [n for part in ("body", "orelse")
+                 for n in getattr(loop, part, [])]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # a def in a loop body runs on call, not per iter
+            if isinstance(node, ast.Call):
+                d = _dotted(node.func, file)
+                if _is_jit(d) or (
+                        d == "functools.partial" and node.args
+                        and _is_jit(_dotted(node.args[0], file))):
+                    out.append(Finding(
+                        file.path, node.lineno, node.col_offset, "GL105",
+                        "jax.jit constructed inside a loop body — each "
+                        "iteration builds a fresh wrapper with an empty "
+                        "trace cache (recompiles every pass); hoist the "
+                        "jit out of the loop"))
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _collect_axes(files: Sequence[_File]) -> Set[str]:
+    axes: Set[str] = set()
+    for file in files:
+        for node in ast.walk(file.tree):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if (isinstance(t, ast.Name)
+                            and t.id.upper().endswith("_AXIS")
+                            and isinstance(node.value, ast.Constant)
+                            and isinstance(node.value.value, str)):
+                        axes.add(node.value.value)
+            elif isinstance(node, ast.Call):
+                d = _dotted(node.func, file)
+                if d and d.split(".")[-1] == "Mesh" and len(node.args) >= 2:
+                    names = _const_str_seq(node.args[1])
+                    if names:
+                        axes.update(names)
+                for kw in node.keywords:
+                    if kw.arg == "axis_names":
+                        names = _const_str_seq(kw.value)
+                        if names:
+                            axes.update(names)
+                    elif (kw.arg in _AXIS_KWARGS
+                          and isinstance(kw.value, ast.Constant)
+                          and isinstance(kw.value.value, str)):
+                        axes.add(kw.value.value)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                a = node.args
+                pos = a.posonlyargs + a.args
+                for arg, dflt in zip(pos[len(pos) - len(a.defaults):],
+                                     a.defaults):
+                    if (arg.arg in _AXIS_KWARGS
+                            and isinstance(dflt, ast.Constant)
+                            and isinstance(dflt.value, str)):
+                        axes.add(dflt.value)
+                for arg, dflt in zip(a.kwonlyargs, a.kw_defaults):
+                    if (dflt is not None and arg.arg in _AXIS_KWARGS
+                            and isinstance(dflt, ast.Constant)
+                            and isinstance(dflt.value, str)):
+                        axes.add(dflt.value)
+    return axes
+
+
+def _check_pspec_axes(file: _File, axes: Set[str], out: List[Finding]):
+    """GL109: string axis in a PartitionSpec literal must be declared."""
+    if not axes:
+        return
+    for node in ast.walk(file.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = _dotted(node.func, file)
+        if not d or d.split(".")[-1] != "PartitionSpec":
+            continue
+        for arg in node.args:
+            for el in ([arg] if not isinstance(arg, (ast.Tuple, ast.List))
+                       else arg.elts):
+                if (isinstance(el, ast.Constant)
+                        and isinstance(el.value, str)
+                        and el.value not in axes):
+                    out.append(Finding(
+                        file.path, node.lineno, node.col_offset, "GL109",
+                        f"PartitionSpec axis {el.value!r} is not an axis "
+                        f"of any mesh declared in the linted files "
+                        f"(known: {sorted(axes)}) — typo'd axes fail "
+                        "far away, at sharding time"))
+
+
+# ------------------------------------------------------------ top level
+
+def analyze_files(paths: Sequence[str],
+                  package_parent: Optional[str] = None) -> List[Finding]:
+    """Lint ``paths`` (Python files) as one closed world: jit scopes
+    propagate across files through intra-package imports resolved
+    relative to ``package_parent`` (the directory CONTAINING the
+    package). Returns findings sorted by (path, line)."""
+    files: List[_File] = []
+    findings: List[Finding] = []
+    for path in paths:
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                src = fh.read()
+            f = _collect_file(path, src, _modkey_for(path, package_parent))
+        except SyntaxError as e:
+            findings.append(Finding(path, e.lineno or 0, 0, "GL000",
+                                    f"does not parse: {e.msg}"))
+            continue
+        _fill_owners(f)
+        files.append(f)
+
+    index: Dict[Tuple[Tuple[str, ...], str], _Func] = {}
+    for f in files:
+        for name, fn in f.by_name.items():
+            index.setdefault((f.modkey, name), fn)
+
+    seeds = _scan_roots(files, index)
+    _propagate(files, index, seeds)
+
+    axes = _collect_axes(files)
+    for f in files:
+        _check_jit_in_loop(f, findings)
+        _check_pspec_axes(f, axes, findings)
+        for fn in f.funcs:
+            if fn.jit_scoped:
+                _check_jit_scoped_body(fn, findings)
+                _check_traced_branches(fn, findings)
+                _check_static_defaults(fn, findings)
+                _check_missing_donate(fn, findings)
+    findings.sort(key=lambda x: (x.path, x.line, x.rule))
+    return findings

@@ -1,0 +1,151 @@
+"""Runtime jit-hygiene sentinels — the dynamic complement to graftlint.
+
+The linter (:mod:`.rules`) proves properties the AST can show; these
+sentinels pin the two properties it cannot:
+
+- **no unexpected host transfers** on a hot path:
+  :func:`guard_transfers` arms ``jax.transfer_guard`` so any *implicit*
+  host<->device crossing inside the context raises. Deliberate,
+  documented syncs (the serving engine's one per-step token readback)
+  are marked in the code with :func:`expected_transfer` — greppable,
+  and exempt under the guard.
+- **bounded recompiles**: :func:`recompile_budget` wraps a code region
+  and asserts a ``jax.jit``-wrapped function traced at most ``budget``
+  new programs inside it, via ``utils.compile_cache.jit_cache_size``.
+  Budget 0 is the steady-state claim ("this traffic pattern compiles
+  nothing new"); the serving tests pin budgets equal to the decode
+  bucket ladder.
+
+Platform honesty: ``jax.transfer_guard`` reports what the backend sees.
+On CPU (the tier-1 mesh) device->host reads are zero-copy and are NOT
+reported, so the guard there catches implicit host->device transfers
+(numpy/scalar args leaking into a jitted call per step — the expensive
+class on TPU too). On real TPU the same tests additionally catch stray
+device->host syncs. Compile once (warm up) BEFORE arming the guard:
+trace-time constant materialization is legitimate one-off traffic.
+
+Exposed as pytest fixtures (``transfer_sentinel``,
+``recompile_sentinel``) through the root conftest; pinned on the three
+hottest paths in ``tests/test_sentinels.py`` (train step,
+``generate()`` decode, serving engine step).
+
+jax is imported lazily — importing this module (e.g. during lint-gate
+collection) costs nothing.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+from ..utils.compile_cache import jit_cache_keys, jit_cache_size
+
+__all__ = [
+    "RecompileBudgetExceeded", "expected_transfer", "guard_transfers",
+    "recompile_budget",
+]
+
+
+class RecompileBudgetExceeded(AssertionError):
+    """A jitted function traced more new programs than its budget."""
+
+
+@contextlib.contextmanager
+def guard_transfers(level: str = "disallow") -> Iterator[None]:
+    """Raise on implicit host<->device transfers inside the context.
+
+    ``level``: a ``jax.transfer_guard`` level — ``"disallow"``
+    (default), ``"log"`` for a non-fatal audit, ``"disallow_explicit"``
+    to also forbid explicit ``device_put``/``jnp.asarray`` staging.
+    No-op (with the same interface) on a jax without transfer guards.
+    """
+    import jax
+
+    guard = getattr(jax, "transfer_guard", None)
+    if guard is None:  # pragma: no cover - jax too old
+        yield
+        return
+    with guard(level):
+        yield
+
+
+@contextlib.contextmanager
+def expected_transfer(reason: str = "") -> Iterator[None]:
+    """Mark a deliberate host<->device sync so it survives an enclosing
+    :func:`guard_transfers`. The ``reason`` argument is documentation
+    at the call site (and greppable): every hot-path sync must say why
+    it exists."""
+    del reason  # call-site documentation only
+    import jax
+
+    guard = getattr(jax, "transfer_guard", None)
+    if guard is None:  # pragma: no cover - jax too old
+        yield
+        return
+    with guard("allow"):
+        yield
+
+
+class _BudgetProbe:
+    """Handle yielded by :func:`recompile_budget` — exposes how many
+    new programs compiled so far inside the context."""
+
+    def __init__(self, fn):
+        self._fn = fn
+        self.before = jit_cache_size(fn)
+        self.keys_before = len(jit_cache_keys(fn))
+
+    @property
+    def compiles(self) -> int:
+        after = jit_cache_size(self._fn)
+        if after < 0 or self.before < 0:
+            return -1  # counter unavailable on this jax build
+        return after - self.before
+
+    @property
+    def new_keys(self) -> tuple:
+        return jit_cache_keys(self._fn)[self.keys_before:]
+
+
+@contextlib.contextmanager
+def recompile_budget(fn, budget: int, *,
+                     label: Optional[str] = None) -> Iterator[_BudgetProbe]:
+    """Assert ``fn`` (a ``jax.jit``-wrapped callable) traces at most
+    ``budget`` new programs inside the context.
+
+    Budget 0 is the steady-state pin: re-running a shape mix that
+    already compiled must trace nothing. When the jax build exposes no
+    ``_cache_size`` counter the assertion is skipped (never a false
+    alarm on version skew) — the probe's ``compiles`` reads -1 then.
+    """
+    probe = _BudgetProbe(fn)
+    yield probe
+    used = probe.compiles
+    if used < 0:
+        return
+    if used > budget:
+        name = label or getattr(fn, "__name__", repr(fn))
+        raise RecompileBudgetExceeded(
+            f"{name}: {used} new compiled program(s), budget {budget}"
+            + (f"; new keys {probe.new_keys!r}" if probe.new_keys else "")
+        )
+
+
+# ---- pytest integration (loaded as a plugin by the root conftest) ----
+try:  # pragma: no cover - import guard
+    import pytest
+except ImportError:  # pragma: no cover
+    pytest = None
+
+if pytest is not None:
+    @pytest.fixture
+    def transfer_sentinel():
+        """The :func:`guard_transfers` context factory:
+        ``with transfer_sentinel(): step(...)``."""
+        return guard_transfers
+
+    @pytest.fixture
+    def recompile_sentinel():
+        """The :func:`recompile_budget` context factory:
+        ``with recompile_sentinel(step, 1): step(...)``."""
+        return recompile_budget

@@ -36,6 +36,8 @@ import jax.numpy as jnp
 from flax import linen as nn
 from jax.sharding import PartitionSpec as P
 
+from ..utils.compat import get_abstract_mesh
+
 
 class MoEMlp(nn.Module):
     """Switch-style top-1 MoE feed-forward block.
@@ -171,7 +173,7 @@ class MoEMlp(nn.Module):
     def _constrain(self, t):
         if self.expert_axis is None or self.is_initializing():
             return t
-        mesh = jax.sharding.get_abstract_mesh()
+        mesh = get_abstract_mesh()
         if mesh is None or self.expert_axis not in getattr(
             mesh, "axis_names", ()
         ):

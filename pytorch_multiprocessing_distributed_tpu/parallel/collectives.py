@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..utils.compat import shard_map
 from .mesh import DATA_AXIS
 
 AxisName = Union[str, Sequence[str]]
@@ -83,7 +84,7 @@ def _all_reduce_program(x, mesh: Mesh, axis_name: str, op: str):
     def body(v):  # v: [1, ...] — this member's value
         return _REDUCERS[op](v[0], axis_name)
 
-    shard = jax.shard_map(
+    shard = shard_map(
         body, mesh=mesh, in_specs=P(axis_name), out_specs=P(), check_vma=False
     )
     return shard(x)

@@ -42,6 +42,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..utils.compat import shard_map
 from .mesh import DATA_AXIS
 
 # NB: ..train imports stay function-local — parallel/__init__ re-exports
@@ -581,7 +582,7 @@ def make_pipelined_lm_train_step(
         mspec = {"loss": P(), "count": P()}
         if is_moe:
             mspec["moe_aux"] = P()
-        sharded = jax.shard_map(
+        sharded = shard_map(
             body_1f1b if schedule == "1f1b" else body,
             mesh=mesh,
             in_specs=(sspec, P(axis_name)),
@@ -628,7 +629,7 @@ def make_pipelined_lm_eval_step(
                 f"global batch {tokens.shape[0]} must divide by "
                 f"data axis x n_microbatches = {dp} x {m}"
             )
-        sharded = jax.shard_map(
+        sharded = shard_map(
             body,
             mesh=mesh,
             in_specs=(_state_specs(state, pipe_axis), P(axis_name)),

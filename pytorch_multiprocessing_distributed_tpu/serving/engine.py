@@ -60,6 +60,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..analysis.sentinels import expected_transfer
 from ..inference.generate import (
     _LN_EPS, _block_chunk_prefill, _block_decode_slots, _embed_at,
     _logits, _make_cs, _prefill, _sample)
@@ -536,17 +537,23 @@ class ServingEngine:
             padded = np.zeros((1, bucket), np.int32)
             padded[0, :length] = request.prompt
             key = self._next_key()
-            tok0, k_pref, v_pref = self._prefill_jit(
-                self.params, jnp.asarray(padded), jnp.int32(length), key)
-            record_jit_key(self._prefill_jit, ("prefill", bucket))
-            slot = self._first_token(request, int(tok0), events)
+            with expected_transfer("prompt upload + first-token "
+                                   "readback (the TTFT boundary)"):
+                tok0, k_pref, v_pref = self._prefill_jit(
+                    self.params, jnp.asarray(padded), jnp.int32(length),
+                    key)
+                record_jit_key(self._prefill_jit, ("prefill", bucket))
+                tok0_host = int(tok0)
+            slot = self._first_token(request, tok0_host, events)
             if slot is None:
                 continue
-            (pool.k_caches, pool.v_caches, pool.positions,
-             pool.last_tokens, pool.active) = self._insert_jit(
-                pool.k_caches, pool.v_caches, pool.positions,
-                pool.last_tokens, pool.active, k_pref, v_pref,
-                jnp.int32(slot), jnp.int32(length), tok0)
+            with expected_transfer("slot/length control upload at "
+                                   "admission (scalar H2D)"):
+                (pool.k_caches, pool.v_caches, pool.positions,
+                 pool.last_tokens, pool.active) = self._insert_jit(
+                    pool.k_caches, pool.v_caches, pool.positions,
+                    pool.last_tokens, pool.active, k_pref, v_pref,
+                    jnp.int32(slot), jnp.int32(length), tok0)
             pool.note_insert(slot, length)
         return events
 
@@ -573,26 +580,32 @@ class ServingEngine:
         chunk = pend.plan.chunk
         padded = np.zeros((1, chunk), np.int32)
         padded[0, :valid] = pend.request.prompt[start:start + valid]
-        x, pend.k_pref, pend.v_pref = self._chunk_jit(
-            self.params, pend.k_pref, pend.v_pref,
-            jnp.asarray(padded), jnp.int32(start))
+        with expected_transfer("chunk upload (fixed [1, chunk] shape)"):
+            x, pend.k_pref, pend.v_pref = self._chunk_jit(
+                self.params, pend.k_pref, pend.v_pref,
+                jnp.asarray(padded), jnp.int32(start))
         record_jit_key(self._chunk_jit,
                        ("prefill_chunk", chunk, pend.plan.width))
         if not is_last:
             return events
         self._pending = None
         key = self._next_key()
-        tok0 = self._tok0_jit(self.params, x,
-                              jnp.int32(pend.plan.length - 1 - start),
-                              key)
-        slot = self._first_token(pend.request, int(tok0), events)
+        with expected_transfer("first-token readback (the TTFT "
+                               "boundary)"):
+            tok0 = self._tok0_jit(self.params, x,
+                                  jnp.int32(pend.plan.length - 1 - start),
+                                  key)
+            tok0_host = int(tok0)
+        slot = self._first_token(pend.request, tok0_host, events)
         if slot is None:
             return events
-        (pool.k_caches, pool.v_caches, pool.positions,
-         pool.last_tokens, pool.active) = self._insert_jit(
-            pool.k_caches, pool.v_caches, pool.positions,
-            pool.last_tokens, pool.active, pend.k_pref, pend.v_pref,
-            jnp.int32(slot), jnp.int32(pend.plan.length), tok0)
+        with expected_transfer("slot/length control upload at "
+                               "admission (scalar H2D)"):
+            (pool.k_caches, pool.v_caches, pool.positions,
+             pool.last_tokens, pool.active) = self._insert_jit(
+                pool.k_caches, pool.v_caches, pool.positions,
+                pool.last_tokens, pool.active, pend.k_pref, pend.v_pref,
+                jnp.int32(slot), jnp.int32(pend.plan.length), tok0)
         pool.note_insert(slot, pend.plan.length)
         return events
 
@@ -624,7 +637,9 @@ class ServingEngine:
                 window=window)
             record_jit_key(self._decode, ("decode", window))
             pool.note_advance()
-            tokens = np.asarray(nxt)  # the step's one host sync
+            with expected_transfer("per-step token readback (the "
+                                   "step's ONE host sync)"):
+                tokens = np.asarray(nxt)
             dt = time.perf_counter() - t0
             emitted = len(self._running)
             self.metrics.record_decode_step(
@@ -636,8 +651,10 @@ class ServingEngine:
                 reason = self._finished(request, token)
                 if reason is not None:
                     self._complete(request, reason)
-                    pool.active = self._release_jit(pool.active,
-                                                    jnp.int32(slot))
+                    with expected_transfer("slot-release control "
+                                           "upload (scalar H2D)"):
+                        pool.active = self._release_jit(
+                            pool.active, jnp.int32(slot))
                     pool.release(slot)
                     del self._running[slot]
                 events.append((request, token, reason is not None))

@@ -28,6 +28,7 @@ from flax.traverse_util import flatten_dict
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.losses import cross_entropy_per_sample
+from ..utils.compat import shard_map
 from ..parallel.mesh import DATA_AXIS
 from .optim import Transform, apply_updates
 from .state import TrainState
@@ -298,7 +299,7 @@ def make_lm_train_step(
         in_specs = (P(), P(axis_name))
     else:
         in_specs = (P(), P(axis_name, seq_axis))
-    sharded = jax.shard_map(
+    sharded = shard_map(
         body,
         mesh=mesh,
         in_specs=in_specs,
@@ -441,7 +442,7 @@ def make_lm_eval_step(
         in_specs = (P(), P(axis_name))
     else:
         in_specs = (P(), P(axis_name, seq_axis))
-    sharded = jax.shard_map(
+    sharded = shard_map(
         body, mesh=mesh, in_specs=in_specs, out_specs=P(),
         check_vma=False,
     )

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.losses import cross_entropy_loss, cross_entropy_per_sample
+from ..utils.compat import shard_map
 from ..utils.metrics import topk_accuracy
 from ..parallel.mesh import DATA_AXIS, MODEL_AXIS
 from .optim import Transform, apply_updates
@@ -224,7 +225,7 @@ def make_train_step(
     ``metrics = {loss, prec1, correct, count}`` are already globally
     reduced (scalars, replicated).
     """
-    sharded = jax.shard_map(
+    sharded = shard_map(
         _train_body(model, optimizer, loss_fn, axis_name, remat=remat,
                     grad_accum=grad_accum, clip_grad_norm=clip_grad_norm,
                     ema_decay=ema_decay),
@@ -264,7 +265,7 @@ def make_eval_step(
     it for reference parity; library callers read it from the dict).
     """
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         _eval_body(model, axis_name, loss_fn=loss_fn),
         mesh=mesh,
         in_specs=(P(), P(axis_name), P(axis_name), P(axis_name)),

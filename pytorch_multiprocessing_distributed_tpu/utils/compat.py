@@ -1,0 +1,114 @@
+"""jax version-skew shims.
+
+The framework is written against the current jax surface; deployment
+containers lag. The one skew that matters today: ``jax.shard_map``
+moved to the top level (with ``check_vma``) after 0.4.x, where it
+lives at ``jax.experimental.shard_map.shard_map`` (with the same
+semantics under the name ``check_rep``). Every package call site
+imports :func:`shard_map` from here; the test suite (which calls
+``jax.shard_map`` directly, matching current-jax idiom) gets the alias
+installed by the root conftest via :func:`install_shard_map_alias`.
+
+Keyword mapping: ``check_vma`` (new name) -> ``check_rep`` (old name).
+Positional use is ``shard_map(f, mesh=..., in_specs=..., out_specs=...)``
+— both jax generations accept the keyword form this module enforces.
+"""
+
+from __future__ import annotations
+
+import jax
+
+HAS_NATIVE_SHARD_MAP = hasattr(jax, "shard_map")
+
+# vma (varying-manual-axes) type tracking: the shard_map generation
+# whose check_vma machinery (jax.typeof().vma, jax.lax.pcast) can PROVE
+# replication invariants through collective AD. 0.4.x check_rep cannot
+# — the pipelined GPT trainer requires this and skips cleanly without.
+HAS_VMA = hasattr(jax.lax, "pcast")
+
+if HAS_NATIVE_SHARD_MAP:
+    _impl = jax.shard_map
+    _CHECK_KW = "check_vma"
+else:
+    from jax.experimental.shard_map import shard_map as _impl  # type: ignore
+
+    _CHECK_KW = "check_rep"
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kw):
+    """``jax.shard_map`` on every supported jax.
+
+    ``check_vma=None`` defers to the backend's default (True on both
+    generations); an explicit bool is forwarded under whichever keyword
+    this jax spells it.
+    """
+    if check_vma is not None:
+        kw[_CHECK_KW] = check_vma
+    return _impl(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                 **kw)
+
+
+def install_shard_map_alias():
+    """Make ``jax.shard_map`` resolve on an old jax (no-op on a new
+    one). Additive only — never shadows a real ``jax.shard_map``."""
+    if not hasattr(jax, "shard_map"):
+        jax.shard_map = shard_map
+    return jax.shard_map
+
+
+def axis_size(axis_name) -> int:
+    """``jax.lax.axis_size`` where it exists; the classic
+    ``psum(1, axis)`` identity elsewhere (a static Python int under
+    shard_map/pmap tracing — exactly what the new API returns)."""
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def set_mesh(mesh):
+    """``jax.set_mesh(mesh)`` as a context manager on every jax: new
+    builds have it natively; on 0.4.x the ``Mesh`` object itself IS the
+    context manager that scopes named-axis resolution for jit."""
+    fn = getattr(jax, "set_mesh", None)
+    if fn is not None:
+        return fn(mesh)
+    return mesh
+
+
+def typeof(x):
+    """``jax.typeof`` (aval with vma tracking on new jax) or the plain
+    abstract value on 0.4.x — callers read optional attrs like ``vma``
+    with ``getattr(..., frozenset())`` so both work."""
+    fn = getattr(jax, "typeof", None)
+    if fn is not None:
+        return fn(x)
+    return jax.core.get_aval(x)
+
+
+def pcast(x, axis_name, *, to="varying"):
+    """``jax.lax.pcast`` on a jax with vma tracking; identity on 0.4.x
+    (check_rep-era shard_map has no varying-manual-axes type state to
+    cast between — replication bookkeeping is implicit there)."""
+    fn = getattr(jax.lax, "pcast", None)
+    if fn is not None:
+        return fn(x, axis_name, to=to)
+    return x
+
+
+def get_abstract_mesh():
+    """The mesh of the active :func:`set_mesh`/``with mesh:`` context,
+    or None when there is none (callers use it to decide whether a
+    ``with_sharding_constraint`` axis name can resolve). New jax:
+    ``jax.sharding.get_abstract_mesh``; 0.4.x: the thread-resources
+    physical mesh that backs the ``with mesh:`` context."""
+    fn = getattr(jax.sharding, "get_abstract_mesh", None)
+    if fn is not None:
+        return fn()
+    try:
+        from jax._src import mesh as _mesh_lib  # 0.4.x private module
+
+        pm = _mesh_lib.thread_resources.env.physical_mesh
+        return None if pm.empty else pm
+    except Exception:  # noqa: BLE001 — a hint, not semantics
+        return None

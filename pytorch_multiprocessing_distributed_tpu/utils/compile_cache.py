@@ -157,11 +157,22 @@ def enable_compilation_cache(
     try:
         os.makedirs(path, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", path)
-    except (OSError, AttributeError) as e:  # unwritable dir / old jax
+    except (OSError, AttributeError) as e:  # unwritable dir / old jaxlib
         import sys
 
         print(f"[pmdt] compilation cache disabled ({e})", file=sys.stderr)
         return None
+    try:
+        # jax memoizes its is-cache-used decision at the FIRST compile
+        # of the process; if anything jitted before this call (warm-up
+        # probes, another subsystem), the new dir would be silently
+        # ignored forever. Resetting returns the cache machinery to its
+        # pristine state so the next compile re-reads the config.
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception:  # noqa: BLE001 — private API; harmless to skip
+        pass
     try:
         # default min-compile-time gate (1 s) is tuned for huge fleets;
         # here EVERY TPU compile is worth keeping (tunnel round-trips),

@@ -1,0 +1,30 @@
+"""GL101 near-miss: shape reads and host-side conversions are fine."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def step(x):
+    b = int(x.shape[0])            # static: shape read
+    n = float(len(x.shape))        # static: len()
+    return jnp.sum(x) / (b * n)
+
+
+def host_summary(x):
+    # not jit-scoped: the host loop may sync freely
+    arr = np.asarray(x)
+    return float(arr.mean()), arr.item() if arr.size == 1 else None
+
+
+def make_step(block_k):
+    def inner(x):
+        # closure-propagated scope; int() on a captured Python config
+        # name is build-time, not a traced-value sync
+        k = int(block_k)
+        return jnp.sum(x) * k
+
+    return inner
+
+
+step2 = jax.jit(make_step(4))

@@ -1,0 +1,16 @@
+"""GL101 positive: host syncs inside a jitted function."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def step(x):
+    y = jnp.sum(x) * 2.0
+    loss = float(y.mean())        # <- GL101
+    host = np.asarray(y)          # <- GL101
+    val = y.item()                # <- GL101
+    jax.block_until_ready(y)      # <- GL101
+    got = jax.device_get(y)       # <- GL101
+    lo = float(x)                 # <- GL101
+    return loss, host, val, got, lo

@@ -1,0 +1,16 @@
+"""GL102 near-miss: jax.debug.print under jit; print on the host."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(x):
+    jax.debug.print("loss {}", jnp.sum(x))  # the jit-safe way
+    return jnp.sum(x)
+
+
+def drive(xs):
+    for x in xs:
+        out = step(x)
+        print("host loop:", out)  # host side — prints are fine here
+    return out

@@ -1,0 +1,15 @@
+"""GL102 positive: print/logging baked into a trace."""
+import logging
+
+import jax
+import jax.numpy as jnp
+
+logger = logging.getLogger(__name__)
+
+
+@jax.jit
+def step(x):
+    print("loss so far", x)            # <- GL102
+    logger.info("step ran")            # <- GL102
+    logging.warning("traced warn")     # <- GL102
+    return jnp.sum(x)

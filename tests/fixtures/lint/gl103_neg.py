@@ -1,0 +1,24 @@
+"""GL103 near-miss: jax.random under jit; clocks on the host."""
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(x, key):
+    noise = jax.random.normal(key, x.shape)  # traced RNG — correct
+    return jnp.sum(x + noise)
+
+
+def timed_drive(x, key):
+    t0 = time.perf_counter()  # host timing around the jit — fine
+    out = step(x, key)
+    return out, time.perf_counter() - t0
+
+
+@jax.jit
+def routed(x, random):
+    # a value merely NAMED random (stdlib module never imported here as
+    # `random`) — attribute calls on it are not host RNG
+    return x * random.scale(x)

@@ -1,0 +1,20 @@
+"""GL103 positive: wall clock / host RNG under jit — including the
+aliased and from-import spellings of stdlib random."""
+import random
+import random as rnd
+import time
+from random import randint
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def step(x):
+    t0 = time.perf_counter()            # <- GL103
+    noise = random.random()             # <- GL103
+    also = rnd.random()                 # <- GL103
+    pick = randint(0, 3)                # <- GL103
+    jitter = np.random.normal()         # <- GL103
+    return jnp.sum(x) + noise + jitter + t0 + also + pick

@@ -1,0 +1,20 @@
+"""GL104 near-miss: local containers and functional .update results."""
+import jax
+import jax.numpy as jnp
+
+HISTORY = []
+
+
+@jax.jit
+def step(x, optimizer, opt_state, params):
+    acc = []
+    for i in range(4):
+        acc.append(x * i)  # local list — legitimate trace-time staging
+    # .update whose RESULT is consumed is a functional API, not a
+    # container mutation (the optax/optim convention)
+    updates, new_opt = optimizer.update(x, opt_state, params)
+    return jnp.stack(acc).sum() + updates, new_opt
+
+
+def record(metrics):
+    HISTORY.append(metrics)  # host-side accounting — fine

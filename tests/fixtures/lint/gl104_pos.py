@@ -1,0 +1,16 @@
+"""GL104 positive: enclosing-scope mutation under jit."""
+import jax
+import jax.numpy as jnp
+
+TRACE_LOG = []
+STATS = {}
+COUNT = 0
+
+
+@jax.jit
+def step(x):
+    global COUNT                  # <- GL104
+    COUNT += 1
+    TRACE_LOG.append(x)           # <- GL104
+    STATS["last"] = x             # <- GL104
+    return jnp.sum(x)

@@ -1,0 +1,23 @@
+"""GL105 near-miss: jit hoisted out of the loop (compiled once)."""
+import jax
+
+f = jax.jit(lambda v: v * 2)
+
+
+def drive(xs):
+    out = []
+    for x in xs:
+        out.append(f(x))  # calling a prebuilt jit in a loop is the point
+    return out
+
+
+def make_steps(models):
+    # defining a FUNCTION in a loop that jits on call is not a per-
+    # iteration compile; the wrapper is built when the closure runs
+    steps = []
+    for m in models:
+        def build(model=m):
+            return jax.jit(model)
+
+        steps.append(build)
+    return steps

@@ -1,0 +1,14 @@
+"""GL105 positive: a fresh jax.jit wrapper per loop iteration."""
+import jax
+
+
+def drive(xs):
+    out = []
+    for x in xs:
+        f = jax.jit(lambda v: v * 2)     # <- GL105
+        out.append(f(x))
+    i = 0
+    while i < len(xs):
+        out.append(jax.jit(abs)(xs[i]))  # <- GL105
+        i += 1
+    return out

@@ -1,0 +1,18 @@
+"""GL106 near-miss: static args, shape reads, is-None checks."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("lo", "mode"))
+def step(x, mask, lo, mode="train"):
+    if lo > 0:  # static arg — branch resolves at trace time
+        x = x + lo
+    if mode == "train":  # static arg
+        x = x * 2
+    if x.ndim > 1:  # shape read — static by construction
+        x = x.sum(0)
+    if mask is not None:  # structural None check, not a value branch
+        x = jnp.where(mask, x, 0.0)
+    return jax.lax.cond(jnp.sum(x) > 0, lambda v: v, lambda v: -v, x)

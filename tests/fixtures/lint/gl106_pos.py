@@ -1,0 +1,12 @@
+"""GL106 positive: Python branches on traced jit arguments."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def relu_or_neg(x, lo):
+    if x > lo:                      # <- GL106
+        return x
+    while lo < 0:                   # <- GL106
+        lo = lo + 1
+    return -x

@@ -1,0 +1,18 @@
+"""GL107 near-miss: hashable static defaults; mutable NON-static
+defaults (pytree leaves, legal)."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("milestones",))
+def schedule(epoch, milestones=(60, 80)):  # tuple hashes — fine
+    return jnp.asarray(epoch) * len(milestones)
+
+
+@jax.jit
+def apply(x, scales=None):  # non-static arg may default mutably-ish
+    if scales is None:
+        return x
+    return x * scales[0]

@@ -1,0 +1,10 @@
+"""GL107 positive: mutable default on a static jit argument."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("milestones",))
+def schedule(epoch, milestones=[60, 80]):   # <- GL107
+    return jnp.asarray(epoch) * len(milestones)

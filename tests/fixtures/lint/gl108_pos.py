@@ -1,0 +1,12 @@
+"""GL108 positive: state-in/state-out jit without donation."""
+import jax
+import jax.numpy as jnp
+
+
+def train_step(state, batch):
+    grads = jax.grad(lambda p: jnp.sum(p * batch))(state.params)
+    new_state = state.replace(params=state.params - 0.1 * grads)
+    return new_state, {"gnorm": jnp.sum(grads * grads)}
+
+
+step = jax.jit(train_step)    # <- GL108

@@ -1,0 +1,16 @@
+"""GL109 near-miss: declared axes only, incl. constants and kwargs."""
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+SEQ_AXIS = "seq"
+
+
+def make_mesh(devices):
+    return Mesh(np.asarray(devices).reshape(2, 2, 2),
+                axis_names=("data", "model", "seq"))
+
+
+BATCH_SPEC = P("data")
+PARAM_SPEC = P(None, "model")
+TOKEN_SPEC = P(("data", "seq"))
+DYNAMIC = P(SEQ_AXIS)  # name refs aren't literals — out of scope

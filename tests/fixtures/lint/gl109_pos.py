@@ -1,0 +1,11 @@
+"""GL109 positive: PartitionSpec axis typo vs the declared mesh."""
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def make_mesh(devices):
+    return Mesh(np.asarray(devices).reshape(4, 2), ("data", "model"))
+
+
+BATCH_SPEC = P("dta")                  # <- GL109
+PARAM_SPEC = P(None, ("model", "dat"))  # <- GL109

@@ -1,0 +1,223 @@
+"""graftlint: the jit-hygiene gate and its rule-by-rule fixture corpus.
+
+Three layers:
+- fixture corpus (``tests/fixtures/lint/``): one minimal positive and
+  one near-miss negative per rule, with expected findings encoded as
+  ``# <- GLxxx`` markers — the test asserts EXACT rule IDs and line
+  numbers, both directions (no missed positives, no false positives);
+- workflow: per-line suppressions and the committed-baseline
+  grandfathering (match on line text, resurface on edit);
+- the tier-1 gate: the whole package must lint clean against the
+  committed baseline. AST-only — no jax work happens here.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+from pytorch_multiprocessing_distributed_tpu.analysis import RULES
+from pytorch_multiprocessing_distributed_tpu.analysis.lint import (
+    default_baseline_path, discover, package_root, run_lint,
+    write_baseline)
+from pytorch_multiprocessing_distributed_tpu.analysis.rules import (
+    analyze_files)
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "lint")
+_MARK = re.compile(r"#\s*<-\s*(GL\d{3})")
+
+
+def _expected(path):
+    out = []
+    with open(path) as fh:
+        for lineno, line in enumerate(fh, 1):
+            for m in _MARK.finditer(line):
+                out.append((m.group(1), lineno))
+    return sorted(out)
+
+
+def _fixture_files():
+    return sorted(f for f in os.listdir(FIXTURES) if f.endswith(".py"))
+
+
+def test_fixture_corpus_is_complete():
+    """Every non-meta rule has a positive AND a near-miss negative."""
+    names = set(_fixture_files())
+    for rid in RULES:
+        if rid == "GL000":  # parse-error pseudo-rule
+            continue
+        stem = rid.lower()
+        assert f"{stem}_pos.py" in names, f"missing positive for {rid}"
+        assert f"{stem}_neg.py" in names, f"missing negative for {rid}"
+
+
+@pytest.mark.parametrize("name", _fixture_files())
+def test_fixture_exact_rules_and_lines(name):
+    """Findings == markers, exactly: rule IDs AND line numbers. A
+    positive fires precisely where annotated; a near-miss negative
+    stays silent."""
+    path = os.path.join(FIXTURES, name)
+    got = sorted((f.rule, f.line) for f in analyze_files([path]))
+    assert got == _expected(path), (
+        f"{name}: expected {_expected(path)}, got {got}")
+
+
+def test_suppression_comment(tmp_path):
+    src = (
+        "import jax\n"
+        "import numpy as np\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    a = np.asarray(x)  # graftlint: disable=GL101 readback OK\n"
+        "    b = x.item()  # graftlint: disable\n"
+        "    c = x.item()\n"
+        "    d = x.item()  # graftlint: disable=GL101 TTFT boundary\n"
+        "    e = x.item()  # graftlint: disable=GL102 wrong rule\n"
+        "    return a, b, c, d, e\n"
+    )
+    p = tmp_path / "sup.py"
+    p.write_text(src)
+    live, _ = run_lint([str(p)], baseline=None)
+    # line 7: no comment; line 9: suppression names a DIFFERENT rule.
+    # Line 8's uppercase reason text must not corrupt the rule list.
+    assert [(f.rule, f.line) for f in live] == [("GL101", 7),
+                                                ("GL101", 9)]
+
+
+def test_baseline_grandfathers_and_resurfaces(tmp_path):
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return x.item()\n"
+    )
+    p = tmp_path / "legacy.py"
+    p.write_text(src)
+    base = tmp_path / "baseline.json"
+    live, _ = run_lint([str(p)], baseline=None)
+    assert len(live) == 1
+    write_baseline(live, str(base), str(tmp_path))
+
+    live2, grand = run_lint([str(p)], baseline=str(base),
+                            base_dir=str(tmp_path))
+    assert not live2 and len(grand) == 1
+
+    # editing the offending line resurfaces the finding (text match)
+    p.write_text(src.replace("x.item()", "(x * 2).item()"))
+    live3, grand3 = run_lint([str(p)], baseline=str(base),
+                             base_dir=str(tmp_path))
+    assert len(live3) == 1 and not grand3
+
+
+def test_package_lints_clean_tier1_gate():
+    """THE gate: every non-baselined finding in the package fails
+    tier-1. AST-only — jax never runs during the scan."""
+    baseline = default_baseline_path()
+    live, grandfathered = run_lint([package_root()], baseline=baseline)
+    assert not live, "graftlint gate RED:\n" + "\n".join(
+        f.render() for f in live)
+    # ratchet note: the committed baseline is empty today; if you are
+    # adding to it, cite lines and justify in the PR
+    assert len(grandfathered) == len(
+        json.load(open(baseline))["findings"])
+
+
+def test_cli_json_and_exit_codes(tmp_path):
+    """CLI contract: --json shape, exit 1 on findings, 0 when clean —
+    run against the fixture corpus so it exercises real findings."""
+    pos = os.path.join(FIXTURES, "gl101_pos.py")
+    proc = subprocess.run(
+        [sys.executable, "-m",
+         "pytorch_multiprocessing_distributed_tpu.analysis.lint",
+         pos, "--json", "--baseline", "none"],
+        capture_output=True, text=True,
+        cwd=os.path.dirname(package_root()))
+    assert proc.returncode == 1, proc.stderr
+    payload = json.loads(proc.stdout)
+    assert not payload["ok"]
+    assert all(f["rule"] == "GL101" for f in payload["findings"])
+
+    neg = os.path.join(FIXTURES, "gl101_neg.py")
+    proc = subprocess.run(
+        [sys.executable, "-m",
+         "pytorch_multiprocessing_distributed_tpu.analysis.lint",
+         neg, "--baseline", "none"],
+        capture_output=True, text=True,
+        cwd=os.path.dirname(package_root()))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_imports_no_jax():
+    """The gate must stay AST-only: importing and running the linter
+    module never imports jax (a backend bring-up would make the lint
+    gate cost seconds instead of milliseconds)."""
+    code = (
+        "import sys\n"
+        "from pytorch_multiprocessing_distributed_tpu.analysis.lint "
+        "import main\n"
+        "rc = main(['--list-rules'])\n"
+        "assert 'jax' not in sys.modules, 'lint imported jax'\n"
+        "sys.exit(rc)\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        cwd=os.path.dirname(package_root()))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_discover_skips_pycache(tmp_path):
+    (tmp_path / "__pycache__").mkdir()
+    (tmp_path / "__pycache__" / "junk.py").write_text("x = 1\n")
+    (tmp_path / "mod.py").write_text("x = 1\n")
+    files = discover([str(tmp_path)])
+    assert [os.path.basename(f) for f in files] == ["mod.py"]
+
+
+def test_typod_path_fails_loudly(tmp_path):
+    """A mistyped CI path must NOT report 'clean' on nothing: the
+    library raises, the CLI exits 2 with a diagnostic."""
+    with pytest.raises(FileNotFoundError):
+        discover([str(tmp_path / "servnig")])
+    proc = subprocess.run(
+        [sys.executable, "-m",
+         "pytorch_multiprocessing_distributed_tpu.analysis.lint",
+         str(tmp_path / "no_such_file.py")],
+        capture_output=True, text=True,
+        cwd=os.path.dirname(package_root()))
+    assert proc.returncode == 2
+    assert "neither a directory nor an existing .py file" in proc.stderr
+
+
+def test_write_baseline_subset_scope_merges(tmp_path):
+    """--write-baseline over a SUBSET of files must keep grandfathered
+    entries for files outside that scope, not overwrite them away."""
+    for name in ("a", "b"):
+        (tmp_path / f"{name}.py").write_text(
+            "import jax\n@jax.jit\ndef f(x):\n    return x.item()\n")
+    base = tmp_path / "baseline.json"
+    env = dict(os.environ)
+    run = lambda *extra: subprocess.run(  # noqa: E731
+        [sys.executable, "-m",
+         "pytorch_multiprocessing_distributed_tpu.analysis.lint",
+         "--baseline", str(base), *extra],
+        capture_output=True, text=True, env=env,
+        cwd=os.path.dirname(package_root()))
+    # baseline BOTH files, then re-baseline only a.py
+    assert run(str(tmp_path), "--write-baseline").returncode == 0
+    assert run(str(tmp_path / "a.py"), "--write-baseline").returncode == 0
+    entries = json.load(open(base))["findings"]
+    assert {os.path.basename(e["path"]) for e in entries} == \
+        {"a.py", "b.py"}
+    # full-scope run still clean against the merged baseline
+    proc = run(str(tmp_path))
+    assert proc.returncode == 0, proc.stdout
+
+
+def test_syntax_error_reports_gl000(tmp_path):
+    p = tmp_path / "broken.py"
+    p.write_text("def broken(:\n")
+    live, _ = run_lint([str(p)], baseline=None)
+    assert [f.rule for f in live] == ["GL000"]
