@@ -60,6 +60,7 @@ import os
 import sys
 import time
 import traceback
+from typing import Optional
 
 # bf16 peak FLOPs/s per chip by device_kind substring (first match wins;
 # more specific generations first). Sources: public TPU spec sheets.
@@ -137,7 +138,11 @@ def probe_backend(timeout: float):
     probe can neither wedge nor poison the parent: the parent only
     initializes a backend the probe just proved healthy.
 
-    Returns (platform_or_None, err_note_or_None).
+    Returns (platform_or_None, err_note_or_None, hung): ``hung``
+    distinguishes a TIMEOUT (the wedged-tunnel signature — the probe
+    process sat on backend init for the whole budget) from a fast
+    failure (rc != 0, usually transient), so the retry policy can stop
+    burning minutes once the wedge pattern repeats.
     """
     import subprocess
 
@@ -149,17 +154,18 @@ def probe_backend(timeout: float):
             capture_output=True, text=True, timeout=timeout,
         )
     except subprocess.TimeoutExpired:
-        return None, f"probe hung past {timeout:.0f}s"
+        return None, f"probe hung past {timeout:.0f}s", True
     except Exception as e:  # noqa: BLE001
-        return None, f"probe failed to launch: {e}"
+        return None, f"probe failed to launch: {e}", False
     if proc.returncode == 0 and proc.stdout.strip():
-        return proc.stdout.strip().splitlines()[-1], None
+        return proc.stdout.strip().splitlines()[-1], None, False
     tail = (proc.stderr or "").strip().splitlines()
     return None, (f"probe rc={proc.returncode}: "
-                  f"{tail[-1] if tail else 'no output'}")
+                  f"{tail[-1] if tail else 'no output'}"), False
 
 
-def init_devices(retries: int = 3, delay: float = 5.0):
+def init_devices(retries: int = 3, delay: float = 5.0,
+                 probe_timeout: Optional[float] = None):
     """Bring up the backend, surviving transient TPU-plugin failures AND
     hangs (the round-1 bench died here with rc=1 and no JSON; round 3
     lost its TPU evidence to a single in-process hang).
@@ -168,10 +174,16 @@ def init_devices(retries: int = 3, delay: float = 5.0):
 
     1. Probe bring-up in a subprocess (``probe_backend``) over a
        multi-attempt budget — default 3 probes x 180 s each, spaced
-       60 s apart (env knobs: ``PMDT_BENCH_PROBE_TIMEOUT``,
-       ``PMDT_BENCH_PROBE_ATTEMPTS``, ``PMDT_BENCH_PROBE_DELAY``).
-       A transiently wedged tunnel gets minutes to recover instead of
-       one strike; a wedged probe dies with its subprocess.
+       60 s apart (``--probe_timeout`` / env knobs:
+       ``PMDT_BENCH_PROBE_TIMEOUT``, ``PMDT_BENCH_PROBE_ATTEMPTS``,
+       ``PMDT_BENCH_PROBE_DELAY``). A transiently wedged tunnel gets
+       minutes to recover instead of one strike; a wedged probe dies
+       with its subprocess. BUT: hangs are not transient — a SECOND
+       hung probe in the same run means the tunnel is wedged for the
+       session, and the remaining budget would burn to the same
+       timeout (round 5 spent 3 x 180 s + 2 x 60 s backoff this way —
+       BENCH_r05.json ``backend_note``), so the loop fails over to CPU
+       at the second hang instead of finishing the schedule.
     2. Only after a probe reports a healthy non-CPU platform does the
        PARENT initialize it — still under a watchdog thread with the
        re-exec escape hatch, in case the backend wedges between probe
@@ -186,18 +198,26 @@ def init_devices(retries: int = 3, delay: float = 5.0):
 
     import jax
 
-    timeout = float(os.environ.get("PMDT_BENCH_PROBE_TIMEOUT", 180))
+    timeout = float(probe_timeout
+                    or os.environ.get("PMDT_BENCH_PROBE_TIMEOUT", 180))
     attempts = int(os.environ.get("PMDT_BENCH_PROBE_ATTEMPTS", retries))
     probe_delay = float(os.environ.get("PMDT_BENCH_PROBE_DELAY", 60))
     platform = None
     probe_note = None
+    hung_before = False
     for attempt in range(max(1, attempts)):
-        platform, probe_note = probe_backend(timeout)
+        platform, probe_note, hung = probe_backend(timeout)
         if platform is not None:
             _log(f"backend probe ok (attempt {attempt + 1}): {platform}")
             break
         _log(f"backend probe attempt {attempt + 1}/{attempts} failed: "
              f"{probe_note}")
+        if hung and hung_before:
+            probe_note += " (second hung probe; failing over early)"
+            _log("second hung probe this run — the tunnel is wedged, "
+                 "not flaky; skipping the remaining retry budget")
+            break
+        hung_before = hung_before or hung
         if attempt + 1 < attempts:
             _log(f"retrying probe in {probe_delay:.0f}s")
             time.sleep(probe_delay)
@@ -567,6 +587,11 @@ def main():
     p.add_argument("--warmup", default=5, type=int)
     p.add_argument("--platform", default="auto", choices=["auto", "cpu"],
                    help="cpu = skip the TPU probe and force the host platform")
+    p.add_argument("--probe_timeout", default=0.0, type=float,
+                   help="per-attempt backend-probe timeout in seconds "
+                        "(0 = $PMDT_BENCH_PROBE_TIMEOUT or 180); a "
+                        "second HUNG probe fails over to CPU "
+                        "immediately regardless of remaining attempts")
     p.add_argument("--remat", action="store_true",
                    help="rematerialize activations (jax.checkpoint) — "
                         "trades ~1.3x step time for the activation HBM")
@@ -586,7 +611,8 @@ def main():
             note = ("TPU init hung; re-exec'd onto CPU"
                     if os.environ.get("PMDT_BENCH_REEXEC") else None)
         else:
-            devices, note = init_devices()
+            devices, note = init_devices(
+                probe_timeout=args.probe_timeout or None)
         _log(f"devices: {len(devices)} x "
              f"{getattr(devices[0], 'device_kind', devices[0].platform)}")
         # post-probe: the cache is for (slow, tunnel-bound) TPU

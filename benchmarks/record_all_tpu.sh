@@ -28,11 +28,12 @@ note "generate bench"
 python benchmarks/generate_bench.py > benchmarks/generate_bench_tpu.txt 2>&1
 tail -4 benchmarks/generate_bench_tpu.txt >&2
 
-note "serving bench (load sweep + length-distribution/bucket sweep)"
+note "serving bench (load + length/bucket + decode-horizon sweeps)"
 python benchmarks/serving_bench.py \
+    --sweep load,length,horizon \
     --json_out benchmarks/serving_bench_tpu.json \
     > benchmarks/serving_bench_tpu.txt 2>&1
-tail -14 benchmarks/serving_bench_tpu.txt >&2
+tail -20 benchmarks/serving_bench_tpu.txt >&2
 
 note "MFU tune sweep (resnet50 north star)"
 python benchmarks/mfu_tune.py --config resnet50_imagenet

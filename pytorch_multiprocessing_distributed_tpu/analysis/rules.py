@@ -13,11 +13,17 @@ Jit-scope inference (two passes over the whole linted file set):
 1. per-file: parse, track import aliases, index every function (incl.
    nested and methods), and mark *roots* — functions decorated with or
    passed to ``jax.jit`` / ``shard_map`` / ``pmap`` / ``vmap`` /
-   ``grad`` / ``checkpoint`` / ``lax.scan``-family wrappers. A wrapper
-   whose argument is a *call* of a local function (the factory idiom
-   this codebase uses everywhere: ``jax.jit(self._make_decode_step())``,
+   ``grad`` / ``checkpoint`` / ``lax.scan``-family wrappers (the
+   control-flow primitives trace their bodies from ANY caller, jitted
+   or not — a ``lax.scan`` body in a host function is still traced).
+   A wrapper whose argument is a *call* of a local function (the
+   factory idiom this codebase uses everywhere:
+   ``jax.jit(self._make_decode_horizon())``,
    ``jax.shard_map(_train_body(...))``) marks the factory's *nested*
-   functions as traced — the factory body itself runs at build time.
+   functions as traced — the factory body itself runs at build time —
+   and a body reaching the wrapper through a local variable
+   (``body = make_body(...); lax.scan(body, ...)``) resolves through
+   the assignment.
 2. global: propagate scope through the call graph — a traced function's
    callees are traced too, resolved through module-level names and
    intra-package ``from``-imports (``serving.engine`` calling
@@ -360,23 +366,44 @@ def _scan_roots(files: Sequence[_File], index) -> List[_Func]:
     seeds: List[_Func] = []
 
     def resolve_arg(file: _File, scope: Optional[_Func], arg: ast.AST,
-                    *, factories: bool = True) -> List[_Func]:
+                    *, factories: bool = True,
+                    seen: Optional[Set[str]] = None) -> List[_Func]:
         """Functions a wrapper argument refers to. A direct Name/self
         attr resolves to its def; a Call of a local def is the factory
-        idiom — the factory's nested defs are the traced ones."""
+        idiom — the factory's nested defs are the traced ones; a Name
+        bound by a local assignment (``body = make_body(...)`` before
+        ``lax.scan(body, ...)``) resolves through the assignment's
+        value (``seen`` breaks self-referential chains)."""
         if isinstance(arg, ast.Name):
             t = _resolve_local(file, arg.id, scope)
             if t is None and arg.id in file.pkg_imports:
                 t = index.get(file.pkg_imports[arg.id])
-            return [t] if t is not None else []
+            if t is not None:
+                return [t]
+            if not factories or (seen and arg.id in seen):
+                return []
+            # control-flow-primitive bodies often reach the wrapper
+            # through a local variable; chase the assignment(s)
+            seen = (seen or set()) | {arg.id}
+            space = (_iter_own(scope.node) if scope is not None
+                     else ast.iter_child_nodes(file.tree))
+            out: List[_Func] = []
+            for node in space:
+                if isinstance(node, ast.Assign) and any(
+                        isinstance(t_, ast.Name) and t_.id == arg.id
+                        for t_ in node.targets):
+                    out.extend(resolve_arg(file, scope, node.value,
+                                           seen=seen))
+            return out
         if (isinstance(arg, ast.Attribute)
                 and isinstance(arg.value, ast.Name)
                 and arg.value.id in ("self", "cls")):
             t = file.by_name.get(arg.attr)
             return [t] if t is not None else []
         if factories and isinstance(arg, ast.Call):
-            inner = resolve_arg(file, scope, arg.func, factories=False)
-            out: List[_Func] = []
+            inner = resolve_arg(file, scope, arg.func, factories=False,
+                                seen=seen)
+            out = []
             for fac in inner:
                 out.extend(_descendants(fac))
             return out

@@ -195,43 +195,173 @@ def _block_decode(p, x_t, k_cache, v_cache, pos, h, dtype, eps,
 
 def _block_decode_slots(p, x_t, k_cache, v_cache, positions, h, dtype,
                         eps, cs=_no_cs, top_k=1, window=None,
-                        attn_impl="xla", block_k=256, interpret=None):
-    """Vector-position variant of :func:`_block_decode` — the serving
-    engine's decode body. Each row (slot) writes its pending token's
-    K/V at its OWN position, then attends over the cache prefix
-    ``[0, window)`` (a STATIC slice: the engine picks ``window`` as the
-    power-of-two bucket covering the longest active sequence, so the
-    attention cost tracks real occupancy while the compiled-shape set
-    stays bounded). ``window=None`` (or >= the cache) is the original
-    full-``s_max`` step — the token-exactness reference.
+                        attn_impl="xla", block_k=256, interpret=None,
+                        kv_valid=None, uniform_positions=False):
+    """Vector-position variant of :func:`_block_decode` — the shared
+    decode body (:func:`_decode_horizon`). Each row (slot) writes its
+    pending token's K/V at its OWN position, then attends over the
+    cache prefix ``[0, window)`` (a STATIC slice: the engine picks
+    ``window`` as the power-of-two bucket covering the longest active
+    sequence, so the attention cost tracks real occupancy while the
+    compiled-shape set stays bounded). ``window=None`` (or >= the
+    cache) is the original full-``s_max`` step — the token-exactness
+    reference.
 
     Writes always go to the FULL cache (an inactive row's frozen
     position may lie beyond the window; re-hitting its own column is
     the documented freeze behavior), only the attention reads are
     windowed. ``attn_impl`` selects the fused flash-decode kernel or
     the XLA reference (:mod:`...ops.pallas.decode_attention`).
+    ``kv_valid`` ([B, S] bool, XLA path only): extra key-column
+    validity for ragged left-padded batches — pad columns never
+    receive attention mass (``generate``'s ``prompt_lengths`` path).
+    ``uniform_positions=True`` asserts every row writes the SAME
+    column (``generate``'s lockstep batch): the cache update then
+    stays the cheap ``dynamic_update_slice`` instead of a per-row
+    scatter — on TPU the scatter is markedly slower, and this is the
+    hottest loop in the framework.
     """
     n = x_t.shape[0]
-    rows = jnp.arange(n)
     hn = _ln(x_t, p["ln1"], eps).astype(dtype)
     q, k, v = jnp.split(_dense(hn, p["attn"]["wqkv"], dtype), 3, axis=-1)
     q = cs(_split_heads(q, h), None, None, "model", None)
     k = cs(_split_heads(k, h), None, None, "model", None)
     v = cs(_split_heads(v, h), None, None, "model", None)
-    # per-slot column write: slot j's K/V lands at its own position
-    # (generate's dynamic_update_slice, vectorized)
-    k_cache = k_cache.at[rows, positions].set(k[:, 0])
-    v_cache = v_cache.at[rows, positions].set(v[:, 0])
+    if uniform_positions:
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k, (0, positions[0], 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v, (0, positions[0], 0, 0))
+    else:
+        # per-slot column write: slot j's K/V lands at its own position
+        # (generate's dynamic_update_slice, vectorized)
+        rows = jnp.arange(n)
+        k_cache = k_cache.at[rows, positions].set(k[:, 0])
+        v_cache = v_cache.at[rows, positions].set(v[:, 0])
     if window is not None and window < k_cache.shape[1]:
         k_win = jax.lax.slice_in_dim(k_cache, 0, window, axis=1)
         v_win = jax.lax.slice_in_dim(v_cache, 0, window, axis=1)
+        valid_win = (None if kv_valid is None
+                     else jax.lax.slice_in_dim(kv_valid, 0, window,
+                                               axis=1))
     else:
         k_win, v_win = k_cache, v_cache
-    att = decode_attention(q, k_win, v_win, positions, impl=attn_impl,
-                           block_k=block_k, interpret=interpret)
+        valid_win = kv_valid
+    if valid_win is not None:
+        if attn_impl == "pallas":
+            raise ValueError(
+                "kv_valid (ragged left-pad masking) composes only with "
+                "the XLA decode path")
+        mask = jnp.logical_and(
+            jnp.arange(k_win.shape[1])[None, :] <= positions[:, None],
+            valid_win)
+        att = decode_attention(q, k_win, v_win, mask=mask, impl="xla")
+    else:
+        att = decode_attention(q, k_win, v_win, positions,
+                               impl=attn_impl, block_k=block_k,
+                               interpret=interpret)
     att = att.reshape(n, 1, -1).astype(dtype)
     x_t = x_t + _dense(att, p["attn"]["wo"], dtype)
     return (x_t + _ffn(p, x_t, dtype, eps, top_k), k_cache, v_cache)
+
+
+def _decode_horizon(model, params, k_caches, v_caches, positions,
+                    last_tokens, active, remaining, eos_ids, keys, *,
+                    cs=_no_cs, cs_cache=None, window=None,
+                    attn_impl="xla", block_k=256, temperature=0.0,
+                    top_k=0, top_p=0.0, offsets=None, kv_valid=None,
+                    uniform_positions=False):
+    """THE fused multi-step decode loop: ``H = keys.shape[0]`` cached
+    decode steps as one ``lax.scan`` — one dispatch, zero host
+    round-trips inside. Both decode callers run on this core:
+    :func:`generate`'s whole decode tail is one call of it, and the
+    serving engine's jitted horizon program is a thin wrapper (so the
+    two cannot drift — the engine==generate token-exactness pin rests
+    on the shared body).
+
+    Per-row freeze gating runs ON DEVICE so a horizon stays token-exact
+    with H single steps even when a row finishes mid-horizon: a row
+    whose sampled token hits its ``eos_ids`` entry, or whose
+    ``remaining`` budget reaches zero, emits that final token and then
+    freezes — position pinned (its masked write re-hits the same
+    column), pending token unchanged, later steps emit ``-1`` for it.
+    :func:`generate` passes never-binding gates (``eos_ids = -1``,
+    ``remaining > H``) so every row runs the full horizon, exactly its
+    old scan.
+
+    Args:
+      model: the ``GPT`` (geometry/dtype/eps/MoE statics).
+      k_caches, v_caches: ``[L, N, S, H, Dh]`` slot caches.
+      positions: ``[N]`` int32 — each row's next write column.
+      last_tokens: ``[N]`` int32 pending tokens (consumed by step 0).
+      active: ``[N]`` bool — frozen rows re-write their own column and
+        emit ``-1``.
+      remaining: ``[N]`` int32 decode-token budgets (decremented per
+        emitted token; 0 freezes the row after its final emit).
+      eos_ids: ``[N]`` int32 stop tokens (``-1`` = none; token ids are
+        non-negative so ``-1`` never matches).
+      keys: ``[H, 2]`` uint32 per-step sample keys (ignored when
+        ``temperature == 0``).
+      window / attn_impl / block_k / kv_valid / uniform_positions: see
+        :func:`_block_decode_slots` (``generate`` sets
+        ``uniform_positions`` — its rows advance in lockstep, so cache
+        writes stay ``dynamic_update_slice``; the engine's slots hold
+        genuinely divergent positions and take the scatter).
+      offsets: ``[N]`` int32 left-pad offsets for ragged ``generate``
+        (position-embedding ids become ``max(positions - offsets, 0)``).
+
+    Returns ``(tokens, carry)``: ``tokens`` ``[H, N]`` int32 (``-1``
+    where the row was frozen BEFORE the step), ``carry`` the updated
+    ``(k_caches, v_caches, positions, last_tokens, active,
+    remaining)``.
+    """
+    dtype = model.dtype
+    eps = getattr(model, "ln_eps", _LN_EPS)
+    moe_k = getattr(model, "moe_top_k", 1)
+    h = model.num_heads
+    n_layers = model.num_layers
+    if cs_cache is None:
+        def cs_cache(c):
+            return c
+
+    def step(carry, key):
+        (k_caches, v_caches, positions, last_tokens, active,
+         remaining) = carry
+        ids = (positions if offsets is None
+               else jnp.maximum(positions - offsets, 0))
+        # cast-then-add, the model's own order — see _embed
+        pos_emb = params["pos_embed"][ids][:, None, :]
+        x_t = (params["embed"][last_tokens][:, None, :].astype(dtype)
+               + pos_emb.astype(dtype))
+        new_k, new_v = [], []
+        for i in range(n_layers):
+            x_t, kc, vc = _block_decode_slots(
+                params[f"block_{i}"], x_t, k_caches[i], v_caches[i],
+                positions, h, dtype, eps, cs, moe_k, window=window,
+                attn_impl=attn_impl, block_k=block_k, kv_valid=kv_valid,
+                uniform_positions=uniform_positions)
+            new_k.append(kc)
+            new_v.append(vc)
+        logits = _logits(params, x_t, eps, cs)[:, 0]
+        nxt = _sample(logits, temperature, top_k, top_p,
+                      key).astype(jnp.int32)
+        # the finishing token IS emitted (the step engine appends the
+        # token before checking eos/budget — same order here), then the
+        # row freezes for the rest of the horizon
+        emitted = jnp.where(active, nxt, -1)
+        remaining = jnp.where(active, remaining - 1, remaining)
+        finished = jnp.logical_and(
+            active, jnp.logical_or(nxt == eos_ids, remaining <= 0))
+        positions = jnp.where(active, positions + 1, positions)
+        last_tokens = jnp.where(active, nxt, last_tokens)
+        active = jnp.logical_and(active, jnp.logical_not(finished))
+        return (cs_cache(jnp.stack(new_k)), cs_cache(jnp.stack(new_v)),
+                positions, last_tokens, active, remaining), emitted
+
+    carry, tokens = jax.lax.scan(
+        step, (k_caches, v_caches, positions, last_tokens, active,
+               remaining), keys)
+    return tokens, carry
 
 
 def _block_chunk_prefill(p, x, k_cache, v_cache, start, h, dtype, eps,
@@ -471,13 +601,7 @@ def generate(
         # columns do
         kv_valid = jnp.arange(s_max)[None, :] >= offsets[:, None]
     cs = _make_cs(mesh)
-    dtype = model.dtype
     eps = getattr(model, "ln_eps", _LN_EPS)
-    moe_k = getattr(model, "moe_top_k", 1)
-    h = model.num_heads
-    n_layers = model.num_layers  # trusted like num_heads/hidden_size:
-    # a gappy params tree then fails LOUDLY at the missing block key
-    head_dim = model.hidden_size // h
 
     def cs_cache(c):
         # caches [L, B, S, H, Dh]: resident head-sharded — the per-chip
@@ -492,32 +616,26 @@ def generate(
 
     keys = (jax.random.split(rng, max_new_tokens) if rng is not None
             else jnp.zeros((max_new_tokens, 2), jnp.uint32))
-    tok0 = _sample(first_logits, temperature, top_k, top_p, keys[0])
+    tok0 = _sample(first_logits, temperature, top_k, top_p,
+                   keys[0]).astype(jnp.int32)
 
-    def step(carry, inp):
-        tok, k_caches, v_caches = carry
-        pos, key = inp
-        x_t = _embed(params, tok[:, None], pos, dtype, offsets)
-        new_k, new_v = [], []
-        for i in range(n_layers):
-            x_t, kc, vc = _block_decode(
-                params[f"block_{i}"], x_t, k_caches[i], v_caches[i],
-                pos, h, dtype, eps, cs, moe_k, kv_valid)
-            new_k.append(kc)
-            new_v.append(vc)
-        logits = _logits(params, x_t, eps, cs)[:, 0]
-        nxt = _sample(logits, temperature, top_k, top_p, key)
-        return (nxt, cs_cache(jnp.stack(new_k)),
-                cs_cache(jnp.stack(new_v))), tok
-
-    # scan positions t .. t+max_new-1; step j CONSUMES token j-1 (written
-    # at position t+j-1) and emits token j
+    # decode tail: ONE call of the shared fused-scan core (the same
+    # body the serving engine's horizon program runs). Step j consumes
+    # token j-1 (written at position t+j-1) and emits token j; the
+    # freeze gates never bind here (no EOS, budget > steps), so every
+    # row runs all max_new_tokens - 1 steps.
     if max_new_tokens > 1:
-        positions = jnp.arange(t, s_max - 1)
-        (last, _, _), toks = jax.lax.scan(
-            step, (tok0, k_caches, v_caches), (positions, keys[1:]))
+        toks, _ = _decode_horizon(
+            model, params, k_caches, v_caches,
+            jnp.full((b,), t, jnp.int32), tok0,
+            jnp.ones((b,), bool),
+            jnp.full((b,), max_new_tokens, jnp.int32),
+            jnp.full((b,), -1, jnp.int32), keys[1:], cs=cs,
+            cs_cache=cs_cache, temperature=temperature, top_k=top_k,
+            top_p=top_p, offsets=offsets, kv_valid=kv_valid,
+            uniform_positions=True)
         generated = jnp.concatenate(
-            [jnp.moveaxis(toks, 0, 1), last[:, None]], axis=1)
+            [tok0[:, None], jnp.moveaxis(toks, 0, 1)], axis=1)
     else:
         generated = tok0[:, None]
     return jnp.concatenate([prompt, generated], axis=1)

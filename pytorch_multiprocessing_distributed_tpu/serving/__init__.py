@@ -1,24 +1,28 @@
 """Serving: continuous-batching request engine over the KV-cache decode.
 
 The inference half of the north star ("serve heavy traffic"): a
-slot-based engine (``engine``) whose jitted decode step keeps a SMALL
-FIXED compiled-program set — one per length bucket, never per batch
-composition — with per-step attention cost tracking the longest
-ACTIVE sequence instead of the cache capacity (``kv_slots``), prompts
-admitted whole or in fixed-size chunks interleaved with decode
-(``scheduler.PrefillPlan``), fed by a FIFO scheduler with admission
-control (``scheduler``), loading trained checkpoints param-only
-(``params``). CLI: repo-root ``serve_lm.py``.
+slot-based engine (``engine``) whose jitted decode keeps a SMALL
+FIXED compiled-program set — one per (length bucket, horizon rung),
+never per batch composition — with per-step attention cost tracking
+the longest ACTIVE sequence instead of the cache capacity
+(``kv_slots``), steady-state decode fused H steps per dispatch with
+ONE overlapped token-block readback per horizon (``decode_horizon`` —
+host syncs/token = 1/H, on-device EOS/budget freezing keeps it
+token-exact), prompts admitted whole or in fixed-size chunks
+interleaved with decode (``scheduler.PrefillPlan``), fed by a FIFO
+scheduler with admission control and the adaptive horizon policy
+(``scheduler``), loading trained checkpoints param-only (``params``).
+CLI: repo-root ``serve_lm.py``.
 """
 
 from .engine import ServingEngine
 from .kv_slots import SlotPool
 from .params import init_params, load_params
 from .scheduler import (FIFOScheduler, PrefillPlan, QueueFull, Request,
-                        bucket_length)
+                        bucket_length, pick_horizon)
 
 __all__ = [
     "ServingEngine", "SlotPool", "FIFOScheduler", "PrefillPlan",
     "QueueFull", "Request", "bucket_length", "init_params",
-    "load_params",
+    "load_params", "pick_horizon",
 ]

@@ -37,9 +37,29 @@ tracks the work actually resident:
   online-softmax accumulation, per-slot position gate) on TPU, the
   bit-identical XLA reference elsewhere; CPU tests pin the kernel in
   interpret mode;
+- **decode horizon** (``decode_horizon=H``): when no admission work is
+  pending, H decode steps run as ONE jitted ``lax.scan``
+  (:func:`...inference.generate._decode_horizon`, the same core
+  ``generate`` decodes on) emitting an ``[H, slots]`` token block with
+  ONE host readback — steady-state throughput stops being bounded by
+  per-step dispatch + sync latency (the reference's per-iteration
+  ``.item()`` sin, re-shaped). EOS/budget gating runs ON DEVICE
+  (per-slot ``eos_ids``/``budgets`` in the pool), freezing finished
+  rows mid-horizon, so an H-step block is token-exact with H single
+  steps. The scheduler picks the horizon adaptively
+  (:func:`~.scheduler.pick_horizon`: bucket-boundary distance,
+  shortest remaining budget, queue pressure) and snaps it to the
+  ``{1, H}`` ladder, bounding decode compiles by
+  ``|buckets touched| x 2``. The readback itself is OVERLAPPED: in
+  steady state horizon ``h+1`` is dispatched before horizon ``h``'s
+  block is synced (double-buffered pending blocks, the trainer's
+  deferred-metrics pattern), so the host never sits between the TPU
+  and its next program;
 - finished slots (EOS / ``max_new_tokens``) are recycled in place —
   stale cache columns are masked until the next tenant overwrites them
-  (see ``kv_slots`` invariants).
+  (see ``kv_slots`` invariants). Finish detection is on-device; the
+  host replays the same rules on the drained block (the mirror the
+  realized per-slot position advances come from).
 
 Greedy decode through the engine is token-for-token identical to
 per-request ``generate`` calls (test-pinned, dense and MoE, bucketed
@@ -53,7 +73,8 @@ single-shard).
 from __future__ import annotations
 
 import time
-from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+from collections import deque
+from typing import Deque, Dict, Iterable, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -62,16 +83,31 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..analysis.sentinels import expected_transfer
 from ..inference.generate import (
-    _LN_EPS, _block_chunk_prefill, _block_decode_slots, _embed_at,
+    _LN_EPS, _block_chunk_prefill, _decode_horizon, _embed_at,
     _logits, _make_cs, _prefill, _sample)
 from ..utils.compile_cache import (jit_cache_keys, jit_cache_size,
                                    record_jit_key)
 from ..utils.metrics import ServingMetrics
 from .kv_slots import SlotPool
 from .scheduler import (DONE, FIFOScheduler, PrefillPlan, Request,
-                        bucket_length)
+                        bucket_length, pick_horizon)
 
 __all__ = ["ServingEngine", "Request"]
+
+
+class _TokenBlock:
+    """One dispatched decode horizon awaiting readback: the device
+    ``[H, slots]`` token block plus the host snapshot needed to
+    attribute it at drain time (which request held each slot when the
+    horizon launched, how many steps it ran, at which window)."""
+
+    __slots__ = ("tokens", "h", "window", "slots")
+
+    def __init__(self, tokens, h, window, slots):
+        self.tokens = tokens
+        self.h = h
+        self.window = window
+        self.slots = slots  # slot -> Request at dispatch time
 
 
 class _PendingPrefill:
@@ -125,6 +161,19 @@ class ServingEngine:
         many tokens, one chunk per engine step, instead of one
         whole-prompt call (None = whole-prompt). Bounds every resident
         request's between-token stall to one chunk's latency.
+      decode_horizon: max decode steps fused into ONE dispatched
+        ``lax.scan`` with ONE token-block readback (default 1 = the
+        per-step engine). The realized horizon per dispatch is
+        :func:`~.scheduler.pick_horizon`'s choice snapped to the
+        ``{1, decode_horizon}`` ladder — H collapses to 1 while
+        admission work is pending (bounded join latency), near a
+        decode-bucket boundary, or when the shortest remaining budget
+        would waste most of the horizon. With H > 1 the engine also
+        overlaps readback: horizon ``h+1`` dispatches before horizon
+        ``h``'s block syncs. Sampled (``temperature > 0``) streams stay
+        reproducible per engine run but depend on the horizon schedule
+        (per-step keys split inside the program); greedy output is
+        horizon-invariant (test-pinned).
       decode_attn: ``"pallas"`` | ``"xla"`` | ``"auto"`` — decode-step
         attention implementation (auto: the fused kernel on single-
         shard TPU, XLA elsewhere; ``"pallas"`` with a mesh is
@@ -140,6 +189,7 @@ class ServingEngine:
                  eos_id: Optional[int] = None, min_bucket: int = 16,
                  decode_buckets: Optional[Sequence[int]] = None,
                  prefill_chunk: Optional[int] = None,
+                 decode_horizon: int = 1,
                  decode_attn: str = "auto", decode_block_k: int = 256):
         if getattr(model, "seq_axis", None) is not None:
             raise NotImplementedError(
@@ -177,6 +227,9 @@ class ServingEngine:
         if prefill_chunk is not None and prefill_chunk < 1:
             raise ValueError(
                 f"prefill_chunk must be >= 1, got {prefill_chunk}")
+        if decode_horizon < 1:
+            raise ValueError(
+                f"decode_horizon must be >= 1, got {decode_horizon}")
         self.model = model
         self.params = params
         self.mesh = mesh
@@ -192,6 +245,11 @@ class ServingEngine:
         self._pending: Optional[_PendingPrefill] = None
         self._prefill_chunk = (None if prefill_chunk is None
                                else int(prefill_chunk))
+        self._horizon_max = int(decode_horizon)
+        # dispatched-but-unsynced token blocks (<= 2: double-buffered —
+        # the overlap depth that hides readback without letting the
+        # host run away from the device)
+        self._blocks: Deque[_TokenBlock] = deque()
         self._buckets = self._build_buckets(decode_buckets)
         if decode_attn == "auto":
             decode_attn = ("pallas" if (mesh is None and
@@ -213,19 +271,18 @@ class ServingEngine:
             cache_sh = NamedSharding(
                 mesh, P(None, None, None, "model", None))
             rep = NamedSharding(mesh, P())
-            decode_out = (rep, cache_sh, cache_sh, rep, rep)
-            insert_out = (cache_sh, cache_sh, rep, rep, rep)
+            decode_out = (rep, cache_sh, cache_sh, rep, rep, rep, rep)
+            insert_out = (cache_sh, cache_sh, rep, rep, rep, rep, rep)
             prefill_out = (rep, cache_sh, cache_sh)
             chunk_out = (rep, cache_sh, cache_sh)
-            release_out = rep
             tok0_out = rep
         else:
             decode_out = insert_out = prefill_out = None
-            chunk_out = release_out = tok0_out = None
+            chunk_out = tok0_out = None
         self._decode = jax.jit(
-            self._make_decode_step(), out_shardings=decode_out,
-            static_argnames=("window",),
-            donate_argnums=(1, 2, 3, 4) if donate_cache else ())
+            self._make_decode_horizon(), out_shardings=decode_out,
+            static_argnames=("window", "horizon"),
+            donate_argnums=(1, 2, 3, 4, 5, 6) if donate_cache else ())
         self._prefill_jit = jax.jit(self._make_prefill(),
                                     out_shardings=prefill_out)
         self._chunk_jit = jax.jit(
@@ -235,11 +292,8 @@ class ServingEngine:
                                  out_shardings=tok0_out)
         self._insert_jit = jax.jit(
             self._insert_fn, out_shardings=insert_out,
-            donate_argnums=(0, 1, 2, 3, 4) if donate_cache else ())
-        self._release_jit = jax.jit(
-            lambda active, slot: active.at[slot].set(False),
-            out_shardings=release_out,
-            donate_argnums=(0,) if donate_cache else ())
+            donate_argnums=(0, 1, 2, 3, 4, 5, 6) if donate_cache
+            else ())
 
     def _build_buckets(self, decode_buckets) -> Tuple[int, ...]:
         """Normalize the decode-window ladder: ascending, capped by and
@@ -264,19 +318,15 @@ class ServingEngine:
         return tuple(ladder)
 
     # ---- jitted programs ----------------------------------------------
-    def _make_decode_step(self):
-        """One masked decode step over every slot. ``window`` is the
-        jit-static attention prefix — the bucketed-compile signature;
-        the body is the SHARED ``inference.generate._block_decode_slots``
-        (generate's scan body with the scalar position replaced by the
-        per-slot position vector)."""
+    def _make_decode_horizon(self):
+        """``horizon`` masked decode steps over every slot as ONE
+        ``lax.scan``, with on-device EOS/budget freezing. ``window``
+        (attention prefix) and ``horizon`` (scan length) are the
+        jit-statics — the ``buckets x {1, H}`` compile signature; the
+        body is the SHARED :func:`...inference.generate._decode_horizon`
+        core ``generate()`` decodes on, so the two cannot drift."""
         model = self.model
         cs = _make_cs(self.mesh)
-        dtype = model.dtype
-        eps = getattr(model, "ln_eps", _LN_EPS)
-        moe_k = getattr(model, "moe_top_k", 1)
-        h = model.num_heads
-        n_layers = model.num_layers
         temperature, top_k, top_p = self._sampling
         attn_impl = self._attn_impl
         block_k = self._decode_block_k
@@ -284,33 +334,22 @@ class ServingEngine:
         def cs_cache(c):
             return cs(c, None, None, None, "model", None)
 
-        def step(params, k_caches, v_caches, positions, last_tokens,
-                 active, key, *, window):
-            n = positions.shape[0]
-            # embed each slot's pending token at its own position
-            # (cast-then-add, the model's own order — see _embed)
-            pos_emb = params["pos_embed"][positions][:, None, :]
-            x_t = (params["embed"][last_tokens][:, None, :].astype(dtype)
-                   + pos_emb.astype(dtype))
-            new_k, new_v = [], []
-            for i in range(n_layers):
-                x_t, kc, vc = _block_decode_slots(
-                    params[f"block_{i}"], x_t, k_caches[i], v_caches[i],
-                    positions, h, dtype, eps, cs, moe_k, window=window,
-                    attn_impl=attn_impl, block_k=block_k)
-                new_k.append(kc)
-                new_v.append(vc)
-            logits = _logits(params, x_t, eps, cs)[:, 0]
-            nxt = _sample(logits, temperature, top_k, top_p,
-                          key).astype(jnp.int32)
-            # inactive rows freeze: position pinned (their masked write
-            # re-hits the same column), pending token unchanged
-            positions = jnp.where(active, positions + 1, positions)
-            last_tokens = jnp.where(active, nxt, last_tokens)
-            return (nxt, cs_cache(jnp.stack(new_k)),
-                    cs_cache(jnp.stack(new_v)), positions, last_tokens)
+        def horizon_step(params, k_caches, v_caches, positions,
+                         last_tokens, active, remaining, eos_ids, key,
+                         *, window, horizon):
+            if temperature > 0.0:
+                keys = jax.random.split(key, horizon)
+            else:  # greedy ignores keys; keep ONE signature per ladder
+                keys = jnp.zeros((horizon, 2), jnp.uint32)
+            tokens, carry = _decode_horizon(
+                model, params, k_caches, v_caches, positions,
+                last_tokens, active, remaining, eos_ids, keys, cs=cs,
+                cs_cache=cs_cache, window=window, attn_impl=attn_impl,
+                block_k=block_k, temperature=temperature, top_k=top_k,
+                top_p=top_p)
+            return (tokens,) + carry
 
-        return step
+        return horizon_step
 
     def _make_prefill(self):
         """Whole-prompt prefill-on-join: the SHARED ``_prefill`` pass on
@@ -387,15 +426,19 @@ class ServingEngine:
 
     @staticmethod
     def _insert_fn(k_caches, v_caches, positions, last_tokens, active,
-                   k_pref, v_pref, slot, length, tok0):
+                   budgets, eos_ids, k_pref, v_pref, slot, length, tok0,
+                   budget, eos):
         """Splice a prefilled request into slot ``slot``: cache columns
         ``[0, bucket)`` overwrite the previous tenant's, the position
         counter starts at the prompt length, the pending token is the
-        prefill's first sample. Pad/stale columns beyond ``length`` are
-        masked until the decode position reaches (and overwrites) them.
-        A chunk-plan cache may be up to ``chunk - 1`` pad columns wider
-        than ``s_max``; the overshoot is sliced off here (valid columns
-        end at the prompt length, which admission bounds by ``s_max``).
+        prefill's first sample, and the on-device finish gates arm —
+        ``budget`` decode tokens remaining (``max_new_tokens - 1``; the
+        first token came from prefill) and the stop id (``-1`` = none).
+        Pad/stale columns beyond ``length`` are masked until the decode
+        position reaches (and overwrites) them. A chunk-plan cache may
+        be up to ``chunk - 1`` pad columns wider than ``s_max``; the
+        overshoot is sliced off here (valid columns end at the prompt
+        length, which admission bounds by ``s_max``).
         """
         s_max = k_caches.shape[2]
         if k_pref.shape[2] > s_max:
@@ -408,7 +451,10 @@ class ServingEngine:
         positions = positions.at[slot].set(length)
         last_tokens = last_tokens.at[slot].set(tok0)
         active = active.at[slot].set(True)
-        return k_caches, v_caches, positions, last_tokens, active
+        budgets = budgets.at[slot].set(budget)
+        eos_ids = eos_ids.at[slot].set(eos)
+        return (k_caches, v_caches, positions, last_tokens, active,
+                budgets, eos_ids)
 
     # ---- compile counters ---------------------------------------------
     @property
@@ -420,9 +466,24 @@ class ServingEngine:
     @property
     def decode_windows(self) -> Tuple[int, ...]:
         """The window buckets that actually compiled, in first-use
-        order (``compile_cache.jit_cache_keys``)."""
-        return tuple(w for tag, w in jit_cache_keys(self._decode)
+        order (``compile_cache.jit_cache_keys``; a window may repeat
+        when both horizon rungs compiled at it — ``decode_programs``
+        has the full pairs)."""
+        return tuple(w for tag, w, _ in jit_cache_keys(self._decode)
                      if tag == "decode")
+
+    @property
+    def decode_programs(self) -> Tuple[Tuple[int, int], ...]:
+        """``(window, horizon)`` pairs that actually compiled, in
+        first-use order — the ladder-bounded program set, never more
+        than ``len(decode_buckets) * 2`` entries."""
+        return tuple((w, h) for tag, w, h in jit_cache_keys(self._decode)
+                     if tag == "decode")
+
+    @property
+    def decode_horizon(self) -> int:
+        """The configured max fused-decode horizon (H_max)."""
+        return self._horizon_max
 
     @property
     def decode_buckets(self) -> Tuple[int, ...]:
@@ -547,15 +608,27 @@ class ServingEngine:
             slot = self._first_token(request, tok0_host, events)
             if slot is None:
                 continue
-            with expected_transfer("slot/length control upload at "
-                                   "admission (scalar H2D)"):
-                (pool.k_caches, pool.v_caches, pool.positions,
-                 pool.last_tokens, pool.active) = self._insert_jit(
-                    pool.k_caches, pool.v_caches, pool.positions,
-                    pool.last_tokens, pool.active, k_pref, v_pref,
-                    jnp.int32(slot), jnp.int32(length), tok0)
-            pool.note_insert(slot, length)
+            self._insert(request, slot, k_pref, v_pref, length, tok0)
         return events
+
+    def _insert(self, request: Request, slot: int, k_pref, v_pref,
+                length: int, tok0) -> None:
+        """Splice a prefilled request into ``slot`` and arm its
+        on-device finish gates (budget = decode tokens still owed; the
+        prefill token is already appended, so ``max_new_tokens - 1``)."""
+        pool = self.pool
+        eos = -1 if request.eos_id is None else int(request.eos_id)
+        with expected_transfer("slot/length/budget control upload at "
+                               "admission (scalar H2D)"):
+            (pool.k_caches, pool.v_caches, pool.positions,
+             pool.last_tokens, pool.active, pool.budgets,
+             pool.eos_ids) = self._insert_jit(
+                pool.k_caches, pool.v_caches, pool.positions,
+                pool.last_tokens, pool.active, pool.budgets,
+                pool.eos_ids, k_pref, v_pref, jnp.int32(slot),
+                jnp.int32(length), tok0,
+                jnp.int32(request.max_new_tokens - 1), jnp.int32(eos))
+        pool.note_insert(slot, length)
 
     def _admit_chunked(self) -> List[Tuple[Request, int, bool]]:
         events: List[Tuple[Request, int, bool]] = []
@@ -599,74 +672,148 @@ class ServingEngine:
         slot = self._first_token(pend.request, tok0_host, events)
         if slot is None:
             return events
-        with expected_transfer("slot/length control upload at "
-                               "admission (scalar H2D)"):
-            (pool.k_caches, pool.v_caches, pool.positions,
-             pool.last_tokens, pool.active) = self._insert_jit(
-                pool.k_caches, pool.v_caches, pool.positions,
-                pool.last_tokens, pool.active, pend.k_pref, pend.v_pref,
-                jnp.int32(slot), jnp.int32(pend.plan.length), tok0)
-        pool.note_insert(slot, pend.plan.length)
+        self._insert(pend.request, slot, pend.k_pref, pend.v_pref,
+                     pend.plan.length, tok0)
         return events
 
-    def _pick_window(self) -> int:
-        """Smallest configured bucket covering the longest ACTIVE
-        sequence's next write (host-mirrored — no device sync)."""
-        need = self.pool.max_active_pos + 1
+    # ---- horizon scheduling / dispatch / drain ------------------------
+    def _inflight_steps(self) -> int:
+        """Decode steps dispatched but not yet drained — the host
+        mirror's conservative position overshoot (every in-flight step
+        MAY have advanced every slot; rows frozen mid-horizon advanced
+        less, which only widens the window pick, never under-sizes
+        it)."""
+        return sum(block.h for block in self._blocks)
+
+    def _min_remaining_eff(self) -> int:
+        """Shortest remaining decode budget over running requests,
+        discounted by in-flight steps already dispatched against each
+        slot (host knows only DRAINED tokens)."""
+        rem = []
+        for slot, request in self._running.items():
+            assumed = sum(block.h for block in self._blocks
+                          if block.slots.get(slot) is request)
+            rem.append(request.max_new_tokens - len(request.tokens)
+                       - assumed)
+        return min(rem) if rem else 0
+
+    def _pick_schedule(self) -> Tuple[int, int]:
+        """``(window, horizon)`` for the next dispatch, off the
+        conservative host mirror: the smallest bucket covering the
+        highest possible next write, and the scheduler's adaptive
+        horizon snapped to the ``{1, H_max}`` ladder."""
+        max_eff = self.pool.max_active_pos + self._inflight_steps()
+        need = max_eff + 1
+        window = self._buckets[-1]
         for b in self._buckets:
             if b >= need:
-                return b
-        return self._buckets[-1]
+                window = b
+                break
+        admission_pending = (self.scheduler.queue_depth > 0
+                             or self._pending is not None)
+        h = pick_horizon(self._horizon_max, window, max_eff,
+                         self._min_remaining_eff(), admission_pending)
+        return window, h
 
-    def step(self) -> List[Tuple[Request, int, bool]]:
-        """One engine iteration: admit (a whole prompt per free slot,
-        or one chunk), then one batched decode step over the pool at
-        the active-length bucket window. Returns the step's token
-        events as ``(request, token, finished)`` tuples (admission
-        first tokens included)."""
-        events = self._admit()
+    def _dispatch(self, overlapped: bool = False) -> None:
+        """Launch one fused decode horizon (no host sync — the token
+        block stays on device in ``self._blocks`` until drained)."""
         pool = self.pool
-        if self._running:
-            key = self._next_key()
-            window = self._pick_window()
-            t0 = time.perf_counter()
-            (nxt, pool.k_caches, pool.v_caches, pool.positions,
-             pool.last_tokens) = self._decode(
-                self.params, pool.k_caches, pool.v_caches,
-                pool.positions, pool.last_tokens, pool.active, key,
-                window=window)
-            record_jit_key(self._decode, ("decode", window))
-            pool.note_advance()
-            with expected_transfer("per-step token readback (the "
-                                   "step's ONE host sync)"):
-                tokens = np.asarray(nxt)
-            dt = time.perf_counter() - t0
-            emitted = len(self._running)
-            self.metrics.record_decode_step(
-                dt, emitted, pool.occupancy, self.scheduler.queue_depth,
-                window)
-            for slot, request in list(self._running.items()):
-                token = int(tokens[slot])
+        window, h = self._pick_schedule()
+        key = self._next_key()
+        (tokens, pool.k_caches, pool.v_caches, pool.positions,
+         pool.last_tokens, pool.active, pool.budgets) = self._decode(
+            self.params, pool.k_caches, pool.v_caches, pool.positions,
+            pool.last_tokens, pool.active, pool.budgets, pool.eos_ids,
+            key, window=window, horizon=h)
+        record_jit_key(self._decode, ("decode", window, h))
+        self._blocks.append(
+            _TokenBlock(tokens, h, window, dict(self._running)))
+        self.metrics.record_dispatch(h, overlapped)
+
+    def _overlap_ok(self) -> bool:
+        """Dispatch horizon h+1 before syncing horizon h's block?
+        Only in steady state: horizons enabled, exactly one block in
+        flight, no admission work wanting a slot or a chunk step, and
+        at least one running request with budget beyond what is
+        already dispatched (an all-frozen horizon would be pure
+        waste)."""
+        return (self._horizon_max > 1
+                and len(self._blocks) == 1
+                and bool(self._running)
+                and self.scheduler.queue_depth == 0
+                and self._pending is None
+                and self._min_remaining_eff() >= 1)
+
+    def _drain_one(self, events: List[Tuple[Request, int, bool]]
+                   ) -> Tuple[int, int]:
+        """Sync the OLDEST pending block (the horizon's ONE host sync)
+        and attribute its tokens: append per request, replay the finish
+        rules the device applied (the host mirror — ``-1`` marks rows
+        the device froze), release finished slots, advance the pool's
+        position mirror by the REALIZED per-slot step counts. Returns
+        ``(window, tokens_emitted)``."""
+        pool = self.pool
+        block = self._blocks.popleft()
+        with expected_transfer("per-horizon token-block readback (the "
+                               "horizon's ONE host sync)"):
+            tokens = np.asarray(block.tokens)
+        realized: Dict[int, int] = {}
+        for h in range(block.h):
+            for slot, request in block.slots.items():
+                if self._running.get(slot) is not request:
+                    continue  # finished in an earlier step/block (or a
+                    # later tenant now holds the slot — its tokens are
+                    # in a later block)
+                token = int(tokens[h, slot])
+                if token < 0:
+                    continue  # device froze the row before this block
                 request.tokens.append(token)
+                realized[slot] = realized.get(slot, 0) + 1
                 reason = self._finished(request, token)
                 if reason is not None:
+                    # the device already cleared the row's active flag
+                    # mid-horizon — no release program, just host books
                     self._complete(request, reason)
-                    with expected_transfer("slot-release control "
-                                           "upload (scalar H2D)"):
-                        pool.active = self._release_jit(
-                            pool.active, jnp.int32(slot))
                     pool.release(slot)
                     del self._running[slot]
                 events.append((request, token, reason is not None))
+        pool.note_advance_slots(realized)
+        return block.window, sum(realized.values())
+
+    def step(self) -> List[Tuple[Request, int, bool]]:
+        """One engine iteration: admit (a whole prompt per free slot,
+        or one chunk), dispatch a decode horizon over the pool at the
+        active-length bucket window (plus, in steady state, the NEXT
+        horizon before this one's readback — the overlap), then drain
+        exactly one token block. Returns the iteration's token events
+        as ``(request, token, finished)`` tuples (admission first
+        tokens included)."""
+        events = self._admit()
+        pool = self.pool
+        if self._running or self._blocks:
+            t0 = time.perf_counter()
+            if self._running and not self._blocks:
+                self._dispatch()
+            if self._overlap_ok():
+                self._dispatch(overlapped=True)
+            occupancy = pool.occupancy  # before releases, like PR 2
+            window, emitted = self._drain_one(events)
+            dt = time.perf_counter() - t0
+            self.metrics.record_decode_step(
+                dt, emitted, occupancy, self.scheduler.queue_depth,
+                window)
         self._step_idx += 1
         return events
 
     @property
     def in_flight(self) -> int:
-        """Requests somewhere in the engine: queued, mid-chunked-
-        prefill, or decoding (drive loops should drain until 0)."""
+        """Work somewhere in the engine: queued, mid-chunked-prefill,
+        decoding, or a dispatched-but-unsynced token block (drive
+        loops should drain until 0)."""
         return (self.scheduler.queue_depth + len(self._running)
-                + (1 if self._pending is not None else 0))
+                + (1 if self._pending is not None else 0)
+                + (1 if self._blocks else 0))
 
     def run(self) -> Iterable[Tuple[Request, int, bool]]:
         """Drive ``step`` until queue, pending prefill and pool drain,

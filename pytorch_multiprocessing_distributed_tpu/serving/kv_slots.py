@@ -6,7 +6,9 @@ instead of growing arrays — see ``inference/generate.py``) applied to
 SERVING: requests join and leave a persistent decode loop, so the cache
 cannot be shaped per batch. Instead the pool owns fixed
 ``[layers, max_slots, s_max, heads, head_dim]`` K/V arrays plus per-slot
-scalars (position counter, last sampled token, active flag), and the
+scalars (position counter, last sampled token, active flag, remaining
+decode budget, stop id — the last two arm the fused horizon's on-device
+finish gating), and the
 engine's jitted decode step runs over ALL slots every step with an
 active-mask — occupancy changes the mask's *values*, never any shape,
 so the step compiles exactly once (pinned via
@@ -32,7 +34,8 @@ Host-side free-list bookkeeping lives here too (``acquire``/
 the caller (the engine threads them through its jitted steps).
 
 The pool also mirrors each ACTIVE slot's position counter on the host
-(``note_insert``/``note_advance``, read via ``max_active_pos``): the
+(``note_insert``/``note_advance_slots``, read via ``max_active_pos``):
+the
 engine's length-bucketed decode picks its attention window from the
 longest *active* sequence BEFORE launching the step, and a device
 read-back of the position vector there would serialize every step on a
@@ -95,6 +98,13 @@ class SlotPool:
         self.last_tokens = self._replicated(
             jnp.zeros((self.max_slots,), jnp.int32))
         self.active = self._replicated(jnp.zeros((self.max_slots,), bool))
+        # on-device finish gates (set at insert): remaining decode-token
+        # budget and stop id per slot — the fused multi-step horizon
+        # freezes finished rows mid-scan without a host round-trip
+        self.budgets = self._replicated(
+            jnp.zeros((self.max_slots,), jnp.int32))
+        self.eos_ids = self._replicated(
+            jnp.full((self.max_slots,), -1, jnp.int32))
         self._free: List[int] = list(range(self.max_slots))
         # host mirror of the device position/active state (see module
         # docstring): feeds the engine's decode-window choice sync-free
@@ -131,9 +141,10 @@ class SlotPool:
         return self._free.pop(0)
 
     def release(self, slot: int) -> None:
-        """Return ``slot`` to the free list. The caller is responsible
-        for clearing the device-side active flag (the engine batches
-        that into its jitted release)."""
+        """Return ``slot`` to the free list. The device-side active
+        flag is already False by the time a slot is released: the
+        fused decode scan clears it on-device when the row's EOS or
+        budget gate fires (there is no separate release program)."""
         if slot in self._free or not 0 <= slot < self.max_slots:
             raise ValueError(f"bad release of slot {slot}")
         self._free.append(slot)
@@ -147,12 +158,15 @@ class SlotPool:
         self._positions_host[slot] = int(position)
         self._active_host[slot] = True
 
-    def note_advance(self) -> None:
-        """Mirror one decode step: every ACTIVE slot's position moved
-        +1 on device (inactive rows stay frozen there too)."""
-        for i, live in enumerate(self._active_host):
-            if live:
-                self._positions_host[i] += 1
+    def note_advance_slots(self, realized) -> None:
+        """Mirror one drained decode horizon: slot ``s`` advanced by
+        ``realized[s]`` device steps — the REALIZED count per slot, not
+        the dispatched horizon length (rows the device froze mid-scan
+        on EOS/budget advanced only up to their freeze, and the mirror
+        must agree with the device's frozen position exactly or the
+        next tenant's window pick drifts)."""
+        for slot, steps in realized.items():
+            self._positions_host[slot] += int(steps)
 
     @property
     def max_active_pos(self) -> int:

@@ -41,6 +41,38 @@ def bucket_length(length: int, min_bucket: int, s_max: int) -> int:
     return min(b, s_max)
 
 
+def pick_horizon(h_max: int, window: int, max_pos: int,
+                 min_remaining: int, admission_pending: bool) -> int:
+    """Adaptive fused-decode horizon, snapped to the ``{1, h_max}``
+    ladder (two compiled scan lengths per window bucket, never a
+    program per horizon value).
+
+    The candidate is ``min(h_max, window - max_pos, min_remaining)``:
+
+    - ``window - max_pos`` — steps until the highest-positioned slot's
+      write would cross the picked attention-window bucket (crossing
+      mid-scan would need a wider window for the WHOLE horizon; running
+      single steps up to the boundary keeps small-bucket traffic
+      paying small-bucket attention);
+    - ``min_remaining`` — the shortest remaining decode budget among
+      running slots: a horizon that mostly outlives every request just
+      burns frozen-row compute;
+    - ``admission_pending`` forces 1: queued requests (or an in-flight
+      chunked prefill) want the next free slot / chunk interleave
+      within one step, not after H of them — the continuous-batching
+      join-latency bound.
+
+    Snapping: any candidate below ``h_max`` realizes as 1 (the
+    candidate is a latency/waste bound, not a useful program size —
+    compiling a scan per intermediate value would defeat the
+    ``buckets x {1, h_max}`` compile budget).
+    """
+    if h_max <= 1 or admission_pending:
+        return 1
+    h = min(h_max, window - max_pos, min_remaining)
+    return h_max if h >= h_max else 1
+
+
 class PrefillPlan:
     """Chunk schedule for one joining prompt.
 

@@ -87,11 +87,19 @@ class ServingMetrics:
       prefill work starts), per request — ``ttft - queue_wait`` is the
       prefill-side latency, so the pair splits "the pool was busy"
       from "the prompt was long" when tuning slot counts;
-    - ``decode_step``: wall seconds per batched decode step;
+    - ``decode_step``: wall seconds per engine decode iteration (one
+      drained token block);
     - ``decode_window``: the attention window (in cache columns) each
       decode step ran over — under length-bucketed decode this tracks
       the longest ACTIVE sequence's bucket, and the bench plots step
       time against it;
+    - ``horizon`` / ``dispatches`` / ``host_syncs`` /
+      ``overlapped_dispatches``: the dispatch-overhead meters. Each
+      fused decode horizon is ONE device dispatch and (at drain) ONE
+      host sync for H emitted tokens, so ``host_syncs_per_token``
+      collapses from 1 toward 1/H — the whole point of horizon decode;
+      ``overlapped_dispatches`` counts horizons launched BEFORE the
+      previous block's readback (the deferred-sync overlap);
     - ``occupancy``: live slots at each decode step (the utilization
       the slot count should be tuned against);
     - ``queue_depth``: queued requests at each decode step (sustained
@@ -107,10 +115,14 @@ class ServingMetrics:
         self.queue_wait = AverageMeter()
         self.decode_step = AverageMeter()
         self.decode_window = AverageMeter()
+        self.horizon = AverageMeter()
         self.occupancy = AverageMeter()
         self.queue_depth = AverageMeter()
         self.tokens_generated = 0
         self.requests_completed = 0
+        self.dispatches = 0
+        self.host_syncs = 0
+        self.overlapped_dispatches = 0
         self._elapsed = 0.0
         self._occupancy_max = 0
         self._queue_wait_max = 0.0
@@ -126,10 +138,24 @@ class ServingMetrics:
         self._queue_wait_max = max(self._queue_wait_max,
                                    queue_wait_seconds)
 
+    def record_dispatch(self, horizon: int,
+                        overlapped: bool = False) -> None:
+        """One device dispatch of a fused ``horizon``-step decode
+        program; ``overlapped`` = launched before the previous block's
+        readback (no host sync sat between the two programs)."""
+        self.dispatches += 1
+        self.horizon.update(horizon)
+        if overlapped:
+            self.overlapped_dispatches += 1
+
     def record_decode_step(self, seconds: float, tokens: int,
                            occupancy: int, queue_depth: int,
                            window: int = 0) -> None:
+        """One drained token block: ``seconds`` of engine decode wall
+        (dispatch + drain), ``tokens`` realized tokens, and the block's
+        ONE host sync."""
         self.decode_step.update(seconds)
+        self.host_syncs += 1
         if window:
             self.decode_window.update(window)
         self.occupancy.update(occupancy)
@@ -142,9 +168,9 @@ class ServingMetrics:
         self.requests_completed += 1
 
     def snapshot(self) -> dict:
-        decode_tps = (0.0 if self._elapsed == 0 else
-                      (self.tokens_generated - self.ttft.count)
-                      / self._elapsed)
+        decode_tokens = self.tokens_generated - self.ttft.count
+        decode_tps = (0.0 if self._elapsed == 0
+                      else decode_tokens / self._elapsed)
         return {
             "requests_completed": self.requests_completed,
             "tokens_generated": self.tokens_generated,
@@ -154,6 +180,12 @@ class ServingMetrics:
             "queue_wait_max_s": self._queue_wait_max,
             "decode_step_avg_s": self.decode_step.avg,
             "decode_window_avg": self.decode_window.avg,
+            "decode_horizon_avg": self.horizon.avg,
+            "decode_dispatches": self.dispatches,
+            "decode_host_syncs": self.host_syncs,
+            "host_syncs_per_token": (0.0 if decode_tokens <= 0 else
+                                     self.host_syncs / decode_tokens),
+            "overlapped_dispatches": self.overlapped_dispatches,
             "decode_tokens_per_sec": decode_tps,
             "occupancy_avg": self.occupancy.avg,
             "occupancy_max": self._occupancy_max,

@@ -10,7 +10,10 @@ decode step compiles once per length bucket (``--decode_buckets`` —
 step cost tracks the longest ACTIVE sequence, not ``--s_max``), long
 prompts can prefill in fixed chunks interleaved with decode
 (``--prefill_chunk`` — no resident request stalls longer than one
-chunk), and per-request tokens stream to stdout as they are emitted.
+chunk), steady-state decode can fuse H steps into one dispatched scan
+with one (overlapped) readback per horizon (``--decode_horizon`` —
+host syncs/token = 1/H), and per-request tokens stream to stdout as
+they are emitted.
 
 Request sources (first match wins):
   --requests FILE   JSON Lines, one request per line:
@@ -73,6 +76,14 @@ parser.add_argument('--prefill_chunk', default=0, type=int,
                          'decode — bounds every resident request\'s '
                          'stall to one chunk (0 = whole-prompt '
                          'prefill-on-join)')
+parser.add_argument('--decode_horizon', default=1, type=int,
+                    help='fuse up to H decode steps into one '
+                         'dispatched lax.scan with ONE token readback '
+                         'per horizon (and overlapped readback in '
+                         'steady state) — host syncs/token drops to '
+                         '1/H; the horizon collapses to 1 while '
+                         'admission work is pending, so join latency '
+                         'stays bounded (1 = per-step decode)')
 parser.add_argument('--decode_attn', default='auto',
                     choices=['auto', 'xla', 'pallas'],
                     help='decode-step attention: fused flash-decode '
@@ -213,6 +224,7 @@ def main():
         eos_id=None if args.eos < 0 else args.eos,
         decode_buckets=decode_buckets,
         prefill_chunk=args.prefill_chunk or None,
+        decode_horizon=args.decode_horizon,
         decode_attn=args.decode_attn)
 
     def emit(events):
@@ -262,6 +274,8 @@ def main():
     snap["decode_step_compiles"] = engine.decode_step_compiles
     snap["decode_buckets"] = list(engine.decode_buckets)
     snap["decode_windows"] = list(engine.decode_windows)
+    snap["decode_horizon"] = engine.decode_horizon
+    snap["decode_programs"] = [list(p) for p in engine.decode_programs]
     snap["prefill_compiles"] = engine.prefill_compiles
     snap["chunk_prefill_compiles"] = engine.chunk_prefill_compiles
     print("metrics: " + json.dumps(snap, sort_keys=True), flush=True)

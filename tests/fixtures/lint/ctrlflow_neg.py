@@ -1,0 +1,43 @@
+"""Control-flow-root near-miss: the same shapes NOT handed to a jax
+control-flow primitive stay host-scoped — syncs/prints there are the
+host loop's business, and a function value passed to a plain Python
+helper is not a trace root."""
+import jax.numpy as jnp
+import numpy as np
+
+
+def host_driver(xs):
+    # called directly in a host loop (never passed to lax.scan):
+    # host scope, syncs allowed
+    total = 0.0
+    for x in xs:
+        total, _ = _accumulate(total, x)
+    return total
+
+
+def _accumulate(carry, x):
+    host = np.asarray(x)
+    print(carry)
+    return carry + jnp.asarray(host), x
+
+
+def pick_driver(xs):
+    # a function VALUE bound to a variable and passed to a plain
+    # helper — _apply is not a trace wrapper, so the body stays host
+    body = _make_body(2)
+    return _apply(body, xs)
+
+
+def _make_body(k):
+    def body(carry, x):
+        print(carry)
+        return carry * k, x
+
+    return body
+
+
+def _apply(fn, xs):
+    out = 0
+    for x in xs:
+        out, _ = fn(out, x)
+    return out

@@ -1,0 +1,56 @@
+"""Control-flow-primitive roots: bodies handed to lax.scan /
+fori_loop / while_loop / cond are jit-traced even when the CALLER is a
+plain host function (the primitives trace their function arguments
+from anywhere) — including bodies that reach the wrapper through a
+local variable bound from a factory call."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def scan_driver(xs):
+    # body handed to lax.scan directly from a NON-jit host function
+    def body(carry, x):
+        host = np.asarray(x)              # <- GL101
+        return carry + x, host
+
+    return jax.lax.scan(body, 0.0, xs)
+
+
+def loop_driver(xs):
+    # the body reaches fori_loop through a local VARIABLE bound from a
+    # factory call — the assignment must be chased to the nested def
+    body = _make_body(3)
+    return jax.lax.fori_loop(0, 4, body, xs)
+
+
+def _make_body(k):
+    def body(i, carry):
+        print(i)                          # <- GL102
+        return carry * k
+
+    return body
+
+
+def cond_driver(pred, x):
+    return jax.lax.cond(pred, _true_fn, _false_fn, x)
+
+
+def _true_fn(x):
+    return float(jnp.sum(x))              # <- GL101
+
+
+def _false_fn(x):
+    return jnp.sum(x) * 2.0
+
+
+def while_driver(x):
+    def keep_going(carry):
+        return carry[1] < 4
+
+    def step(carry):
+        val, i = carry
+        val = val + jnp.asarray(np.random.rand())  # <- GL103
+        return val, i + 1
+
+    return jax.lax.while_loop(keep_going, step, (x, 0))

@@ -1,0 +1,70 @@
+"""bench.py backend-probe retry policy.
+
+Round-5 burned its whole probe budget (3 x 180 s + 2 x 60 s backoff)
+on a wedged tunnel whose every probe HUNG to the timeout — a hang is
+not a transient failure, so the second one must fail the run over to
+CPU immediately. Fast failures (probe rc != 0) keep the full retry
+budget: those really are transient. All probes are monkeypatched —
+no subprocess, no TPU plugin, no sleeping."""
+
+import bench
+
+
+def _no_sleep(monkeypatch):
+    sleeps = []
+    monkeypatch.setattr(bench.time, "sleep", lambda s: sleeps.append(s))
+    return sleeps
+
+
+def test_second_hang_fails_over_immediately(monkeypatch):
+    calls = []
+
+    def probe(timeout):
+        calls.append(timeout)
+        return None, f"probe hung past {timeout:.0f}s", True
+
+    monkeypatch.setattr(bench, "probe_backend", probe)
+    sleeps = _no_sleep(monkeypatch)
+    devices, note = bench.init_devices(probe_timeout=7)
+    assert len(calls) == 2, "second hang must abort the retry schedule"
+    assert calls == [7, 7]  # --probe_timeout reaches every attempt
+    assert len(sleeps) == 1  # only the backoff BETWEEN probes 1 and 2
+    assert devices[0].platform == "cpu"
+    assert "CPU fallback" in note and "second hung probe" in note
+
+
+def test_fast_failures_keep_the_full_budget(monkeypatch):
+    calls = []
+
+    def probe(timeout):
+        calls.append(timeout)
+        return None, "probe rc=1: imploded", False
+
+    monkeypatch.setattr(bench, "probe_backend", probe)
+    _no_sleep(monkeypatch)
+    devices, note = bench.init_devices()
+    assert len(calls) == 3  # transient errors retry to the cap
+    assert devices[0].platform == "cpu"
+    assert "CPU fallback" in note
+
+
+def test_hang_then_error_then_recovery(monkeypatch):
+    """One hang does not trip the early failover, and a later healthy
+    probe still wins the run."""
+    outcomes = [
+        (None, "probe hung past 7s", True),
+        (None, "probe rc=1: transient", False),
+        ("cpu", None, False),
+    ]
+    calls = []
+
+    def probe(timeout):
+        calls.append(timeout)
+        return outcomes[len(calls) - 1]
+
+    monkeypatch.setattr(bench, "probe_backend", probe)
+    _no_sleep(monkeypatch)
+    devices, note = bench.init_devices(probe_timeout=7)
+    assert len(calls) == 3
+    assert devices[0].platform == "cpu"
+    assert note is None  # healthy probe: no fallback note

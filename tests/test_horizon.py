@@ -1,0 +1,193 @@
+"""Fused multi-step decode horizon: token-exactness vs the per-step
+engine and generate() (including EOS/budget freezes mid-horizon and
+ragged join/leave churn), the buckets x {1, H} compile ladder, the
+overlapped-readback bookkeeping, and the pure horizon-pick policy."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_multiprocessing_distributed_tpu import models
+from pytorch_multiprocessing_distributed_tpu.inference import generate
+from pytorch_multiprocessing_distributed_tpu.serving import (
+    ServingEngine, init_params, pick_horizon)
+
+
+def _tiny(**kw):
+    return models.GPT(vocab_size=61, max_seq_len=64, hidden_size=32,
+                      num_layers=2, num_heads=2, mlp_dim=64,
+                      attn_impl="xla", **kw)
+
+
+@pytest.fixture(scope="module")
+def served():
+    model = _tiny()
+    params = init_params(model, 1)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, model.vocab_size, (n,))
+               for n in (3, 7, 12, 5, 9)]
+    return model, params, prompts
+
+
+def _ref_tail(model, params, prompt, n):
+    out = generate(model, params, jnp.asarray(prompt)[None, :],
+                   max_new_tokens=n)
+    return np.asarray(out[0, -n:]).tolist()
+
+
+def _serve_tokens(engine, prompts, n):
+    return [list(r.tokens)
+            for r in engine.serve([(p, n) for p in prompts])]
+
+
+def test_horizon_matches_step_engine_ragged(served):
+    """The acceptance pin: decode_horizon in {1, 4, 8} is byte-
+    identical to per-request generate() (and hence to the PR-2
+    step-by-step engine, whose equivalence with generate() is pinned
+    in test_serving) — 5 ragged requests through 2 slots, so requests
+    join and leave while horizons are in flight."""
+    model, params, prompts = served
+    ref = [_ref_tail(model, params, p, 6) for p in prompts]
+    for h in (1, 4, 8):
+        engine = ServingEngine(model, params, max_slots=2, s_max=32,
+                               min_bucket=8, decode_horizon=h)
+        assert _serve_tokens(engine, prompts, 6) == ref, f"H={h}"
+        # every compiled program sits on the buckets x {1, H} ladder
+        for window, horizon in engine.decode_programs:
+            assert window in engine.decode_buckets
+            assert horizon in (1, h)
+        assert engine.pool.occupancy == 0
+        assert not engine._blocks  # every token block drained
+
+
+def test_eos_freezes_mid_horizon(served):
+    """A request whose stop token lands mid-horizon emits exactly up
+    to (and including) the EOS token — the device freeze — and the
+    tail of the [H, slots] block is discarded by the host mirror."""
+    model, params, prompts = served
+    ref = _ref_tail(model, params, prompts[1], 12)
+    eos = int(ref[4])
+    engine = ServingEngine(model, params, max_slots=1, s_max=32,
+                           min_bucket=8, decode_buckets=(),
+                           decode_horizon=8)
+    engine.submit(prompts[1], 12, eos_id=eos)
+    done = [r for r, _, fin in engine.run() if fin]
+    (request,) = done
+    assert request.finish_reason == "eos"
+    assert list(request.tokens) == ref[:5]
+    assert engine.pool.occupancy == 0
+    # the freeze happened INSIDE a fused horizon, not on a 1-step tail
+    assert any(h > 1 for _, h in engine.decode_programs)
+
+
+def test_steady_state_sync_and_dispatch_budget(served):
+    """The dispatch-overhead contract: a queue-empty steady state at
+    H=4 makes ONE dispatch and ONE host sync per horizon — syncs per
+    decode token = 1/H — with the readback overlapped (horizon h+1
+    launched before h's block synced), and re-serving the same shape
+    compiles nothing new."""
+    model, params, prompts = served
+    engine = ServingEngine(model, params, max_slots=1, s_max=32,
+                           min_bucket=8, decode_buckets=(),
+                           decode_horizon=4)
+    (request,) = engine.serve([(prompts[0], 13)])
+    assert list(request.tokens) == _ref_tail(model, params,
+                                             prompts[0], 13)
+    snap = engine.metrics.snapshot()
+    # 12 decode tokens = 3 fused horizons of 4: one dispatch + one
+    # sync each, horizons 2 and 3 dispatched before the previous sync
+    assert snap["decode_dispatches"] == 3
+    assert snap["decode_host_syncs"] == 3
+    assert snap["overlapped_dispatches"] == 2
+    assert snap["decode_horizon_avg"] == 4.0
+    assert snap["host_syncs_per_token"] == pytest.approx(0.25)
+    assert engine.decode_programs == ((32, 4),)
+    # steady state: the same request shape retraces nothing
+    engine.serve([(prompts[0], 13)])
+    assert engine.decode_programs == ((32, 4),)
+
+
+def test_queue_pressure_collapses_horizon(served):
+    """While the queue holds waiting requests the scheduler pins H=1
+    (the continuous-batching join-latency bound): with more requests
+    than slots, fused horizons only appear once the queue drains."""
+    model, params, prompts = served
+    engine = ServingEngine(model, params, max_slots=1, s_max=32,
+                           min_bucket=8, decode_buckets=(),
+                           decode_horizon=8)
+    ref = [_ref_tail(model, params, p, 9) for p in prompts[:2]]
+    assert _serve_tokens(engine, prompts[:2], 9) == ref
+    programs = dict(engine.decode_programs)
+    assert set(programs.values()) <= {1, 8}
+    # the first tenant decodes under queue pressure -> some H=1 work;
+    # the last tenant's tail runs fused -> some H=8 work
+    horizons = [h for _, h in engine.decode_programs]
+    assert 1 in horizons and 8 in horizons
+
+
+def test_horizon_with_chunked_prefill(served):
+    """Chunked admission interleaves with horizon decode: while a
+    prefill plan is mid-flight the horizon collapses to 1 (the chunk
+    gets its step), and the streams stay token-exact."""
+    model, params, prompts = served
+    ref = [_ref_tail(model, params, p, 6) for p in prompts[:3]]
+    engine = ServingEngine(model, params, max_slots=2, s_max=32,
+                           min_bucket=8, prefill_chunk=4,
+                           decode_horizon=8)
+    assert _serve_tokens(engine, prompts[:3], 6) == ref
+
+
+@pytest.mark.slow
+def test_horizon_matches_generate_moe(served):
+    """Horizon decode through dropless MoE routing: fused steps route
+    per token exactly like the per-step engine / generate()."""
+    _, _, prompts = served
+    model = _tiny(n_experts=2, moe_top_k=2, moe_capacity_factor=2.0)
+    params = init_params(model, 2)
+    ref = [_ref_tail(model, params, p, 6) for p in prompts[:3]]
+    engine = ServingEngine(model, params, max_slots=2, s_max=32,
+                           min_bucket=8, decode_horizon=4)
+    assert _serve_tokens(engine, prompts[:3], 6) == ref
+
+
+@pytest.mark.slow
+def test_tp_horizon_matches_single_shard(served):
+    """TP serving with fused horizons: the scan carries the head-
+    sharded caches through H steps without respecializing, same tokens
+    as single-shard."""
+    from pytorch_multiprocessing_distributed_tpu.inference import (
+        shard_params_for_tp_decode)
+    from pytorch_multiprocessing_distributed_tpu.parallel import make_mesh
+
+    model, params, prompts = served
+    mesh = make_mesh(4, 2)
+    tp_params = shard_params_for_tp_decode(params, mesh)
+    ref = [_ref_tail(model, params, p, 6) for p in prompts[:3]]
+    engine = ServingEngine(model, tp_params, max_slots=2, s_max=32,
+                           mesh=mesh, min_bucket=8, decode_horizon=4)
+    assert _serve_tokens(engine, prompts[:3], 6) == ref
+    programs = set(engine.decode_programs)
+    # join/leave churn on a mesh must not respecialize any program
+    engine.serve([(p, 6) for p in prompts[:3]])
+    assert set(engine.decode_programs) == programs
+
+
+def test_pick_horizon_unit():
+    """The pure scheduling policy: ladder snapping and each clamp."""
+    # H=1 engine / admission pressure always collapse to 1
+    assert pick_horizon(1, 32, 5, 100, False) == 1
+    assert pick_horizon(8, 32, 5, 100, True) == 1
+    # full headroom: the fused rung
+    assert pick_horizon(8, 32, 5, 100, False) == 8
+    # bucket boundary closer than H -> snap DOWN to 1, not a mid value
+    assert pick_horizon(8, 32, 27, 100, False) == 1
+    assert pick_horizon(8, 32, 24, 100, False) == 8  # exactly fits
+    # shortest remaining budget below H -> 1 (don't outlive everyone)
+    assert pick_horizon(8, 256, 5, 3, False) == 1
+    assert pick_horizon(8, 256, 5, 8, False) == 8
+
+
+def test_engine_validates_horizon(served):
+    model, params, _ = served
+    with pytest.raises(ValueError, match="decode_horizon"):
+        ServingEngine(model, params, max_slots=1, decode_horizon=0)

@@ -144,3 +144,34 @@ def test_serving_engine_step_sentinels():
             finished = engine.serve([(p, 4) for p in prompts])
     assert engine.decode_step_compiles == touched
     assert all(len(r.tokens) == 4 for r in finished)
+
+
+def test_horizon_steady_state_sentinels():
+    """Horizon engine (decode_horizon=4): steady state makes at most
+    ONE host sync per H emitted tokens and ONE dispatch per horizon —
+    the per-horizon token-block readback is the only (expected_transfer
+    -marked) sync on the path — and a re-serve of the same shape under
+    the guard compiles NOTHING new and transfers nothing unexpected."""
+    model = _tiny_gpt()
+    params = init_params(model, 3)
+    prompt = np.random.default_rng(3).integers(0, model.vocab_size, (5,))
+    engine = ServingEngine(model, params, max_slots=1, s_max=32,
+                           min_bucket=8, decode_buckets=(),
+                           decode_horizon=4)
+    engine.serve([(prompt, 13)])  # warm the single (window, H) program
+    before = engine.metrics.snapshot()
+
+    with guard_transfers():
+        with recompile_budget(engine._decode, 0,
+                              label="horizon steady state"):
+            (request,) = engine.serve([(prompt, 13)])
+    snap = engine.metrics.snapshot()
+    assert len(request.tokens) == 13
+    dispatches = snap["decode_dispatches"] - before["decode_dispatches"]
+    syncs = snap["decode_host_syncs"] - before["decode_host_syncs"]
+    # 12 decode tokens at H=4: exactly 3 fused dispatches, each drained
+    # by exactly one host sync (<= 1 sync per 4 emitted tokens)
+    assert dispatches == 3
+    assert syncs == 3
+    assert syncs * 4 <= 13
+    assert engine.decode_programs == ((32, 4),)

@@ -32,13 +32,18 @@ def test_cli_serves_jsonl_requests(tmp_path):
         + json.dumps({"text": "hello", "max_new_tokens": 5}) + "\n")
     metrics_path = tmp_path / "metrics.json"
     out = _serve(tmp_path, "--random_init", "--requests", str(reqs),
-                 "--max_slots", "2", "--metrics_out", str(metrics_path))
+                 "--max_slots", "2", "--decode_horizon", "4",
+                 "--metrics_out", str(metrics_path))
     assert "done(length)" in out
     assert "metrics:" in out
     snap = json.loads(metrics_path.read_text())
     assert snap["requests_completed"] == 2
     assert snap["tokens_generated"] == 8
+    # short budgets (< H) keep every dispatch on the H=1 rung: still
+    # exactly one compiled decode program
     assert snap["decode_step_compiles"] == 1
+    assert snap["decode_horizon"] == 4
+    assert snap["decode_host_syncs"] == snap["decode_dispatches"]
     assert snap["rejected"] == 0
 
 
