@@ -3,13 +3,27 @@
 
 PYTEST_ENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 
-.PHONY: test test-fast lint dryrun bench bench-cpu store clean
+.PHONY: test test-fast lint check check-update dryrun bench bench-cpu \
+        store clean
 
 # graftlint: AST-only jit-hygiene gate (no jax import, milliseconds).
 # Exit 1 on any non-baselined finding; the tier-1 suite and
 # benchmarks/on_grant.sh enforce the same gate.
 lint:
 	python -m pytorch_multiprocessing_distributed_tpu.analysis.lint
+
+# graftcheck: jaxpr-level program auditor — collective budgets,
+# donation/resharding/dtype audits, golden fingerprints for the
+# canonical programs (traces/compiles on the 8-device CPU mesh; never
+# executes). Exit 1 on any budget/fingerprint drift; enforced in
+# tier-1 (tests/test_graftcheck.py) and on_grant.sh step 0.
+check:
+	$(PYTEST_ENV) python -m pytorch_multiprocessing_distributed_tpu.analysis.check
+
+# refresh analysis/fingerprints.json after a DELIBERATE program change
+# (review the JSON diff in the PR; inline invariants still enforce)
+check-update:
+	$(PYTEST_ENV) python -m pytorch_multiprocessing_distributed_tpu.analysis.check --update
 
 # full suite on the virtual 8-device CPU mesh (incl. slow e2e CLI runs)
 test:

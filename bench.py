@@ -165,25 +165,29 @@ def probe_backend(timeout: float):
 
 
 def init_devices(retries: int = 3, delay: float = 5.0,
-                 probe_timeout: Optional[float] = None):
+                 probe_timeout: Optional[float] = None,
+                 probe_attempts: Optional[int] = None):
     """Bring up the backend, surviving transient TPU-plugin failures AND
     hangs (the round-1 bench died here with rc=1 and no JSON; round 3
-    lost its TPU evidence to a single in-process hang).
+    lost its TPU evidence to a single in-process hang; round 5 burned
+    3 x 180 s + 2 x 60 s backoff on a tunnel whose every probe hung).
 
     Protocol:
 
     1. Probe bring-up in a subprocess (``probe_backend``) over a
-       multi-attempt budget — default 3 probes x 180 s each, spaced
-       60 s apart (``--probe_timeout`` / env knobs:
-       ``PMDT_BENCH_PROBE_TIMEOUT``, ``PMDT_BENCH_PROBE_ATTEMPTS``,
-       ``PMDT_BENCH_PROBE_DELAY``). A transiently wedged tunnel gets
-       minutes to recover instead of one strike; a wedged probe dies
-       with its subprocess. BUT: hangs are not transient — a SECOND
-       hung probe in the same run means the tunnel is wedged for the
-       session, and the remaining budget would burn to the same
-       timeout (round 5 spent 3 x 180 s + 2 x 60 s backoff this way —
-       BENCH_r05.json ``backend_note``), so the loop fails over to CPU
-       at the second hang instead of finishing the schedule.
+       multi-attempt budget (``--probe_timeout``/``--probe_attempts``
+       / env knobs: ``PMDT_BENCH_PROBE_TIMEOUT``,
+       ``PMDT_BENCH_PROBE_ATTEMPTS``, ``PMDT_BENCH_PROBE_DELAY``; the
+       CLI defaults to TWO attempts — see below). A transiently wedged
+       tunnel gets minutes to recover instead of one strike; a wedged
+       probe dies with its subprocess. Hang policy (the r05 lesson,
+       BENCH_r05.json ``backend_note``): a hang is not a transient —
+       a hung probe already gave the tunnel its full timeout to
+       recover, so the 60 s backoff sleep is SKIPPED after one, and a
+       SECOND hung probe fails the run over to CPU immediately
+       regardless of remaining budget. Fast failures (probe rc != 0)
+       keep the backoff and the full attempt budget: those really are
+       transient.
     2. Only after a probe reports a healthy non-CPU platform does the
        PARENT initialize it — still under a watchdog thread with the
        re-exec escape hatch, in case the backend wedges between probe
@@ -200,12 +204,19 @@ def init_devices(retries: int = 3, delay: float = 5.0,
 
     timeout = float(probe_timeout
                     or os.environ.get("PMDT_BENCH_PROBE_TIMEOUT", 180))
-    attempts = int(os.environ.get("PMDT_BENCH_PROBE_ATTEMPTS", retries))
+    # `is not None`, not truthiness: an explicit 0 means "as few as
+    # possible" and floors to ONE probe below — NOT a fall-through to
+    # the 3-attempt legacy default (probing can't be skipped entirely:
+    # the platform decision needs one answer; --platform cpu skips)
+    attempts = int(probe_attempts if probe_attempts is not None
+                   else os.environ.get("PMDT_BENCH_PROBE_ATTEMPTS",
+                                       retries))
+    attempts = max(1, attempts)
     probe_delay = float(os.environ.get("PMDT_BENCH_PROBE_DELAY", 60))
     platform = None
     probe_note = None
     hung_before = False
-    for attempt in range(max(1, attempts)):
+    for attempt in range(attempts):
         platform, probe_note, hung = probe_backend(timeout)
         if platform is not None:
             _log(f"backend probe ok (attempt {attempt + 1}): {platform}")
@@ -219,8 +230,15 @@ def init_devices(retries: int = 3, delay: float = 5.0,
             break
         hung_before = hung_before or hung
         if attempt + 1 < attempts:
-            _log(f"retrying probe in {probe_delay:.0f}s")
-            time.sleep(probe_delay)
+            if hung:
+                # the probe just sat on the tunnel for the whole
+                # timeout — that WAS the recovery window; sleeping
+                # another 60 s on top re-creates the r05 burn
+                _log("retrying immediately (hung probe already spent "
+                     f"{timeout:.0f}s of recovery time)")
+            else:
+                _log(f"retrying probe in {probe_delay:.0f}s")
+                time.sleep(probe_delay)
     if platform is None:
         note = (f"TPU backend unavailable after {attempts} subprocess "
                 f"probes x {timeout:.0f}s ({probe_note}); CPU fallback")
@@ -300,21 +318,33 @@ def compile_step(step, *args):
     compiles don't populate jit's cache, so lowering for cost analysis
     and then calling the jitted wrapper would compile the same program
     twice — a multi-ten-second tax on the exact harness whose round-1
-    failure was a startup timeout). FLOPs come from XLA's own cost model.
+    failure was a startup timeout). Lowering + cost analysis go through
+    the shared ``utils.compile_cache.lowered_cost_analysis`` path (the
+    same one the graftcheck auditor inspects, so the benched program
+    and the audited program cannot drift).
     """
+    from pytorch_multiprocessing_distributed_tpu.utils.compile_cache import (
+        lowered_cost_analysis)
+
     try:
-        compiled = step.lower(*args).compile()
+        compiled, cost = lowered_cost_analysis(step, *args)
     except Exception as e:
         _log(f"AOT compile unavailable ({e}); falling back to jit")
         return step, None
     flops = None
-    try:
-        analyses = compiled.cost_analysis()
-        ca = analyses[0] if isinstance(analyses, (list, tuple)) else analyses
-        f = ca.get("flops", 0.0)
+    if cost is None:
+        # compat.cost_analysis_dict swallowed the reason; re-probe the
+        # raw call (failure path only) so a one-shot grant capture's
+        # log still says WHY the MFU column is empty
+        try:
+            compiled.cost_analysis()
+            _log("cost_analysis unavailable (backend returned no "
+                 "usable cost model)")
+        except Exception as e:  # noqa: BLE001
+            _log(f"cost_analysis unavailable: {e}")
+    else:
+        f = cost.get("flops", 0.0)
         flops = float(f) if f and f > 0 else None
-    except Exception as e:
-        _log(f"cost_analysis unavailable: {e}")
     return compiled, flops
 
 
@@ -573,7 +603,7 @@ def run_bench(config: str, dtype_name: str, batch_size: int,
     return result
 
 
-def main():
+def build_parser():
     p = argparse.ArgumentParser()
     p.add_argument("--config", default="resnet50_imagenet",
                    choices=sorted(CONFIGS),
@@ -592,6 +622,16 @@ def main():
                         "(0 = $PMDT_BENCH_PROBE_TIMEOUT or 180); a "
                         "second HUNG probe fails over to CPU "
                         "immediately regardless of remaining attempts")
+    p.add_argument("--probe_attempts",
+                   default=int(os.environ.get(
+                       "PMDT_BENCH_PROBE_ATTEMPTS", 2)),
+                   type=int,
+                   help="backend-probe attempts before CPU fallback, "
+                        "floored at 1 "
+                        "(default $PMDT_BENCH_PROBE_ATTEMPTS or 2 — "
+                        "r05 showed a wedged tunnel hangs EVERY probe, "
+                        "so a long schedule only burns the window; "
+                        "hung probes also skip the 60s backoff)")
     p.add_argument("--remat", action="store_true",
                    help="rematerialize activations (jax.checkpoint) — "
                         "trades ~1.3x step time for the activation HBM")
@@ -599,7 +639,11 @@ def main():
                    help="LM configs: stream the head+CE over N vocab "
                         "slices (logits never materialize); 0 = dense. "
                         "Non-canonical probe knob like --remat")
-    args = p.parse_args()
+    return p
+
+
+def main():
+    args = build_parser().parse_args()
 
     result = None
     try:
@@ -612,7 +656,8 @@ def main():
                     if os.environ.get("PMDT_BENCH_REEXEC") else None)
         else:
             devices, note = init_devices(
-                probe_timeout=args.probe_timeout or None)
+                probe_timeout=args.probe_timeout or None,
+                probe_attempts=args.probe_attempts)
         _log(f"devices: {len(devices)} x "
              f"{getattr(devices[0], 'device_kind', devices[0].platform)}")
         # post-probe: the cache is for (slow, tunnel-bound) TPU

@@ -22,6 +22,19 @@ if ! timeout -k 10 120 python -m pytorch_multiprocessing_distributed_tpu.analysi
   exit 1
 fi
 
+note "0b. graftcheck gate (jaxpr-level program audit — CPU trace, ~1 min)"
+# A red program audit means a hot program's communication/donation/
+# dtype contract drifted from its committed budget: a perf number
+# captured on the drifted program proves nothing about the committed
+# one. Runs on the HOST platform — never touches the TPU plugin.
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python -m pytorch_multiprocessing_distributed_tpu.analysis.check; then
+  echo "graftcheck gate RED — inspect the named program/rule, fix (or" >&2
+  echo "re-baseline deliberately with 'make check-update')" >&2
+  exit 1
+fi
+
 note "1. baselines still missing/legacy (need-first order)"
 $T python benchmarks/record_baselines.py --missing
 

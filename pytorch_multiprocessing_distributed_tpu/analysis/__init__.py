@@ -1,4 +1,5 @@
-"""graftlint — JAX/TPU jit-hygiene static analysis for this codebase.
+"""Static analysis for this codebase: graftlint (source level) +
+graftcheck (program level).
 
 The paper's core obligation is that every hot path stays inside XLA:
 no stray host sync, Python side effect, or silent recompile in the
@@ -15,10 +16,20 @@ PR*:
 - :mod:`.sentinels` — the runtime complement: ``jax.transfer_guard``
   context managers and recompile-budget assertions built on
   ``utils.compile_cache``, pinned in tests on the three hottest paths
-  (train step, ``generate()`` decode, serving engine step).
+  (train step, ``generate()`` decode, serving engine step);
+- :mod:`.ir` / :mod:`.programs` / :mod:`.check` — **graftcheck**, the
+  jaxpr-level auditor (``make check``): traces the registered
+  canonical programs abstractly (DP/TP/FSDP train steps, ``generate``
+  prefill+decode, the serving decode ladder, the MoE layer) and
+  enforces collective budgets per mesh axis, donation aliasing,
+  resharding/replication caps, dtype-promotion counts, and golden
+  program fingerprints committed in ``analysis/fingerprints.json``.
+  These modules DO import jax (they interrogate the tracer) — the
+  lint CLI stays jax-free; import them directly, never from here.
 
-Rule IDs are stable (``GL1xx``) — suppression comments and the
-baseline file refer to them.
+Rule IDs are stable (graftlint ``GL1xx``, graftcheck ``GC1xx``) —
+suppression comments, the baseline file and the fingerprint snapshot
+refer to them.
 """
 
 from .rules import RULES, Finding, analyze_files  # noqa: F401

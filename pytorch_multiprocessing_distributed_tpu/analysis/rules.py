@@ -70,6 +70,12 @@ RULES: Dict[str, str] = {
     "GL109": "PartitionSpec axis name not declared by any mesh in the "
              "linted files (typo'd axis names fail far from here, at "
              "sharding time)",
+    "GL110": "device scalar built from a Python value inside a "
+             "lax.scan/cond/while body (jnp.int32(i), jnp.asarray(c) — "
+             "the body retraces per host call and each constant is an "
+             "implicit H2D the transfer sentinel only catches at "
+             "runtime; stage it outside, or thread it through the "
+             "carry)",
 }
 
 # wrappers that COMPILE (jit family) — GL105/106/107/108 anchor on these
@@ -122,6 +128,9 @@ class _Func:
     calls: Set[str] = field(default_factory=set)
     nested: Dict[str, "_Func"] = field(default_factory=dict)
     jit_scoped: bool = False
+    # body of a control-flow primitive (lax.scan/cond/while/fori/
+    # switch/map) — traced from ANY caller, jitted or not (GL110)
+    ctrl_body: bool = False
     # direct jit root: (statics, donate_seen, site_line) — only set when
     # the function NAME is wrapped/decorated by jax.jit itself, so its
     # static_argnames/argnums are knowable (GL106/107/108 need this)
@@ -444,8 +453,10 @@ def _scan_roots(files: Sequence[_File], index) -> List[_Func]:
                 continue
             scope = file.owner.get(id(node))
             func_args = node.args
-            if d and d.endswith(("scan", "while_loop", "fori_loop",
-                                 "cond", "switch", "map")):
+            is_ctrl = bool(d) and d.endswith(
+                ("scan", "while_loop", "fori_loop", "cond", "switch",
+                 "map"))
+            if is_ctrl:
                 candidates = func_args  # body position varies — take all
             else:
                 candidates = func_args[:1]
@@ -458,6 +469,8 @@ def _scan_roots(files: Sequence[_File], index) -> List[_Func]:
                                    _donate_seen(node.keywords),
                                    node.lineno)
                     target.jit_scoped = True
+                    if is_ctrl:
+                        target.ctrl_body = True
                     seeds.append(target)
     return seeds
 
@@ -772,6 +785,90 @@ def _check_missing_donate(fn: _Func, out: List[Finding]):
             "resident, doubling state HBM (donate_argnums=(0,))"))
 
 
+_JNP_SCALAR_CTORS = {
+    "asarray", "array", "int8", "int16", "int32", "int64", "uint8",
+    "uint16", "uint32", "uint64", "float16", "bfloat16", "float32",
+    "float64",
+}
+
+
+def _module_numeric_const(file: _File, name: str) -> bool:
+    """True when ``name`` is assigned a numeric literal at MODULE
+    level (``EPS = 1e-6``) — the module-scope half of GL110's
+    'Python scalar captured from a host scope'."""
+    for node in ast.iter_child_nodes(file.tree):
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == name
+                for t in node.targets):
+            return (isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, (int, float, bool)))
+    return False
+
+
+def _check_ctrl_body_scalars(fn: _Func, out: List[Finding]):
+    """GL110 — only in control-flow bodies (``lax.scan``/``cond``/
+    ``while``/``fori``/``switch``), which jax re-traces on EVERY host
+    call when the wrapper runs outside jit: a ``jnp.int32(chunk)`` /
+    ``jnp.asarray(0.5)`` built from a Python value there materializes
+    a fresh device constant per call — the implicit H2D class the
+    runtime sentinel (``guard_transfers``) catches only when traffic
+    actually hits it. Flags numeric literals and names captured from
+    HOST scopes; operands that are body parameters/locals, captured
+    from an enclosing TRACED function (tracers), or shape-derived are
+    exempt — and so is the WHOLE body when any lexical ancestor is
+    itself jit-traced (the wrapper then runs under jit: the body
+    traces once per compile and its constants bake into the
+    executable — no per-call H2D)."""
+    if not fn.ctrl_body:
+        return
+    ancestor = fn.parent
+    while ancestor is not None:
+        if ancestor.jit_scoped:
+            return
+        ancestor = ancestor.parent
+    file = fn.file
+    locals_ = _local_names(fn)
+    for node in _iter_own(fn.node):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        d = _dotted(node.func, file)
+        if (not d or not d.startswith("jax.numpy.")
+                or d.split(".")[-1] not in _JNP_SCALAR_CTORS):
+            continue
+        arg = node.args[0]
+        flagged = False
+        if isinstance(arg, ast.Constant) and isinstance(
+                arg.value, (int, float, bool)):
+            flagged = True
+        elif (isinstance(arg, ast.Name) and arg.id not in locals_
+                and not _is_shape_static(arg)):
+            parent = fn.parent
+            while parent is not None:
+                if (arg.id in _local_names(parent)
+                        or arg.id in parent.nested):
+                    # bound by an enclosing fn: a tracer when that fn
+                    # is itself traced, a Python scalar when it is a
+                    # host factory/driver
+                    flagged = not parent.jit_scoped
+                    break
+                parent = parent.parent
+            else:
+                # no enclosing fn binds it: a module-level NUMERIC
+                # constant (EPS = 1e-6) is a host scalar too — same
+                # fresh-device-constant-per-trace hazard; anything
+                # else at module scope (arrays, config objects) is
+                # not knowably a Python scalar, so it stays exempt
+                flagged = _module_numeric_const(file, arg.id)
+        if flagged:
+            out.append(Finding(
+                file.path, node.lineno, node.col_offset, "GL110",
+                f"`{ast.unparse(node) if hasattr(ast, 'unparse') else d}"
+                f"` builds a device scalar from a Python value inside "
+                f"control-flow body `{fn.qual}` — re-traced per host "
+                "call, an implicit H2D each time (stage it outside the "
+                "body or thread it through the carry)"))
+
+
 def _check_jit_in_loop(file: _File, out: List[Finding]):
     """GL105: jax.jit(...) lexically inside a for/while body."""
     loops: List[ast.AST] = [n for n in ast.walk(file.tree)
@@ -906,5 +1003,6 @@ def analyze_files(paths: Sequence[str],
                 _check_traced_branches(fn, findings)
                 _check_static_defaults(fn, findings)
                 _check_missing_donate(fn, findings)
+                _check_ctrl_body_scalars(fn, findings)
     findings.sort(key=lambda x: (x.path, x.line, x.rule))
     return findings

@@ -756,3 +756,83 @@ def beam_search(
     prompt_k = jnp.broadcast_to(
         prompt[:, None, :], (b, k_beams, t))
     return jnp.concatenate([prompt_k, history], axis=2), scores
+
+
+# ----------------------------------------------------------- graftcheck
+
+def audit_programs():
+    """graftcheck registration hook: the canonical inference programs.
+
+    - ``generate_dense``: prefill + fused decode scan on the bf16 tiny
+      GPT — zero collectives (single shard), and the committed dtype
+      budget pins exactly which bf16->f32 upcasts feed matmuls (the
+      deliberate f32 logit/attention-probability islands); a new
+      upcast on an activation-sized tensor moves the count and fails
+      the gate.
+    - ``generate_tp``: the same program under a ``model``-axis mesh,
+      COMPILED (CPU, partitioned) so GSPMD's inserted collectives are
+      countable: the committed HLO budget is the Megatron contract —
+      all-reduces for the row-parallel matmuls, no weight-sized
+      all-gather (``max_allgather_bytes`` caps implicit
+      replication; cf. arXiv:2112.01075 on redistribution cost).
+    """
+    def tiny_model():
+        # ONE audit geometry across the LM-family hooks
+        from ..analysis.programs import audit_tiny_gpt
+
+        return audit_tiny_gpt()
+
+    def pieces():
+        model = tiny_model()
+        params = jax.eval_shape(
+            lambda: model.init(jax.random.PRNGKey(0),
+                               jnp.zeros((1, 8), jnp.int32),
+                               train=False))["params"]
+        prompt = jax.ShapeDtypeStruct((2, 8), jnp.int32)
+        return model, params, prompt
+
+    def build_dense():
+        model, params, prompt = pieces()
+
+        def fn(p, t):
+            return generate(model, p, t, max_new_tokens=8)
+
+        return {"fn": fn, "args": (params, prompt),
+                "expect_collectives": {}}
+
+    def build_tp():
+        from ..parallel.mesh import audit_mesh
+
+        model, params, prompt = pieces()
+        mesh = audit_mesh(data=1, model=2)
+
+        def fn(p, t):
+            return generate(model, p, t, max_new_tokens=8, mesh=mesh)
+
+        return {
+            "fn": fn, "args": (params, prompt), "mesh": mesh,
+            "compile": True, "compile_fn": jax.jit(fn),
+            "require_hlo": ("all-reduce",),
+            # the Megatron contract, pinned: one fused row-parallel
+            # all-reduce per layer per phase (prefill pass + decode
+            # scan body) on this jax's partitioner; a third per-layer
+            # reduction means someone broke the column-then-row
+            # sharding pattern. Derived from the SHARED audit model so
+            # an audit_tiny_gpt geometry change tracks automatically.
+            "expect_hlo_counts": {"all-reduce": model.num_layers * 2},
+            # implicit replication cap: the largest legitimate gather
+            # in TP decode is activation-sized; a weight- or
+            # cache-sized one means a dropped sharding. The [D, V]
+            # head kernel is the biggest weight — cap STRICTLY below
+            # it (-1: the check is `worst > cap`, and gathering
+            # exactly the whole head weight IS the dropped-sharding
+            # case).
+            "max_allgather_bytes":
+                model.hidden_size * model.vocab_size * 4 - 1,
+        }
+
+    return [
+        {"name": "generate_dense", "min_devices": 1,
+         "build": build_dense},
+        {"name": "generate_tp", "min_devices": 2, "build": build_tp},
+    ]

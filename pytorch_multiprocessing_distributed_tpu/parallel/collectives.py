@@ -122,3 +122,34 @@ def reduce_tensor(tensor, mesh: Mesh, axis_name: str = DATA_AXIS):
     metrics in-step via ``psum``, which is cheaper).
     """
     return all_reduce(tensor, mesh, axis_name, op="mean")
+
+
+# ------------------------------------------------------------- graftcheck
+
+def audit_programs():
+    """graftcheck registration hook (``analysis/programs.py``): the
+    host-level ``all_reduce`` program — the simplest budget in the
+    registry, pinned inline to exactly one payload-sized ``psum``. If
+    this ever reads 2, someone double-reduced the moral equivalent of
+    ``dist.all_reduce``."""
+    def build():
+        import jax.numpy as jnp
+
+        from .mesh import audit_mesh
+
+        mesh = audit_mesh(data=4, model=2)
+        stacked = jax.ShapeDtypeStruct((4, 16), jnp.float32)
+
+        def fn(x):
+            return _all_reduce_program(x, mesh, DATA_AXIS, "sum")
+
+        return {
+            "fn": fn,
+            "args": (stacked,),
+            # one psum of the per-member [16] f32 payload = 64 bytes
+            "expect_collectives": {
+                "psum@data": {"count": 1, "bytes": 64}},
+        }
+
+    return [{"name": "collectives_all_reduce", "min_devices": 8,
+             "build": build}]

@@ -62,3 +62,25 @@ def make_mesh(
 def data_axis_size(mesh: Mesh) -> int:
     """The DP degree — the reference's ``world_size``."""
     return mesh.shape[DATA_AXIS]
+
+
+def audit_mesh(data: int = 1, model: int = 1) -> Mesh:
+    """The mesh graftcheck's canonical programs are audited on.
+
+    One place so every registered program (``analysis/programs.py``
+    hooks) agrees on geometry — committed collective budgets are
+    per-shard byte counts and must not drift with ad-hoc mesh choices.
+    Built over host devices (the audits trace/lower/compile, never
+    execute); raises with the fix spelled out when the process exposes
+    too few devices (``make check`` sets the flag).
+    """
+    need = data * model
+    devices = jax.devices()
+    if len(devices) < need:
+        raise RuntimeError(
+            f"graftcheck mesh needs {need} devices (data={data} x "
+            f"model={model}) but this process exposes {len(devices)} — "
+            "run under XLA_FLAGS=--xla_force_host_platform_device_count"
+            "=8 (the `make check` environment)"
+        )
+    return make_mesh(data, model, devices=devices[:need])

@@ -831,3 +831,59 @@ class ServingEngine:
             pass
         assert all(r.state == DONE for r in submitted)
         return submitted
+
+
+# --------------------------------------------------------------- graftcheck
+
+def audit_programs():
+    """graftcheck registration hook: the serving decode ladder.
+
+    The engine's whole compile-budget story is that decode programs
+    form a SMALL CLOSED SET — ``buckets x {1, H}`` — regardless of
+    traffic (``decode_programs`` pins the runtime side). This hook
+    enumerates that exact ladder abstractly (the same jitted
+    ``_decode`` the dispatcher calls, traced per static ``(window,
+    horizon)`` with the pool's own shapes), so every program traffic
+    can ever run has a committed fingerprint: a semantic change to the
+    hot decode scan — an extra cache copy, a dropped freeze gate, a
+    new f32 upcast — fails tier-1 with the program named, before any
+    TPU time is burned on it."""
+    def specs():
+        # ONE audit geometry across the LM-family hooks
+        from ..analysis.programs import audit_tiny_gpt
+
+        model = audit_tiny_gpt()
+        params = jax.eval_shape(
+            lambda: model.init(jax.random.PRNGKey(0),
+                               jnp.zeros((1, 1), jnp.int32),
+                               train=False))["params"]
+        engine = ServingEngine(model, params, max_slots=4, s_max=32,
+                               min_bucket=8, decode_horizon=4)
+        pool = engine.pool
+
+        def sds(x):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype)
+
+        args = (params, sds(pool.k_caches), sds(pool.v_caches),
+                sds(pool.positions), sds(pool.last_tokens),
+                sds(pool.active), sds(pool.budgets), sds(pool.eos_ids),
+                jax.ShapeDtypeStruct((2,), jnp.uint32))
+
+        out = []
+        for window in engine.decode_buckets:
+            for horizon in sorted({1, engine.decode_horizon}):
+                def build(w=window, h=horizon):
+                    return {
+                        "fn": engine._decode, "args": args,
+                        "kwargs": {"window": w, "horizon": h},
+                        # single-shard decode moves zero collective
+                        # bytes — that IS the serving cost model
+                        "expect_collectives": {},
+                    }
+                out.append({
+                    "name": f"serving_decode_w{window}_h{horizon}",
+                    "min_devices": 1, "build": build,
+                })
+        return out
+
+    return specs()

@@ -96,6 +96,26 @@ def pcast(x, axis_name, *, to="varying"):
     return x
 
 
+def cost_analysis_dict(compiled):
+    """``compiled.cost_analysis()`` normalized to ONE plain dict across
+    jax generations: 0.4.x returns a per-device list of dicts (take
+    the first — SPMD programs are identical per device), newer jaxes
+    return the dict directly. None when the backend/executable exposes
+    no cost model (never raises — callers treat cost as optional)."""
+    try:
+        analyses = compiled.cost_analysis()
+    except Exception:  # noqa: BLE001 — diagnostic-only surface
+        return None
+    if isinstance(analyses, (list, tuple)):
+        analyses = analyses[0] if analyses else None
+    if not analyses:
+        return None
+    try:
+        return dict(analyses)
+    except Exception:  # noqa: BLE001
+        return None
+
+
 def get_abstract_mesh():
     """The mesh of the active :func:`set_mesh`/``with mesh:`` context,
     or None when there is none (callers use it to decide whether a

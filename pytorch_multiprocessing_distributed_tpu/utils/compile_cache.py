@@ -112,6 +112,26 @@ def jit_cache_keys(fn) -> Tuple:
     return tuple(_key_slot(fn)[1])
 
 
+def lowered_cost_analysis(fn, *args, **kwargs):
+    """AOT-lower and compile a jitted ``fn`` once; returns
+    ``(compiled, cost)`` where ``cost`` is XLA's own per-program cost
+    dict (``flops`` etc., normalized across 0.4.x's list-shaped return
+    by ``utils.compat.cost_analysis_dict``) or None when unavailable.
+
+    The ONE lowering path shared by the benchmark harness
+    (``bench.compile_step`` drives its MFU math off the ``flops``
+    entry) and the graftcheck auditor (``analysis/programs.py`` reads
+    the compiled module's HLO text for GSPMD-inserted collectives) —
+    so the program the auditor inspects can never drift from the one
+    the bench times. Compiles but never executes; raises whatever
+    ``lower``/``compile`` raise (callers own the fallback policy).
+    """
+    from .compat import cost_analysis_dict
+
+    compiled = fn.lower(*args, **kwargs).compile()
+    return compiled, cost_analysis_dict(compiled)
+
+
 def enable_compilation_cache(
     path: Optional[str] = None, platform_hint: Optional[str] = None,
 ) -> Optional[str]:

@@ -1,0 +1,32 @@
+"""GL110 near-miss: the same constructors where they are fine —
+traced operands, shape-derived scalars, values captured from an
+enclosing TRACED scope (tracers, not Python scalars), and host-side
+staging outside any control-flow body (the `expected_transfer`
+territory the sentinels annotate)."""
+import jax
+import jax.numpy as jnp
+
+TABLE = jnp.arange(4.0)  # module-level DEVICE array, staged once
+
+
+def drive(xs):
+    def body(carry, x):
+        y = jnp.asarray(x)                  # traced operand — fine
+        n = jnp.int32(x.shape[0])           # shape-static — fine
+        t = jnp.asarray(TABLE)              # already on device — fine
+        return carry + jnp.sum(y) + n + t[0], y
+
+    out, ys = jax.lax.scan(body, jnp.zeros(()), xs)
+    start = jnp.int32(3)  # host scope, not a ctrl body — fine
+    return out + start, ys
+
+
+@jax.jit
+def step(v):
+    scale = v * 2  # a TRACER in the enclosing jitted scope
+
+    def body(c, x):
+        eps = jnp.asarray(1e-6)  # under jit: baked once per compile
+        return c + jnp.asarray(scale) * x + eps, c  # tracer — fine
+
+    return jax.lax.scan(body, jnp.zeros(()), v)

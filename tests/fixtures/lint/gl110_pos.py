@@ -1,0 +1,29 @@
+"""GL110 positive: device scalars built from Python values inside
+control-flow bodies. The host calls `lax.scan`/`lax.cond` outside any
+jit, so each call RE-TRACES the body — and every `jnp.<ctor>(python
+value)` inside it stages a fresh device constant: an implicit H2D per
+call that only the runtime transfer sentinel would otherwise see."""
+import jax
+import jax.numpy as jnp
+
+EPS = 1e-6  # module-level Python scalar — still a host value
+
+
+def drive(xs, flag):
+    chunk = 4  # host config captured by the traced body
+
+    def body(carry, x):
+        start = jnp.int32(chunk)            # <- GL110
+        eps = jnp.asarray(1e-6)             # <- GL110
+        tol = jnp.float32(EPS)              # <- GL110
+        return carry + x * (eps + tol) + start, carry
+
+    out, ys = jax.lax.scan(body, jnp.zeros(()), xs)
+
+    def true_fn(v):
+        return v + jnp.array(1)             # <- GL110
+
+    def false_fn(v):
+        return v
+
+    return jax.lax.cond(flag, true_fn, false_fn, out), ys

@@ -1,9 +1,13 @@
 """bench.py backend-probe retry policy.
 
 Round-5 burned its whole probe budget (3 x 180 s + 2 x 60 s backoff)
-on a wedged tunnel whose every probe HUNG to the timeout — a hang is
-not a transient failure, so the second one must fail the run over to
-CPU immediately. Fast failures (probe rc != 0) keep the full retry
+on a wedged tunnel whose every probe HUNG to the timeout — and the
+driver's plain ``python bench.py`` still showed the 3 x 180 s pattern
+afterward, because the attempt budget defaulted to 3 and every hang
+also paid the 60 s backoff. The policy now: the CLI defaults to TWO
+probe attempts, a hang skips the backoff (its timeout WAS the
+recovery window), and a second hang fails over to CPU immediately.
+Fast failures (probe rc != 0) keep the backoff and the full retry
 budget: those really are transient. All probes are monkeypatched —
 no subprocess, no TPU plugin, no sleeping."""
 
@@ -28,7 +32,9 @@ def test_second_hang_fails_over_immediately(monkeypatch):
     devices, note = bench.init_devices(probe_timeout=7)
     assert len(calls) == 2, "second hang must abort the retry schedule"
     assert calls == [7, 7]  # --probe_timeout reaches every attempt
-    assert len(sleeps) == 1  # only the backoff BETWEEN probes 1 and 2
+    # a hung probe already spent its whole timeout on the tunnel: no
+    # backoff sleep on top (the r05 burn was 3x180s PLUS 2x60s)
+    assert sleeps == []
     assert devices[0].platform == "cpu"
     assert "CPU fallback" in note and "second hung probe" in note
 
@@ -41,16 +47,17 @@ def test_fast_failures_keep_the_full_budget(monkeypatch):
         return None, "probe rc=1: imploded", False
 
     monkeypatch.setattr(bench, "probe_backend", probe)
-    _no_sleep(monkeypatch)
+    sleeps = _no_sleep(monkeypatch)
     devices, note = bench.init_devices()
     assert len(calls) == 3  # transient errors retry to the cap
+    assert len(sleeps) == 2  # and each retry keeps its backoff
     assert devices[0].platform == "cpu"
     assert "CPU fallback" in note
 
 
 def test_hang_then_error_then_recovery(monkeypatch):
-    """One hang does not trip the early failover, and a later healthy
-    probe still wins the run."""
+    """One hang does not trip the early failover (and pays no
+    backoff), and a later healthy probe still wins the run."""
     outcomes = [
         (None, "probe hung past 7s", True),
         (None, "probe rc=1: transient", False),
@@ -63,8 +70,58 @@ def test_hang_then_error_then_recovery(monkeypatch):
         return outcomes[len(calls) - 1]
 
     monkeypatch.setattr(bench, "probe_backend", probe)
-    _no_sleep(monkeypatch)
+    sleeps = _no_sleep(monkeypatch)
     devices, note = bench.init_devices(probe_timeout=7)
     assert len(calls) == 3
+    assert len(sleeps) == 1  # only the rc!=0 failure backs off
     assert devices[0].platform == "cpu"
     assert note is None  # healthy probe: no fallback note
+
+
+def test_cli_defaults_to_two_probe_attempts(monkeypatch):
+    """The r05 regression pin: the driver runs plain `python bench.py`,
+    so the DEFAULT budget must already be the short one — two probes,
+    not three (a wedged tunnel hangs every probe identically)."""
+    monkeypatch.delenv("PMDT_BENCH_PROBE_ATTEMPTS", raising=False)
+    # the default is baked at parser construction; rebuild post-delenv
+    args = bench.build_parser().parse_args([])
+    assert args.probe_attempts == 2
+
+    monkeypatch.setenv("PMDT_BENCH_PROBE_ATTEMPTS", "5")
+    args = bench.build_parser().parse_args([])
+    assert args.probe_attempts == 5  # env still steers the default
+    args = bench.build_parser().parse_args(["--probe_attempts", "1"])
+    assert args.probe_attempts == 1  # explicit flag beats env
+
+
+def test_probe_attempts_reaches_init_devices(monkeypatch):
+    """Worst case at the CLI default: hang + hang = 2 x timeout, ZERO
+    backoff sleeps — 360 s instead of r05's 780 s schedule. Also pins
+    that the budget reaches the loop for fast failures (2 probes, one
+    backoff)."""
+    calls = []
+    hung_probe = [True]
+
+    def probe(timeout):
+        calls.append(timeout)
+        return None, "probe down", hung_probe[0]
+
+    monkeypatch.setattr(bench, "probe_backend", probe)
+    sleeps = _no_sleep(monkeypatch)
+    devices, note = bench.init_devices(probe_attempts=2)
+    assert len(calls) == 2
+    assert sleeps == []  # hangs never pay the backoff on top
+    assert devices[0].platform == "cpu"
+
+    calls.clear()
+    hung_probe[0] = False  # transient rc!=0 failures
+    devices, note = bench.init_devices(probe_attempts=2)
+    assert len(calls) == 2
+    assert len(sleeps) == 1  # fast failures keep their backoff
+    assert devices[0].platform == "cpu"
+
+    calls.clear()
+    devices, _ = bench.init_devices(probe_attempts=0)
+    assert len(calls) == 1, (
+        "an explicit 0 floors to ONE probe — it must not fall through "
+        "to the 3-attempt legacy default")
