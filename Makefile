@@ -3,8 +3,8 @@
 
 PYTEST_ENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 
-.PHONY: test test-fast lint check check-update dryrun bench bench-cpu \
-        store clean
+.PHONY: test test-fast lint check check-update chaos dryrun bench \
+        bench-cpu store clean
 
 # graftlint: AST-only jit-hygiene gate (no jax import, milliseconds).
 # Exit 1 on any non-baselined finding; the tier-1 suite and
@@ -24,6 +24,14 @@ check:
 # (review the JSON diff in the PR; inline invariants still enforce)
 check-update:
 	$(PYTEST_ENV) python -m pytorch_multiprocessing_distributed_tpu.analysis.check --update
+
+# graftfault: the deterministic fault matrix — every registered
+# injection site swept (recover or fail fast, unaffected requests
+# token-exact), plus checkpoint-corruption recovery and the SIGTERM
+# preemption path. Seeded FaultPlans: the same faults hit the same
+# operations on every run. Part of tier-1; this target runs it alone.
+chaos:
+	$(PYTEST_ENV) python -m pytest tests/test_graftfault.py tests/test_runtime_store.py -q
 
 # full suite on the virtual 8-device CPU mesh (incl. slow e2e CLI runs)
 test:

@@ -27,6 +27,16 @@ Three sweeps over the continuous-batching :class:`ServingEngine`:
    latency, not TPU compute, bounded the H=1 engine (on the CPU
    dispatch-bound config the speedup target is >= 2x at H=8).
 
+4. **Chaos sweep** (``--sweep chaos``): the same steady state served
+   fault-free and then under a BACKGROUND fault rate (a graftfault
+   ``every=K`` rule injecting a transient dispatch error every K-th
+   dispatch — every one recovered by bounded retry, with the
+   post-fault H=1 cooldown engaged). The point of record: the
+   throughput degradation budget — tok/s under faults vs fault-free,
+   with the injected/retry/collapse counts printed beside it, so the
+   cost of surviving a given fault rate is RECORDED, never silently
+   eaten.
+
 ``offered=inf`` is the closed-loop limit: every request submitted
 up front, measuring peak engine throughput. CPU-runnable (shapes clamp
 down off-TPU, same convention as ``generate_bench.py``), TPU-ready.
@@ -58,7 +68,8 @@ def _percentile(values, q):
 
 
 def run_point(model, params, prompts, new_tokens, slots, offered_rps,
-              s_max, warmup=False, **engine_kwargs):
+              s_max, warmup=False, arm_plan=None, **engine_kwargs):
+    from pytorch_multiprocessing_distributed_tpu.runtime import faults
     from pytorch_multiprocessing_distributed_tpu.serving import (
         ServingEngine)
     from pytorch_multiprocessing_distributed_tpu.utils.metrics import (
@@ -66,31 +77,44 @@ def run_point(model, params, prompts, new_tokens, slots, offered_rps,
 
     engine = ServingEngine(model, params, max_slots=slots, s_max=s_max,
                            **engine_kwargs)
-    if warmup:
-        # steady-state sweeps: pay every compile before the clock, then
-        # measure on fresh meters (the horizon sweep compiles up to 2x
-        # the programs of H=1 — charging compiles to the point would
-        # invert the comparison)
-        engine.serve([(p, new_tokens) for p in prompts])
-        engine.metrics = ServingMetrics()
-    # arrival schedule: evenly spaced at the offered rate (inf = all at
-    # t=0). Open loop — lateness accumulates if the engine can't keep up
-    arrivals = ([0.0] * len(prompts) if offered_rps == float("inf")
-                else [i / offered_rps for i in range(len(prompts))])
-    t_start = time.perf_counter()
-    pending = list(zip(prompts, arrivals))
-    finished = []
-    while pending or engine.in_flight:
-        now = time.perf_counter() - t_start
-        while pending and pending[0][1] <= now:
-            prompt, _ = pending.pop(0)
-            engine.submit(prompt, new_tokens)
-        if engine.in_flight:
-            for request, _, done in engine.step():
-                if done:
-                    finished.append(request)
-        elif pending:
-            time.sleep(min(0.005, pending[0][1] - now))
+    try:
+        if arm_plan is not None:
+            # chaos sweep: arm BEFORE the warm-up pass so the
+            # degraded-mode programs (collapsed-horizon windows) also
+            # compile before the clock; ``injected`` below counts the
+            # measured window only
+            faults.arm(arm_plan)
+        if warmup:
+            # steady-state sweeps: pay every compile before the clock,
+            # then measure on fresh meters (the horizon sweep compiles
+            # up to 2x the programs of H=1 — charging compiles to the
+            # point would invert the comparison)
+            engine.serve([(p, new_tokens) for p in prompts])
+            engine.metrics = ServingMetrics()
+        injected_base = (arm_plan.triggered() if arm_plan is not None
+                         else 0)
+        # arrival schedule: evenly spaced at the offered rate (inf =
+        # all at t=0). Open loop — lateness accumulates if the engine
+        # can't keep up
+        arrivals = ([0.0] * len(prompts) if offered_rps == float("inf")
+                    else [i / offered_rps for i in range(len(prompts))])
+        t_start = time.perf_counter()
+        pending = list(zip(prompts, arrivals))
+        finished = []
+        while pending or engine.in_flight:
+            now = time.perf_counter() - t_start
+            while pending and pending[0][1] <= now:
+                prompt, _ = pending.pop(0)
+                engine.submit(prompt, new_tokens)
+            if engine.in_flight:
+                for request, _, done in engine.step():
+                    if done:
+                        finished.append(request)
+            elif pending:
+                time.sleep(min(0.005, pending[0][1] - now))
+    finally:
+        if arm_plan is not None:
+            faults.disarm()
     wall = time.perf_counter() - t_start
     ttfts = [r.first_token_time - r.submit_time for r in finished]
     waits = [r.admit_time - r.submit_time for r in finished]
@@ -115,6 +139,11 @@ def run_point(model, params, prompts, new_tokens, slots, offered_rps,
         "decode_compiles": engine.decode_step_compiles,
         "decode_windows": list(engine.decode_windows),
         "decode_programs": [list(p) for p in engine.decode_programs],
+        "dispatch_retries": snap["dispatch_retries"],
+        "requests_failed": snap["requests_failed"],
+        "horizon_collapses": snap["horizon_collapses"],
+        "injected": (arm_plan.triggered() - injected_base
+                     if arm_plan is not None else 0),
     }
 
 
@@ -215,6 +244,70 @@ def run_horizon_sweep(model, params, args, rng):
     return results
 
 
+def run_chaos_sweep(model, params, args, rng):
+    """Fault-free vs background-fault-rate steady state: the recorded
+    degradation budget. One transient error every --chaos_every
+    decode-dispatch ATTEMPTS (seeded, deterministic; each recovered
+    fault adds one retry attempt, so the realized per-dispatch rate is
+    1/(chaos_every - 1)), every one recovered by the engine's bounded
+    retry + cooldown — the sweep measures what that survival COSTS in
+    tok/s."""
+    from pytorch_multiprocessing_distributed_tpu.runtime.faults import (
+        FaultPlan, FaultRule)
+
+    if args.chaos_every < 2:
+        # every=1 would fault every attempt INCLUDING the retries —
+        # retries exhaust and the run dies instead of measuring
+        raise SystemExit("--chaos_every must be >= 2 (every attempt "
+                         "faulting leaves no attempt to recover on)")
+
+    new_tokens = max(args.new_tokens, 65)
+    prompt_hi = max(2, min(args.prompt_max,
+                           model.max_seq_len - new_tokens) - 1)
+    s_max = min(model.max_seq_len, prompt_hi + new_tokens)
+    slots = int(args.slots.split(",")[0])
+    lengths = [int(rng.integers(max(1, prompt_hi // 2), prompt_hi + 1))
+               for _ in range(slots)]
+    prompts = [rng.integers(0, model.vocab_size, (n,)).tolist()
+               for n in lengths]
+    point = dict(decode_buckets=(), decode_horizon=4,
+                 retry_backoff_s=0.0)
+    base = run_point(model, params, prompts, new_tokens, slots,
+                     float("inf"), s_max, warmup=True, **point)
+    plan = FaultPlan([FaultRule("serving.decode_dispatch", "error",
+                                times=0, every=args.chaos_every)],
+                     seed=7)
+    fault = run_point(model, params, prompts, new_tokens, slots,
+                      float("inf"), s_max, warmup=True, arm_plan=plan,
+                      **point)
+    base_tps = base["decode_tokens_per_sec"]
+    fault_tps = fault["decode_tokens_per_sec"]
+    degradation = (0.0 if base_tps == 0
+                   else 1.0 - fault_tps / base_tps)
+    results = []
+    for label, r in (("fault-free", base), ("faulted", fault)):
+        r.update(mode=label, chaos_every=args.chaos_every)
+        results.append(r)
+        print(f"chaos {label:10s}  {r['decode_tokens_per_sec']:9.1f} "
+              f"decode tok/s  injected={r['injected']:3d}  "
+              f"retries={r['dispatch_retries']:3d}  "
+              f"collapses={r['horizon_collapses']:3d}  "
+              f"failed={r['requests_failed']}", flush=True)
+    # dispatch_retries counts retries from EVERY engine fault domain;
+    # equality holds here because the sweep injects only dispatch
+    # faults and the local CPU run has no real transients to add
+    assert fault["dispatch_retries"] == fault["injected"], (
+        "every injected fault must be VISIBLY retried, none eaten")
+    assert fault["requests_failed"] == 0, (
+        "a background transient rate must be fully recovered")
+    print(f"# degradation budget at 1/{args.chaos_every - 1} "
+          f"per-dispatch fault rate: {100 * degradation:.1f}% "
+          f"({base_tps:.1f} -> {fault_tps:.1f} tok/s)", flush=True)
+    results.append({"mode": "budget", "chaos_every": args.chaos_every,
+                    "degradation_frac": degradation})
+    return results
+
+
 def main():
     _common.apply_platform_env()
     p = argparse.ArgumentParser()
@@ -230,7 +323,12 @@ def main():
                         "submitted up front)")
     p.add_argument("--sweep", default="load,length,horizon", type=str,
                    help="which sweeps to run: load, length, horizon, "
-                        "or any comma list")
+                        "chaos, or any comma list")
+    p.add_argument("--chaos_every", default=5, type=int,
+                   help="chaos sweep: inject one transient fault every "
+                        "K-th dispatch ATTEMPT, K >= 2 (realized "
+                        "per-dispatch rate 1/(K-1): each recovered "
+                        "fault adds one retry attempt)")
     p.add_argument("--len_dist", default="short,long,mixed", type=str,
                    help="length-sweep prompt distributions")
     p.add_argument("--prefill_chunk", default=32, type=int,
@@ -280,7 +378,7 @@ def main():
     record = {"platform": platform, "model": args.model,
               "requests": args.requests, "new_tokens": args.new_tokens,
               "s_max": s_max, "load_sweep": [], "length_sweep": [],
-              "horizon_sweep": []}
+              "horizon_sweep": [], "chaos_sweep": []}
     sweeps = args.sweep.split(",")
 
     if "load" in sweeps:
@@ -312,6 +410,10 @@ def main():
     if "horizon" in sweeps:
         record["horizon_sweep"] = run_horizon_sweep(
             model, params, args, rng)
+
+    if "chaos" in sweeps:
+        record["chaos_sweep"] = run_chaos_sweep(model, params, args,
+                                                rng)
 
     if args.json_out:
         with open(args.json_out, "w") as f:

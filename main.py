@@ -315,16 +315,35 @@ def main(args):
                 print(f"Resumed from {ck.directory}/{epoch} "
                       f"(continuing at epoch {start_epoch})")
         ck.close()
-    elif args.resume == "auto":
+    auto_resume = False
+    if args.ckpt_backend != "orbax" and args.resume == "auto":
         from pytorch_multiprocessing_distributed_tpu.train.checkpoint import (
             resolve_auto_resume)
 
         args.resume = resolve_auto_resume(args.save_path) or ""
+        auto_resume = bool(args.resume)
         if not args.resume and dist.is_primary():
             print(f"--resume auto: no checkpoint under {args.save_path}; "
                   "starting fresh")
     if args.ckpt_backend != "orbax" and args.resume:
-        state = load_checkpoint(args.resume, state)
+        if auto_resume:
+            # auto picks the checkpoint, so it also owns the recovery:
+            # a corrupt newest checkpoint (digest mismatch) is reported
+            # and the previous valid epoch restores instead. An
+            # EXPLICIT --resume path still fails loudly — the user
+            # named that file.
+            from pytorch_multiprocessing_distributed_tpu.train.checkpoint import (
+                checkpoint_epoch, load_with_fallback)
+
+            # anchor the fallback walk at the primary-resolved epoch:
+            # a stale EXTRA checkpoint on one host (newer than what the
+            # primary resolved) must not shift that host's walk and get
+            # misdiagnosed as cross-host divergence
+            state, args.resume = load_with_fallback(
+                args.save_path, state,
+                anchor=checkpoint_epoch(args.resume))
+        else:
+            state = load_checkpoint(args.resume, state)
         # continue the epoch series (LR schedule + log numbering) from
         # where the checkpoint left off
         start_epoch = int(state.epoch) + 1

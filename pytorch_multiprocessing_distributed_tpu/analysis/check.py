@@ -51,7 +51,7 @@ import jax  # noqa: E402
 
 try:  # best-effort when jax was pre-imported with another platform
     jax.config.update("jax_platforms", "cpu")
-except Exception:  # noqa: BLE001 — backend may already be live
+except Exception:  # noqa: BLE001  # graftlint: disable=GL111 backend may already be live; config stays as-is
     pass
 
 from . import ir  # noqa: E402

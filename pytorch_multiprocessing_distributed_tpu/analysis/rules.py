@@ -76,6 +76,11 @@ RULES: Dict[str, str] = {
              "implicit H2D the transfer sentinel only catches at "
              "runtime; stage it outside, or thread it through the "
              "carry)",
+    "GL111": "broad except (bare, Exception, BaseException) that "
+             "swallows the error — no re-raise, the bound exception "
+             "unused, nothing logged: a fault domain that eats its "
+             "faults cannot be recovered OR debugged (record the "
+             "error, re-raise, or narrow the except)",
 }
 
 # wrappers that COMPILE (jit family) — GL105/106/107/108 anchor on these
@@ -869,6 +874,76 @@ def _check_ctrl_body_scalars(fn: _Func, out: List[Finding]):
                 "body or thread it through the carry)"))
 
 
+_BROAD_EXC = {"Exception", "BaseException"}
+
+
+def _is_broad_handler(handler: ast.ExceptHandler, file: _File) -> bool:
+    """Bare ``except:``, ``except Exception``, ``except BaseException``
+    (alone or anywhere in a tuple)."""
+    t = handler.type
+    if t is None:
+        return True
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    for el in elts:
+        d = _dotted(el, file)
+        if d and d.split(".")[-1] in _BROAD_EXC:
+            return True
+    return False
+
+
+def _handler_records(handler: ast.ExceptHandler, file: _File) -> bool:
+    """Does the handler re-raise, use the bound exception (format it,
+    store it, wrap it), or at least emit through a logging-ish call?
+    Any of these makes the swallow deliberate and observable."""
+    bound = handler.name
+    for stmt in handler.body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Raise):
+                return True
+            if (bound and isinstance(node, ast.Name)
+                    and node.id == bound):
+                return True
+            if isinstance(node, ast.Call):
+                d = _dotted(node.func, file)
+                last = d.split(".")[-1] if d else ""
+                if (last in _LOG_ATTRS or last in ("print", "warn")
+                        or d == "warnings.warn"):
+                    return True
+    return False
+
+
+def _check_swallowed_except(file: _File, out: List[Finding]):
+    """GL111 — a broad except whose handler swallows the error: no
+    re-raise, the bound exception never read, nothing logged. Silent
+    fault-swallowing is the anti-pattern the graftfault layer exists
+    to kill: a retry path can only recover what it can SEE, and a
+    fleet can only page on what is recorded. The optional-dependency
+    probe idiom (a ``try`` whose body is imports only) is exempt —
+    there the absence of the module IS the information."""
+    for node in ast.walk(file.tree):
+        if not isinstance(node, ast.Try):
+            continue
+        import_probe = bool(node.body) and all(
+            isinstance(s, (ast.Import, ast.ImportFrom))
+            for s in node.body)
+        if import_probe:
+            continue
+        for handler in node.handlers:
+            if not _is_broad_handler(handler, file):
+                continue
+            if _handler_records(handler, file):
+                continue
+            shown = ("except:" if handler.type is None else
+                     f"except {ast.unparse(handler.type)}:"
+                     if hasattr(ast, "unparse") else "except ...:")
+            out.append(Finding(
+                file.path, handler.lineno, handler.col_offset, "GL111",
+                f"`{shown}` swallows the error — no re-raise, the "
+                "exception unused, nothing logged; record it, re-raise "
+                "it, or narrow the except (silent fault-swallowing "
+                "hides exactly the failures graftfault injects)"))
+
+
 def _check_jit_in_loop(file: _File, out: List[Finding]):
     """GL105: jax.jit(...) lexically inside a for/while body."""
     loops: List[ast.AST] = [n for n in ast.walk(file.tree)
@@ -997,6 +1072,7 @@ def analyze_files(paths: Sequence[str],
     for f in files:
         _check_jit_in_loop(f, findings)
         _check_pspec_axes(f, axes, findings)
+        _check_swallowed_except(f, findings)
         for fn in f.funcs:
             if fn.jit_scoped:
                 _check_jit_scoped_body(fn, findings)

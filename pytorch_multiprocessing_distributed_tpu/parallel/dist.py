@@ -21,47 +21,36 @@ from __future__ import annotations
 
 import os
 import socket
-import threading
 import time
 from typing import Optional
 
 import jax
 
+from ..runtime.faults import (maybe_fault, register_site,
+                              run_with_timeout)
 
 _initialized = False
 _store = None         # TCPStore client kept for control-plane use
 _store_server = None  # TCPStoreServer handle when this process hosts it
 
+# the bring-up hazard point: a pod whose rendezvous/barrier faults
+# must fail FAST and NAMED (the reference inherits NCCL's silent hang)
+_SITE_RENDEZVOUS = register_site(
+    "runtime.rendezvous",
+    "multihost rendezvous/barrier on the control plane (store "
+    "bring-up, coordinator publish, experiment barriers)")
+
 
 def _run_with_watchdog(fn, timeout: float, what: str, hint: str):
-    """Run ``fn`` in a daemon thread, bounded by ``timeout`` seconds.
-
-    ``jax.distributed.initialize`` (and backend bring-up generally) can
-    HANG rather than raise when a peer never shows up — the reference
-    inherits the same failure mode from NCCL and just sits there. The
-    discipline bench.py uses for backend probing applies here: complete,
-    raise, or fail fast with an ACTIONABLE error (SURVEY.md §5 failure
-    detection: "fail-fast pod init with clear coordinator-timeout
-    errors").
-    """
-    box = {}
-
-    def target():
-        try:
-            box["result"] = fn()
-        except BaseException as e:  # noqa: BLE001 — re-raised on caller
-            box["err"] = e
-
-    t = threading.Thread(target=target, daemon=True, name=f"pmdt-{what}")
-    t.start()
-    t.join(timeout)
-    if "err" in box:
-        raise box["err"]
-    if "result" not in box:
-        raise RuntimeError(
-            f"{what} did not complete within {timeout:.0f}s. {hint}"
-        )
-    return box["result"]
+    """Bounded bring-up: ``jax.distributed.initialize`` (and backend
+    bring-up generally) can HANG rather than raise when a peer never
+    shows up — the reference inherits the same failure mode from NCCL
+    and just sits there. graftfault's shared watchdog applies the
+    bench.py probing discipline here: complete, raise, or fail fast
+    with an ACTIONABLE :class:`~..runtime.faults.FaultTimeout`
+    (SURVEY.md §5 failure detection: "fail-fast pod init with clear
+    coordinator-timeout errors")."""
+    return run_with_timeout(fn, timeout, what, hint)
 
 
 def _is_local_host(host: str) -> bool:
@@ -99,6 +88,7 @@ def _store_rendezvous(timeout: float):
     """
     from ..runtime.store import TCPStore, TCPStoreServer
 
+    maybe_fault(_SITE_RENDEZVOUS)
     master = os.environ["PMDT_MASTER_ADDR"]
     try:
         host, port_s = master.rsplit(":", 1)
@@ -298,7 +288,10 @@ def is_primary() -> bool:
 
 
 def barrier(name: str = "barrier") -> None:
-    """Block until every host arrives (control-plane sync)."""
+    """Block until every host arrives (control-plane sync). An
+    injected fault here surfaces named (fail fast) — a half-synced
+    fleet must never proceed silently."""
+    maybe_fault(_SITE_RENDEZVOUS)
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 

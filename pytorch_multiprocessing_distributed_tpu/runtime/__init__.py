@@ -6,9 +6,22 @@ device data plane stays with XLA (that's the TPU-native design); the HOST
 control plane — rendezvous, barriers, health keys — is C++:
 
 - :mod:`.store` — TCP key-value store (c10d ``TCPStore`` analogue),
-  ``csrc/tcp_store.cpp`` via ctypes.
+  ``csrc/tcp_store.cpp`` via ctypes;
+- :mod:`.faults` — graftfault: deterministic fault injection (named
+  sites, seeded :class:`~.faults.FaultPlan`) plus the shared recovery
+  primitives (:func:`~.faults.retry_with_backoff`,
+  :func:`~.faults.run_with_timeout`) every layer retries through.
 """
 
+from .faults import (DeadlineExceeded, FaultInjected, FaultPlan,
+                     FaultRule, FaultTimeout, GraftFaultError, armed,
+                     maybe_fault, register_site, registered_sites,
+                     retry_with_backoff, run_with_timeout)
 from .store import TCPStore, TCPStoreServer
 
-__all__ = ["TCPStore", "TCPStoreServer"]
+__all__ = [
+    "TCPStore", "TCPStoreServer", "GraftFaultError", "FaultInjected",
+    "FaultTimeout", "DeadlineExceeded", "FaultPlan", "FaultRule",
+    "armed", "maybe_fault", "register_site", "registered_sites",
+    "retry_with_backoff", "run_with_timeout",
+]

@@ -1,0 +1,407 @@
+"""graftfault: deterministic fault injection + the recovery primitives.
+
+A fleet treats preemption and partial failure as routine (PAPERS.md,
+arXiv:2204.06514); a serving engine or trainer that has never *seen* a
+hung readback, a torn checkpoint or a flaky store connection cannot
+claim to survive one. This module is the seam that makes those failures
+reproducible: every hazard point in the stack registers a named
+**injection site** and routes through :func:`maybe_fault`; tests (and
+the ``PMDT_FAULT_PLAN`` env hook) arm a seeded :class:`FaultPlan` that
+decides — deterministically, by per-site call count — which calls
+fail, hang, or corrupt their payload. The fault-matrix suite
+(``tests/test_graftfault.py``, ``make chaos``) sweeps every registered
+site and pins the headline invariant: under any single injected fault,
+every *unaffected* request's tokens are byte-identical to the
+fault-free run, and the fault itself is either recovered or surfaces
+as a named :class:`GraftFaultError` — never a hang, never a silent
+swallow.
+
+Disarmed cost is ZERO by construction: :func:`maybe_fault` is one
+module-global read and an ``is None`` check on the host, outside every
+jitted program — no extra compiles, transfers or host syncs on any hot
+path (pinned by ``tests/test_sentinels.py`` running against the
+instrumented engine).
+
+The recovery half lives here too, so every layer retries the same way:
+
+- :func:`retry_with_backoff` — bounded retries with exponential
+  backoff for transient (OSError-shaped) failures; used by the
+  runtime store, the engine's decode dispatch, and admission-retry.
+- :func:`run_with_timeout` — run a callable under a watchdog thread
+  and fail fast with a :class:`FaultTimeout` naming what hung; used
+  by the engine's horizon-readback watchdog and the multihost
+  bring-up in :mod:`..parallel.dist`.
+
+Fault kinds (``FaultRule.kind``):
+
+- ``"error"``  — raise :class:`FaultInjected` (a ``ConnectionError``
+  subclass: the transient class every retry path catches);
+- ``"fatal"``  — raise :class:`GraftFaultError` (NOT retryable: pins
+  the fail-fast path);
+- ``"hang"``   — sleep ``hang_s`` seconds (the watchdog's prey), then
+  return normally;
+- ``"corrupt"``— flip one payload byte (seed-chosen offset) and return
+  the corrupted payload; sites that move bytes (checkpoint write,
+  store set) pass them through ``maybe_fault(site, payload)``.
+
+Env hook: ``PMDT_FAULT_PLAN="seed=7;store.get=error:2;``
+``serving.horizon_readback=hang:1:0.5"`` arms a plan at import — the
+same schedule grammar tests build programmatically
+(``site=kind[:times[:arg]]``; ``arg`` is seconds for ``hang``, the
+skip-first-N offset otherwise; ``times=0`` = unlimited; an optional
+``every=K`` element makes rules fire on every K-th hit — the
+background-fault-rate mode ``serving_bench.py --sweep chaos`` uses).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+__all__ = [
+    "GraftFaultError", "FaultInjected", "FaultTimeout",
+    "DeadlineExceeded", "PoolPoisonedError", "FaultRule",
+    "FaultPlan", "register_site",
+    "registered_sites", "maybe_fault", "arm", "disarm", "armed",
+    "active_plan", "retry_with_backoff", "run_with_timeout",
+    "plan_from_spec",
+]
+
+
+class GraftFaultError(RuntimeError):
+    """Base class for every named fault this layer raises or injects.
+
+    The fail-fast contract: a fault that cannot be recovered surfaces
+    as (a subclass of) this, naming its site — never a bare hang or a
+    silently swallowed exception."""
+
+
+class FaultInjected(GraftFaultError, ConnectionError):
+    """An injected *transient* fault (``kind="error"``).
+
+    Subclasses ``ConnectionError`` (hence ``OSError``) so the same
+    bounded-retry paths that recover real socket flakes recover the
+    injected ones — the injection exercises the production code path,
+    not a test-only branch."""
+
+
+class FaultTimeout(GraftFaultError):
+    """A watchdog-bounded operation did not complete in time."""
+
+
+class DeadlineExceeded(GraftFaultError):
+    """A request outlived its per-request deadline and was evicted
+    (quarantined as FAILED with this as its recorded error)."""
+
+
+class PoolPoisonedError(GraftFaultError):
+    """A jitted program that DONATES live shared state failed
+    mid-execution: XLA consumed the donated input buffers when the
+    launch started, so the state's owner cannot keep running on them.
+    Fatal for the whole fault domain by design — quarantining one
+    request (or retrying) would keep operating on deleted buffers and
+    crash every later caller with an unnamed deleted-buffer error;
+    the holder (e.g. an engine replica) must be discarded/rebuilt."""
+
+
+# --------------------------------------------------------------- registry
+
+_SITES: Dict[str, str] = {}
+_PLAN: Optional["FaultPlan"] = None
+
+
+def register_site(name: str, description: str) -> str:
+    """Declare a named injection site (idempotent; module-import time).
+
+    Registration is what the fault matrix sweeps: a hazard point that
+    calls :func:`maybe_fault` without registering is invisible to the
+    coverage assertion, so always register next to the call."""
+    _SITES.setdefault(name, description)
+    return name
+
+
+def registered_sites() -> Dict[str, str]:
+    """``{site name: description}`` for every registered site."""
+    return dict(_SITES)
+
+
+def maybe_fault(site: str, payload=None):
+    """The per-hazard-point hook: returns ``payload`` untouched when no
+    plan is armed (one global read + ``is None`` — the whole disarmed
+    cost), else lets the armed plan decide (raise / hang / corrupt)."""
+    plan = _PLAN
+    if plan is None:
+        return payload
+    return plan.apply(site, payload)
+
+
+def arm(plan: "FaultPlan") -> "FaultPlan":
+    global _PLAN
+    _PLAN = plan
+    return plan
+
+
+def disarm() -> None:
+    global _PLAN
+    _PLAN = None
+
+
+def active_plan() -> Optional["FaultPlan"]:
+    return _PLAN
+
+
+class armed:
+    """``with armed(plan): ...`` — arm for the block, always disarm."""
+
+    def __init__(self, plan: "FaultPlan"):
+        self.plan = plan
+
+    def __enter__(self) -> "FaultPlan":
+        return arm(self.plan)
+
+    def __exit__(self, *exc) -> None:
+        disarm()
+
+
+# ------------------------------------------------------------------ plan
+
+class FaultRule:
+    """One scheduled fault at one site.
+
+    Args:
+      site: registered site name the rule triggers at.
+      kind: ``"error"`` | ``"fatal"`` | ``"hang"`` | ``"corrupt"``.
+      times: how many hits trigger (0 = unlimited) — fail-once is
+        ``times=1``, fail-N is ``times=N``.
+      after: skip the first ``after`` hits of the site (fault the
+        steady state, not the warm-up).
+      every: with ``every=K > 0``, trigger only on every K-th eligible
+        hit — a background fault *rate* instead of a burst.
+      hang_s: sleep length for ``kind="hang"``.
+    """
+
+    def __init__(self, site: str, kind: str = "error", *,
+                 times: int = 1, after: int = 0, every: int = 0,
+                 hang_s: float = 0.25):
+        if kind not in ("error", "fatal", "hang", "corrupt"):
+            raise ValueError(
+                f"unknown fault kind {kind!r} (want error|fatal|hang|"
+                f"corrupt)")
+        if times < 0 or after < 0 or every < 0:
+            raise ValueError("times/after/every must be >= 0")
+        self.site = site
+        self.kind = kind
+        self.times = int(times)
+        self.after = int(after)
+        self.every = int(every)
+        self.hang_s = float(hang_s)
+        self.triggered = 0  # plan-lifetime trigger count (observable)
+
+    def should_fire(self, hit: int) -> bool:
+        """``hit`` is the site's 0-based call index."""
+        if hit < self.after:
+            return False
+        if self.times and self.triggered >= self.times:
+            return False
+        if self.every and (hit - self.after) % self.every != 0:
+            return False
+        return True
+
+    def __repr__(self) -> str:
+        return (f"FaultRule({self.site!r}, {self.kind!r}, "
+                f"times={self.times}, after={self.after}, "
+                f"every={self.every})")
+
+
+class FaultPlan:
+    """A deterministic, seedable fault schedule over named sites.
+
+    Purely count-driven: the n-th call to a site either faults or it
+    does not, decided by the rules — rerunning the same workload under
+    the same plan injects the same faults at the same operations (the
+    property the token-exactness matrix rests on). ``seed`` feeds only
+    payload corruption (which byte flips)."""
+
+    def __init__(self, rules: Iterable[FaultRule], seed: int = 0):
+        self.rules: List[FaultRule] = list(rules)
+        self.seed = int(seed)
+        self.hits: Dict[str, int] = {}
+        # the count bookkeeping is the determinism guarantee; armed
+        # process-wide (the env hook) it can be reached from multiple
+        # threads (e.g. threaded TCPStore clients), and an
+        # unsynchronized read-modify-write would let two threads claim
+        # the same hit index — double-firing or skipping scheduled
+        # faults and corrupting triggered()/site_hits() assertions
+        self._mu = threading.Lock()
+
+    def triggered(self, site: Optional[str] = None) -> int:
+        """Faults actually injected (optionally at one site)."""
+        return sum(r.triggered for r in self.rules
+                   if site is None or r.site == site)
+
+    def site_hits(self, site: str) -> int:
+        """How many times a site was reached (armed calls only)."""
+        return self.hits.get(site, 0)
+
+    def _corrupt(self, site: str, payload):
+        if payload is None:
+            return payload
+        data = bytearray(payload)
+        if not data:
+            return bytes(data)
+        # seed + site + hit index -> deterministic flipped offset
+        idx = (self.seed * 1000003 + len(data)
+               + self.hits.get(site, 1) * 7919) % len(data)
+        data[idx] ^= 0xFF
+        return bytes(data)
+
+    def apply(self, site: str, payload):
+        with self._mu:
+            hit = self.hits.get(site, 0)
+            self.hits[site] = hit + 1
+            fired: Optional[FaultRule] = None
+            for rule in self.rules:
+                if rule.site != site or not rule.should_fire(hit):
+                    continue
+                rule.triggered += 1
+                fired = rule
+                break
+        # the slow parts (sleep, byte-flip) run OUTSIDE the lock so a
+        # hang rule on one thread never serializes other sites
+        if fired is None:
+            return payload
+        if fired.kind == "error":
+            raise FaultInjected(
+                f"graftfault: injected transient fault at "
+                f"{site!r} (hit {hit})")
+        if fired.kind == "fatal":
+            raise GraftFaultError(
+                f"graftfault: injected fatal fault at {site!r} "
+                f"(hit {hit})")
+        if fired.kind == "hang":
+            time.sleep(fired.hang_s)
+            return payload
+        if payload is None:
+            # a corrupt rule at a site that passes no payload would
+            # otherwise no-op while still consuming its budget and
+            # reporting triggered() injections that never happened —
+            # false confidence is the one thing a chaos drill must
+            # never produce
+            raise GraftFaultError(
+                f"graftfault: corrupt rule armed at {site!r}, but that "
+                "site passes no payload to corrupt — use kind='error' "
+                "(or 'hang'/'fatal') for this site")
+        return self._corrupt(site, payload)
+
+
+def plan_from_spec(spec: str) -> FaultPlan:
+    """Parse the ``PMDT_FAULT_PLAN`` grammar into a plan.
+
+    ``"seed=7;every=0;site=kind[:times[:arg]];..."`` — ``arg`` is
+    ``hang_s`` (seconds) for ``hang`` rules and ``after`` otherwise.
+    ``seed=``/``every=`` are plan-wide and position-independent: they
+    apply to EVERY rule in the spec no matter where they appear
+    (``"site=error:1;every=10"`` and ``"every=10;site=error:1"`` build
+    the same plan — the grammar has no order-sensitive elements).
+    """
+    seed = 0
+    every = 0
+    sites: List[Tuple[str, str]] = []
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        key, _, value = part.partition("=")
+        key, value = key.strip(), value.strip()
+        if key == "seed":
+            seed = int(value)
+        elif key == "every":
+            every = int(value)
+        else:
+            sites.append((key, value))
+    rules: List[FaultRule] = []
+    for key, value in sites:
+        fields = value.split(":")
+        kind = fields[0]
+        times = int(fields[1]) if len(fields) > 1 else 1
+        kw = {}
+        if len(fields) > 2:
+            if kind == "hang":
+                kw["hang_s"] = float(fields[2])
+            else:
+                kw["after"] = int(fields[2])
+        rules.append(FaultRule(key, kind, times=times, every=every,
+                               **kw))
+    return FaultPlan(rules, seed=seed)
+
+
+# --------------------------------------------------------------- recovery
+
+def retry_with_backoff(fn: Callable, *, attempts: int = 3,
+                       base_delay_s: float = 0.05,
+                       max_delay_s: float = 2.0,
+                       retry_on: Tuple[type, ...] = (OSError,),
+                       on_retry: Optional[Callable] = None,
+                       sleep: Callable[[float], None] = time.sleep):
+    """Run ``fn()`` with bounded exponential-backoff retries.
+
+    Retries only on ``retry_on`` (default: the OSError family —
+    sockets, :class:`FaultInjected`); anything else propagates
+    immediately (fail fast beats masking a logic bug as a flake). The
+    final failure re-raises the LAST transient error — bounded means
+    bounded. ``on_retry(attempt_index, exc)`` observes each retry
+    (metrics hooks); ``sleep`` is injectable so tests never wait.
+    """
+    if attempts < 1:
+        raise ValueError(f"attempts must be >= 1, got {attempts}")
+    delay = base_delay_s
+    for attempt in range(attempts):
+        try:
+            return fn()
+        except retry_on as e:
+            if attempt == attempts - 1:
+                raise
+            if on_retry is not None:
+                on_retry(attempt, e)
+            if delay > 0:
+                sleep(delay)
+            delay = min(delay * 2, max_delay_s)
+
+
+def run_with_timeout(fn: Callable, timeout_s: float, what: str,
+                     hint: str = ""):
+    """Run ``fn()`` in a daemon thread, bounded by ``timeout_s``.
+
+    The watchdog discipline for operations that HANG rather than raise
+    when a peer/device never answers (backend bring-up, a wedged
+    horizon readback): complete, raise the worker's own error, or fail
+    fast with a :class:`FaultTimeout` naming what hung. The abandoned
+    worker thread is daemonic — it cannot keep the process alive."""
+    box: Dict[str, object] = {}
+
+    def target():
+        try:
+            box["result"] = fn()
+        except BaseException as e:  # re-raised on the caller below
+            box["err"] = e
+
+    t = threading.Thread(target=target, daemon=True,
+                         name=f"pmdt-watchdog-{what}")
+    t.start()
+    t.join(timeout_s)
+    if "err" in box:
+        raise box["err"]  # type: ignore[misc]
+    if "result" not in box:
+        raise FaultTimeout(
+            f"{what} did not complete within {timeout_s:.3g}s."
+            + (f" {hint}" if hint else ""))
+    return box["result"]
+
+
+# env hook: arm a plan for the whole process (chaos drills on a live
+# CLI — serve_lm.py / train runs — without touching any test harness)
+_ENV_SPEC = os.environ.get("PMDT_FAULT_PLAN")
+if _ENV_SPEC:
+    arm(plan_from_spec(_ENV_SPEC))

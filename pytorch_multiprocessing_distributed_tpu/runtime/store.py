@@ -4,6 +4,13 @@ The host-control-plane analogue of the TCPStore behind the reference's
 ``init_process_group`` (``main.py:190-193``): ``set``/``get``/``add``/
 ``wait`` plus a counting ``barrier``. The shared library is built on
 demand with the repo Makefile (g++ only, no Python build deps).
+
+Fault domain: every client operation runs under graftfault's bounded
+:func:`~.faults.retry_with_backoff` — one transient socket flake (or
+an injected :class:`~.faults.FaultInjected` at the ``store.get`` /
+``store.set`` sites) no longer kills a training run's control plane;
+a persistent failure still raises after the bounded attempts (fail
+fast, never an unbounded retry storm against a dead coordinator).
 """
 
 from __future__ import annotations
@@ -13,6 +20,16 @@ import os
 import subprocess
 import threading
 from typing import Optional, Tuple
+
+from .faults import (FaultInjected, maybe_fault, register_site,
+                     retry_with_backoff)
+
+# the flaky-connection hazard points the fault matrix sweeps
+_SITE_GET = register_site(
+    "store.get", "runtime store fetch (get/wait) over the TCP socket")
+_SITE_SET = register_site(
+    "store.set", "runtime store mutation (set/add/delete) over the "
+    "TCP socket")
 
 _CSRC = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
@@ -104,16 +121,66 @@ class TCPStoreServer:
 
 
 class TCPStore:
-    """Client connection to a :class:`TCPStoreServer`."""
+    """Client connection to a :class:`TCPStoreServer`.
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 20080):
+    Args:
+      retries: bounded attempts per operation (>= 1); transient
+        OSError-family failures (including injected faults at the
+        ``store.get``/``store.set`` sites) are retried with
+        exponential backoff, anything else — and the last transient
+        failure — propagates. Exception: :meth:`add` retries injected
+        faults only (real failures are commit-ambiguous — see its
+        docstring).
+      backoff_s: first-retry delay (doubles per retry, capped at 2 s).
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 20080, *,
+                 retries: int = 3, backoff_s: float = 0.05):
+        if retries < 1:
+            raise ValueError(f"retries must be >= 1, got {retries}")
         self._lib = _load()
+        self._host, self._port = host, int(port)
         self._fd = self._lib.pmdt_store_connect(host.encode(), port)
         if self._fd < 0:
             raise ConnectionError(f"cannot connect to store at {host}:{port}")
         # each client needs a private connection for blocking waits; guard
         # against cross-thread interleaving on this one
         self._mu = threading.Lock()
+        self._retries = int(retries)
+        self._backoff_s = float(backoff_s)
+
+    def _reconnect(self, attempt: int, exc: BaseException) -> None:
+        """``on_retry`` hook: a REAL socket failure (peer RST, EPIPE)
+        leaves ``self._fd`` dead, so without this every retry would
+        beat on the same broken fd and "bounded retry" would only ever
+        recover *injected* faults. Injected faults fire before the
+        wire call — the fd is healthy — and skip the teardown.
+        Best-effort: if the reconnect itself fails the old fd stays
+        and the bounded retries surface the persistent failure."""
+        if isinstance(exc, FaultInjected):
+            return
+        with self._mu:
+            # close the dead fd BEFORE connecting: the kernel hands
+            # the new socket the lowest free number — often the one
+            # just closed — so close-after-connect would tear down
+            # the replacement
+            if self._fd >= 0:
+                self._lib.pmdt_store_disconnect(self._fd)
+                self._fd = -1
+            fd = self._lib.pmdt_store_connect(
+                self._host.encode(), self._port)
+            if fd >= 0:
+                self._fd = fd
+
+    def _retry(self, fn):
+        """The one retry policy every store op runs under (the real
+        path behind ``scheduler.QueueFull``'s "shed load or retry"
+        advice at the control-plane layer): bounded backoff, plus a
+        reconnect between attempts when the failure was a real socket
+        error (see :meth:`_reconnect`)."""
+        return retry_with_backoff(fn, attempts=self._retries,
+                                  base_delay_s=self._backoff_s,
+                                  on_retry=self._reconnect)
 
     def close(self) -> None:
         if self._fd >= 0:
@@ -127,12 +194,16 @@ class TCPStore:
         self.close()
 
     def set(self, key: str, value: bytes) -> None:
-        with self._mu:
-            status = self._lib.pmdt_store_set(
-                self._fd, key.encode(), value, len(value)
-            )
-        if status != 0:
-            raise OSError(f"store set({key!r}) failed: {status}")
+        def once():
+            payload = maybe_fault(_SITE_SET, value)
+            with self._mu:
+                status = self._lib.pmdt_store_set(
+                    self._fd, key.encode(), payload, len(payload)
+                )
+            if status != 0:
+                raise OSError(f"store set({key!r}) failed: {status}")
+
+        self._retry(once)
 
     def _fetch_dyn(self, op_name: str, key: str) -> Tuple[int, bytes]:
         """Run a dyn-allocating fetch op; the value crosses the socket
@@ -154,43 +225,73 @@ class TCPStore:
         return status, value
 
     def get(self, key: str) -> Optional[bytes]:
-        status, value = self._fetch_dyn("get_dyn", key)
-        if status == -1:
-            return None
-        if status < 0:
-            raise OSError(f"store get({key!r}) failed: {status}")
-        return value
+        def once():
+            maybe_fault(_SITE_GET)
+            status, value = self._fetch_dyn("get_dyn", key)
+            if status == -1:
+                return None
+            if status < 0:
+                raise OSError(f"store get({key!r}) failed: {status}")
+            return value
+
+        return self._retry(once)
 
     def add(self, key: str, delta: int = 1) -> int:
         """Atomically add to an integer key; returns the new value (which
-        may be any integer — status and value travel separately)."""
-        buf = ctypes.create_string_buffer(32)
-        out_len = ctypes.c_int64(0)
-        with self._mu:
-            status = self._lib.pmdt_store_add(
-                self._fd, key.encode(), delta, buf, 32, ctypes.byref(out_len)
-            )
-        if status != 0:
-            raise OSError(f"store add({key!r}) failed: {status}")
-        return int(buf.raw[: out_len.value])
+        may be any integer — status and value travel separately).
+
+        NOT retried on real socket failures: ``add`` is not idempotent,
+        and a failure after the server committed (request sent, the
+        response lost to a peer RST) would double-count on retry — for
+        the counting :meth:`barrier` that orphans an arrival index and
+        wedges every rank at ``wait()`` forever, exactly the silent
+        hang this layer forbids. The client cannot tell send-failed
+        from response-lost, so ambiguity fails loud. Injected faults at
+        the site fire BEFORE the wire call (nothing committed), so they
+        alone are retried — chaos drills still exercise the backoff."""
+        def once():
+            maybe_fault(_SITE_SET)
+            buf = ctypes.create_string_buffer(32)
+            out_len = ctypes.c_int64(0)
+            with self._mu:
+                status = self._lib.pmdt_store_add(
+                    self._fd, key.encode(), delta, buf, 32,
+                    ctypes.byref(out_len)
+                )
+            if status != 0:
+                raise OSError(f"store add({key!r}) failed: {status}")
+            return int(buf.raw[: out_len.value])
+
+        return retry_with_backoff(once, attempts=self._retries,
+                                  base_delay_s=self._backoff_s,
+                                  retry_on=(FaultInjected,))
 
     def wait(self, key: str) -> bytes:
         """Block until ``key`` exists; returns its value."""
-        status, value = self._fetch_dyn("wait_dyn", key)
-        if status != 0:
-            raise OSError(f"store wait({key!r}) aborted: {status}")
-        return value
+        def once():
+            maybe_fault(_SITE_GET)
+            status, value = self._fetch_dyn("wait_dyn", key)
+            if status != 0:
+                raise OSError(f"store wait({key!r}) aborted: {status}")
+            return value
+
+        return self._retry(once)
 
     def delete(self, key: str) -> bool:
-        buf = ctypes.create_string_buffer(8)
-        out_len = ctypes.c_int64(0)
-        with self._mu:
-            status = self._lib.pmdt_store_delete(
-                self._fd, key.encode(), buf, 8, ctypes.byref(out_len)
-            )
-        if status != 0:
-            raise OSError(f"store delete({key!r}) failed: {status}")
-        return buf.raw[: out_len.value] == b"1"
+        def once():
+            maybe_fault(_SITE_SET)
+            buf = ctypes.create_string_buffer(8)
+            out_len = ctypes.c_int64(0)
+            with self._mu:
+                status = self._lib.pmdt_store_delete(
+                    self._fd, key.encode(), buf, 8,
+                    ctypes.byref(out_len)
+                )
+            if status != 0:
+                raise OSError(f"store delete({key!r}) failed: {status}")
+            return buf.raw[: out_len.value] == b"1"
+
+        return self._retry(once)
 
     def barrier(self, name: str, world_size: int) -> None:
         """Counting barrier: arrive, then wait for the release key.

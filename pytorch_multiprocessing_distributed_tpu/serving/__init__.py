@@ -18,11 +18,11 @@ CLI: repo-root ``serve_lm.py``.
 from .engine import ServingEngine
 from .kv_slots import SlotPool
 from .params import init_params, load_params
-from .scheduler import (FIFOScheduler, PrefillPlan, QueueFull, Request,
-                        bucket_length, pick_horizon)
+from .scheduler import (DONE, FAILED, FIFOScheduler, PrefillPlan,
+                        QueueFull, Request, bucket_length, pick_horizon)
 
 __all__ = [
     "ServingEngine", "SlotPool", "FIFOScheduler", "PrefillPlan",
     "QueueFull", "Request", "bucket_length", "init_params",
-    "load_params", "pick_horizon",
+    "load_params", "pick_horizon", "DONE", "FAILED",
 ]

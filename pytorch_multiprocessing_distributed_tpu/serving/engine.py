@@ -68,6 +68,23 @@ positions in place of the scan counter. With ``mesh`` the caches and
 attention shard over the ``model`` axis exactly like TP ``generate`` —
 single-host TP serving (XLA attention path; the Pallas kernel is
 single-shard).
+
+**Fault domains (graftfault).** Every host-side hazard point registers
+a named injection site (``runtime.faults``) and runs under bounded
+retry: transient failures of per-request work (prefill, chunk, insert)
+quarantine JUST that request — evicted as FAILED with its error, its
+slot's device gates scrubbed and the slot recycled — while engine-wide
+work (decode dispatch, readback) fails fast with a named
+``GraftFaultError`` once retries exhaust. A recovered fault opens a
+cooldown during which the adaptive horizon collapses to 1 (smaller
+blast radius), the bounded queue sheds load under pressure, and every
+absorbed fault is visible in ``ServingMetrics`` (``dispatch_retries``,
+``requests_failed``, ``requests_shed``, ``watchdog_trips``,
+``horizon_collapses``). The headline invariant is the fault matrix's:
+under any single injected fault, every unaffected request's tokens are
+byte-identical to the fault-free run (``tests/test_graftfault.py``).
+Disarmed cost is one module-global read per hazard point — no extra
+compiles, transfers, or host syncs (sentinel-pinned).
 """
 
 from __future__ import annotations
@@ -85,14 +102,45 @@ from ..analysis.sentinels import expected_transfer
 from ..inference.generate import (
     _LN_EPS, _block_chunk_prefill, _decode_horizon, _embed_at,
     _logits, _make_cs, _prefill, _sample)
+from ..runtime.faults import (DeadlineExceeded, FaultInjected,
+                              FaultTimeout, GraftFaultError,
+                              PoolPoisonedError, maybe_fault,
+                              register_site, retry_with_backoff,
+                              run_with_timeout)
 from ..utils.compile_cache import (jit_cache_keys, jit_cache_size,
                                    record_jit_key)
 from ..utils.metrics import ServingMetrics
 from .kv_slots import SlotPool
-from .scheduler import (DONE, FIFOScheduler, PrefillPlan, Request,
-                        bucket_length, pick_horizon)
+from .scheduler import (DONE, FAILED, FIFOScheduler, PrefillPlan,
+                        QueueFull, Request, bucket_length, pick_horizon)
 
 __all__ = ["ServingEngine", "Request"]
+
+# graftfault injection sites: the serving engine's hazard points, one
+# per distinct failure domain the fault-matrix suite must prove
+# recoverable (or fail-fast). Registered next to the code that calls
+# maybe_fault — an unregistered hazard is invisible to the sweep.
+_SITE_DISPATCH = register_site(
+    "serving.decode_dispatch",
+    "fused decode-horizon dispatch (the engine's hot XLA launch)")
+_SITE_READBACK = register_site(
+    "serving.horizon_readback",
+    "token-block readback sync at horizon drain (the step's ONE host "
+    "sync; watchdog-bounded when readback_timeout_s is set)")
+_SITE_PREFILL = register_site(
+    "serving.prefill",
+    "whole-prompt prefill-on-join + first-token readback")
+_SITE_CHUNK = register_site(
+    "serving.prefill_chunk",
+    "one [1, chunk] incremental-prefill step of a joining prompt")
+_SITE_TOK0 = register_site(
+    "serving.prefill_tok0",
+    "first-token sample + readback after the LAST prefill chunk (the "
+    "chunked path's TTFT boundary; the whole-prompt path's is inside "
+    "serving.prefill)")
+_SITE_INSERT = register_site(
+    "serving.slot_insert",
+    "slot splice of a prefilled request (cache columns + finish gates)")
 
 
 class _TokenBlock:
@@ -179,6 +227,27 @@ class ServingEngine:
         shard TPU, XLA elsewhere; ``"pallas"`` with a mesh is
         rejected).
       decode_block_k: K/V block size the Pallas decode kernel streams.
+      dispatch_retries: bounded attempts for transient (OSError-family,
+        incl. injected) failures of the engine's host-side operations
+        — decode dispatch, readback, prefill, chunk, insert. Engine-
+        wide operations (dispatch/readback) that stay broken after the
+        attempts fail fast with a named ``GraftFaultError``; per-
+        request operations quarantine the request instead (evicted as
+        FAILED with its error, slot scrubbed and recycled — the engine
+        keeps serving everyone else). 1 = no retries.
+      retry_backoff_s: first-retry delay (doubles per retry).
+      readback_timeout_s: optional watchdog bound on ONE horizon
+        token-block readback attempt (retry backoff between transient
+        failures is never charged against it). None (default) = no
+        watchdog thread on the hot path; set it to detect a HUNG
+        readback (device/runtime wedge) and fail fast with a
+        ``FaultTimeout`` instead of sitting forever. Counted in
+        ``ServingMetrics.watchdog_trips``.
+      fault_cooldown: decode dispatches for which the adaptive horizon
+        collapses to 1 after a recovered transient fault (graceful
+        degradation: smaller blast radius + faster drain while the
+        fault domain is suspect); each forced collapse is counted in
+        ``ServingMetrics.horizon_collapses``.
     """
 
     def __init__(self, model, params, *, max_slots: int,
@@ -190,7 +259,11 @@ class ServingEngine:
                  decode_buckets: Optional[Sequence[int]] = None,
                  prefill_chunk: Optional[int] = None,
                  decode_horizon: int = 1,
-                 decode_attn: str = "auto", decode_block_k: int = 256):
+                 decode_attn: str = "auto", decode_block_k: int = 256,
+                 dispatch_retries: int = 3,
+                 retry_backoff_s: float = 0.02,
+                 readback_timeout_s: Optional[float] = None,
+                 fault_cooldown: int = 8):
         if getattr(model, "seq_axis", None) is not None:
             raise NotImplementedError(
                 "the engine wants the dense view of an SP model — pass "
@@ -230,6 +303,16 @@ class ServingEngine:
         if decode_horizon < 1:
             raise ValueError(
                 f"decode_horizon must be >= 1, got {decode_horizon}")
+        if dispatch_retries < 1:
+            raise ValueError(
+                f"dispatch_retries must be >= 1, got {dispatch_retries}")
+        if readback_timeout_s is not None and readback_timeout_s <= 0:
+            raise ValueError(
+                f"readback_timeout_s must be > 0, got "
+                f"{readback_timeout_s}")
+        if fault_cooldown < 0:
+            raise ValueError(
+                f"fault_cooldown must be >= 0, got {fault_cooldown}")
         self.model = model
         self.params = params
         self.mesh = mesh
@@ -257,11 +340,22 @@ class ServingEngine:
                            else "xla")
         self._attn_impl = decode_attn
         self._decode_block_k = int(decode_block_k)
+        self._dispatch_retries = int(dispatch_retries)
+        self._retry_backoff_s = float(retry_backoff_s)
+        self._readback_timeout_s = (None if readback_timeout_s is None
+                                    else float(readback_timeout_s))
+        self._cooldown_steps = int(fault_cooldown)
+        self._cooldown = 0  # dispatches left in the post-fault window
+        # sticky: flips True at the first deadline-bearing submission,
+        # so deadline-free serving (the default) never pays the
+        # per-step queue + running scan in _expire_deadlines
+        self._deadlines_seen = False
         self._step_idx = 0
         self._key_idx = 0  # one fresh fold per sampled program call
         # donation keeps one resident cache copy per step on TPU; the
         # CPU backend lacks donation and would warn every call
         donate_cache = (jax.default_backend() != "cpu")
+        self._donate_cache = donate_cache
         # explicit out_shardings pin every program's outputs to the
         # pool's own placements — otherwise GSPMD's (normalized) output
         # sharding differs from the first call's input sharding and the
@@ -276,9 +370,10 @@ class ServingEngine:
             prefill_out = (rep, cache_sh, cache_sh)
             chunk_out = (rep, cache_sh, cache_sh)
             tok0_out = rep
+            evict_out = (rep, rep)
         else:
             decode_out = insert_out = prefill_out = None
-            chunk_out = tok0_out = None
+            chunk_out = tok0_out = evict_out = None
         self._decode = jax.jit(
             self._make_decode_horizon(), out_shardings=decode_out,
             static_argnames=("window", "horizon"),
@@ -294,6 +389,13 @@ class ServingEngine:
             self._insert_fn, out_shardings=insert_out,
             donate_argnums=(0, 1, 2, 3, 4, 5, 6) if donate_cache
             else ())
+        # quarantine/deadline eviction: clear a slot's on-device finish
+        # gates so the frozen row stops advancing. Compiled lazily on
+        # the FIRST eviction — the fault-free path never traces it
+        # (disarmed-cost pin: the sentinel compile budgets don't move)
+        self._evict_jit = jax.jit(
+            self._evict_fn, out_shardings=evict_out,
+            donate_argnums=(0, 1) if donate_cache else ())
 
     def _build_buckets(self, decode_buckets) -> Tuple[int, ...]:
         """Normalize the decode-window ladder: ascending, capped by and
@@ -456,6 +558,146 @@ class ServingEngine:
         return (k_caches, v_caches, positions, last_tokens, active,
                 budgets, eos_ids)
 
+    @staticmethod
+    def _evict_fn(active, budgets, slot):
+        """Scrub one slot's on-device finish gates (quarantine /
+        deadline eviction): the row freezes exactly like an EOS'd one
+        — masked every step, its stale KV columns invisible until the
+        next tenant's insert overwrites them (the same invariant slot
+        recycling already rests on — a quarantined request is never
+        resurrected with stale cache state)."""
+        return active.at[slot].set(False), budgets.at[slot].set(0)
+
+    # ---- fault domains (graftfault) -----------------------------------
+    def _donated(self, fn):
+        """Execute a jitted program whose inputs DONATE the pool's
+        arrays (``_decode``/``_insert_jit``/``_evict_jit`` on TPU).
+        Once the launch starts, the donated buffers are consumed — a
+        mid-execution failure (XlaRuntimeError, device OOM, even an
+        OSError-shaped one) leaves the pool unusable for EVERY
+        resident request, not just the one being worked on, so it is
+        classified as the engine-fatal named ``PoolPoisonedError``:
+        quarantine would keep "serving" from deleted buffers and a
+        retry would replay against them. Injected faults fire BEFORE
+        this wrapper (nothing is donated yet) and keep their
+        transient/retry semantics; the CPU backend never donates, so
+        there ordinary per-request classification applies."""
+        if not self._donate_cache:
+            return fn()
+        try:
+            return fn()
+        except GraftFaultError:
+            raise
+        except Exception as e:
+            raise PoolPoisonedError(
+                "a pool-donating program failed mid-execution "
+                f"({type(e).__name__}: {e}); the KV slot pool's "
+                "buffers are consumed — discard this engine replica "
+                "(and the requests it held), it cannot keep serving"
+            ) from e
+
+    def _attempted(self, fn):
+        """Run one host-side operation under the engine's bounded
+        retry policy (transient OSError-family failures only — incl.
+        injected ``FaultInjected``); every absorbed retry is counted
+        and opens the post-fault horizon-collapse cooldown."""
+        return retry_with_backoff(
+            fn, attempts=self._dispatch_retries,
+            base_delay_s=self._retry_backoff_s,
+            on_retry=self._note_retry)
+
+    def _note_retry(self, attempt: int, exc: BaseException) -> None:
+        self.metrics.record_retry()
+        self._cooldown = self._cooldown_steps
+
+    def _attempted_engine(self, fn, what: str):
+        """Engine-wide operations (decode dispatch, readback): retries
+        exhausted means the whole fault domain is down — fail FAST
+        with a named error, never a hang or a stale engine."""
+        try:
+            return self._attempted(fn)
+        except GraftFaultError:
+            raise
+        except OSError as e:
+            raise GraftFaultError(
+                f"{what} still failing after {self._dispatch_retries} "
+                f"attempt(s): {type(e).__name__}: {e}") from e
+
+    def _quarantine(self, request: Request, error: BaseException,
+                    reason: str = "error",
+                    slot: Optional[int] = None) -> None:
+        """Evict one request as FAILED with its error recorded. If it
+        holds a slot, the slot's device gates are scrubbed and the
+        slot is recycled; tokens it may still emit from already-
+        dispatched horizons are dropped at drain (the ``_running``
+        identity check). The engine keeps serving everyone else."""
+        if slot is None:
+            slot = request.slot
+        if slot is not None:
+            self._scrub_slot(slot)
+            if self._running.get(slot) is request:
+                del self._running[slot]
+            self.pool.release(slot)
+        self.scheduler.fail(request, error, reason)
+        request.finish_time = time.perf_counter()
+        self.metrics.record_failure()
+
+    def _poisoned(self, request: Request, error: BaseException,
+                  slot: Optional[int] = None) -> None:
+        """Classify a per-request failure: transient classes (retries
+        already exhausted) and ordinary exceptions quarantine the
+        request; a FATAL injected/declared fault propagates — the
+        fail-fast half of the contract."""
+        if (isinstance(error, GraftFaultError)
+                and not isinstance(error, (FaultInjected,
+                                           DeadlineExceeded))):
+            raise error
+        self._quarantine(request, error, slot=slot)
+
+    def _scrub_slot(self, slot: int) -> None:
+        pool = self.pool
+        with expected_transfer("slot-scrub control upload on "
+                               "quarantine/eviction (scalar H2D, "
+                               "fault path only)"):
+            pool.active, pool.budgets = self._donated(
+                lambda: self._evict_jit(
+                    pool.active, pool.budgets, jnp.int32(slot)))
+
+    def _expire_deadlines(self) -> None:
+        """Fail every request past its per-request deadline — queued,
+        mid-chunked-prefill, or running (evicted + slot scrubbed).
+        Free when no deadline-bearing request was ever submitted (the
+        default config): the sticky flag skips the per-step scans."""
+        if not self._deadlines_seen:
+            return
+        now = time.perf_counter()
+        for request in self.scheduler.expire(now):
+            self._quarantine(
+                request,
+                DeadlineExceeded(
+                    f"request {request.uid} exceeded its "
+                    f"{request.deadline_s:.3g}s deadline in the queue"),
+                reason="deadline")
+        pend = self._pending
+        if pend is not None and pend.request.overdue(now):
+            self._pending = None
+            self._quarantine(
+                pend.request,
+                DeadlineExceeded(
+                    f"request {pend.request.uid} exceeded its "
+                    f"{pend.request.deadline_s:.3g}s deadline "
+                    f"mid-chunked-prefill"),
+                reason="deadline")
+        for slot, request in list(self._running.items()):
+            if request.overdue(now):
+                self._quarantine(
+                    request,
+                    DeadlineExceeded(
+                        f"request {request.uid} exceeded its "
+                        f"{request.deadline_s:.3g}s deadline after "
+                        f"{len(request.tokens)} token(s)"),
+                    reason="deadline", slot=slot)
+
     # ---- compile counters ---------------------------------------------
     @property
     def decode_step_compiles(self) -> int:
@@ -504,27 +746,72 @@ class ServingEngine:
 
     # ---- request lifecycle --------------------------------------------
     def submit(self, prompt: Sequence[int], max_new_tokens: int, *,
-               eos_id: Optional[int] = None, uid=None) -> Request:
+               eos_id: Optional[int] = None, uid=None,
+               deadline_s: Optional[float] = None) -> Request:
         """Queue a request (FIFO). Raises ValueError when it can never
-        fit a slot, ``QueueFull`` at the queue bound."""
+        fit a slot, ``QueueFull`` at the queue bound. ``deadline_s``
+        bounds the request's total wall time from submission; past it
+        the engine evicts it as FAILED (``DeadlineExceeded``)."""
         request = Request(prompt, max_new_tokens,
                           self.eos_id if eos_id is None else eos_id,
-                          uid)
+                          uid, deadline_s=deadline_s)
         return self.enqueue(request)
+
+    def submit_retrying(self, prompt: Sequence[int],
+                        max_new_tokens: int, *, attempts: int = 8,
+                        backoff_s: float = 0.0,
+                        eos_id: Optional[int] = None, uid=None,
+                        deadline_s: Optional[float] = None,
+                        events_out: Optional[list] = None) -> Request:
+        """The tested retry path behind ``QueueFull``'s "shed load or
+        retry" advice: bounded retry-with-backoff that STEPS the
+        engine between attempts, so the bounded queue can actually
+        drain instead of spinning on a full one. The request keeps its
+        first attempt's ``submit_time`` (TTFT includes backpressure
+        wait); the final ``QueueFull`` propagates — bounded means
+        bounded, and every rejected attempt is already counted in
+        ``ServingMetrics.requests_shed``.
+
+        The drain steps produce token events like any other
+        :meth:`step` — an event-driven caller passes ``events_out``
+        (appended in order) or those completions would be invisible to
+        its own event loop; callers that track request state instead
+        can ignore it."""
+        request = Request(prompt, max_new_tokens,
+                          self.eos_id if eos_id is None else eos_id,
+                          uid, deadline_s=deadline_s)
+
+        def drain_a_step(attempt: int, exc: BaseException) -> None:
+            events = self.step()
+            if events_out is not None:
+                events_out.extend(events)
+
+        return retry_with_backoff(
+            lambda: self.enqueue(request), attempts=attempts,
+            base_delay_s=backoff_s, retry_on=(QueueFull,),
+            on_retry=drain_a_step)
 
     def enqueue(self, request: Request) -> Request:
         """Queue a pre-built :class:`Request`. ``submit_time`` is
         stamped on the FIRST attempt and survives ``QueueFull`` retries,
-        so TTFT honestly includes backpressure wait."""
+        so TTFT honestly includes backpressure wait. Every rejection at
+        the queue bound is counted (``requests_shed``) — load-shedding
+        is part of the degradation ladder, not a silent drop."""
         if request.submit_time is None:
             request.submit_time = time.perf_counter()
+        if request.deadline_s is not None:
+            self._deadlines_seen = True
         if request.prompt and (
                 min(request.prompt) < 0
                 or max(request.prompt) >= self.model.vocab_size):
             raise ValueError(
                 f"prompt token ids must be in [0, vocab_size="
                 f"{self.model.vocab_size})")
-        return self.scheduler.submit(request)
+        try:
+            return self.scheduler.submit(request)
+        except QueueFull:
+            self.metrics.record_shed()
+            raise
 
     def _next_key(self) -> jax.Array:
         """Per-call PRNG key (sampling only; greedy programs take the
@@ -598,17 +885,32 @@ class ServingEngine:
             padded = np.zeros((1, bucket), np.int32)
             padded[0, :length] = request.prompt
             key = self._next_key()
-            with expected_transfer("prompt upload + first-token "
-                                   "readback (the TTFT boundary)"):
-                tok0, k_pref, v_pref = self._prefill_jit(
-                    self.params, jnp.asarray(padded), jnp.int32(length),
-                    key)
-                record_jit_key(self._prefill_jit, ("prefill", bucket))
-                tok0_host = int(tok0)
+
+            def prefill_once():
+                maybe_fault(_SITE_PREFILL)
+                with expected_transfer("prompt upload + first-token "
+                                       "readback (the TTFT boundary)"):
+                    tok0, k_pref, v_pref = self._prefill_jit(
+                        self.params, jnp.asarray(padded),
+                        jnp.int32(length), key)
+                    record_jit_key(self._prefill_jit,
+                                   ("prefill", bucket))
+                    return tok0, k_pref, v_pref, int(tok0)
+
+            try:
+                tok0, k_pref, v_pref, tok0_host = self._attempted(
+                    prefill_once)
+            except Exception as e:
+                self._poisoned(request, e)
+                continue
             slot = self._first_token(request, tok0_host, events)
             if slot is None:
                 continue
-            self._insert(request, slot, k_pref, v_pref, length, tok0)
+            try:
+                self._insert(request, slot, k_pref, v_pref, length,
+                             tok0)
+            except Exception as e:
+                self._poisoned(request, e, slot=slot)
         return events
 
     def _insert(self, request: Request, slot: int, k_pref, v_pref,
@@ -618,16 +920,26 @@ class ServingEngine:
         prefill token is already appended, so ``max_new_tokens - 1``)."""
         pool = self.pool
         eos = -1 if request.eos_id is None else int(request.eos_id)
-        with expected_transfer("slot/length/budget control upload at "
-                               "admission (scalar H2D)"):
-            (pool.k_caches, pool.v_caches, pool.positions,
-             pool.last_tokens, pool.active, pool.budgets,
-             pool.eos_ids) = self._insert_jit(
-                pool.k_caches, pool.v_caches, pool.positions,
-                pool.last_tokens, pool.active, pool.budgets,
-                pool.eos_ids, k_pref, v_pref, jnp.int32(slot),
-                jnp.int32(length), tok0,
-                jnp.int32(request.max_new_tokens - 1), jnp.int32(eos))
+
+        def insert_once():
+            # the injected site fires BEFORE the jitted call, so a
+            # retried injection never re-runs against donated buffers;
+            # a real mid-call failure consumed the donated pool —
+            # _donated classifies it engine-fatal (PoolPoisonedError)
+            maybe_fault(_SITE_INSERT)
+            with expected_transfer("slot/length/budget control upload "
+                                   "at admission (scalar H2D)"):
+                return self._donated(lambda: self._insert_jit(
+                    pool.k_caches, pool.v_caches, pool.positions,
+                    pool.last_tokens, pool.active, pool.budgets,
+                    pool.eos_ids, k_pref, v_pref, jnp.int32(slot),
+                    jnp.int32(length), tok0,
+                    jnp.int32(request.max_new_tokens - 1),
+                    jnp.int32(eos)))
+
+        (pool.k_caches, pool.v_caches, pool.positions,
+         pool.last_tokens, pool.active, pool.budgets,
+         pool.eos_ids) = self._attempted(insert_once)
         pool.note_insert(slot, length)
 
     def _admit_chunked(self) -> List[Tuple[Request, int, bool]]:
@@ -653,27 +965,56 @@ class ServingEngine:
         chunk = pend.plan.chunk
         padded = np.zeros((1, chunk), np.int32)
         padded[0, :valid] = pend.request.prompt[start:start + valid]
-        with expected_transfer("chunk upload (fixed [1, chunk] shape)"):
-            x, pend.k_pref, pend.v_pref = self._chunk_jit(
-                self.params, pend.k_pref, pend.v_pref,
-                jnp.asarray(padded), jnp.int32(start))
+
+        def chunk_once():
+            # site before the jitted call (donated prefill caches):
+            # injected retries are always safe, see _insert's note
+            maybe_fault(_SITE_CHUNK)
+            with expected_transfer("chunk upload (fixed [1, chunk] "
+                                   "shape)"):
+                return self._chunk_jit(
+                    self.params, pend.k_pref, pend.v_pref,
+                    jnp.asarray(padded), jnp.int32(start))
+
+        try:
+            x, pend.k_pref, pend.v_pref = self._attempted(chunk_once)
+        except Exception as e:
+            self._pending = None
+            self._poisoned(pend.request, e)
+            return events
         record_jit_key(self._chunk_jit,
                        ("prefill_chunk", chunk, pend.plan.width))
         if not is_last:
             return events
         self._pending = None
         key = self._next_key()
-        with expected_transfer("first-token readback (the TTFT "
-                               "boundary)"):
-            tok0 = self._tok0_jit(self.params, x,
-                                  jnp.int32(pend.plan.length - 1 - start),
-                                  key)
-            tok0_host = int(tok0)
+
+        def tok0_once():
+            # same fault domain as the whole-prompt path's first-token
+            # readback (there it lives inside serving.prefill):
+            # per-request work — retry, then quarantine just this
+            # request. _tok0_jit donates nothing, so retries are safe.
+            maybe_fault(_SITE_TOK0)
+            with expected_transfer("first-token readback (the TTFT "
+                                   "boundary)"):
+                t = self._tok0_jit(
+                    self.params, x,
+                    jnp.int32(pend.plan.length - 1 - start), key)
+                return t, int(t)
+
+        try:
+            tok0, tok0_host = self._attempted(tok0_once)
+        except Exception as e:
+            self._poisoned(pend.request, e)
+            return events
         slot = self._first_token(pend.request, tok0_host, events)
         if slot is None:
             return events
-        self._insert(pend.request, slot, pend.k_pref, pend.v_pref,
-                     pend.plan.length, tok0)
+        try:
+            self._insert(pend.request, slot, pend.k_pref, pend.v_pref,
+                         pend.plan.length, tok0)
+        except Exception as e:
+            self._poisoned(pend.request, e, slot=slot)
         return events
 
     # ---- horizon scheduling / dispatch / drain ------------------------
@@ -713,19 +1054,40 @@ class ServingEngine:
                              or self._pending is not None)
         h = pick_horizon(self._horizon_max, window, max_eff,
                          self._min_remaining_eff(), admission_pending)
+        if self._cooldown > 0:
+            # post-fault degradation: smaller blast radius per dispatch
+            # (one token's work lost on a repeat, not a horizon's) and
+            # faster drain while the fault domain is suspect
+            self._cooldown -= 1
+            if h > 1:
+                h = 1
+                self.metrics.record_horizon_collapse()
         return window, h
 
     def _dispatch(self, overlapped: bool = False) -> None:
         """Launch one fused decode horizon (no host sync — the token
-        block stays on device in ``self._blocks`` until drained)."""
+        block stays on device in ``self._blocks`` until drained).
+        Transient dispatch failures are retried (the injected site
+        fires before the XLA launch, so nothing is donated on a
+        retried injection); exhaustion fails fast with a named
+        ``GraftFaultError`` — the dispatch domain covers every
+        resident slot, so there is no single request to quarantine."""
         pool = self.pool
         window, h = self._pick_schedule()
         key = self._next_key()
+
+        def launch():
+            maybe_fault(_SITE_DISPATCH)
+            return self._donated(lambda: self._decode(
+                self.params, pool.k_caches, pool.v_caches,
+                pool.positions, pool.last_tokens, pool.active,
+                pool.budgets, pool.eos_ids, key, window=window,
+                horizon=h))
+
         (tokens, pool.k_caches, pool.v_caches, pool.positions,
-         pool.last_tokens, pool.active, pool.budgets) = self._decode(
-            self.params, pool.k_caches, pool.v_caches, pool.positions,
-            pool.last_tokens, pool.active, pool.budgets, pool.eos_ids,
-            key, window=window, horizon=h)
+         pool.last_tokens, pool.active,
+         pool.budgets) = self._attempted_engine(launch,
+                                                "decode dispatch")
         record_jit_key(self._decode, ("decode", window, h))
         self._blocks.append(
             _TokenBlock(tokens, h, window, dict(self._running)))
@@ -755,9 +1117,38 @@ class ServingEngine:
         ``(window, tokens_emitted)``."""
         pool = self.pool
         block = self._blocks.popleft()
-        with expected_transfer("per-horizon token-block readback (the "
-                               "horizon's ONE host sync)"):
-            tokens = np.asarray(block.tokens)
+
+        def readback():
+            maybe_fault(_SITE_READBACK)
+            with expected_transfer("per-horizon token-block readback "
+                                   "(the horizon's ONE host sync)"):
+                return np.asarray(block.tokens)
+
+        def attempt():
+            if self._readback_timeout_s is None:
+                return readback()
+            # watchdog: a WEDGED readback (device/runtime hang) raises
+            # a named FaultTimeout instead of blocking the engine
+            # forever — the failure mode retries cannot see because
+            # nothing ever returns. Bounds ONE attempt, inside the
+            # retry ladder, so backoff sleeps between transient
+            # failures are never charged against the hang budget (a
+            # FaultTimeout is not OSError-shaped, so it propagates
+            # un-retried — a hang fails fast, a flake retries).
+            try:
+                return run_with_timeout(
+                    readback, self._readback_timeout_s,
+                    "horizon token-block readback",
+                    hint="the device never delivered the block "
+                         "(wedged runtime or an injected hang); the "
+                         "engine fails fast rather than serving stale "
+                         "state.")
+            except FaultTimeout:
+                self.metrics.record_watchdog_trip()
+                raise
+
+        tokens = self._attempted_engine(attempt,
+                                        "horizon token-block readback")
         realized: Dict[int, int] = {}
         for h in range(block.h):
             for slot, request in block.slots.items():
@@ -788,7 +1179,9 @@ class ServingEngine:
         horizon before this one's readback — the overlap), then drain
         exactly one token block. Returns the iteration's token events
         as ``(request, token, finished)`` tuples (admission first
-        tokens included)."""
+        tokens included; a quarantined request emits no event — read
+        its ``state``/``error``)."""
+        self._expire_deadlines()
         events = self._admit()
         pool = self.pool
         if self._running or self._blocks:
@@ -824,12 +1217,15 @@ class ServingEngine:
     def serve(self, requests: Iterable[Tuple[Sequence[int], int]]
               ) -> List[Request]:
         """Convenience batch API: submit ``(prompt, max_new_tokens)``
-        pairs, run to drain, return the finished ``Request`` records in
-        submission order."""
+        pairs, run to drain, return the ``Request`` records in
+        submission order. Every record comes back terminal: ``DONE``,
+        or ``FAILED`` with the cause on ``request.error`` (quarantined
+        / deadline-evicted requests are reported, not hidden — check
+        ``state`` when a fault plan or deadlines are in play)."""
         submitted = [self.submit(p, n) for p, n in requests]
         for _ in self.run():
             pass
-        assert all(r.state == DONE for r in submitted)
+        assert all(r.state in (DONE, FAILED) for r in submitted)
         return submitted
 
 

@@ -144,7 +144,12 @@ class SlotPool:
         """Return ``slot`` to the free list. The device-side active
         flag is already False by the time a slot is released: the
         fused decode scan clears it on-device when the row's EOS or
-        budget gate fires (there is no separate release program)."""
+        budget gate fires (there is no separate release program), and
+        the engine's quarantine/deadline eviction path scrubs it
+        explicitly (``ServingEngine._evict_fn``) BEFORE releasing — a
+        failed request's row freezes like an EOS'd one and its stale
+        KV columns stay masked until the next tenant's insert
+        overwrites them (never resurrected with stale cache state)."""
         if slot in self._free or not 0 <= slot < self.max_slots:
             raise ValueError(f"bad release of slot {slot}")
         self._free.append(slot)

@@ -119,13 +119,19 @@ class PrefillPlan:
 
 class QueueFull(RuntimeError):
     """Raised by ``submit`` when the bounded queue is at capacity —
-    the engine's backpressure signal (callers shed load or retry)."""
+    the engine's backpressure signal. Callers shed load or retry:
+    the real, tested retry path is
+    :meth:`~.engine.ServingEngine.submit_retrying` (bounded
+    retry-with-backoff that steps the engine between attempts so the
+    queue can actually drain); every shed is counted in
+    ``ServingMetrics.requests_shed``."""
 
 
 # request lifecycle states
 QUEUED = "queued"
 RUNNING = "running"
 DONE = "done"
+FAILED = "failed"
 
 _uid_counter = itertools.count()
 
@@ -144,23 +150,39 @@ class Request:
       (TTFT = ``first_token_time - submit_time``, queue wait =
       ``admit_time - submit_time`` — TTFT deliberately INCLUDES the
       queue wait; the two stats split where the latency came from);
-    - ``finish_reason``: ``"eos"`` or ``"length"`` once DONE.
+    - ``finish_reason``: ``"eos"`` or ``"length"`` once DONE, or the
+      fault-domain reasons once FAILED (``"error"`` for a poisoned
+      request, ``"deadline"`` for an expired one) with the causing
+      exception recorded in ``error`` — a quarantined request reports
+      WHAT killed it instead of taking the engine down with it;
+    - ``deadline_s``: optional wall-clock budget from ``submit_time``;
+      past it the engine evicts the request (queued or running) as
+      FAILED with a :class:`~..runtime.faults.DeadlineExceeded`.
     """
 
     def __init__(self, prompt: Sequence[int], max_new_tokens: int,
-                 eos_id: Optional[int] = None, uid=None):
+                 eos_id: Optional[int] = None, uid=None,
+                 deadline_s: Optional[float] = None):
         self.prompt = list(int(t) for t in prompt)
         self.max_new_tokens = int(max_new_tokens)
         self.eos_id = None if eos_id is None else int(eos_id)
         self.uid = next(_uid_counter) if uid is None else uid
+        self.deadline_s = None if deadline_s is None else float(deadline_s)
         self.state = QUEUED
         self.tokens: List[int] = []
         self.slot: Optional[int] = None
+        self.error: Optional[BaseException] = None
         self.submit_time: Optional[float] = None
         self.admit_time: Optional[float] = None
         self.first_token_time: Optional[float] = None
         self.finish_time: Optional[float] = None
         self.finish_reason: Optional[str] = None
+
+    def overdue(self, now: float) -> bool:
+        """Past the per-request deadline (False when none is set)."""
+        return (self.deadline_s is not None
+                and self.submit_time is not None
+                and now - self.submit_time > self.deadline_s)
 
     def __repr__(self) -> str:
         return (f"Request(uid={self.uid}, state={self.state}, "
@@ -224,3 +246,22 @@ class FIFOScheduler:
         request.state = DONE
         request.finish_reason = reason
         request.slot = None
+
+    def fail(self, request: Request, error: BaseException,
+             reason: str = "error") -> None:
+        """Quarantine: the request leaves the engine as FAILED with its
+        error recorded — reported, never silently dropped, and never
+        re-admitted (the engine scrubs any slot it held)."""
+        request.state = FAILED
+        request.finish_reason = reason
+        request.error = error
+        request.slot = None
+
+    def expire(self, now: float) -> List[Request]:
+        """Remove and return QUEUED requests past their deadline (the
+        engine fails each one; RUNNING requests are the engine's own
+        eviction problem — it owns their slots)."""
+        overdue = [r for r in self._queue if r.overdue(now)]
+        for request in overdue:
+            self._queue.remove(request)
+        return overdue

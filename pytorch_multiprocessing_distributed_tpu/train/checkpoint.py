@@ -12,19 +12,50 @@ on rank 0 at the final epoch only, named ``model_{epoch}.pth``
 
 msgpack via ``flax.serialization`` rather than pickle: deterministic,
 framework-neutral bytes, no arbitrary-code-execution on load.
+
+Durability + integrity (graftfault hardening):
+
+- the write path is fsync'd on BOTH sides of the atomic rename (file
+  before ``os.replace``, parent directory after) — ``os.replace``
+  alone orders nothing on power loss, so "atomic" used to overpromise;
+- every checkpoint carries a sha256 sidecar (``model_N.pth.sha256``)
+  written from the exact bytes handed to the OS; :func:`load_checkpoint`
+  verifies it and a truncated/bit-flipped file raises
+  :class:`CheckpointCorruptError` NAMING the file and both digests
+  instead of failing deep inside msgpack (or worse, resuming from
+  garbage weights);
+- :func:`load_with_fallback` is the resume path that survives it:
+  newest checkpoint corrupt -> warn with the digest mismatch, fall
+  back to the previous valid epoch, resume there.
 """
 
 from __future__ import annotations
 
+import hashlib
 import os
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 from flax import serialization
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..parallel import dist
+from ..runtime.faults import GraftFaultError, maybe_fault, register_site
 from .state import TrainState
+
+# the torn/corrupt-artifact hazard the fault matrix sweeps: fires on
+# the serialized payload right before it reaches the OS, so an
+# injected corruption is caught by the digest verification exactly
+# like real bit rot would be
+_SITE_WRITE = register_site(
+    "train.checkpoint_write",
+    "msgpack checkpoint payload write + fsync + atomic rename")
+
+
+class CheckpointCorruptError(GraftFaultError):
+    """A checkpoint's bytes do not match its recorded sha256 digest
+    (torn write, bit rot, truncation). Names the file and both
+    digests; resume paths fall back to the previous valid epoch."""
 
 
 def _gather_for_host(tree):
@@ -55,10 +86,55 @@ def checkpoint_path(save_path: str, epoch: int) -> str:
     return os.path.join(save_path, "model_{0}.pth".format(epoch))
 
 
+def digest_path(path: str) -> str:
+    """Sidecar holding the checkpoint's sha256 (hex)."""
+    return path + ".sha256"
+
+
+def _fsync_dir(dirname: str) -> None:
+    """fsync a DIRECTORY so a just-renamed entry survives power loss
+    (the rename itself lives in the directory's metadata). Platforms
+    whose dirfds reject fsync (some network filesystems) degrade to
+    the rename-only guarantee."""
+    try:
+        fd = os.open(dirname, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:  # EINVAL on fsync-less dirfds: keep rename-only
+        pass
+    finally:
+        os.close(fd)
+
+
+def write_atomic_durable(path: str, payload: bytes) -> None:
+    """tmp-write -> fsync(file) -> atomic rename -> fsync(parent dir).
+
+    ``os.replace`` alone is atomic against CONCURRENT readers but
+    orders nothing against power loss: the data blocks and the rename
+    can reach disk in either order, so the old comment's "no torn
+    checkpoints" only held for clean exits. Both fsyncs make the
+    rename a real durability barrier."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _fsync_dir(os.path.dirname(os.path.abspath(path)))
+
+
 def save_checkpoint(save_path: str, state: TrainState, epoch: int) -> Optional[str]:
     """Write the state on the primary host; returns the path (None on
     non-primary hosts, which mirror the reference's rank-gating at
-    ``main.py:75``)."""
+    ``main.py:75``).
+
+    The sha256 of the serialized payload is written alongside
+    (``model_N.pth.sha256``), AFTER the checkpoint itself is durable —
+    a crash between the two leaves a valid checkpoint with no digest
+    (verified loads treat a missing sidecar as legacy, not corrupt),
+    never a digest pointing at torn bytes."""
     # Collective leaf replication first — ALL hosts participate even
     # though only the primary writes (see _gather_for_host).
     state = _gather_for_host(state)
@@ -67,17 +143,59 @@ def save_checkpoint(save_path: str, state: TrainState, epoch: int) -> Optional[s
     # Pull fully-addressable host copies off the devices.
     host_state = jax.device_get(state)
     payload = serialization.to_bytes(host_state)
+    digest = hashlib.sha256(payload).hexdigest()
     path = checkpoint_path(save_path, epoch)
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(payload)
-    os.replace(tmp, path)  # atomic: no torn checkpoints on preemption
+    # injected fault point: "corrupt" flips a payload byte AFTER the
+    # digest was computed — exactly what bit rot / a torn write does
+    written = maybe_fault(_SITE_WRITE, payload)
+    # re-save of the same epoch (preemption re-save, torn-epoch redo):
+    # drop the stale sidecar BEFORE replacing the checkpoint, so a
+    # crash between the two replaces degrades to "valid checkpoint, no
+    # digest" — never the old digest paired with the new payload
+    dpath = digest_path(path)
+    if os.path.exists(dpath):
+        os.remove(dpath)
+    write_atomic_durable(path, written)
+    write_atomic_durable(dpath, digest.encode("ascii"))
     return path
 
 
-def load_checkpoint(path: str, template: TrainState) -> TrainState:
+def verify_checkpoint(path: str, payload: Optional[bytes] = None) -> bool:
+    """Check ``path`` against its sha256 sidecar. True when they
+    match OR no sidecar exists (legacy checkpoint — nothing to verify
+    against); raises :class:`CheckpointCorruptError` on a mismatch.
+
+    ``payload``: the file's already-read bytes, so a verified load
+    hashes the SAME buffer it deserializes instead of reading a
+    multi-GB checkpoint twice (``load_with_fallback`` walks N
+    candidates per host)."""
+    dpath = digest_path(path)
+    if not os.path.exists(dpath):
+        return True
+    with open(dpath, "rb") as f:
+        expected = f.read().decode("ascii").strip()
+    if payload is None:
+        with open(path, "rb") as f:
+            payload = f.read()
+    actual = hashlib.sha256(payload).hexdigest()
+    if actual != expected:
+        raise CheckpointCorruptError(
+            f"checkpoint {path!r} is corrupt: sha256 {actual} does not "
+            f"match the recorded digest {expected} ({dpath}) — torn "
+            "write, truncation, or bit rot; falling back to the "
+            "previous checkpoint is the intended recovery")
+    return True
+
+
+def load_checkpoint(path: str, template: TrainState,
+                    verify: bool = True) -> TrainState:
     """Restore a checkpoint into the structure of ``template``
     (a freshly-initialized state with the same model/optimizer).
+
+    ``verify`` (default) checks the sha256 sidecar first: corrupt
+    bytes raise :class:`CheckpointCorruptError` naming the file and
+    digests instead of a cryptic msgpack unpack error (or a silent
+    garbage restore). Checkpoints without a sidecar load unverified.
 
     Forward-compatible with checkpoints written before a TrainState
     field existed (e.g. ``ema_params``): missing top-level fields keep
@@ -95,9 +213,22 @@ def load_checkpoint(path: str, template: TrainState) -> TrainState:
     optimizer starts fresh (torch SGD momentum buffers don't map onto
     this optimizer's tree), and the epoch keeps the template's value.
     """
-    import zipfile
-
-    if zipfile.is_zipfile(path):
+    # Sniff the torch-zip magic from the FIRST 4 BYTES before
+    # committing to a full read: load_torch_checkpoint re-reads from
+    # disk itself, so buffering a multi-GB archive here would double
+    # the I/O and transiently hold an extra copy. A msgpack state dict
+    # starts with a map-header byte, never ``PK\x03\x04``, so the
+    # prefix discriminates unambiguously. msgpack checkpoints are read
+    # ONCE: the digest check and the deserializer share the buffer.
+    with open(path, "rb") as f:
+        head = f.read(4)
+        is_torch_zip = head == b"PK\x03\x04"
+        payload = None if is_torch_zip else head + f.read()
+    if verify:
+        # torch zips never get a sidecar written (reference artifacts);
+        # verify_checkpoint re-reads the file only when one exists.
+        verify_checkpoint(path, payload=payload)
+    if is_torch_zip:
         from ..utils.torch_interop import load_torch_checkpoint
 
         params, stats = load_torch_checkpoint(
@@ -107,8 +238,6 @@ def load_checkpoint(path: str, template: TrainState) -> TrainState:
         if getattr(template, "ema_params", None):
             state = state.replace(ema_params=params)
         return state
-    with open(path, "rb") as f:
-        payload = f.read()
     state_dict = serialization.msgpack_restore(payload)
     template_dict = serialization.to_state_dict(template)
     if template_dict.get("ema_params") and not state_dict.get("ema_params"):
@@ -145,13 +274,121 @@ def prune_checkpoints(save_path: str, keep: int) -> None:
     if keep <= 0:
         return
     for _, name in sorted(_checkpoint_epochs(save_path))[:-keep]:
-        os.remove(os.path.join(save_path, name))
+        path = os.path.join(save_path, name)
+        os.remove(path)
+        # the digest sidecar lives and dies with its checkpoint
+        if os.path.exists(digest_path(path)):
+            os.remove(digest_path(path))
 
 
 def latest_checkpoint(save_path: str) -> Optional[str]:
     """Highest-epoch ``model_*.pth`` under ``save_path``, if any."""
     found = _checkpoint_epochs(save_path)
     return os.path.join(save_path, max(found)[1]) if found else None
+
+
+def checkpoint_epoch(path: str) -> Optional[int]:
+    """Epoch parsed from a ``model_<epoch>.pth`` path, else ``None``.
+
+    The inverse of the naming scheme :func:`_checkpoint_epochs`
+    decodes; ``--resume auto`` callers use it to turn the
+    primary-resolved path back into the ``anchor`` epoch for
+    :func:`load_with_fallback`."""
+    name = os.path.basename(path)
+    if name.startswith("model_") and name.endswith(".pth"):
+        try:
+            return int(name[len("model_"):-len(".pth")])
+        except ValueError:
+            pass
+    return None
+
+
+def load_with_fallback(save_path: str, template: TrainState, *,
+                       anchor: Optional[int] = None,
+                       ) -> Tuple[TrainState, str]:
+    """Resume from the newest VALID checkpoint under ``save_path``.
+
+    ``anchor``: cap the walk at this epoch (checkpoints newer than it
+    are ignored, not treated as candidates). ``--resume auto`` passes
+    the primary-resolved epoch here, so a STALE extra checkpoint on
+    one host (newer than what the primary resolved) cannot shift that
+    host's walk and get misdiagnosed as cross-host divergence.
+
+    The corrupt-checkpoint recovery path: walk checkpoints newest to
+    oldest, verify each digest, restore the first that passes —
+    reporting (stderr, primary host) every corrupt artifact skipped,
+    with its digest mismatch. Training then resumes at the fallback's
+    epoch (the restored ``state.epoch``; the torn epoch is redone,
+    exactly like a preemption resume). Raises the LAST
+    :class:`CheckpointCorruptError` when every checkpoint is corrupt,
+    ``FileNotFoundError`` when there are none.
+
+    Multi-host: digests verify against HOST-LOCAL bytes, so a corrupt
+    copy on one host must not silently shift just that host to an
+    older epoch — the split-brain :func:`resolve_auto_resume` exists
+    to prevent. After the walk, every host — including one whose walk
+    found nothing valid — reaches ONE agreement collective with its
+    verified epoch (``-1`` = exhausted), and on any divergence EVERY
+    host raises: an asymmetric check (peer dies, primary proceeds)
+    would leave the survivors wedged forever at their next training
+    collective instead of failing loudly.
+
+    Returns ``(state, path_loaded)``."""
+    found = _checkpoint_epochs(save_path)
+    if anchor is not None:
+        found = [(e, n) for e, n in found if e <= anchor]
+    last_err: Optional[CheckpointCorruptError] = None
+    chosen = None  # (epoch, path, state)
+    for epoch, name in sorted(found, reverse=True):
+        path = os.path.join(save_path, name)
+        try:
+            state = load_checkpoint(path, template)
+        except CheckpointCorruptError as e:
+            last_err = e
+            if dist.is_primary():
+                import sys
+
+                print(f"[pmdt] {e}\n[pmdt] falling back to the "
+                      "previous checkpoint", file=sys.stderr)
+            continue
+        chosen = (epoch, path, state)
+        break
+    _require_fallback_agreement(
+        -1 if chosen is None else chosen[0],
+        save_path if chosen is None else chosen[1])
+    if chosen is None:
+        if last_err is not None:
+            raise last_err
+        raise FileNotFoundError(
+            f"no model_*.pth checkpoints under {save_path!r}")
+    return chosen[2], chosen[1]
+
+
+def _require_fallback_agreement(epoch: int, path: str) -> None:
+    """Every host must fall back to the SAME epoch, or ALL die loudly.
+
+    Symmetric by construction: each host contributes its verified
+    epoch (``-1`` = walk exhausted) to one all-gather that every host
+    reaches exactly once, then applies the same unanimity check — so
+    divergence kills the whole job with a named error on every rank,
+    never a survivor hanging at its next collective."""
+    if jax.process_count() == 1:
+        return
+    import numpy as _np
+    from jax.experimental import multihost_utils
+
+    epochs = _np.asarray(
+        multihost_utils.process_allgather(_np.int32(epoch)))
+    if int(epochs.min()) == int(epochs.max()):
+        return
+    raise CheckpointCorruptError(
+        f"--resume auto fallback diverged across hosts: per-host "
+        f"verified epochs {epochs.tolist()} (this host, rank "
+        f"{dist.get_rank()}: epoch {epoch}, {path}; -1 = every local "
+        "copy corrupt). A newer checkpoint copy is corrupt on some "
+        "host — restore/re-sync save_path across hosts instead of "
+        "resuming split-brain (epoch-skewed save collectives "
+        "deadlock). Raised on EVERY rank so no host survives to hang")
 
 
 def resolve_auto_resume(save_path: str) -> Optional[str]:
@@ -177,12 +414,24 @@ def resolve_auto_resume(save_path: str) -> Optional[str]:
     if epoch < 0:
         return None
     match = [name for e, name in found if e == epoch]
-    if not match:
+    # symmetric presence check: EVERY host reaches this one all-gather
+    # and every host applies the same test, so a missing file kills
+    # the whole job loudly — a host raising alone (while the others
+    # proceed into load_with_fallback's agreement collective) would
+    # leave them wedged forever instead
+    import numpy as _np
+
+    has = _np.asarray(
+        multihost_utils.process_allgather(_np.int32(bool(match))))
+    if int(has.min()) == 0:
         raise FileNotFoundError(
             f"--resume auto: primary host resolved epoch {epoch} but "
-            f"this host (rank {dist.get_rank()}) has no matching "
-            f"model_*.pth under {save_path} — auto-resume across hosts "
-            "requires save_path on a SHARED filesystem (or pass an "
-            "explicit --resume path)"
+            f"{int((has == 0).sum())} host(s) have no matching "
+            f"model_*.pth under {save_path} (this host, rank "
+            f"{dist.get_rank()}: "
+            f"{'found' if match else 'missing'}) — auto-resume across "
+            "hosts requires save_path on a SHARED filesystem (or pass "
+            "an explicit --resume path). Raised on EVERY rank so no "
+            "host survives to hang at the next collective"
         )
     return os.path.join(save_path, match[0])

@@ -285,6 +285,11 @@ def make_lm_train_step(
         loss = jax.lax.psum(loss_sum, axes) / count
         grads = jax.tree.map(lambda g: jax.lax.psum(g, axes), grads)
 
+        # NaN/inf skip-and-count guard off the globally-summed grads
+        # (replicated — every shard agrees): see step.guard_nonfinite
+        from .step import finite_grads, guard_nonfinite
+
+        finite = finite_grads(grads)
         updates, new_opt = optimizer.update(
             grads, state.opt_state, state.params, lr_step=state.epoch
         )
@@ -293,6 +298,8 @@ def make_lm_train_step(
         metrics = {"loss": loss, "count": count}
         if is_moe:
             metrics["moe_aux"] = jax.lax.psum(aux, axes) / world
+        new_state, metrics = guard_nonfinite(finite, new_state, state,
+                                             metrics)
         return new_state, metrics
 
     if seq_axis is None:
@@ -370,6 +377,9 @@ def make_lm_train_step_tp(
         (_, (loss, aux)), grads = jax.value_and_grad(
             obj, has_aux=True
         )(state.params)
+        from .step import finite_grads, guard_nonfinite
+
+        finite = finite_grads(grads)
         updates, new_opt = optimizer.update(
             grads, state.opt_state, state.params, lr_step=state.epoch
         )
@@ -379,6 +389,8 @@ def make_lm_train_step_tp(
         metrics = {"loss": loss, "count": count}
         if is_moe:
             metrics["moe_aux"] = aux
+        new_state, metrics = guard_nonfinite(finite, new_state, state,
+                                             metrics)
         return new_state, metrics
 
     from .step import lazy_gspmd_jit

@@ -27,7 +27,15 @@ from typing import Optional
 
 import jax
 
+from ..runtime.faults import maybe_fault, register_site
 from .state import TrainState
+
+# the sharded-writer hazard point: a failed orbax commit must surface
+# as ITS error at the save call (orbax's manager keeps partial step
+# dirs out of all_steps(), so a failed save never becomes a resume
+# candidate — the fault matrix pins the fail-fast side here)
+_SITE_SAVE = register_site(
+    "train.orbax_save", "orbax sharded checkpoint save/commit")
 
 
 class OrbaxCheckpointer:
@@ -80,6 +88,7 @@ class OrbaxCheckpointer:
         # mid-flight is invisible to has_epoch, and a blind re-save of
         # it would raise StepAlreadyExistsError (observed shape: async
         # periodic save + SIGTERM re-saving the same resume point)
+        maybe_fault(_SITE_SAVE)
         self.manager.wait_until_finished()
         if self.has_epoch(epoch):
             self.manager.delete(epoch)

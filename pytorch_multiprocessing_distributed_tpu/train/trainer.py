@@ -324,6 +324,7 @@ class Trainer:
 
         self.train_loader.set_epoch(epoch)
         n_batches = len(self.train_loader)
+        skipped = 0  # steps the NaN/inf grad guard refused to apply
         pending = []  # device-resident metric dicts since the last fetch
         window_start = time.time()
         end = time.time()
@@ -339,6 +340,13 @@ class Trainer:
                 self._checkpoint_if_preempted(epoch)
                 fetched = jax.device_get(pending)  # the sync point
                 for m in fetched:
+                    # the guard's skip indicator rides the same windowed
+                    # fetch — a skipped step is VISIBLE, never silent,
+                    # and its metrics (the poisoned batch's, possibly
+                    # NaN) stay out of every meter
+                    if int(m.get("skipped", 0)):
+                        skipped += 1
+                        continue
                     losses.update(float(m["loss"]), int(m["count"]))
                     top1.update(float(m["prec1"]), int(m["count"]))
                 now = time.time()
@@ -361,6 +369,12 @@ class Trainer:
                     )
             end = time.time()
         if dist.is_primary():
+            if skipped:
+                print(
+                    f"Epoch [{epoch}]: NaN/inf grad guard skipped "
+                    f"{skipped}/{n_batches} step(s) (params carried "
+                    "through unchanged)"
+                )
             self.train_logger.write([epoch, losses.avg, top1.avg])
 
     # ---------------------------------------------------------------- eval

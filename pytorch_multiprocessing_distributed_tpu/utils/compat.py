@@ -104,7 +104,7 @@ def cost_analysis_dict(compiled):
     no cost model (never raises — callers treat cost as optional)."""
     try:
         analyses = compiled.cost_analysis()
-    except Exception:  # noqa: BLE001 — diagnostic-only surface
+    except Exception:  # noqa: BLE001  # graftlint: disable=GL111 cost model is optional; None IS the record
         return None
     if isinstance(analyses, (list, tuple)):
         analyses = analyses[0] if analyses else None
@@ -112,7 +112,7 @@ def cost_analysis_dict(compiled):
         return None
     try:
         return dict(analyses)
-    except Exception:  # noqa: BLE001
+    except Exception:  # noqa: BLE001  # graftlint: disable=GL111 diagnostic-only surface
         return None
 
 
@@ -130,5 +130,5 @@ def get_abstract_mesh():
 
         pm = _mesh_lib.thread_resources.env.physical_mesh
         return None if pm.empty else pm
-    except Exception:  # noqa: BLE001 — a hint, not semantics
+    except Exception:  # noqa: BLE001  # graftlint: disable=GL111 a hint, not semantics; None = no mesh context
         return None

@@ -43,7 +43,7 @@ def jit_cache_size(fn) -> int:
     """
     try:
         return int(fn._cache_size())
-    except Exception:  # noqa: BLE001 — counter is diagnostic-only
+    except Exception:  # noqa: BLE001  # graftlint: disable=GL111 counter is diagnostic-only; -1 = unavailable
         return -1
 
 
@@ -169,7 +169,7 @@ def enable_compilation_cache(
         # its subprocess probe, always passes platform_hint).
         try:
             plat = jax.default_backend()
-        except Exception:  # noqa: BLE001 — cache is best-effort
+        except Exception:  # noqa: BLE001  # graftlint: disable=GL111 cache is best-effort; empty platform falls through
             plat = ""
     if plat and plat.split(",")[0].strip().lower() == "cpu":
         return None
@@ -191,7 +191,7 @@ def enable_compilation_cache(
         from jax._src import compilation_cache as _cc
 
         _cc.reset_cache()
-    except Exception:  # noqa: BLE001 — private API; harmless to skip
+    except Exception:  # noqa: BLE001  # graftlint: disable=GL111 private API; harmless to skip
         pass
     try:
         # default min-compile-time gate (1 s) is tuned for huge fleets;

@@ -104,7 +104,20 @@ class ServingMetrics:
       the slot count should be tuned against);
     - ``queue_depth``: queued requests at each decode step (sustained
       > 0 means the pool, not the arrival rate, is the bottleneck);
-    - token/request counters for end-to-end tokens/sec.
+    - token/request counters for end-to-end tokens/sec;
+    - fault-domain counters (graftfault): ``dispatch_retries``
+      (transient errors recovered by bounded retry across EVERY
+      engine fault domain — dispatch, readback, prefill, chunk, tok0,
+      insert — one counter because they share one retry policy; the
+      name keeps the stable metrics surface),
+      ``requests_failed`` (poisoned/deadline-evicted requests
+      quarantined with their error), ``requests_shed`` (submissions
+      rejected at the queue bound — the load-shed half of the
+      degradation ladder), ``watchdog_trips`` (hung horizon readbacks
+      detected and failed fast), ``horizon_collapses`` (dispatches
+      forced to H=1 during a post-fault cooldown). A fault that is
+      absorbed must still be VISIBLE — silent recovery is how fleets
+      rot.
 
     All meters are host-side ``AverageMeter``s; ``snapshot()`` flattens
     them into the plain dict the CLI prints and the benchmark records.
@@ -123,6 +136,11 @@ class ServingMetrics:
         self.dispatches = 0
         self.host_syncs = 0
         self.overlapped_dispatches = 0
+        self.dispatch_retries = 0
+        self.requests_failed = 0
+        self.requests_shed = 0
+        self.watchdog_trips = 0
+        self.horizon_collapses = 0
         self._elapsed = 0.0
         self._occupancy_max = 0
         self._queue_wait_max = 0.0
@@ -167,6 +185,30 @@ class ServingMetrics:
     def record_completion(self) -> None:
         self.requests_completed += 1
 
+    # ---- fault-domain counters (graftfault) ----
+    def record_retry(self) -> None:
+        """One transient error absorbed by bounded retry, in ANY of
+        the engine's fault domains (dispatch, readback, prefill,
+        chunk, tok0, insert — all share the one retry policy)."""
+        self.dispatch_retries += 1
+
+    def record_failure(self) -> None:
+        """One request quarantined (poisoned prefill/insert, or its
+        deadline expired) — evicted as FAILED, engine kept serving."""
+        self.requests_failed += 1
+
+    def record_shed(self) -> None:
+        """One submission rejected at the queue bound (QueueFull)."""
+        self.requests_shed += 1
+
+    def record_watchdog_trip(self) -> None:
+        """One hung horizon readback detected and failed fast."""
+        self.watchdog_trips += 1
+
+    def record_horizon_collapse(self) -> None:
+        """One dispatch degraded to H=1 during a post-fault cooldown."""
+        self.horizon_collapses += 1
+
     def snapshot(self) -> dict:
         decode_tokens = self.tokens_generated - self.ttft.count
         decode_tps = (0.0 if self._elapsed == 0
@@ -191,4 +233,9 @@ class ServingMetrics:
             "occupancy_max": self._occupancy_max,
             "queue_depth_avg": self.queue_depth.avg,
             "decode_steps": self.decode_step.count,
+            "dispatch_retries": self.dispatch_retries,
+            "requests_failed": self.requests_failed,
+            "requests_shed": self.requests_shed,
+            "watchdog_trips": self.watchdog_trips,
+            "horizon_collapses": self.horizon_collapses,
         }

@@ -1,0 +1,58 @@
+"""GL111 near-miss negatives: broad excepts that re-raise, record,
+log, or narrow — every deliberate, observable handling shape."""
+
+import logging
+import warnings
+
+logger = logging.getLogger(__name__)
+
+
+def reraises(fetch):
+    try:
+        return fetch()
+    except Exception:
+        raise
+
+
+def wraps_with_cause(fetch):
+    try:
+        return fetch()
+    except Exception as e:
+        raise RuntimeError("fetch failed") from e
+
+
+def records_the_error(fetch, failures):
+    try:
+        return fetch()
+    except Exception as e:
+        failures.append(e)
+        return None
+
+
+def logs_the_swallow(fetch):
+    try:
+        return fetch()
+    except Exception:
+        logger.warning("fetch failed; falling back to default")
+        return None
+
+
+def warns_the_swallow(fetch):
+    try:
+        return fetch()
+    except Exception:
+        warnings.warn("fetch failed")
+        return None
+
+
+def narrow_except(fetch):
+    try:
+        return fetch()
+    except OSError:
+        return None
+
+
+try:  # optional-dependency probe: import-only try body is exempt
+    import torch as _torch
+except Exception:
+    _torch = None

@@ -1,0 +1,40 @@
+"""GL111 positives: broad excepts that swallow the error — no
+re-raise, bound exception unused, nothing logged."""
+
+
+def swallow_pass(fetch):
+    try:
+        return fetch()
+    except Exception:  # <- GL111
+        pass
+
+
+def swallow_default(fetch):
+    try:
+        return fetch()
+    except:  # noqa: E722  # <- GL111
+        return None
+
+
+def swallow_unused_name(fetch):
+    try:
+        return fetch()
+    except BaseException as e:  # noqa: F841  # <- GL111
+        return -1
+
+
+def swallow_in_tuple(fetch):
+    try:
+        return fetch()
+    except (ValueError, Exception):  # <- GL111
+        return 0
+
+
+def non_import_probe():
+    # NOT the import-probe exemption: the try body does real work too
+    try:
+        import json
+
+        return json.loads(open("cfg.json").read())
+    except Exception:  # <- GL111
+        return {}

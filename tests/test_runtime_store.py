@@ -1,4 +1,7 @@
-"""C++ TCP store: build, serve, coordinate multiple clients."""
+"""C++ TCP store: build, serve, coordinate multiple clients — and the
+graftfault retry domain around every client operation (one transient
+flake no longer kills the control plane; persistent failure still
+fails fast after the bounded attempts)."""
 
 import shutil
 import threading
@@ -13,6 +16,12 @@ pytestmark = pytest.mark.skipif(
 from pytorch_multiprocessing_distributed_tpu.runtime import (  # noqa: E402
     TCPStore,
     TCPStoreServer,
+)
+from pytorch_multiprocessing_distributed_tpu.runtime.faults import (  # noqa: E402
+    FaultInjected,
+    FaultPlan,
+    FaultRule,
+    armed,
 )
 
 
@@ -71,6 +80,82 @@ def test_server_stop_with_connected_clients():
     assert not t.is_alive()
     assert "aborted" in blocked_result["err"]
     idle.close()
+
+
+def test_transient_fault_recovered_by_retry(server):
+    """An injected flake at the store.get / store.set sites is absorbed
+    by the client's bounded backoff — the op still succeeds, and the
+    plan records exactly the scheduled number of injections."""
+    with TCPStore(port=server.port, retries=3, backoff_s=0.0) as c:
+        c.set("rk", b"v0")
+        plan = FaultPlan([
+            FaultRule("store.get", "error", times=2),
+            FaultRule("store.set", "error", times=2),
+        ])
+        with armed(plan):
+            c.set("rk", b"v1")          # 2 injected failures + success
+            assert c.get("rk") == b"v1"  # same
+        assert plan.triggered("store.set") == 2
+        assert plan.triggered("store.get") == 2
+        # disarmed again: plain path
+        assert c.get("rk") == b"v1"
+
+
+def test_real_socket_failure_reconnects_and_recovers(server):
+    """A REAL dead fd (peer RST / EPIPE — not an injected fault, which
+    fires before the wire call) is recovered by the on_retry
+    reconnect: without it every retry would beat on the same broken
+    descriptor and only injected faults would ever be recoverable."""
+    c = TCPStore(port=server.port, retries=3, backoff_s=0.0)
+    try:
+        c.set("rk", b"v1")
+        # kill the client connection behind the store's back
+        c._lib.pmdt_store_disconnect(c._fd)
+        c.set("rk", b"v2")  # OSError -> reconnect -> retry succeeds
+        assert c.get("rk") == b"v2"
+    finally:
+        c.close()
+
+
+def test_add_not_retried_on_real_socket_failure(server):
+    """``add`` is not idempotent: on a REAL socket failure the client
+    cannot tell send-failed from response-lost-after-commit, and a
+    blind retry could double-count — orphaning a barrier arrival index
+    and wedging every rank at wait() forever. Ambiguity fails loud.
+    Injected faults fire BEFORE the wire call (nothing committed), so
+    they alone stay retryable."""
+    c = TCPStore(port=server.port, retries=3, backoff_s=0.0)
+    try:
+        plan = FaultPlan([FaultRule("store.set", "error", times=2)])
+        with armed(plan):
+            assert c.add("loud", 1) == 1  # injected: retried, safe
+        assert plan.triggered("store.set") == 2
+        c._lib.pmdt_store_disconnect(c._fd)  # real dead fd
+        with pytest.raises(OSError):
+            c.add("loud", 1)
+        # counter unchanged from the client's last committed view once
+        # a fresh connection asks (no hidden double-count, no retry)
+        c._fd = -1  # already torn down above; skip double-disconnect
+    finally:
+        c.close()
+    with TCPStore(port=server.port, retries=1) as c2:
+        assert c2.add("loud", 0) in (1, 2)  # 2 only if the dead-fd
+        # attempt reached the server before teardown — either way it
+        # was ONE attempt, surfaced loudly, never silently replayed
+
+
+def test_persistent_fault_fails_after_bounded_retries(server):
+    """Bounded means bounded: a fault outliving the retry budget
+    surfaces as the transient error itself — no unbounded retry storm
+    against a dead coordinator, no silent swallow."""
+    with TCPStore(port=server.port, retries=2, backoff_s=0.0) as c:
+        plan = FaultPlan([FaultRule("store.get", "error", times=0)])
+        with armed(plan):
+            with pytest.raises(FaultInjected):
+                c.get("anything")
+        assert plan.site_hits("store.get") == 2  # exactly the budget
+    with pytest.raises(ValueError, match="retries"):
+        TCPStore(port=server.port, retries=0)
 
 
 def test_add_atomic_across_clients(server):

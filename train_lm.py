@@ -156,8 +156,8 @@ def main(args):
     from pytorch_multiprocessing_distributed_tpu.parallel import (
         dist, make_mesh)
     from pytorch_multiprocessing_distributed_tpu.train.checkpoint import (
-        load_checkpoint, prune_checkpoints, resolve_auto_resume,
-        save_checkpoint)
+        checkpoint_epoch, load_checkpoint, load_with_fallback,
+        prune_checkpoints, resolve_auto_resume, save_checkpoint)
     from pytorch_multiprocessing_distributed_tpu.train.lm import (
         create_lm_train_state, make_lm_train_step, make_lm_train_step_tp)
     from pytorch_multiprocessing_distributed_tpu.train.optim import sgd
@@ -385,8 +385,10 @@ def main(args):
                       f"{ck.directory}; starting fresh", flush=True)
         elif args.resume:
             resume_epoch = int(args.resume)
-    elif resume_path == 'auto':
+    auto_msgpack = False
+    if args.ckpt_backend != 'orbax' and resume_path == 'auto':
         resume_path = resolve_auto_resume(args.save_path) or ''
+        auto_msgpack = bool(resume_path)
         if not resume_path and dist.is_primary():
             print(f"--resume auto: no checkpoint under "
                   f"{args.save_path}; starting fresh", flush=True)
@@ -401,10 +403,20 @@ def main(args):
                 print(f"Resumed from {ck.directory}/{resume_epoch} "
                       f"(continuing at epoch {start_epoch})", flush=True)
         elif ck is None and resume_path:
-            st = load_checkpoint(resume_path, st)
+            if auto_msgpack:
+                # auto picked the checkpoint, so it owns the recovery:
+                # a corrupt newest checkpoint falls back to the
+                # previous valid epoch (an explicit path fails loudly);
+                # the walk is anchored at the primary-resolved epoch so
+                # a stale extra checkpoint on one host cannot shift it
+                st, used = load_with_fallback(
+                    args.save_path, st,
+                    anchor=checkpoint_epoch(resume_path))
+            else:
+                st, used = load_checkpoint(resume_path, st), resume_path
             start_epoch = int(st.epoch) + 1
             if dist.is_primary():
-                print(f"Resumed from {resume_path} (continuing at "
+                print(f"Resumed from {used} (continuing at "
                       f"epoch {start_epoch})", flush=True)
         return st
 
@@ -488,6 +500,15 @@ def main(args):
                 (tok_sharded,) = shard_batch((jnp.asarray(batch),), mesh)
                 state, metrics = step(state, tok_sharded)
             if i % args.print_freq == 0 or i == len(loader) - 1:
+                if int(np.asarray(metrics.get('skipped', 0))):
+                    # NaN/inf grad guard refused this step — its loss
+                    # is the poisoned batch's (possibly NaN); keep it
+                    # out of the printed line and the epoch average
+                    if dist.is_primary():
+                        print(f"Epoch: [{epoch}][{i}/{len(loader)}]\t"
+                              "step skipped (non-finite grads)",
+                              flush=True)
+                    continue
                 loss = float(np.asarray(metrics['loss']))
                 losses, seen = losses + loss, seen + 1
                 if dist.is_primary():
