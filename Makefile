@@ -3,8 +3,8 @@
 
 PYTEST_ENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 
-.PHONY: test test-fast lint check check-update chaos dryrun bench \
-        bench-cpu store clean
+.PHONY: test test-fast lint check check-update chaos scope dryrun \
+        bench bench-cpu store clean
 
 # graftlint: AST-only jit-hygiene gate (no jax import, milliseconds).
 # Exit 1 on any non-baselined finding; the tier-1 suite and
@@ -32,6 +32,15 @@ check-update:
 # operations on every run. Part of tier-1; this target runs it alone.
 chaos:
 	$(PYTEST_ENV) python -m pytest tests/test_graftfault.py tests/test_runtime_store.py -q
+
+# graftscope: observability smoke — a synthetic engine run must emit a
+# Perfetto-loadable Chrome trace, a JSONL event log with COMPLETE
+# per-request lifecycles, and a parseable Prometheus text exposition
+# (plus one live scrape of the /metrics endpoint). Schema drift fails
+# here, not during an incident. Same body runs in tier-1
+# (test_scope_smoke_end_to_end in tests/test_graftscope.py).
+scope:
+	$(PYTEST_ENV) python benchmarks/scope_smoke.py
 
 # full suite on the virtual 8-device CPU mesh (incl. slow e2e CLI runs)
 test:

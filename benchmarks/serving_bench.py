@@ -125,9 +125,17 @@ def run_point(model, params, prompts, new_tokens, slots, offered_rps,
         "wall_s": wall,
         "tokens_per_sec": total_tokens / wall,
         "ttft_avg_ms": 1e3 * float(np.mean(ttfts)),
+        # tail latencies for EVERY sweep point (graftscope): a change
+        # that keeps the mean but breaks the p99 is bench-visible
+        "ttft_p50_ms": 1e3 * _percentile(ttfts, 50),
         "ttft_p95_ms": 1e3 * _percentile(ttfts, 95),
+        "ttft_p99_ms": 1e3 * _percentile(ttfts, 99),
         "queue_wait_p95_ms": 1e3 * _percentile(waits, 95),
+        "queue_wait_p99_ms": 1e3 * _percentile(waits, 99),
         "decode_step_avg_s": snap["decode_step_avg_s"],
+        "decode_step_p50_s": snap["decode_step_p50_s"],
+        "decode_step_p95_s": snap["decode_step_p95_s"],
+        "decode_step_p99_s": snap["decode_step_p99_s"],
         "decode_window_avg": snap["decode_window_avg"],
         "decode_tokens_per_sec": snap["decode_tokens_per_sec"],
         "decode_horizon_avg": snap["decode_horizon_avg"],

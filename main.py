@@ -20,6 +20,9 @@ import argparse
 import os
 import shutil
 
+from pytorch_multiprocessing_distributed_tpu.runtime import (
+    scope as graftscope)
+
 parser = argparse.ArgumentParser(description="Confidence Aware Learning")
 parser.add_argument('--batch_size', default=64, type=int, help='Batch size')
 parser.add_argument('--epochs', default=20, type=int, help='Total number of epochs to run')
@@ -131,6 +134,7 @@ parser.add_argument('--torch_export', action='store_true',
                          'torch-loadable state_dict '
                          '(model_{epoch}.torch.pth, reference model '
                          'naming; ResNet family only)')
+graftscope.add_cli_args(parser)
 
 
 def main(args):
@@ -143,6 +147,10 @@ def main(args):
             f"--torch_export supports the ResNet family only "
             f"(got --model {args.model})"
         )
+    # arm before any jax work: compile/placement phases belong on the
+    # timeline too (zero cost when no graftscope flag is set; the
+    # Trainer's spans and the flight recorder attach automatically)
+    graftscope.arm_from_args(args)
     # Backend selection must happen before device queries.
     from pytorch_multiprocessing_distributed_tpu.utils.hostenv import (
         force_cpu_devices_from_env)
@@ -404,6 +412,8 @@ def main(args):
                 out, jax.device_get(params), jax.device_get(batch_stats))
             print(f"Exported torch state_dict -> {out}")
 
+    if dist.is_primary():
+        graftscope.export_from_args(args)
     dist.destroy_process_group()
 
 

@@ -81,6 +81,12 @@ RULES: Dict[str, str] = {
              "unused, nothing logged: a fault domain that eats its "
              "faults cannot be recovered OR debugged (record the "
              "error, re-raise, or narrow the except)",
+    "GL112": "graftscope emission or datetime wall-clock read inside "
+             "jit-traced code — the timestamp is a trace-time "
+             "constant and the event records ONCE, at trace time: a "
+             "silent lie on the timeline (emit at host boundaries — "
+             "drain, admission, metric fetch; bare time.* reads are "
+             "GL103's)",
 }
 
 # wrappers that COMPILE (jit family) — GL105/106/107/108 anchor on these
@@ -97,6 +103,10 @@ _TRACE_DOTTED = _JIT_DOTTED | {
 }
 _TIME_ATTRS = {"time", "perf_counter", "monotonic", "process_time",
                "sleep", "time_ns", "perf_counter_ns", "monotonic_ns"}
+# graftscope emission helpers (GL112): timestamps read at trace time
+# record one constant event — never inside traced scope
+_SCOPE_EMITTERS = {"emit", "emit_span", "span", "flight_dump"}
+_DATETIME_CLOCKS = {"now", "utcnow", "today"}
 _LOG_ATTRS = {"debug", "info", "warning", "warn", "error", "critical",
               "exception", "log"}
 _LOG_BASES = {"logger", "log", "LOG", "logging"}
@@ -681,6 +691,27 @@ def _check_jit_scoped_body(fn: _Func, out: List[Finding]):
                     add(node, "GL103",
                         f"np.random in jit-traced `{fn.qual}` draws "
                         "once at trace time — use jax.random")
+                    continue
+                # ---- GL112: graftscope emission / datetime clocks —
+                # the silent-lie class GL103's time.* check cannot
+                # see (the clock read hides inside the emit helper,
+                # or behind the datetime module)
+                parts = d.split(".")
+                if (len(parts) >= 2 and parts[-2] == "scope"
+                        and parts[-1] in _SCOPE_EMITTERS):
+                    add(node, "GL112",
+                        f"graftscope {parts[-1]}() in jit-traced "
+                        f"`{fn.qual}` stamps a trace-time constant "
+                        "and records ONE event, at trace time — a "
+                        "silent lie on the timeline; emit at a host "
+                        "boundary instead")
+                    continue
+                if (root == "datetime"
+                        and parts[-1] in _DATETIME_CLOCKS):
+                    add(node, "GL112",
+                        f"{d} in jit-traced `{fn.qual}` is baked in "
+                        "as a trace-time constant (the datetime "
+                        "spelling of GL103's wall-clock rule)")
                     continue
             continue
         # ---- GL104: captured-container mutation. Only BARE statement

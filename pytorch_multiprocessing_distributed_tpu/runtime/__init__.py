@@ -10,7 +10,11 @@ control plane — rendezvous, barriers, health keys — is C++:
 - :mod:`.faults` — graftfault: deterministic fault injection (named
   sites, seeded :class:`~.faults.FaultPlan`) plus the shared recovery
   primitives (:func:`~.faults.retry_with_backoff`,
-  :func:`~.faults.run_with_timeout`) every layer retries through.
+  :func:`~.faults.run_with_timeout`) every layer retries through;
+- :mod:`.scope` — graftscope: the zero-host-sync structured event bus
+  (spans/instants at host boundaries), flight recorder, and the
+  Chrome-trace / JSONL / Prometheus exporters. Every injected fault,
+  retry and watchdog trip lands on its timeline.
 """
 
 from .faults import (DeadlineExceeded, FaultInjected, FaultPlan,

@@ -60,6 +60,8 @@ import threading
 import time
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
+from . import scope as _scope
+
 __all__ = [
     "GraftFaultError", "FaultInjected", "FaultTimeout",
     "DeadlineExceeded", "PoolPoisonedError", "FaultRule",
@@ -272,6 +274,11 @@ class FaultPlan:
         # hang rule on one thread never serializes other sites
         if fired is None:
             return payload
+        # graftscope: every injected fault is a visible, site-named
+        # event — a chaos drill whose timeline cannot show where the
+        # faults landed proves nothing
+        _scope.emit("fault.injected", cat="fault", site=site,
+                    kind=fired.kind, hit=hit)
         if fired.kind == "error":
             raise FaultInjected(
                 f"graftfault: injected transient fault at "
@@ -363,6 +370,10 @@ def retry_with_backoff(fn: Callable, *, attempts: int = 3,
         except retry_on as e:
             if attempt == attempts - 1:
                 raise
+            # visible on the timeline BEFORE the on_retry hook runs —
+            # a retry that crashes its own metrics hook still shows
+            _scope.emit("fault.retry", cat="fault", attempt=attempt,
+                        error=type(e).__name__)
             if on_retry is not None:
                 on_retry(attempt, e)
             if delay > 0:
@@ -394,6 +405,8 @@ def run_with_timeout(fn: Callable, timeout_s: float, what: str,
     if "err" in box:
         raise box["err"]  # type: ignore[misc]
     if "result" not in box:
+        _scope.emit("fault.timeout", cat="fault", what=what,
+                    timeout_s=timeout_s)
         raise FaultTimeout(
             f"{what} did not complete within {timeout_s:.3g}s."
             + (f" {hint}" if hint else ""))

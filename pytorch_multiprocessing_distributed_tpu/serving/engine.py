@@ -85,6 +85,20 @@ under any single injected fault, every unaffected request's tokens are
 byte-identical to the fault-free run (``tests/test_graftfault.py``).
 Disarmed cost is one module-global read per hazard point — no extra
 compiles, transfers, or host syncs (sentinel-pinned).
+
+**Observability (graftscope).** Every request's lifecycle — submit →
+queued → admit → prefill (whole or chunked) → first token → horizon
+blocks → EOS/FAILED/shed — and every engine phase (dispatch, drain,
+insert) emits structured events through ``runtime.scope``, ALWAYS at
+boundaries where the host already synchronizes; arming the scope adds
+zero compiles, transfers or host syncs (the same sentinel pin as
+graftfault's disarmed cost, now tested with the scope ARMED). Fault
+handling is on the same timeline: injections, retries, watchdog trips,
+horizon collapses, quarantines. Engine-fatal paths
+(``PoolPoisonedError``, watchdog fail-fast, an unhandled error in
+``step()``) dump the flight-recorder ring before propagating — the
+postmortem starts from the last seconds of events, not a bare stack
+trace.
 """
 
 from __future__ import annotations
@@ -102,6 +116,7 @@ from ..analysis.sentinels import expected_transfer
 from ..inference.generate import (
     _LN_EPS, _block_chunk_prefill, _decode_horizon, _embed_at,
     _logits, _make_cs, _prefill, _sample)
+from ..runtime import scope as graftscope
 from ..runtime.faults import (DeadlineExceeded, FaultInjected,
                               FaultTimeout, GraftFaultError,
                               PoolPoisonedError, maybe_fault,
@@ -589,6 +604,15 @@ class ServingEngine:
         except GraftFaultError:
             raise
         except Exception as e:
+            # flight-record FIRST: the ring holds the dispatch/drain
+            # events leading into the poisoned launch — exactly what
+            # the postmortem needs and exactly what a propagating
+            # exception is about to make unreachable
+            graftscope.emit("engine.fatal", cat="fault",
+                            error="PoolPoisonedError",
+                            cause=type(e).__name__)
+            graftscope.flight_dump(
+                f"PoolPoisonedError: {type(e).__name__}: {e}")
             raise PoolPoisonedError(
                 "a pool-donating program failed mid-execution "
                 f"({type(e).__name__}: {e}); the KV slot pool's "
@@ -641,6 +665,10 @@ class ServingEngine:
         self.scheduler.fail(request, error, reason)
         request.finish_time = time.perf_counter()
         self.metrics.record_failure()
+        graftscope.emit("request.failed", cat="request",
+                        req=request.uid, reason=reason,
+                        error=type(error).__name__,
+                        tokens=len(request.tokens))
 
     def _poisoned(self, request: Request, error: BaseException,
                   slot: Optional[int] = None) -> None:
@@ -808,10 +836,17 @@ class ServingEngine:
                 f"prompt token ids must be in [0, vocab_size="
                 f"{self.model.vocab_size})")
         try:
-            return self.scheduler.submit(request)
+            submitted = self.scheduler.submit(request)
         except QueueFull:
             self.metrics.record_shed()
+            graftscope.emit("request.shed", cat="request",
+                            req=request.uid)
             raise
+        graftscope.emit("request.submit", cat="request",
+                        req=request.uid,
+                        prompt_len=len(request.prompt),
+                        max_new_tokens=request.max_new_tokens)
+        return submitted
 
     def _next_key(self) -> jax.Array:
         """Per-call PRNG key (sampling only; greedy programs take the
@@ -831,7 +866,10 @@ class ServingEngine:
     def _complete(self, request: Request, reason: str) -> None:
         request.finish_time = time.perf_counter()
         self.scheduler.complete(request, reason)
-        self.metrics.record_completion()
+        self.metrics.record_completion(len(request.tokens))
+        graftscope.emit("request.done", cat="request",
+                        req=request.uid, reason=reason,
+                        tokens=len(request.tokens))
 
     def _pop_admission(self) -> Optional[Request]:
         """FIFO head into prefill: stamp admission (the queue-wait half
@@ -841,6 +879,9 @@ class ServingEngine:
             request.admit_time = time.perf_counter()
             self.metrics.record_admission(
                 request.admit_time - request.submit_time)
+            graftscope.emit(
+                "request.admit", cat="request", req=request.uid,
+                queue_wait_s=request.admit_time - request.submit_time)
         return request
 
     def _first_token(self, request: Request, token: int,
@@ -851,6 +892,9 @@ class ServingEngine:
         request.first_token_time = time.perf_counter()
         self.metrics.record_first_token(
             request.first_token_time - request.submit_time)
+        graftscope.emit(
+            "request.first_token", cat="request", req=request.uid,
+            ttft_s=request.first_token_time - request.submit_time)
         request.tokens.append(token)
         reason = self._finished(request, token)
         if reason is not None:
@@ -898,8 +942,11 @@ class ServingEngine:
                     return tok0, k_pref, v_pref, int(tok0)
 
             try:
-                tok0, k_pref, v_pref, tok0_host = self._attempted(
-                    prefill_once)
+                with graftscope.span("serving.prefill", cat="serving",
+                                     req=request.uid, bucket=bucket,
+                                     prompt_len=length):
+                    tok0, k_pref, v_pref, tok0_host = self._attempted(
+                        prefill_once)
             except Exception as e:
                 self._poisoned(request, e)
                 continue
@@ -937,9 +984,11 @@ class ServingEngine:
                     jnp.int32(request.max_new_tokens - 1),
                     jnp.int32(eos)))
 
-        (pool.k_caches, pool.v_caches, pool.positions,
-         pool.last_tokens, pool.active, pool.budgets,
-         pool.eos_ids) = self._attempted(insert_once)
+        with graftscope.span("serving.slot_insert", cat="serving",
+                             req=request.uid, slot=slot):
+            (pool.k_caches, pool.v_caches, pool.positions,
+             pool.last_tokens, pool.active, pool.budgets,
+             pool.eos_ids) = self._attempted(insert_once)
         pool.note_insert(slot, length)
 
     def _admit_chunked(self) -> List[Tuple[Request, int, bool]]:
@@ -977,7 +1026,11 @@ class ServingEngine:
                     jnp.asarray(padded), jnp.int32(start))
 
         try:
-            x, pend.k_pref, pend.v_pref = self._attempted(chunk_once)
+            with graftscope.span("serving.prefill_chunk", cat="serving",
+                                 req=pend.request.uid, start=start,
+                                 chunk=chunk):
+                x, pend.k_pref, pend.v_pref = self._attempted(
+                    chunk_once)
         except Exception as e:
             self._pending = None
             self._poisoned(pend.request, e)
@@ -1003,7 +1056,9 @@ class ServingEngine:
                 return t, int(t)
 
         try:
-            tok0, tok0_host = self._attempted(tok0_once)
+            with graftscope.span("serving.prefill_tok0", cat="serving",
+                                 req=pend.request.uid):
+                tok0, tok0_host = self._attempted(tok0_once)
         except Exception as e:
             self._poisoned(pend.request, e)
             return events
@@ -1062,6 +1117,8 @@ class ServingEngine:
             if h > 1:
                 h = 1
                 self.metrics.record_horizon_collapse()
+                graftscope.emit("fault.horizon_collapse", cat="fault",
+                                cooldown_left=self._cooldown)
         return window, h
 
     def _dispatch(self, overlapped: bool = False) -> None:
@@ -1092,6 +1149,9 @@ class ServingEngine:
         self._blocks.append(
             _TokenBlock(tokens, h, window, dict(self._running)))
         self.metrics.record_dispatch(h, overlapped)
+        graftscope.emit("decode.dispatch", cat="serving", window=window,
+                        horizon=h, overlapped=overlapped,
+                        occupancy=pool.occupancy)
 
     def _overlap_ok(self) -> bool:
         """Dispatch horizon h+1 before syncing horizon h's block?
@@ -1145,32 +1205,39 @@ class ServingEngine:
                          "state.")
             except FaultTimeout:
                 self.metrics.record_watchdog_trip()
+                graftscope.emit("fault.watchdog_trip", cat="fault",
+                                what="horizon_readback")
                 raise
 
-        tokens = self._attempted_engine(attempt,
-                                        "horizon token-block readback")
-        realized: Dict[int, int] = {}
-        for h in range(block.h):
-            for slot, request in block.slots.items():
-                if self._running.get(slot) is not request:
-                    continue  # finished in an earlier step/block (or a
-                    # later tenant now holds the slot — its tokens are
-                    # in a later block)
-                token = int(tokens[h, slot])
-                if token < 0:
-                    continue  # device froze the row before this block
-                request.tokens.append(token)
-                realized[slot] = realized.get(slot, 0) + 1
-                reason = self._finished(request, token)
-                if reason is not None:
-                    # the device already cleared the row's active flag
-                    # mid-horizon — no release program, just host books
-                    self._complete(request, reason)
-                    pool.release(slot)
-                    del self._running[slot]
-                events.append((request, token, reason is not None))
-        pool.note_advance_slots(realized)
-        return block.window, sum(realized.values())
+        with graftscope.span("decode.drain", cat="serving", h=block.h,
+                             window=block.window) as drain_span:
+            tokens = self._attempted_engine(
+                attempt, "horizon token-block readback")
+            realized: Dict[int, int] = {}
+            for h in range(block.h):
+                for slot, request in block.slots.items():
+                    if self._running.get(slot) is not request:
+                        continue  # finished in an earlier step/block
+                        # (or a later tenant now holds the slot — its
+                        # tokens are in a later block)
+                    token = int(tokens[h, slot])
+                    if token < 0:
+                        continue  # device froze the row pre-block
+                    request.tokens.append(token)
+                    realized[slot] = realized.get(slot, 0) + 1
+                    reason = self._finished(request, token)
+                    if reason is not None:
+                        # the device already cleared the row's active
+                        # flag mid-horizon — no release program, just
+                        # host books
+                        self._complete(request, reason)
+                        pool.release(slot)
+                        del self._running[slot]
+                    events.append((request, token, reason is not None))
+            pool.note_advance_slots(realized)
+            emitted = sum(realized.values())
+            drain_span.note(tokens=emitted)
+        return block.window, emitted
 
     def step(self) -> List[Tuple[Request, int, bool]]:
         """One engine iteration: admit (a whole prompt per free slot,
@@ -1181,6 +1248,24 @@ class ServingEngine:
         as ``(request, token, finished)`` tuples (admission first
         tokens included; a quarantined request emits no event — read
         its ``state``/``error``)."""
+        try:
+            return self._step_inner()
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except BaseException as e:
+            # engine-fatal: whatever escapes step() (watchdog
+            # fail-fast, exhausted dispatch retries, PoolPoisonedError,
+            # a plain bug) takes the engine down — leave the flight
+            # ring on disk first. Quarantined per-request failures
+            # never reach here (absorbed inside the admit/drain paths).
+            if not isinstance(e, PoolPoisonedError):  # already dumped
+                graftscope.emit("engine.fatal", cat="fault",
+                                error=type(e).__name__)
+                graftscope.flight_dump(
+                    f"engine step: {type(e).__name__}: {e}")
+            raise
+
+    def _step_inner(self) -> List[Tuple[Request, int, bool]]:
         self._expire_deadlines()
         events = self._admit()
         pool = self.pool

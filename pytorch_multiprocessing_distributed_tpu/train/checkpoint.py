@@ -40,6 +40,7 @@ from flax import serialization
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..parallel import dist
+from ..runtime import scope as graftscope
 from ..runtime.faults import GraftFaultError, maybe_fault, register_site
 from .state import TrainState
 
@@ -140,23 +141,28 @@ def save_checkpoint(save_path: str, state: TrainState, epoch: int) -> Optional[s
     state = _gather_for_host(state)
     if not dist.is_primary():
         return None
-    # Pull fully-addressable host copies off the devices.
-    host_state = jax.device_get(state)
-    payload = serialization.to_bytes(host_state)
-    digest = hashlib.sha256(payload).hexdigest()
     path = checkpoint_path(save_path, epoch)
-    # injected fault point: "corrupt" flips a payload byte AFTER the
-    # digest was computed — exactly what bit rot / a torn write does
-    written = maybe_fault(_SITE_WRITE, payload)
-    # re-save of the same epoch (preemption re-save, torn-epoch redo):
-    # drop the stale sidecar BEFORE replacing the checkpoint, so a
-    # crash between the two replaces degrades to "valid checkpoint, no
-    # digest" — never the old digest paired with the new payload
-    dpath = digest_path(path)
-    if os.path.exists(dpath):
-        os.remove(dpath)
-    write_atomic_durable(path, written)
-    write_atomic_durable(dpath, digest.encode("ascii"))
+    with graftscope.span("checkpoint.write", cat="train", epoch=epoch,
+                         path=os.path.basename(path)) as ckpt_span:
+        # Pull fully-addressable host copies off the devices.
+        host_state = jax.device_get(state)
+        payload = serialization.to_bytes(host_state)
+        digest = hashlib.sha256(payload).hexdigest()
+        # injected fault point: "corrupt" flips a payload byte AFTER
+        # the digest was computed — exactly what bit rot / a torn
+        # write does
+        written = maybe_fault(_SITE_WRITE, payload)
+        # re-save of the same epoch (preemption re-save, torn-epoch
+        # redo): drop the stale sidecar BEFORE replacing the
+        # checkpoint, so a crash between the two replaces degrades to
+        # "valid checkpoint, no digest" — never the old digest paired
+        # with the new payload
+        dpath = digest_path(path)
+        if os.path.exists(dpath):
+            os.remove(dpath)
+        write_atomic_durable(path, written)
+        write_atomic_durable(dpath, digest.encode("ascii"))
+        ckpt_span.note(bytes=len(payload))
     return path
 
 

@@ -36,6 +36,7 @@ import jax.numpy as jnp
 
 from ..data.pipeline import ShardedLoader, prefetch_to_device
 from ..parallel import dist
+from ..runtime import scope as graftscope
 from ..parallel.mesh import MODEL_AXIS
 from ..utils import AverageMeter, Logger
 from ..utils.plotting import draw_plot
@@ -204,6 +205,7 @@ class Trainer:
             preempted = bool(flags.max())
         if not preempted:
             return
+        graftscope.emit("train.preempted", cat="train", epoch=epoch)
         if dist.is_primary():
             print(
                 f"SIGTERM received: checkpointing at epoch {epoch} "
@@ -257,35 +259,28 @@ class Trainer:
         overlap serialization with the next epochs; callers that rely
         on the artifact existing when they move on (final epoch,
         preemption exit) keep the default."""
-        if self.ckpt_backend == "orbax":
-            self._orbax.save(state, epoch)
-            if wait:
-                self._orbax.wait()
-        else:
-            save_checkpoint(self.save_path, state, epoch)
-            if dist.is_primary():
-                prune_checkpoints(self.save_path, self.keep_checkpoints)
+        with graftscope.span("train.checkpoint", cat="train",
+                             epoch=epoch, backend=self.ckpt_backend,
+                             wait=wait):
+            if self.ckpt_backend == "orbax":
+                self._orbax.save(state, epoch)
+                if wait:
+                    self._orbax.wait()
+            else:
+                save_checkpoint(self.save_path, state, epoch)
+                if dist.is_primary():
+                    prune_checkpoints(self.save_path,
+                                      self.keep_checkpoints)
 
     def fit(self) -> TrainState:
         """The reference's epoch loop (``main.py:67-82``)."""
         prev_handler = self._install_preemption_handler()
         try:
-            for epoch in range(self.start_epoch, self.epochs + 1):
-                # LR schedule is a function of the epoch carried in the
-                # state (uniform across replicas — fixed vs reference
-                # main.py:69-70).
-                self.state = self.state.replace(
-                    epoch=jnp.asarray(epoch, jnp.int32)
-                )
-                self.train_epoch(epoch)
-                self.validate(epoch, mode="test")
-                periodic = self.save_every and epoch % self.save_every == 0
-                if epoch == self.epochs or periodic:
-                    # mid-training periodic saves may overlap with the
-                    # next epochs (async orbax); the final one is durable
-                    # before fit returns
-                    self._save_state(self.state, epoch,
-                                     wait=epoch == self.epochs)
+            # an unhandled exception unwinding the epoch loop dumps
+            # the flight ring first (preemption's SystemExit is exempt
+            # — that exit is the graceful path, not a crash)
+            with graftscope.flight_recorder("trainer loop"):
+                self._fit_epochs()
         finally:
             try:
                 if self.ckpt_backend == "orbax":
@@ -301,6 +296,24 @@ class Trainer:
         if dist.is_primary():
             draw_plot(self.save_path)
         return self.state
+
+    def _fit_epochs(self) -> None:
+        for epoch in range(self.start_epoch, self.epochs + 1):
+            # LR schedule is a function of the epoch carried in the
+            # state (uniform across replicas — fixed vs reference
+            # main.py:69-70).
+            self.state = self.state.replace(
+                epoch=jnp.asarray(epoch, jnp.int32)
+            )
+            self.train_epoch(epoch)
+            self.validate(epoch, mode="test")
+            periodic = self.save_every and epoch % self.save_every == 0
+            if epoch == self.epochs or periodic:
+                # mid-training periodic saves may overlap with the
+                # next epochs (async orbax); the final one is durable
+                # before fit returns
+                self._save_state(self.state, epoch,
+                                 wait=epoch == self.epochs)
 
     @staticmethod
     def _restore_handler(prev_handler) -> None:
@@ -332,13 +345,20 @@ class Trainer:
             prefetch_to_device(self.train_loader, self.mesh)
         ):
             data_time.update(time.time() - end)
+            # data-wait span, recorded retroactively from the meter's
+            # own measurement — graftscope adds NO clock reads or
+            # syncs to the hot loop, only an append when armed
+            graftscope.emit_span("train.data", data_time.val,
+                                 cat="train", batch=i)
             self.state, metrics = self.train_step(self.state, images, labels)
             # NO host sync here: the scalars stay on device and the next
             # step's dispatch overlaps this one's execution.
             pending.append(metrics)
             if i % self.print_freq == 0 or i == n_batches - 1:
                 self._checkpoint_if_preempted(epoch)
-                fetched = jax.device_get(pending)  # the sync point
+                with graftscope.span("train.metrics_fetch", cat="train",
+                                     epoch=epoch, steps=len(pending)):
+                    fetched = jax.device_get(pending)  # the sync point
                 for m in fetched:
                     # the guard's skip indicator rides the same windowed
                     # fetch — a skipped step is VISIBLE, never silent,
@@ -353,6 +373,13 @@ class Trainer:
                 batch_time.update(
                     (now - window_start) / len(pending), len(pending)
                 )
+                # the fetch boundary is the ONE honest per-window
+                # timing point under async dispatch: the window span
+                # covers its steps' wall clock, attributed here
+                graftscope.emit_span(
+                    "train.window", now - window_start, cat="train",
+                    epoch=epoch, steps=len(pending),
+                    step_avg_s=batch_time.val)
                 window_start = now
                 pending = []
                 if dist.is_primary() and i % self.print_freq == 0:
@@ -403,7 +430,10 @@ class Trainer:
                 valid = jnp.ones(labels.shape, bool)
             pending.append(self.eval_step(eval_state, images, labels, valid))
             if i % self.print_freq == 0 or i == n_batches - 1:
-                for m in jax.device_get(pending):
+                with graftscope.span("train.eval_fetch", cat="train",
+                                     epoch=epoch, steps=len(pending)):
+                    fetched = jax.device_get(pending)
+                for m in fetched:
                     losses.update(float(m["loss"]), int(m["count"]))
                     total_correct += int(m["correct"])  # GLOBAL (psum-ed)
                 now = time.time()

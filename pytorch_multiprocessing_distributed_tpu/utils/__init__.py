@@ -5,10 +5,10 @@ TPU-native re-design of the reference's ``utils.py`` and
 ``/root/reference/plot_curves.py:7-37``).
 """
 
-from .meters import AverageMeter
+from .meters import AverageMeter, PercentileMeter
 from .logger import Logger
 from .metrics import accuracy, topk_accuracy
-from .plotting import draw_plot
+from .plotting import draw_plot, draw_timeline
 from .torch_interop import (
     from_torch_state_dict,
     load_torch_checkpoint,
@@ -25,10 +25,12 @@ from .compile_cache import enable_compilation_cache
 
 __all__ = [
     "AverageMeter",
+    "PercentileMeter",
     "Logger",
     "accuracy",
     "topk_accuracy",
     "draw_plot",
+    "draw_timeline",
     "to_torch_state_dict",
     "from_torch_state_dict",
     "save_torch_checkpoint",

@@ -21,7 +21,7 @@ from typing import Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
-from .meters import AverageMeter
+from .meters import AverageMeter, PercentileMeter
 
 
 def topk_accuracy(
@@ -119,19 +119,35 @@ class ServingMetrics:
       absorbed must still be VISIBLE — silent recovery is how fleets
       rot.
 
-    All meters are host-side ``AverageMeter``s; ``snapshot()`` flattens
-    them into the plain dict the CLI prints and the benchmark records.
+    The latency meters (``ttft``/``queue_wait``/``decode_step``, plus
+    per-request generated-token counts) are
+    :class:`~.meters.PercentileMeter`\\ s (graftscope): ``snapshot()``
+    reports p50/p90/p95/p99 beside the averages — p95/p99 TTFT is THE
+    serving SLO, and an average actively hides a broken tail — and
+    :meth:`snapshot_delta` reports the same stats over just the window
+    since the previous delta (steady-state dashboards; run-total
+    averages smear warm-up over everything). ``snapshot()`` flattens
+    everything into the plain dict the CLI prints, the stats endpoint
+    exposes, and the benchmark records.
     """
 
     def __init__(self) -> None:
-        self.ttft = AverageMeter()
-        self.queue_wait = AverageMeter()
-        self.decode_step = AverageMeter()
+        self.ttft = PercentileMeter()
+        self.queue_wait = PercentileMeter()
+        self.decode_step = PercentileMeter()
+        self.request_tokens = PercentileMeter()
         self.decode_window = AverageMeter()
         self.horizon = AverageMeter()
         self.occupancy = AverageMeter()
         self.queue_depth = AverageMeter()
         self.tokens_generated = 0
+        # decode (post-first) tokens, accumulated from DRAINED blocks —
+        # the authoritative decode-token count. The old derivation
+        # ``tokens_generated - ttft.count`` silently miscounts the
+        # moment first-token samples and TTFT samples decouple (e.g. a
+        # latency recorded for a request that failed before its first
+        # token); an explicit counter cannot.
+        self.decode_tokens = 0
         self.requests_completed = 0
         self.dispatches = 0
         self.host_syncs = 0
@@ -144,6 +160,7 @@ class ServingMetrics:
         self._elapsed = 0.0
         self._occupancy_max = 0
         self._queue_wait_max = 0.0
+        self._delta_base: dict = {}
 
     def record_first_token(self, ttft_seconds: float) -> None:
         self.ttft.update(ttft_seconds)
@@ -180,10 +197,15 @@ class ServingMetrics:
         self._occupancy_max = max(self._occupancy_max, occupancy)
         self.queue_depth.update(queue_depth)
         self.tokens_generated += tokens
+        self.decode_tokens += tokens
         self._elapsed += seconds
 
-    def record_completion(self) -> None:
+    def record_completion(self, tokens: int = 0) -> None:
+        """``tokens`` = the finished request's generated-token count
+        (tokens/request is a percentile the capacity planner reads)."""
         self.requests_completed += 1
+        if tokens:
+            self.request_tokens.update(tokens)
 
     # ---- fault-domain counters (graftfault) ----
     def record_retry(self) -> None:
@@ -210,12 +232,18 @@ class ServingMetrics:
         self.horizon_collapses += 1
 
     def snapshot(self) -> dict:
-        decode_tokens = self.tokens_generated - self.ttft.count
+        # decode tokens come from DRAINED blocks (the explicit
+        # counter), never re-derived as tokens_generated - ttft.count:
+        # that subtraction breaks the moment a TTFT-family sample
+        # exists without a first token behind it (a request failed
+        # before its first token whose latency-to-failure is recorded)
+        decode_tokens = self.decode_tokens
         decode_tps = (0.0 if self._elapsed == 0
                       else decode_tokens / self._elapsed)
-        return {
+        snap = {
             "requests_completed": self.requests_completed,
             "tokens_generated": self.tokens_generated,
+            "decode_tokens": decode_tokens,
             "ttft_avg_s": self.ttft.avg,
             "ttft_last_s": self.ttft.val,
             "queue_wait_avg_s": self.queue_wait.avg,
@@ -239,3 +267,47 @@ class ServingMetrics:
             "watchdog_trips": self.watchdog_trips,
             "horizon_collapses": self.horizon_collapses,
         }
+        # graftscope percentile telemetry: the tail IS the SLO
+        for name, meter in (("ttft", self.ttft),
+                            ("queue_wait", self.queue_wait),
+                            ("decode_step", self.decode_step)):
+            for q, v in meter.percentiles((50, 90, 95, 99)).items():
+                snap[f"{name}_{q}_s"] = v
+        for q, v in self.request_tokens.percentiles((50, 95)).items():
+            snap[f"tokens_per_request_{q}"] = v
+        snap["tokens_per_request_avg"] = self.request_tokens.avg
+        return snap
+
+    # counters whose deltas snapshot_delta reports
+    _DELTA_COUNTERS = (
+        "tokens_generated", "decode_tokens", "requests_completed",
+        "requests_failed", "requests_shed", "dispatches", "host_syncs",
+        "dispatch_retries", "horizon_collapses", "watchdog_trips",
+    )
+
+    def snapshot_delta(self) -> dict:
+        """Steady-state window: counter deltas and latency percentiles
+        over ONLY the activity since the previous ``snapshot_delta``
+        call (the first call's window starts at construction). This is
+        the stats a dashboard scrapes — run-total averages smear
+        warm-up compiles over the steady state; a window does not."""
+        out = {}
+        elapsed = self._elapsed - self._delta_base.get("_elapsed", 0.0)
+        for key in self._DELTA_COUNTERS:
+            cur = getattr(self, key)
+            out[f"window_{key}"] = cur - self._delta_base.get(key, 0)
+            self._delta_base[key] = cur
+        self._delta_base["_elapsed"] = self._elapsed
+        out["window_elapsed_s"] = elapsed
+        out["window_decode_tokens_per_sec"] = (
+            0.0 if elapsed == 0
+            else out["window_decode_tokens"] / elapsed)
+        for name, meter in (("ttft", self.ttft),
+                            ("queue_wait", self.queue_wait),
+                            ("decode_step", self.decode_step)):
+            for stat, v in meter.window_stats((50, 95, 99)).items():
+                key = (f"window_{name}_count" if stat == "count"
+                       else f"window_{name}_{stat}_s")
+                out[key] = v
+            meter.advance_window()
+        return out

@@ -1,14 +1,21 @@
-"""End-of-run accuracy/loss curve rendering.
+"""End-of-run accuracy/loss curve rendering + graftscope timelines.
 
 Artifact parity target: ``draw_plot`` in reference ``plot_curves.py:7-37``
 — reads ``train.log`` / ``test.log`` via :class:`..utils.Logger`, writes
 ``test_accuracy.png`` and ``loss.png`` with the same series, labels,
 legends and titles.
+
+:func:`draw_timeline` is the serving-era sibling of that artifact: the
+one-glance PNG, but of a graftscope JSONL event log (``serve_lm.py
+--events_out`` / ``train_lm.py --events_out`` / a flight dump) instead
+of an epoch curve — spans as horizontal bars on one lane per event
+name, instants as ticks, lanes grouped and colored by category.
 """
 
 from __future__ import annotations
 
 import os
+from typing import Optional
 
 from .logger import Logger
 
@@ -43,3 +50,65 @@ def draw_plot(save_path: str) -> None:
     plt.title("loss")
     plt.savefig(os.path.join(save_path, "loss.png"))
     plt.close()
+
+
+def draw_timeline(events_path: str,
+                  out_path: Optional[str] = None) -> str:
+    """Render a graftscope JSONL event log as a timeline PNG.
+
+    One horizontal lane per event name (lanes grouped by category so
+    every ``request.*`` sits together, every ``fault.*`` together);
+    spans (``ph="X"``) are bars from start to end, instants
+    (``ph="i"``) are tick marks. The time axis is seconds from the
+    first event. Works on a flight dump too (its header line is not
+    an event and is skipped by the parser).
+
+    Returns the path written (default: the event log's name with a
+    ``.png`` suffix).
+    """
+    import matplotlib
+
+    matplotlib.use("Agg")  # same headless discipline as draw_plot
+    import matplotlib.pyplot as plt
+
+    from ..runtime.scope import events_from_jsonl
+
+    events = events_from_jsonl(events_path)
+    if not events:
+        raise ValueError(f"no graftscope events in {events_path}")
+    if out_path is None:
+        out_path = os.path.splitext(events_path)[0] + ".png"
+
+    t0 = min(e["ts"] for e in events)
+    # lanes: category-major, then name — stable, readable grouping
+    lanes = sorted({(e["cat"], e["name"]) for e in events})
+    lane_of = {key: i for i, key in enumerate(lanes)}
+    cats = sorted({c for c, _ in lanes})
+    cmap = plt.get_cmap("tab10")
+    color_of = {c: cmap(i % 10) for i, c in enumerate(cats)}
+
+    fig, ax = plt.subplots(
+        figsize=(10, max(2.0, 0.4 * len(lanes) + 1.2)))
+    for e in events:
+        y = lane_of[(e["cat"], e["name"])]
+        color = color_of[e["cat"]]
+        start = e["ts"] - t0
+        if e["ph"] == "X":
+            ax.barh(y, max(e.get("dur", 0.0), 1e-9), left=start,
+                    height=0.6, color=color, edgecolor="none",
+                    alpha=0.85)
+        else:
+            ax.plot([start], [y], marker="|", markersize=12,
+                    color=color, linestyle="none")
+    ax.set_yticks(range(len(lanes)))
+    ax.set_yticklabels([name for _, name in lanes], fontsize=8)
+    ax.invert_yaxis()  # first lane on top, chrome://tracing style
+    ax.set_xlabel("seconds since first event")
+    ax.set_title(os.path.basename(events_path))
+    handles = [plt.Line2D([], [], color=color_of[c], lw=6, label=c)
+               for c in cats]
+    ax.legend(handles=handles, loc="lower right", fontsize=8)
+    fig.tight_layout()
+    fig.savefig(out_path, dpi=120)
+    plt.close(fig)
+    return out_path

@@ -24,17 +24,28 @@ Request sources (first match wins):
   --synthetic N     N deterministic Zipf prompts (default; no assets
                     needed — smoke runs and benchmarks)
 
+Observability (graftscope): ``--trace_out t.json`` (Chrome-trace/
+Perfetto timeline), ``--events_out e.jsonl`` (raw event log with one
+``request.timeline`` lifecycle summary per request), ``--stats_port N``
+(live Prometheus ``/metrics`` + ``/snapshot.json`` over stdlib
+http.server), ``--flight_path f.jsonl`` (flight-recorder dump on
+engine-fatal errors). The final metrics snapshot carries p50/p90/
+p95/p99 for TTFT, queue wait, and decode step beside the averages.
+
 Examples (CPU mesh):
   PMDT_FORCE_CPU_DEVICES=8 python serve_lm.py --model gpt_tiny \\
       --random_init --synthetic 8 --max_slots 4 --max_new_tokens 16
   python serve_lm.py --model gpt_tiny --ckpt lm_run/model_2.pth \\
-      --requests reqs.jsonl --max_slots 8 --tp 2 --metrics_out m.json
+      --requests reqs.jsonl --max_slots 8 --tp 2 --metrics_out m.json \\
+      --trace_out trace.json --stats_port 9100
 """
 
 import argparse
 import json
 import sys
 
+from pytorch_multiprocessing_distributed_tpu.runtime import (
+    scope as graftscope)
 from pytorch_multiprocessing_distributed_tpu.utils.compile_cache import (
     enable_compilation_cache)
 
@@ -115,6 +126,7 @@ parser.add_argument('--metrics_out', default='', type=str,
                     help='write the final metrics snapshot as JSON')
 parser.add_argument('--quiet', action='store_true',
                     help='suppress per-token streaming lines')
+graftscope.add_cli_args(parser, stats_port=True)
 
 
 def _load_requests(args, vocab_size, skipped):
@@ -168,6 +180,9 @@ def main():
     if not args.ckpt and not args.random_init:
         raise SystemExit("pass --ckpt PATH (trained params) or "
                          "--random_init (smoke run)")
+    # arm BEFORE the engine exists: compile-phase prefill/insert spans
+    # are part of the timeline (warm-up cost made visible, not hidden)
+    graftscope.arm_from_args(args)
     from pytorch_multiprocessing_distributed_tpu.utils.hostenv import (
         force_cpu_devices_from_env)
 
@@ -227,6 +242,15 @@ def main():
         decode_horizon=args.decode_horizon,
         decode_attn=args.decode_attn)
 
+    stats_server = None
+    if args.stats_port:
+        # live telemetry beside the serving loop: /metrics (Prometheus
+        # text exposition) + /snapshot.json, stdlib http.server only
+        stats_server = graftscope.start_stats_server(
+            engine.metrics.snapshot, port=args.stats_port)
+        print(f"stats: http://127.0.0.1:"
+              f"{stats_server.server_address[1]}/metrics", flush=True)
+
     def emit(events):
         if args.quiet:
             return
@@ -241,33 +265,47 @@ def main():
 
     rejected = 0
     skipped = []
-    for prompt, max_new in _load_requests(args, model.vocab_size,
-                                          skipped):
-        request = Request(prompt, max_new, engine.eos_id)
-        while True:
-            try:
-                engine.enqueue(request)
-                break
-            except QueueFull:
-                # finite source + bounded queue = backpressure, not
-                # load shedding: drain a step, then re-enqueue the
-                # SAME request (its submit_time — and so its TTFT —
-                # keeps the first attempt's stamp)
+    served = []
+    # a crash anywhere in the drive loop leaves the flight ring on
+    # disk before propagating (engine-internal fatals already dump;
+    # this covers the CLI's own loop)
+    with graftscope.flight_recorder("serve_lm drive loop"):
+        for prompt, max_new in _load_requests(args, model.vocab_size,
+                                              skipped):
+            request = Request(prompt, max_new, engine.eos_id)
+            while True:
+                try:
+                    engine.enqueue(request)
+                    served.append(request)
+                    break
+                except QueueFull:
+                    # finite source + bounded queue = backpressure,
+                    # not load shedding: drain a step, then
+                    # re-enqueue the SAME request (its submit_time —
+                    # and so its TTFT — keeps the first attempt's
+                    # stamp)
+                    emit(engine.step())
+                except ValueError as e:
+                    rejected += 1
+                    print(f"rejected: {e}", file=sys.stderr)
+                    break
+            if args.stdin:
+                # online source: serve while the producer is still
+                # typing (an offline file bulk-admits + drains below)
                 emit(engine.step())
-            except ValueError as e:
-                rejected += 1
-                print(f"rejected: {e}", file=sys.stderr)
-                break
-        if args.stdin:
-            # online source: serve while the producer is still typing
-            # (an offline file bulk-admits and drains below instead)
-            emit(engine.step())
 
-    for event in engine.run():
-        emit([event])
+        for event in engine.run():
+            emit([event])
     for msg in skipped:
         print(f"rejected: {msg}", file=sys.stderr)
     rejected += len(skipped)
+    # one lifecycle summary event per terminal request: a JSONL
+    # consumer reads complete per-request stories (queue wait, TTFT,
+    # decode tail, finish reason) without re-deriving them from the
+    # raw span stream
+    for request in served:
+        graftscope.emit("request.timeline", cat="request",
+                        **request.timeline())
 
     snap = engine.metrics.snapshot()
     snap["rejected"] = rejected
@@ -282,6 +320,9 @@ def main():
     if args.metrics_out:
         with open(args.metrics_out, "w") as f:
             json.dump(snap, f, indent=2, sort_keys=True)
+    graftscope.export_from_args(args)
+    if stats_server is not None:
+        stats_server.shutdown()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,25 @@
+"""GL112 near-miss: graftscope at host boundaries around the jit (the
+intended discipline), and lookalikes — an ``.emit`` on a non-scope
+object, a non-clock datetime call."""
+from datetime import datetime
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_multiprocessing_distributed_tpu.runtime import (
+    scope as graftscope)
+
+
+@jax.jit
+def step(x):
+    return jnp.sum(x) * 2
+
+
+def drive(x, bus):
+    # host side of the dispatch boundary — exactly where spans belong
+    with graftscope.span("train.step_dispatch"):
+        y = step(x)
+    graftscope.emit("train.step_done", cat="train")
+    bus.emit("not-graftscope")  # an unrelated emitter object
+    when = datetime.strptime("2024", "%Y")  # parse, not a clock read
+    return y, when

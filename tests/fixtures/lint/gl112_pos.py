@@ -1,0 +1,22 @@
+"""GL112 positive: graftscope emission and datetime clocks under jit —
+the timestamp (and the event itself) freezes at trace time, so the
+timeline silently lies while the code looks instrumented."""
+from datetime import datetime
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_multiprocessing_distributed_tpu.runtime import (
+    scope as graftscope)
+from pytorch_multiprocessing_distributed_tpu.runtime.scope import emit
+
+
+@jax.jit
+def step(x):
+    graftscope.emit("step.start", cat="train")     # <- GL112
+    emit("step.alias", cat="train")                # <- GL112
+    stamp = datetime.now()                         # <- GL112
+    with graftscope.span("step.body"):             # <- GL112
+        y = jnp.sum(x)
+    graftscope.emit_span("step.tail", 0.0)         # <- GL112
+    return y, stamp

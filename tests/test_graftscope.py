@@ -1,0 +1,812 @@
+"""graftscope: the structured event bus, percentile telemetry, the
+exporters, and the flight recorder.
+
+What must stay true:
+
+- **zero disarmed cost**: emission helpers reduce to one global read;
+  ``span()`` disarmed returns a SHARED no-op object (no allocation);
+- **zero armed cost on device paths**: the serving engine's sentinel
+  pins (0 compiles / 0 transfers / 0 extra host syncs in steady
+  state) hold with a scope ARMED — instrumentation lives strictly at
+  boundaries where the host already synchronizes;
+- **exact percentiles**: ``PercentileMeter`` agrees with
+  ``np.percentile`` to the float, including weighted updates and
+  windowed views;
+- **honest accounting**: ``decode_tokens`` comes from drained blocks
+  (an explicit counter), never re-derived as
+  ``tokens_generated - ttft.count`` — the derivation that breaks the
+  moment TTFT-family samples decouple from first tokens;
+- **loadable artifacts**: the Chrome-trace export carries the schema
+  Perfetto requires, the JSONL log round-trips, the Prometheus text
+  exposition parses, the stats endpoint serves both live;
+- **crash truth**: engine-fatal paths (an injected
+  ``PoolPoisonedError`` included) leave the flight ring on disk, with
+  the events leading into the failure.
+"""
+
+import importlib.util
+import json
+import os
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pytorch_multiprocessing_distributed_tpu import models
+from pytorch_multiprocessing_distributed_tpu.analysis.sentinels import (
+    guard_transfers, recompile_budget)
+from pytorch_multiprocessing_distributed_tpu.runtime import (
+    scope as graftscope)
+from pytorch_multiprocessing_distributed_tpu.runtime.faults import (
+    FaultPlan, FaultRule, PoolPoisonedError, armed)
+from pytorch_multiprocessing_distributed_tpu.runtime.scope import (
+    Event, Scope, events_from_jsonl, prometheus_text, scoped,
+    start_stats_server, to_chrome_trace, write_chrome_trace,
+    write_jsonl)
+from pytorch_multiprocessing_distributed_tpu.serving import (
+    DONE, FAILED, ServingEngine, init_params)
+from pytorch_multiprocessing_distributed_tpu.utils.meters import (
+    AverageMeter, PercentileMeter, exact_percentile)
+from pytorch_multiprocessing_distributed_tpu.utils.metrics import (
+    ServingMetrics)
+
+
+def _tiny(**kw):
+    return models.GPT(vocab_size=61, max_seq_len=64, hidden_size=32,
+                      num_layers=2, num_heads=2, mlp_dim=64,
+                      attn_impl="xla", **kw)
+
+
+# ------------------------------------------------------------ event bus
+
+class TestEventBus:
+    def test_disarmed_is_a_shared_noop(self):
+        """Disarmed cost contract: emit returns immediately, span()
+        hands back the SAME object every time (no allocation), and
+        nothing is recorded anywhere."""
+        graftscope.disarm()
+        assert graftscope.active_scope() is None
+        graftscope.emit("never", cat="x", huge=list(range(3)))
+        s1 = graftscope.span("a")
+        s2 = graftscope.span("b", cat="y", k=1)
+        assert s1 is s2  # the shared _NULL_SPAN singleton
+        with s1 as live:
+            live.note(tokens=5)  # no-op twin keeps caller code unconditional
+        assert graftscope.flight_dump("nothing armed") is None
+
+    def test_emit_span_ordering_and_nesting(self):
+        with scoped() as s:
+            graftscope.emit("run.start", cat="run", n=3)
+            with graftscope.span("outer", cat="run") as outer:
+                graftscope.emit("inner.mark", cat="run")
+                with graftscope.span("inner", cat="run"):
+                    pass
+                outer.note(tokens=7)
+            graftscope.emit("run.end")
+        assert graftscope.active_scope() is None  # scoped() disarms
+        events = s.events()
+        names = [e.name for e in events]
+        # spans record at EXIT: inner closes before outer
+        assert names == ["run.start", "inner.mark", "inner", "outer",
+                         "run.end"]
+        # seq is a process-wide total order even under equal timestamps
+        assert [e.seq for e in events] == sorted(e.seq for e in events)
+        outer_ev = events[names.index("outer")]
+        inner_ev = events[names.index("inner")]
+        mark = events[names.index("inner.mark")]
+        # temporal nesting: the outer span contains its children
+        assert outer_ev.ts <= inner_ev.ts
+        assert inner_ev.end <= outer_ev.end + 1e-9
+        assert outer_ev.ts <= mark.ts <= outer_ev.end
+        # mid-span note landed before the span closed
+        assert outer_ev.attrs["tokens"] == 7
+        assert outer_ev.ph == "X" and mark.ph == "i"
+
+    def test_span_records_its_killer(self):
+        with scoped() as s:
+            with pytest.raises(ValueError):
+                with graftscope.span("doomed", cat="run"):
+                    raise ValueError("boom")
+        (ev,) = s.events()
+        assert ev.attrs["error"] == "ValueError"
+
+    def test_emit_span_retroactive(self):
+        with scoped() as s:
+            graftscope.emit_span("data.wait", 0.25, cat="train", batch=3)
+        (ev,) = s.events()
+        assert ev.ph == "X"
+        assert ev.dur == pytest.approx(0.25)
+        assert ev.attrs == {"batch": 3}
+
+    def test_ring_only_scope_bounds_memory(self):
+        s = Scope(keep=False, flight_capacity=4)
+        with scoped(s):
+            for i in range(10):
+                graftscope.emit("tick", i=i)
+        assert len(s.events()) == 4
+        assert [e.attrs["i"] for e in s.tail()] == [6, 7, 8, 9]
+        assert s.dropped == 6
+        assert s.counts() == {"tick": 4}
+        with pytest.raises(ValueError, match="flight_capacity"):
+            Scope(flight_capacity=0)
+
+    def test_counts_and_keep_mode(self):
+        with scoped() as s:
+            for _ in range(3):
+                graftscope.emit("a")
+            graftscope.emit("b")
+        assert s.counts() == {"a": 3, "b": 1}
+        assert len(s.events()) == 4  # keep=True: full log
+
+
+# ------------------------------------------------------- exact meters
+
+class TestPercentileMeter:
+    def test_exact_against_numpy(self):
+        rng = np.random.default_rng(0)
+        values = rng.lognormal(0.0, 1.5, size=257).tolist()
+        m = PercentileMeter()
+        for v in values:
+            m.update(v)
+        for q in (0, 10, 50, 90, 95, 99, 99.9, 100):
+            assert m.percentile(q) == pytest.approx(
+                float(np.percentile(values, q)), rel=0, abs=1e-12), q
+        assert m.avg == pytest.approx(float(np.mean(values)))
+        assert m.max == max(values)
+        snap = m.percentiles((50, 95, 99))
+        assert set(snap) == {"p50", "p95", "p99"}
+
+    def test_weighted_update_matches_population(self):
+        """update(v, n) records v n times — the percentile population
+        and the inherited weighted average stay consistent."""
+        m = PercentileMeter()
+        m.update(1.0, 3)
+        m.update(5.0, 1)
+        assert m.count == 4 and len(m.values) == 4
+        assert m.percentile(50) == pytest.approx(
+            float(np.percentile([1.0, 1.0, 1.0, 5.0], 50)))
+        assert m.avg == pytest.approx(2.0)
+
+    def test_empty_and_single(self):
+        m = PercentileMeter()
+        assert m.percentile(99) == 0.0 and m.max == 0.0
+        m.update(2.5)
+        assert m.percentile(1) == 2.5 and m.percentile(99) == 2.5
+
+    def test_exact_percentile_interpolates(self):
+        assert exact_percentile([0.0, 10.0], 50) == pytest.approx(5.0)
+        assert exact_percentile([0.0, 10.0], 25) == pytest.approx(2.5)
+
+    def test_window_stats(self):
+        m = PercentileMeter()
+        for v in (10.0, 20.0):
+            m.update(v)
+        m.advance_window()
+        for v in (1.0, 2.0, 3.0):
+            m.update(v)
+        win = m.window_stats((50,))
+        assert win["count"] == 3.0
+        assert win["avg"] == pytest.approx(2.0)
+        assert win["max"] == 3.0
+        assert win["p50"] == pytest.approx(
+            float(np.percentile([1.0, 2.0, 3.0], 50)))
+        # run-total view still covers everything
+        assert m.count == 5
+        assert m.percentile(100) == 20.0
+
+    def test_reset_clears_samples(self):
+        m = PercentileMeter()
+        m.update(3.0)
+        m.advance_window()
+        m.reset()
+        assert m.values == [] and m.window_values() == []
+        assert isinstance(m, AverageMeter)  # drop-in contract
+
+
+# -------------------------------------------------- serving telemetry
+
+class TestServingMetrics:
+    def test_snapshot_has_percentiles(self):
+        m = ServingMetrics()
+        for t in (0.1, 0.2, 0.9):
+            m.record_first_token(t)
+        m.record_admission(0.05)
+        m.record_decode_step(0.01, 4, 2, 0, 16)
+        snap = m.snapshot()
+        for name in ("ttft", "queue_wait", "decode_step"):
+            for q in ("p50", "p90", "p95", "p99"):
+                assert f"{name}_{q}_s" in snap
+        assert snap["ttft_p99_s"] == pytest.approx(
+            float(np.percentile([0.1, 0.2, 0.9], 99)))
+        m.record_completion(12)
+        snap = m.snapshot()
+        assert snap["tokens_per_request_p50"] == 12.0
+        assert snap["tokens_per_request_avg"] == 12.0
+
+    def test_decode_tokens_from_drained_blocks(self):
+        """Regression (the satellite fix): decode_tokens is the
+        explicit drained-block counter. The old derivation
+        ``tokens_generated - ttft.count`` silently undercounts the
+        moment a TTFT-family sample exists without a first token
+        behind it (a request failed before its first token, its
+        latency-to-failure recorded)."""
+        m = ServingMetrics()
+        m.record_first_token(0.05)          # request A: real tok0
+        m.record_decode_step(0.01, 4, 1, 0, 16)  # 4 drained tokens
+        m.ttft.update(0.5)   # request B: latency to FAILURE, no token
+        m.record_failure()
+        snap = m.snapshot()
+        assert snap["decode_tokens"] == 4
+        old_derivation = m.tokens_generated - m.ttft.count
+        assert old_derivation == 3  # the silent undercount, pinned
+        assert snap["decode_tokens_per_sec"] == pytest.approx(4 / 0.01)
+
+    def test_engine_decode_tokens_exact_under_quarantine(self):
+        """Engine-level: with one request quarantined before its first
+        token, decode_tokens still equals the survivors' post-first
+        tokens exactly."""
+        model = _tiny()
+        engine = ServingEngine(model, init_params(model, 5),
+                               max_slots=2, s_max=32, min_bucket=8,
+                               retry_backoff_s=0.0, dispatch_retries=2)
+        prompts = [list(range(2, 7)), list(range(3, 9)),
+                   list(range(1, 4))]
+        plan = FaultPlan([FaultRule("serving.prefill", "error",
+                                    times=2)])
+        with armed(plan):
+            reqs = [engine.submit(p, 4) for p in prompts]
+            for _ in engine.run():
+                pass
+        assert reqs[0].state == FAILED and not reqs[0].tokens
+        assert [r.state for r in reqs[1:]] == [DONE, DONE]
+        snap = engine.metrics.snapshot()
+        survivors = sum(len(r.tokens) for r in reqs[1:])
+        assert snap["tokens_generated"] == survivors
+        # 1 prefill token each; the rest drained from decode blocks
+        assert snap["decode_tokens"] == survivors - 2
+
+    def test_snapshot_delta_windows(self):
+        m = ServingMetrics()
+        m.record_first_token(0.1)
+        m.record_decode_step(0.5, 10, 1, 0, 16)
+        d1 = m.snapshot_delta()
+        assert d1["window_decode_tokens"] == 10
+        assert d1["window_ttft_count"] == 1.0
+        assert d1["window_decode_tokens_per_sec"] == pytest.approx(20.0)
+        # second window: only NEW activity
+        m.record_first_token(0.3)
+        m.record_first_token(0.5)
+        m.record_decode_step(0.5, 4, 1, 0, 16)
+        d2 = m.snapshot_delta()
+        assert d2["window_decode_tokens"] == 4
+        assert d2["window_ttft_count"] == 2.0
+        assert d2["window_ttft_p50_s"] == pytest.approx(
+            float(np.percentile([0.3, 0.5], 50)))
+        # run-total snapshot is untouched by the windowing
+        assert m.snapshot()["decode_tokens"] == 14
+        # idle window: zero deltas, zero rates (no division blowup)
+        d3 = m.snapshot_delta()
+        assert d3["window_decode_tokens"] == 0
+        assert d3["window_decode_tokens_per_sec"] == 0.0
+
+
+# ----------------------------------------------------------- exporters
+
+class TestExporters:
+    def _sample_scope(self):
+        with scoped() as s:
+            with graftscope.span("phase", cat="serving", req=1):
+                graftscope.emit("mark", cat="fault", site="x")
+        return s
+
+    def test_chrome_trace_schema(self, tmp_path):
+        s = self._sample_scope()
+        path = tmp_path / "trace.json"
+        write_chrome_trace(str(path), s.events(), t0=s.t0)
+        doc = json.loads(path.read_text())
+        assert doc["displayTimeUnit"] == "ms"
+        evs = doc["traceEvents"]
+        assert len(evs) == 2
+        for e in evs:
+            # the Perfetto/chrome://tracing required keys
+            assert {"name", "ph", "ts", "pid", "tid"} <= set(e)
+            assert isinstance(e["ts"], float) and e["ts"] >= 0.0
+        span_ev = next(e for e in evs if e["ph"] == "X")
+        inst = next(e for e in evs if e["ph"] == "i")
+        assert span_ev["dur"] >= 0.0
+        assert inst["s"] == "t"  # instant scope marker
+        assert inst["args"]["site"] == "x"
+        assert span_ev["args"]["req"] == 1
+
+    def test_jsonl_roundtrip(self, tmp_path):
+        s = self._sample_scope()
+        path = tmp_path / "events.jsonl"
+        write_jsonl(str(path), s.events())
+        back = events_from_jsonl(str(path))
+        assert [e["name"] for e in back] == ["mark", "phase"]
+        assert back[1]["ph"] == "X" and "dur" in back[1]
+        assert back[0]["seq"] < back[1]["seq"]
+
+    def test_prometheus_text(self):
+        text = prometheus_text(
+            {"ttft_p99_s": 0.25, "decode_tokens": 40,
+             "decode_programs": [[32, 1]], "mode": "steady",
+             "armed": True, "99weird key": 1.5},
+            prefix="pmdt_serving")
+        lines = [ln for ln in text.splitlines() if ln]
+        # every gauge: one TYPE line + one sample line, parseable
+        samples = {}
+        for ln in lines:
+            if ln.startswith("# TYPE "):
+                assert ln.endswith(" gauge")
+                continue
+            name, value = ln.rsplit(" ", 1)
+            samples[name] = float(value)
+        assert samples["pmdt_serving_ttft_p99_s"] == 0.25
+        assert samples["pmdt_serving_decode_tokens"] == 40.0
+        assert samples["pmdt_serving__99weird_key"] == 1.5
+        # non-numeric values (and bools) never become gauges
+        assert not any("programs" in k or "mode" in k or "armed" in k
+                       for k in samples)
+
+    def test_timeline_plot_from_jsonl(self, tmp_path):
+        """The plot_curves.py parity artifact, now for serving: a
+        JSONL event log renders to a timeline PNG (flight dumps render
+        too — the header line is skipped by the parser)."""
+        from pytorch_multiprocessing_distributed_tpu.utils.plotting import (
+            draw_timeline)
+
+        with scoped() as s:
+            with graftscope.span("serving.prefill", cat="serving",
+                                 req=0):
+                pass
+            graftscope.emit("fault.injected", cat="fault", site="x")
+            graftscope.emit_span("decode.drain", 0.01, cat="serving")
+        path = tmp_path / "run.jsonl"
+        write_jsonl(str(path), s.events())
+        out = draw_timeline(str(path))
+        assert out == str(tmp_path / "run.png")
+        assert (tmp_path / "run.png").stat().st_size > 0
+        with pytest.raises(ValueError, match="no graftscope events"):
+            empty = tmp_path / "empty.jsonl"
+            empty.write_text("")
+            draw_timeline(str(empty))
+
+    def test_stats_server_serves_metrics_and_snapshot(self):
+        m = ServingMetrics()
+        m.record_first_token(0.125)
+        server = start_stats_server(m.snapshot, port=0)
+        try:
+            port = server.server_address[1]
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics") as resp:
+                body = resp.read().decode()
+                assert resp.headers["Content-Type"].startswith(
+                    "text/plain")
+            assert "pmdt_serving_ttft_avg_s 0.125" in body
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/snapshot.json") as resp:
+                snap = json.loads(resp.read())
+            assert snap["ttft_avg_s"] == 0.125
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/nope")
+            assert err.value.code == 404
+        finally:
+            server.shutdown()
+
+    def test_stats_server_is_live_not_cached(self):
+        """The endpoint re-reads the snapshot per scrape — live
+        telemetry, not a boot-time copy."""
+        m = ServingMetrics()
+        server = start_stats_server(m.snapshot, port=0)
+        try:
+            port = server.server_address[1]
+
+            def scrape():
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/snapshot.json") as r:
+                    return json.loads(r.read())
+
+            assert scrape()["requests_completed"] == 0
+            m.record_completion(3)
+            assert scrape()["requests_completed"] == 1
+        finally:
+            server.shutdown()
+
+
+# ------------------------------------------------------ flight recorder
+
+class TestFlightRecorder:
+    def test_flight_dump_writes_header_and_tail(self, tmp_path):
+        target = tmp_path / "flight.jsonl"
+        with scoped(Scope(keep=False, flight_capacity=3,
+                          flight_path=str(target))):
+            for i in range(7):
+                graftscope.emit("tick", i=i)
+            out = graftscope.flight_dump("test reason")
+        assert out == str(target)
+        lines = [json.loads(ln) for ln in
+                 target.read_text().splitlines()]
+        header, events = lines[0], lines[1:]
+        assert header["graftscope_flight"] == "test reason"
+        assert header["events"] == 3
+        assert header["events_before_window"] == 4
+        assert [e["i"] for e in events] == [4, 5, 6]  # oldest-first
+        # a dump parses through the standard JSONL reader (header
+        # skipped)
+        assert len(events_from_jsonl(str(target))) == 3
+
+    def test_flight_recorder_context_dumps_on_crash(self, tmp_path):
+        target = tmp_path / "crash.jsonl"
+        with scoped(Scope(flight_path=str(target))) as s:
+            with pytest.raises(RuntimeError):
+                with graftscope.flight_recorder("drive loop"):
+                    graftscope.emit("work", step=1)
+                    raise RuntimeError("boom")
+        assert target.exists()
+        names = [e["name"] for e in events_from_jsonl(str(target))]
+        assert names == ["work", "engine.fatal"]
+        fatal = s.events()[-1]
+        assert fatal.attrs == {"what": "drive loop",
+                               "error": "RuntimeError"}
+
+    def test_flight_recorder_passes_clean_exit(self, tmp_path):
+        target = tmp_path / "clean.jsonl"
+        with scoped(Scope(flight_path=str(target))):
+            with graftscope.flight_recorder("drive loop"):
+                graftscope.emit("work")
+        assert not target.exists()  # no crash, no dump
+
+    def test_dump_failure_never_masks_the_crash(self, tmp_path):
+        """flight_dump sits on raise paths by contract: a typo'd
+        directory (or any write failure) is reported and swallowed —
+        the engine-fatal error stays the one that propagates."""
+        bad = str(tmp_path / "no_such_dir" / "f.jsonl")
+        with scoped(Scope(flight_path=bad)):
+            graftscope.emit("work")
+            assert graftscope.flight_dump("typo'd dir") is None
+            # the context-manager path: the ORIGINAL error survives
+            with pytest.raises(RuntimeError, match="the real crash"):
+                with graftscope.flight_recorder("drive", path=bad):
+                    raise RuntimeError("the real crash")
+        # unserializable attrs fall back to repr, never a TypeError
+        target = tmp_path / "weird.jsonl"
+        with scoped(Scope(flight_path=str(target))):
+            graftscope.emit("odd", payload=object())
+            assert graftscope.flight_dump("repr fallback") == str(
+                target)
+        (ev,) = events_from_jsonl(str(target))
+        assert "object object" in ev["payload"]
+
+    def test_arm_from_args_keep_mode(self):
+        """Full log only when an export artifact will consume it;
+        --stats_port/--flight_path alone arm the bounded ring (a
+        long-running server must not grow memory for a log nothing
+        reads)."""
+        import argparse
+
+        parser = argparse.ArgumentParser()
+        graftscope.add_cli_args(parser, stats_port=True)
+        try:
+            s = graftscope.arm_from_args(
+                parser.parse_args(["--stats_port", "1"]))
+            assert s.keep is False
+            assert s.flight_path == "graftscope_flight.jsonl"
+            s = graftscope.arm_from_args(
+                parser.parse_args(["--trace_out", "/tmp/t.json"]))
+            assert s.keep is True
+            assert s.flight_path == "/tmp/t.flight.jsonl"
+            assert graftscope.arm_from_args(
+                parser.parse_args([])) is None
+        finally:
+            graftscope.disarm()
+
+    def test_env_hook_ring_mode_can_dump(self, tmp_path):
+        """PMDT_SCOPE=1 (ring-only drills) arms WITH the default
+        flight path — the ring's only consumer is the crash dump, so
+        the mode must be able to write one."""
+        import subprocess
+        import sys as _sys
+
+        code = (
+            "from pytorch_multiprocessing_distributed_tpu.runtime "
+            "import scope\n"
+            "s = scope.active_scope()\n"
+            "assert s is not None and s.keep is False\n"
+            "assert s.flight_path == 'graftscope_flight.jsonl'\n"
+            "print('env hook OK')\n")
+        env = dict(os.environ, PMDT_SCOPE="1")
+        proc = subprocess.run([_sys.executable, "-c", code], env=env,
+                              capture_output=True, text=True,
+                              timeout=120,
+                              cwd=os.path.dirname(os.path.dirname(
+                                  os.path.abspath(__file__))))
+        assert proc.returncode == 0, proc.stderr
+        assert "env hook OK" in proc.stdout
+
+    def test_engine_fatal_pool_poison_dumps_flight(self, tmp_path):
+        """The acceptance scenario: an injected engine-fatal
+        ``PoolPoisonedError`` (mid-execution failure of a pool-
+        donating program, graftfault's harness) leaves the flight
+        ring on disk — the dispatch/drain events leading into the
+        poisoned launch, then the fatal marker."""
+        target = tmp_path / "poisoned.jsonl"
+        model = _tiny()
+        engine = ServingEngine(model, init_params(model, 1),
+                               max_slots=1, s_max=32, min_bucket=8,
+                               decode_buckets=(), retry_backoff_s=0.0)
+        with scoped(Scope(flight_path=str(target))):
+            engine.submit(list(range(5)), 4)
+            engine._donate_cache = True  # CPU never donates; simulate
+
+            def exploding_decode(*a, **k):
+                raise RuntimeError("simulated XlaRuntimeError mid-exec")
+
+            engine._decode = exploding_decode
+            with pytest.raises(PoolPoisonedError, match="pool-donating"):
+                for _ in engine.run():
+                    pass
+        events = events_from_jsonl(str(target))
+        names = [e["name"] for e in events]
+        # the lifecycle that led in is present, then the fatal marker
+        assert "request.submit" in names
+        assert "serving.prefill" in names
+        assert names[-1] == "engine.fatal"
+        fatal = events[-1]
+        assert fatal["error"] == "PoolPoisonedError"
+        assert fatal["cause"] == "RuntimeError"
+
+    def test_generic_step_fatal_dumps_once(self, tmp_path):
+        """A non-poison fatal escaping step() dumps too (watchdog
+        fail-fast class), via the step()-level recorder."""
+        target = tmp_path / "fatal.jsonl"
+        model = _tiny()
+        engine = ServingEngine(model, init_params(model, 1),
+                               max_slots=1, s_max=32, min_bucket=8,
+                               decode_buckets=(), retry_backoff_s=0.0,
+                               dispatch_retries=1)
+        with scoped(Scope(flight_path=str(target))):
+            engine.submit(list(range(4)), 3)
+            plan = FaultPlan([FaultRule("serving.decode_dispatch",
+                                        "error", times=5)])
+            with armed(plan):
+                with pytest.raises(Exception,
+                                   match="serving.decode_dispatch"):
+                    for _ in engine.run():
+                        pass
+        events = events_from_jsonl(str(target))
+        names = [e["name"] for e in events]
+        assert names[-1] == "engine.fatal"
+        assert "fault.injected" in names  # the injection is on the tape
+
+
+# ------------------------------------------- armed-cost sentinel pins
+
+class TestArmedCost:
+    def test_engine_steady_state_sentinels_with_scope_armed(self):
+        """The tentpole's hard criterion: arming graftscope adds ZERO
+        compiles, ZERO transfers, and ZERO host syncs to the serving
+        hot path. Same pin as tests/test_sentinels.py's steady-state
+        engine test — now with the scope ARMED and recording."""
+        model = _tiny()
+        params = init_params(model, 7)
+        rng = np.random.default_rng(7)
+        prompts = [rng.integers(0, model.vocab_size, (n,))
+                   for n in (3, 9, 12)]
+        engine = ServingEngine(model, params, max_slots=2, s_max=32,
+                               min_bucket=8)
+        engine.serve([(p, 4) for p in prompts])  # warm, disarmed
+        compiles = engine.decode_step_compiles
+        syncs_before = engine.metrics.snapshot()["decode_host_syncs"]
+
+        with scoped() as s:
+            with guard_transfers():
+                with recompile_budget(engine._decode, 0,
+                                      label="armed steady state"):
+                    finished = engine.serve([(p, 4) for p in prompts])
+        assert all(r.state == DONE for r in finished)
+        assert engine.decode_step_compiles == compiles
+        # the armed pass produced a full timeline...
+        counts = s.counts()
+        assert counts["request.done"] == 3
+        assert counts["decode.dispatch"] >= 1
+        assert counts["decode.drain"] == counts["decode.dispatch"]
+        # ...and EXACTLY the disarmed pass's host syncs: one per drain
+        syncs = (engine.metrics.snapshot()["decode_host_syncs"]
+                 - syncs_before)
+        assert syncs == counts["decode.drain"]
+
+    def test_trainer_window_fetch_only_sync(self):
+        """LM train loop shape: spans ride the windowed metric fetch
+        the loop already pays — emitting them adds no device work
+        (the step's program is untouched; pinned by the sentinel
+        suite's train-step test plus this armed smoke)."""
+        import jax
+        import jax.numpy as jnp
+
+        from pytorch_multiprocessing_distributed_tpu.parallel import (
+            make_mesh)
+        from pytorch_multiprocessing_distributed_tpu.train.lm import (
+            create_lm_train_state, make_lm_train_step)
+        from pytorch_multiprocessing_distributed_tpu.train.optim import (
+            sgd)
+        from pytorch_multiprocessing_distributed_tpu.train.step import (
+            shard_batch)
+
+        model = _tiny()
+        mesh = make_mesh(8, 1)
+        opt = sgd(learning_rate=0.1)
+        tokens = jnp.asarray(np.random.default_rng(0).integers(
+            0, model.vocab_size, (16, 32)))
+        state = create_lm_train_state(model, jax.random.PRNGKey(0),
+                                      tokens[:2], opt)
+        step = make_lm_train_step(model, opt, mesh)
+        (tok,) = shard_batch((tokens,), mesh)
+        state, _ = step(state, tok)
+        state, _ = step(state, tok)  # placement fixed point (see
+        # tests/test_sentinels.py)
+
+        with scoped() as s:
+            with guard_transfers():
+                with recompile_budget(step, 0, label="armed train"):
+                    for i in range(3):
+                        state, metrics = step(state, tok)
+                        graftscope.emit_span("train.data", 0.0,
+                                             cat="train", batch=i)
+                    with graftscope.span("train.metrics_fetch",
+                                         cat="train"):
+                        fetched = jax.device_get(metrics)
+        assert np.isfinite(float(np.asarray(fetched["loss"])))
+        assert s.counts() == {"train.data": 3,
+                              "train.metrics_fetch": 1}
+
+
+# ----------------------------------------------------- fault timeline
+
+class TestFaultTimeline:
+    def test_injected_fault_and_retry_are_events(self):
+        """Every injected fault and every retry is a visible,
+        site-named event — a chaos drill's timeline shows where the
+        faults landed."""
+        from pytorch_multiprocessing_distributed_tpu.runtime.faults import (
+            maybe_fault, register_site, retry_with_backoff)
+
+        register_site("test.scope_site",
+                      "synthetic site for the timeline test")
+        plan = FaultPlan([FaultRule("test.scope_site", "error",
+                                    times=2)])
+        with scoped() as s:
+            with armed(plan):
+                retry_with_backoff(
+                    lambda: maybe_fault("test.scope_site", "ok"),
+                    attempts=3, base_delay_s=0.0)
+        counts = s.counts()
+        assert counts["fault.injected"] == 2
+        assert counts["fault.retry"] == 2
+        injected = [e for e in s.events()
+                    if e.name == "fault.injected"]
+        assert all(e.attrs["site"] == "test.scope_site"
+                   for e in injected)
+        assert injected[0].cat == "fault"
+
+    def test_request_timeline_record(self):
+        """Request.timeline(): latencies for exactly the phases the
+        request reached."""
+        from pytorch_multiprocessing_distributed_tpu.serving.scheduler import (
+            Request)
+
+        r = Request([1, 2, 3], 4, None)
+        t = r.timeline()
+        assert t["prompt_len"] == 3 and "queue_wait_s" not in t
+        r.submit_time = 100.0
+        r.admit_time = 100.5
+        r.first_token_time = 101.0
+        r.finish_time = 103.0
+        r.tokens = [7, 8, 9]
+        r.state = DONE
+        r.finish_reason = "length"
+        t = r.timeline()
+        assert t["queue_wait_s"] == pytest.approx(0.5)
+        assert t["ttft_s"] == pytest.approx(1.0)
+        assert t["decode_s"] == pytest.approx(2.0)
+        assert t["total_s"] == pytest.approx(3.0)
+        assert t["tokens"] == 3 and t["state"] == DONE
+
+    def test_thread_ids_separate_lanes(self):
+        """Events carry the emitting thread id — concurrent lanes
+        (engine loop vs stats thread) stay separable in the trace."""
+        with scoped() as s:
+            graftscope.emit("main.lane")
+            t = threading.Thread(
+                target=lambda: graftscope.emit("other.lane"))
+            t.start()
+            t.join()
+        a, b = s.events()
+        assert a.tid != b.tid
+        trace = to_chrome_trace(s.events())
+        tids = {e["tid"] for e in trace["traceEvents"]}
+        assert len(tids) == 2
+
+
+# ------------------------------------------------ trainer loop, armed
+
+@pytest.mark.slow
+def test_trainer_fit_timeline(tmp_path):
+    """Trainer.fit with a scope armed (the main.py --trace_out path):
+    the whole epoch timeline lands — data waits, windowed metric
+    fetches, window spans, validation, checkpoint (with the
+    checkpoint.write byte count) — and the run itself is unchanged
+    (artifacts written, no crash, flight ring never dumped). Slow
+    (full vit fit); the armed-cost CRITERION stays tier-1 via
+    TestArmedCost."""
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_multiprocessing_distributed_tpu.data.pipeline import (
+        ShardedLoader)
+    from pytorch_multiprocessing_distributed_tpu.parallel import (
+        make_mesh)
+    from pytorch_multiprocessing_distributed_tpu.train import (
+        create_train_state)
+    from pytorch_multiprocessing_distributed_tpu.train.optim import sgd
+    from pytorch_multiprocessing_distributed_tpu.train.trainer import (
+        Trainer)
+
+    rng = np.random.default_rng(0)
+    images = rng.integers(0, 255, (64, 32, 32, 3), dtype=np.uint8)
+    labels = rng.integers(0, 10, (64,)).astype(np.int64)
+    loader = lambda train: ShardedLoader(  # noqa: E731
+        images, labels, batch_size=16, world_size=8, train=train,
+        shuffle=False, with_valid=not train)
+    model = models.get_model("vit_tiny", num_classes=10)
+    opt = sgd(learning_rate=0.1)
+    state = create_train_state(
+        model, jax.random.PRNGKey(0), jnp.zeros((2, 32, 32, 3)), opt)
+    trainer = Trainer(
+        model=model, optimizer=opt, mesh=make_mesh(), state=state,
+        train_loader=loader(True), test_loader=loader(False),
+        save_path=str(tmp_path), epochs=1, print_freq=2)
+
+    flight = tmp_path / "flight.jsonl"
+    with scoped(Scope(flight_path=str(flight))) as s:
+        trainer.fit()
+    counts = s.counts()
+    assert counts["train.data"] == 4  # 64 imgs / (16-batch) steps
+    assert counts["train.metrics_fetch"] >= 1
+    assert counts["train.window"] == counts["train.metrics_fetch"]
+    assert counts["train.eval_fetch"] >= 1
+    assert counts["train.checkpoint"] == 1  # final epoch
+    write = next(e for e in s.events()
+                 if e.name == "checkpoint.write")
+    assert write.attrs["bytes"] > 0
+    assert write.attrs["epoch"] == 1
+    # clean run: artifact exists, flight ring never dumped
+    assert (tmp_path / "model_1.pth").exists()
+    assert not flight.exists()
+    # every window span's step attribution is coherent
+    for ev in s.events():
+        if ev.name == "train.window":
+            assert ev.attrs["steps"] >= 1
+            assert ev.dur >= 0.0
+
+
+# --------------------------------------------------- make-scope smoke
+
+def test_scope_smoke_end_to_end(tmp_path):
+    """The ``make scope`` body, in-process: a synthetic engine run
+    emits a Perfetto-loadable Chrome trace, a JSONL log with complete
+    per-request lifecycles, and a parseable Prometheus exposition
+    (live endpoint scraped once) — every assertion lives in
+    benchmarks/scope_smoke.py so the CI target and this tier-1 test
+    can never drift apart."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "scope_smoke", os.path.join(repo, "benchmarks",
+                                    "scope_smoke.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    out = mod.run(str(tmp_path))
+    assert out["snapshot"]["requests_completed"] == 4
+    assert graftscope.active_scope() is None  # smoke disarms

@@ -35,6 +35,9 @@ import math
 import os
 import time
 
+from pytorch_multiprocessing_distributed_tpu.runtime import (
+    scope as graftscope)
+
 parser = argparse.ArgumentParser(
     description="TPU-native GPT training (LM counterpart of main.py)")
 parser.add_argument('--model', default='gpt_tiny', type=str,
@@ -134,9 +137,13 @@ parser.add_argument('--sample_beams', default=0, type=int,
                     help='> 1: decode --sample tokens with beam search '
                          'of this width instead of greedy (prints the '
                          'best beam)')
+graftscope.add_cli_args(parser)
 
 
 def main(args):
+    # arm before any jax work: compile/placement phases belong on the
+    # timeline too (zero cost when no graftscope flag is set)
+    graftscope.arm_from_args(args)
     from pytorch_multiprocessing_distributed_tpu.utils.hostenv import (
         force_cpu_devices_from_env)
 
@@ -485,70 +492,118 @@ def main(args):
     # local rows instead). tp/pp steps take the host array directly.
     use_prefetch = (args.parallel in ('dp', 'sp')
                     and jax.process_count() == 1)
-    for epoch in range(start_epoch, args.epochs + 1):
-        state = state.replace(epoch=jnp.asarray(epoch, jnp.int32))
-        loader.set_epoch(epoch)
-        t0, losses, seen = time.time(), 0.0, 0
-        batches = (prefetch_to_device(loader, mesh) if use_prefetch
-                   else loader)
-        for i, batch in enumerate(batches):
-            if use_prefetch:
-                state, metrics = step(state, batch)
-            elif args.parallel in ('tp', 'pp'):
-                state, metrics = step(state, jnp.asarray(batch))
-            else:
-                (tok_sharded,) = shard_batch((jnp.asarray(batch),), mesh)
-                state, metrics = step(state, tok_sharded)
-            if i % args.print_freq == 0 or i == len(loader) - 1:
-                if int(np.asarray(metrics.get('skipped', 0))):
-                    # NaN/inf grad guard refused this step — its loss
-                    # is the poisoned batch's (possibly NaN); keep it
-                    # out of the printed line and the epoch average
+
+    def train_epochs():
+        nonlocal state
+        # the clock reads below are graftscope's only per-step host
+        # cost — taken ONLY while a scope is armed (disarmed, the loop
+        # is byte-for-byte the old one)
+        armed = graftscope.active_scope() is not None
+        for epoch in range(start_epoch, args.epochs + 1):
+            state = state.replace(epoch=jnp.asarray(epoch, jnp.int32))
+            loader.set_epoch(epoch)
+            t0, losses, seen = time.time(), 0.0, 0
+            batches = (prefetch_to_device(loader, mesh) if use_prefetch
+                       else loader)
+            t_ready = time.perf_counter() if armed else 0.0
+            for i, batch in enumerate(batches):
+                if armed:
+                    # data wait: time from step dispatch to the next
+                    # batch being in hand (prefetch hides H2D here)
+                    graftscope.emit_span(
+                        "train.data", time.perf_counter() - t_ready,
+                        cat="train", epoch=epoch, batch=i)
+                if use_prefetch:
+                    state, metrics = step(state, batch)
+                elif args.parallel in ('tp', 'pp'):
+                    with graftscope.span("train.h2d", cat="train",
+                                         batch=i):
+                        tok = jnp.asarray(batch)
+                    state, metrics = step(state, tok)
+                else:
+                    with graftscope.span("train.h2d", cat="train",
+                                         batch=i):
+                        (tok_sharded,) = shard_batch(
+                            (jnp.asarray(batch),), mesh)
+                    state, metrics = step(state, tok_sharded)
+                if i % args.print_freq == 0 or i == len(loader) - 1:
+                    # the print boundary is the loop's ONE deliberate
+                    # host sync — the same boundary graftscope stamps
+                    with graftscope.span("train.metrics_fetch",
+                                         cat="train", epoch=epoch,
+                                         batch=i) as mspan:
+                        skipped = int(
+                            np.asarray(metrics.get('skipped', 0)))
+                        loss = (None if skipped
+                                else float(np.asarray(metrics['loss'])))
+                    if skipped:
+                        # NaN/inf grad guard refused this step — its
+                        # loss is the poisoned batch's (possibly NaN);
+                        # keep it out of the printed line and the
+                        # epoch average
+                        mspan.note(skipped=True)
+                        graftscope.emit("train.step_skipped",
+                                        cat="train", epoch=epoch,
+                                        batch=i)
+                        if dist.is_primary():
+                            print(f"Epoch: [{epoch}][{i}/{len(loader)}]\t"
+                                  "step skipped (non-finite grads)",
+                                  flush=True)
+                        t_ready = time.perf_counter() if armed else 0.0
+                        continue
+                    losses, seen = losses + loss, seen + 1
                     if dist.is_primary():
+                        extra = ''
+                        if 'moe_aux' in metrics:
+                            extra = (f"\tAux "
+                                     f"{float(np.asarray(metrics['moe_aux'])):.3f}")
                         print(f"Epoch: [{epoch}][{i}/{len(loader)}]\t"
-                              "step skipped (non-finite grads)",
-                              flush=True)
-                    continue
-                loss = float(np.asarray(metrics['loss']))
-                losses, seen = losses + loss, seen + 1
-                if dist.is_primary():
-                    extra = ''
-                    if 'moe_aux' in metrics:
-                        extra = (f"\tAux "
-                                 f"{float(np.asarray(metrics['moe_aux'])):.3f}")
-                    print(f"Epoch: [{epoch}][{i}/{len(loader)}]\t"
-                          f"Loss {loss:.4f}\t"
-                          f"Tok/s {args.batch_size * args.seq_len * (i + 1) / (time.time() - t0):.0f}"
-                          f"{extra}", flush=True)
-        avg = losses / max(1, seen)
-        if dist.is_primary():
-            logger.write([epoch, avg, math.exp(min(avg, 20.0))])
-        if eval_step is not None:
-            tot, cnt = 0.0, 0.0
-            for batch in val_loader:
-                tok = jnp.asarray(batch)
-                if args.parallel not in ('tp', 'pp'):
-                    (tok,) = shard_batch((tok,), mesh)
-                m = eval_step(state, tok)
-                c = float(np.asarray(m['count']))
-                tot, cnt = tot + float(np.asarray(m['loss'])) * c, cnt + c
-            vloss = tot / max(1.0, cnt)
+                              f"Loss {loss:.4f}\t"
+                              f"Tok/s {args.batch_size * args.seq_len * (i + 1) / (time.time() - t0):.0f}"
+                              f"{extra}", flush=True)
+                t_ready = time.perf_counter() if armed else 0.0
+            avg = losses / max(1, seen)
             if dist.is_primary():
-                print(f"Val: [{epoch}]\tLoss {vloss:.4f}\t"
-                      f"PPL {math.exp(min(vloss, 20.0)):.2f}", flush=True)
-                test_logger.write(
-                    [epoch, vloss, math.exp(min(vloss, 20.0))])
-        if (args.save_every and epoch % args.save_every == 0
-                and epoch < args.epochs):
-            # periodic checkpoint (collective; the final epoch is
-            # saved once below)
-            if ck is not None:
-                ck.save(state, epoch)  # retention inside the manager
-            else:
-                save_checkpoint(args.save_path, state, epoch)
-                if args.keep_checkpoints and dist.is_primary():
-                    prune_checkpoints(args.save_path,
-                                      args.keep_checkpoints)
+                logger.write([epoch, avg, math.exp(min(avg, 20.0))])
+            if eval_step is not None:
+                with graftscope.span("train.validate", cat="train",
+                                     epoch=epoch):
+                    tot, cnt = 0.0, 0.0
+                    for batch in val_loader:
+                        tok = jnp.asarray(batch)
+                        if args.parallel not in ('tp', 'pp'):
+                            (tok,) = shard_batch((tok,), mesh)
+                        m = eval_step(state, tok)
+                        c = float(np.asarray(m['count']))
+                        tot = tot + float(np.asarray(m['loss'])) * c
+                        cnt = cnt + c
+                    vloss = tot / max(1.0, cnt)
+                if dist.is_primary():
+                    print(f"Val: [{epoch}]\tLoss {vloss:.4f}\t"
+                          f"PPL {math.exp(min(vloss, 20.0)):.2f}",
+                          flush=True)
+                    test_logger.write(
+                        [epoch, vloss, math.exp(min(vloss, 20.0))])
+            if (args.save_every and epoch % args.save_every == 0
+                    and epoch < args.epochs):
+                # periodic checkpoint (collective; the final epoch is
+                # saved once below)
+                with graftscope.span("train.checkpoint", cat="train",
+                                     epoch=epoch,
+                                     backend=args.ckpt_backend):
+                    if ck is not None:
+                        ck.save(state, epoch)  # retention inside
+                    else:
+                        save_checkpoint(args.save_path, state, epoch)
+                        if args.keep_checkpoints and dist.is_primary():
+                            prune_checkpoints(args.save_path,
+                                              args.keep_checkpoints)
+
+    # a crash unwinding the epoch loop dumps the flight ring first —
+    # the postmortem starts with the last windows' spans, not a bare
+    # stack trace
+    with graftscope.flight_recorder("train_lm epoch loop"):
+        train_epochs()
     if args.hf_export:
         from pytorch_multiprocessing_distributed_tpu.train.checkpoint import (
             _gather_for_host)
@@ -558,16 +613,20 @@ def main(args):
         # gather becomes a no-op pass-through
         state = _gather_for_host(state)
     if start_epoch <= args.epochs:
-        if ck is not None:
-            ck.save(state, args.epochs)
-            ck.wait()  # final save durable before exit
-        else:
-            save_checkpoint(args.save_path, state, args.epochs)
-            # prune after EVERY save (Trainer semantics): retention
-            # means "newest K overall", identically on both backends
-            # (orbax's max_to_keep counts the final save too)
-            if args.keep_checkpoints and dist.is_primary():
-                prune_checkpoints(args.save_path, args.keep_checkpoints)
+        with graftscope.span("train.checkpoint", cat="train",
+                             epoch=args.epochs,
+                             backend=args.ckpt_backend, final=True):
+            if ck is not None:
+                ck.save(state, args.epochs)
+                ck.wait()  # final save durable before exit
+            else:
+                save_checkpoint(args.save_path, state, args.epochs)
+                # prune after EVERY save (Trainer semantics): retention
+                # means "newest K overall", identically on both
+                # backends (orbax's max_to_keep counts the final save)
+                if args.keep_checkpoints and dist.is_primary():
+                    prune_checkpoints(args.save_path,
+                                      args.keep_checkpoints)
     elif dist.is_primary():
         # resume landed past --epochs: nothing trained, and rewriting
         # model_{epochs}.pth would relabel a LATER-epoch state
@@ -646,6 +705,8 @@ def main(args):
 
     if ck is not None:
         ck.close()
+    if dist.is_primary():
+        graftscope.export_from_args(args)
     dist.destroy_process_group()
 
 
