@@ -3,8 +3,8 @@
 
 PYTEST_ENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 
-.PHONY: test test-fast lint check check-update chaos scope dryrun \
-        bench bench-cpu store clean
+.PHONY: test test-fast lint check check-update chaos scope meter \
+        dryrun bench bench-cpu store clean
 
 # graftlint: AST-only jit-hygiene gate (no jax import, milliseconds).
 # Exit 1 on any non-baselined finding; the tier-1 suite and
@@ -12,16 +12,19 @@ PYTEST_ENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cp
 lint:
 	python -m pytorch_multiprocessing_distributed_tpu.analysis.lint
 
-# graftcheck: jaxpr-level program auditor — collective budgets,
-# donation/resharding/dtype audits, golden fingerprints for the
-# canonical programs (traces/compiles on the 8-device CPU mesh; never
-# executes). Exit 1 on any budget/fingerprint drift; enforced in
-# tier-1 (tests/test_graftcheck.py) and on_grant.sh step 0.
+# graftcheck + graftmeter: jaxpr-level program auditor — collective
+# budgets, donation/resharding/dtype audits, golden fingerprints —
+# plus the committed cost/memory budgets (analysis/costs.json:
+# FLOPs, bytes accessed, argument/output/temp HBM per canonical
+# program), all in ONE pass (traces/compiles on the 8-device CPU
+# mesh; never executes). Exit 1 on any drift; enforced in tier-1
+# (tests/test_graftcheck.py) and on_grant.sh step 0.
 check:
 	$(PYTEST_ENV) python -m pytorch_multiprocessing_distributed_tpu.analysis.check
 
-# refresh analysis/fingerprints.json after a DELIBERATE program change
-# (review the JSON diff in the PR; inline invariants still enforce)
+# refresh analysis/fingerprints.json AND analysis/costs.json after a
+# DELIBERATE program change (review the JSON diffs in the PR; inline
+# invariants still enforce)
 check-update:
 	$(PYTEST_ENV) python -m pytorch_multiprocessing_distributed_tpu.analysis.check --update
 
@@ -41,6 +44,18 @@ chaos:
 # (test_scope_smoke_end_to_end in tests/test_graftscope.py).
 scope:
 	$(PYTEST_ENV) python benchmarks/scope_smoke.py
+
+# graftmeter: capacity/efficiency smoke — a registry canary must
+# re-measure clean against the committed analysis/costs.json budgets,
+# plan_capacity's slot prediction must match a real CPU-backend
+# SlotPool allocation within 0.5%, a served engine with the HBM
+# ledger armed must expose pmdt_hbm_* gauges on a live /metrics
+# scrape, and the ledger must render to a breakdown PNG. Same body
+# runs in tier-1 (test_meter_smoke_end_to_end in
+# tests/test_graftmeter.py); the full 15-program budget gate is
+# `make check`.
+meter:
+	$(PYTEST_ENV) python benchmarks/meter_smoke.py
 
 # full suite on the virtual 8-device CPU mesh (incl. slow e2e CLI runs)
 test:

@@ -76,6 +76,22 @@ PEAK_FLOPS = [
     ("v2", 45e12),
 ]
 
+# HBM bandwidth per chip (bytes/s) by the same device_kind substrings —
+# the roofline's second axis (graftmeter): a step whose arithmetic
+# intensity sits below peak_flops/peak_bw is bandwidth-bound and no
+# kernel fusion will reach MXU peak. Sources: public TPU spec sheets.
+PEAK_HBM_BW = [
+    ("v6e", 1640e9),
+    ("v6 lite", 1640e9),
+    ("v5p", 2765e9),
+    ("v5e", 819e9),
+    ("v5 lite", 819e9),
+    ("v5", 2765e9),
+    ("v4", 1228e9),
+    ("v3", 900e9),
+    ("v2", 700e9),
+]
+
 CONFIGS = {
     "resnet18_cifar": dict(
         model="res", image_size=32, batch=512, num_classes=10, stem="cifar",
@@ -301,37 +317,53 @@ def init_devices(retries: int = 3, delay: float = 5.0,
     return jax.devices(), note
 
 
-def chip_peak_flops(device) -> float:
-    kind = getattr(device, "device_kind", "").lower()
+def _chip_peak(device, table):
+    """First device_kind-substring match in an ordered peak table
+    (more specific generations first); None off-TPU / unknown chip —
+    the ONE lookup both the FLOPs and HBM-bandwidth axes use."""
     if device.platform != "tpu":
         return None
-    for key, peak in PEAK_FLOPS:
+    kind = getattr(device, "device_kind", "").lower()
+    for key, peak in table:
         if key in kind:
             return peak
     return None
 
 
+def chip_peak_flops(device) -> float:
+    return _chip_peak(device, PEAK_FLOPS)
+
+
+def chip_peak_hbm_bw(device) -> float:
+    return _chip_peak(device, PEAK_HBM_BW)
+
+
 def compile_step(step, *args):
-    """AOT-compile the step ONCE; return (callable, per-chip FLOPs).
+    """AOT-compile the step ONCE; return ``(callable, costs)`` where
+    ``costs`` is the graftmeter record for the exact executable
+    (``{flops, bytes_accessed, arithmetic_intensity, memory}`` —
+    ``analysis.meter.costs_record``) or None when AOT is unavailable.
 
     The compiled executable drives the warmup/timed loops directly (AOT
     compiles don't populate jit's cache, so lowering for cost analysis
     and then calling the jitted wrapper would compile the same program
     twice — a multi-ten-second tax on the exact harness whose round-1
-    failure was a startup timeout). Lowering + cost analysis go through
-    the shared ``utils.compile_cache.lowered_cost_analysis`` path (the
-    same one the graftcheck auditor inspects, so the benched program
-    and the audited program cannot drift).
+    failure was a startup timeout). Lowering + cost/memory analysis go
+    through the shared ``utils.compile_cache.lowered_program_analysis``
+    path (the same one the graftcheck/graftmeter auditors inspect, so
+    the benched program, the budgeted program and the audited program
+    cannot drift).
     """
+    from pytorch_multiprocessing_distributed_tpu.analysis.meter import (
+        costs_record)
     from pytorch_multiprocessing_distributed_tpu.utils.compile_cache import (
-        lowered_cost_analysis)
+        lowered_program_analysis)
 
     try:
-        compiled, cost = lowered_cost_analysis(step, *args)
+        compiled, cost, memory = lowered_program_analysis(step, *args)
     except Exception as e:
         _log(f"AOT compile unavailable ({e}); falling back to jit")
         return step, None
-    flops = None
     if cost is None:
         # compat.cost_analysis_dict swallowed the reason; re-probe the
         # raw call (failure path only) so a one-shot grant capture's
@@ -342,10 +374,7 @@ def compile_step(step, *args):
                  "usable cost model)")
         except Exception as e:  # noqa: BLE001
             _log(f"cost_analysis unavailable: {e}")
-    else:
-        f = cost.get("flops", 0.0)
-        flops = float(f) if f and f > 0 else None
-    return compiled, flops
+    return compiled, costs_record(cost, memory)
 
 
 def build_workload(config: str, dtype_name: str, batch_size: int,
@@ -445,7 +474,10 @@ def run_bench(config: str, dtype_name: str, batch_size: int,
         config, dtype_name, batch_size, devices, remat=remat,
         vocab_chunks=vocab_chunks,
     )
-    step, flops = compile_step(step, state, *batch_args)
+    step, costs = compile_step(step, state, *batch_args)
+    flops = float(costs["flops"]) if costs and costs["flops"] else None
+    bytes_accessed = (float(costs["bytes_accessed"])
+                      if costs and costs["bytes_accessed"] else None)
 
     from pytorch_multiprocessing_distributed_tpu.utils.profiler import sync
 
@@ -528,9 +560,15 @@ def run_bench(config: str, dtype_name: str, batch_size: int,
     # smaller number.
     per_chip = items_per_step / step_s / n_dev
     peak = chip_peak_flops(devices[0])
-    mfu = None
-    if flops and peak:
-        mfu = round(flops / step_s / peak, 4)
+    peak_bw = chip_peak_hbm_bw(devices[0])
+    # measured-vs-roofline join (graftmeter): achieved FLOP/s, bytes/s
+    # and the intensity-limited ceiling, from the SAME static model the
+    # committed cost budgets pin. Null-safe on CPU/unknown chips.
+    from pytorch_multiprocessing_distributed_tpu.analysis.meter import (
+        roofline)
+
+    eff = roofline(flops, bytes_accessed, step_s, peak, peak_bw)
+    mfu = eff["mfu"]
 
     result = {
         "metric": metric_for(config)[0],
@@ -565,6 +603,17 @@ def run_bench(config: str, dtype_name: str, batch_size: int,
             "vocab_chunks": vocab_chunks,
             "flops_per_step_per_chip": flops,
             "peak_flops_per_chip": peak,
+            # ---- graftmeter efficiency attribution: every record
+            # carries WHERE the time went, not just how much of it
+            "bytes_accessed_per_step_per_chip": bytes_accessed,
+            "peak_hbm_bw_per_chip": peak_bw,
+            "arithmetic_intensity": eff["arithmetic_intensity"],
+            "achieved_flops_per_sec": eff["achieved_flops_per_sec"],
+            "achieved_bytes_per_sec": eff["achieved_bytes_per_sec"],
+            "roofline_flops_per_sec": eff["roofline_flops_per_sec"],
+            "roofline_bound": eff["roofline_bound"],
+            "roofline_frac": eff["roofline_frac"],
+            "hbm_memory": (costs or {}).get("memory"),
         },
     }
     if note:

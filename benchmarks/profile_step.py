@@ -115,7 +115,7 @@ def main():
         args.config, args.dtype, args.batch_size, devices,
         remat=args.remat,
     )
-    step, flops = bench.compile_step(step, state, *batch_args)
+    step, costs = bench.compile_step(step, state, *batch_args)
     for _ in range(3):  # steady state before the trace
         state, m = step(state, *batch_args)
     sync(m)
@@ -161,7 +161,7 @@ def main():
         "steps_traced": args.steps,
         "device_plane_line": op_line,
         "device_ms_per_step": round(total_ps / 1e9 / args.steps, 3),
-        "flops_per_step": flops,
+        "flops_per_step": (costs or {}).get("flops"),
         "categories_pct": {
             k: round(100 * v / total_ps, 2)
             for k, v in sorted(cats.items(), key=lambda kv: -kv[1])

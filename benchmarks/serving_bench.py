@@ -69,15 +69,24 @@ def _percentile(values, q):
 
 def run_point(model, params, prompts, new_tokens, slots, offered_rps,
               s_max, warmup=False, arm_plan=None, **engine_kwargs):
+    from pytorch_multiprocessing_distributed_tpu.runtime import (
+        hbm as hbm_ledger)
     from pytorch_multiprocessing_distributed_tpu.runtime import faults
     from pytorch_multiprocessing_distributed_tpu.serving import (
         ServingEngine)
     from pytorch_multiprocessing_distributed_tpu.utils.metrics import (
         ServingMetrics)
 
-    engine = ServingEngine(model, params, max_slots=slots, s_max=s_max,
-                           **engine_kwargs)
+    # graftmeter: one fresh ledger per point, armed BEFORE the engine
+    # so the pool/params registrations land — every sweep point then
+    # records its resident HBM beside its throughput. Armed inside
+    # the try: a failed engine construction must still disarm (a
+    # stale process-wide ledger would silently absorb later points'
+    # registrations).
+    ledger = hbm_ledger.arm(hbm_ledger.HbmLedger())
     try:
+        engine = ServingEngine(model, params, max_slots=slots,
+                               s_max=s_max, **engine_kwargs)
         if arm_plan is not None:
             # chaos sweep: arm BEFORE the warm-up pass so the
             # degraded-mode programs (collapsed-horizon windows) also
@@ -115,12 +124,36 @@ def run_point(model, params, prompts, new_tokens, slots, offered_rps,
     finally:
         if arm_plan is not None:
             faults.disarm()
+        hbm_ledger.disarm()
     wall = time.perf_counter() - t_start
     ttfts = [r.first_token_time - r.submit_time for r in finished]
     waits = [r.admit_time - r.submit_time for r in finished]
     total_tokens = sum(len(r.tokens) for r in finished)
     snap = engine.metrics.snapshot()
+    # graftmeter efficiency attribution: decode MFU charges the run's
+    # total DISPATCHED scan steps (the horizon meter's sum — collapsed
+    # H=1 dispatches in the chaos sweep's cooldowns count as 1, not
+    # H_max) at the steady-state program's per-step static FLOPs; the
+    # chip does that work regardless of occupancy, so this IS the
+    # utilization (window variation across buckets is the remaining
+    # approximation). Null off-TPU (no peak) — never a fake number.
+    mfu = None
+    decode_flops = None
+    if engine.decode_programs:
+        import bench
+
+        w, h = max(engine.decode_programs, key=lambda p: (p[1], p[0]))
+        decode_flops = engine.decode_program_analysis(w, h).get("flops")
+        peak = bench.chip_peak_flops(jax.devices()[0])
+        if decode_flops and peak and wall > 0:
+            steps_dispatched = engine.metrics.horizon.sum
+            mfu = round((decode_flops / h) * steps_dispatched
+                        / wall / peak, 4)
     return {
+        "hbm_resident_bytes": ledger.total_bytes,
+        "hbm_per_slot_bytes": engine.pool.per_slot_bytes,
+        "decode_flops_per_dispatch": decode_flops,
+        "mfu": mfu,
         "completed": len(finished),
         "wall_s": wall,
         "tokens_per_sec": total_tokens / wall,

@@ -134,7 +134,7 @@ parser.add_argument('--torch_export', action='store_true',
                          'torch-loadable state_dict '
                          '(model_{epoch}.torch.pth, reference model '
                          'naming; ResNet family only)')
-graftscope.add_cli_args(parser)
+graftscope.add_cli_args(parser, stats_port=True)
 
 
 def main(args):
@@ -151,6 +151,12 @@ def main(args):
     # timeline too (zero cost when no graftscope flag is set; the
     # Trainer's spans and the flight recorder attach automatically)
     graftscope.arm_from_args(args)
+    from pytorch_multiprocessing_distributed_tpu.runtime import hbm
+
+    if args.stats_port:
+        # graftmeter: arm the HBM ledger before any state is placed so
+        # the Trainer's params/opt-state registrations land on it
+        hbm.arm()
     # Backend selection must happen before device queries.
     from pytorch_multiprocessing_distributed_tpu.utils.hostenv import (
         force_cpu_devices_from_env)
@@ -385,6 +391,24 @@ def main(args):
         ckpt_backend=args.ckpt_backend,
         ckpt_async=args.ckpt_async,
     )
+    stats_server = None
+    if args.stats_port:
+        # live trainer telemetry: hbm_* capacity gauges (graftmeter
+        # ledger) + the loop's windowed loss/throughput, on /metrics
+        # and /snapshot.json over stdlib http.server
+
+        def live_snapshot():
+            snap = dict(trainer.live)
+            ledger = hbm.active_ledger()
+            if ledger is not None:
+                snap.update(ledger.snapshot())
+            return snap
+
+        stats_server = graftscope.start_stats_server(
+            live_snapshot, port=args.stats_port, prefix="pmdt")
+        print(f"stats: http://127.0.0.1:"
+              f"{stats_server.server_address[1]}/metrics", flush=True)
+
     if args.profile:
         from pytorch_multiprocessing_distributed_tpu.utils.profiler import trace
 
@@ -414,6 +438,8 @@ def main(args):
 
     if dist.is_primary():
         graftscope.export_from_args(args)
+    if stats_server is not None:
+        stats_server.shutdown()
     dist.destroy_process_group()
 
 

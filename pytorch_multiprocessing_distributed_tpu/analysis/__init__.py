@@ -25,11 +25,17 @@ PR*:
   resharding/replication caps, dtype-promotion counts, and golden
   program fingerprints committed in ``analysis/fingerprints.json``.
   These modules DO import jax (they interrogate the tracer) — the
-  lint CLI stays jax-free; import them directly, never from here.
+  lint CLI stays jax-free; import them directly, never from here;
+- :mod:`.meter` — **graftmeter**, the static cost/memory model
+  (``analysis/costs.json`` budgets enforced by the same ``make
+  check`` pass: FLOPs, bytes accessed, arithmetic intensity,
+  argument/output/temp HBM per program), the HBM capacity planner
+  (``plan_capacity``), and the roofline helpers both benches stamp
+  records with. Jax-importing like graftcheck.
 
-Rule IDs are stable (graftlint ``GL1xx``, graftcheck ``GC1xx``) —
-suppression comments, the baseline file and the fingerprint snapshot
-refer to them.
+Rule IDs are stable (graftlint ``GL1xx``, graftcheck ``GC1xx``,
+graftmeter ``GM1xx``) — suppression comments, the baseline file and
+the budget snapshots refer to them.
 """
 
 from .rules import RULES, Finding, analyze_files  # noqa: F401

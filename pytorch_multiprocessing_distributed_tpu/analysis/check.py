@@ -14,7 +14,13 @@ and enforces two layers of contract:
    collective sets, and a structural digest. Any drift fails with a
    readable diff naming the program and rule; deliberate changes are
    re-baselined with ``make check-update`` (and reviewed as a JSON
-   diff in the PR).
+   diff in the PR);
+3. **committed cost/memory budgets** (``analysis/costs.json``,
+   graftmeter — GM rules from ``analysis/meter.py``): per-program
+   FLOPs, bytes accessed, arithmetic intensity and the compiled
+   argument/output/temp/generated-code HBM breakdown, measured off
+   the SAME compile as the HLO audit. Temp-HBM growth fails with a
+   "+N MiB temp" diff naming program + field.
 
 Workflow::
 
@@ -175,31 +181,66 @@ def compare(records: Dict[str, dict], committed: Dict[str, dict],
 
 def run_check(names: Optional[Sequence[str]] = None, *,
               update: bool = False,
-              fingerprints: Optional[str] = None
+              fingerprints: Optional[str] = None,
+              costs: Optional[str] = None
               ) -> Tuple[List[Finding], Dict[str, dict], List[str]]:
     """Library entry (the tier-1 gate calls this in-process): audit,
     compare (or snapshot with ``update``), return
-    ``(findings, records, skipped)``."""
+    ``(findings, records, skipped)``. One pass enforces BOTH committed
+    files: ``analysis/fingerprints.json`` (structure/collective
+    budgets, GC rules) and ``analysis/costs.json`` (graftmeter
+    FLOPs/bytes/memory budgets, GM rules) — the audit's one compile
+    feeds both, so they can never disagree about which program ran."""
+    from . import meter
+
     path = fingerprints or default_fingerprints_path()
+    costs_path = costs or meter.default_costs_path()
     records, findings, skipped = run_audits(names)
+    # split each record: "costs" is graftmeter's half, committed and
+    # compared separately in costs.json
+    fp_records: Dict[str, dict] = {}
+    cost_records: Dict[str, dict] = {}
+    for name, rec in records.items():
+        rec = dict(rec)
+        cost_rec = rec.pop("costs", None)
+        fp_records[name] = rec
+        if cost_rec is not None:
+            cost_records[name] = cost_rec
     committed = load_fingerprints(path)
+    committed_costs = meter.load_costs(costs_path)
+    failed_fp = frozenset(f.program for f in findings
+                          if f.rule == "GC100")
+    # a GM100 (compile-for-metering failure) program produced no cost
+    # record but its committed budget is NOT stale — keep it, like a
+    # GC100's fingerprint entry
+    failed_costs = frozenset(f.program for f in findings
+                             if f.rule in ("GC100", "GM100"))
     if update:
         # prune stale names only on a COMPLETE clean enumeration: a
         # name-filtered, device-limited, or build-failed (GC100 — the
         # program produced no record) run must keep the entries it
         # could not re-trace, or one transient breakage would silently
         # delete a program's committed budget history
-        full = (not names and not skipped
-                and not any(f.rule == "GC100" for f in findings))
+        full = not names and not skipped and not failed_fp
         keep = {} if full else {k: v for k, v in committed.items()
-                                if k not in records}
-        write_fingerprints(records, path, keep=keep)
+                                if k not in fp_records}
+        write_fingerprints(fp_records, path, keep=keep)
+        full_costs = not names and not skipped and not failed_costs
+        keep_costs = ({} if full_costs
+                      else {k: v for k, v in committed_costs.items()
+                            if k not in cost_records})
+        if cost_records or keep_costs != committed_costs:
+            # skip the no-op rewrite (nothing measured, nothing pruned)
+            meter.write_costs(cost_records, costs_path, keep=keep_costs)
         return findings, records, skipped
     findings = findings + compare(
-        records, committed,
+        fp_records, committed,
         full_scope=not names and not skipped,
-        failed=frozenset(f.program for f in findings
-                         if f.rule == "GC100"))
+        failed=failed_fp)
+    findings = findings + meter.compare_costs(
+        cost_records, committed_costs,
+        full_scope=not names and not skipped,
+        failed=failed_costs)
     return findings, records, skipped
 
 
@@ -221,6 +262,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser.add_argument("--fingerprints", default=None, metavar="FILE",
                         help="fingerprint file (default: "
                              "analysis/fingerprints.json)")
+    parser.add_argument("--costs", default=None, metavar="FILE",
+                        help="graftmeter cost-budget file (default: "
+                             "analysis/costs.json)")
     parser.add_argument("--list", action="store_true", dest="list_only",
                         help="list registered programs and exit")
     parser.add_argument("--list-rules", action="store_true",
@@ -228,8 +272,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     args = parser.parse_args(argv)
 
     if args.list_rules:
+        from .meter import RULES_GM
+
         for rid in sorted(RULES_GC):
             print(f"{rid}  {RULES_GC[rid]}")
+        for rid in sorted(RULES_GM):
+            print(f"{rid}  {RULES_GM[rid]}")
         return 0
     if args.list_only:
         from .programs import collect
@@ -242,7 +290,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     try:
         findings, records, skipped = run_check(
             args.programs, update=args.update,
-            fingerprints=args.fingerprints)
+            fingerprints=args.fingerprints, costs=args.costs)
     except KeyError as e:
         print(f"graftcheck: {e.args[0]}", file=sys.stderr)
         return 2

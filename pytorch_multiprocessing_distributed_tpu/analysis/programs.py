@@ -230,19 +230,45 @@ def audit_program(spec: ProgramSpec
                     f"buffer(s), expected >= {need} — a declared "
                     "donate_argnums is not reaching the executable")
 
-    if built.get("compile"):
-        try:
-            from ..utils.compile_cache import lowered_cost_analysis
+    # ---- compile: graftmeter cost/memory budget (ALWAYS — every
+    # canonical program carries a committed record in
+    # analysis/costs.json) + the HLO collective audit (opt-in via
+    # "compile"). One executable serves both: the budgeted program and
+    # the collective-audited program cannot drift.
+    compiled = None
+    try:
+        from ..utils.compat import (cost_analysis_dict,
+                                    memory_analysis_dict)
+        from ..utils.compile_cache import lowered_program_analysis
 
-            target = (built.get("compile_fn") or lower_fn or fn)
-            with _mesh_ctx(mesh):
-                if target is lower_fn and lowered is not None:
-                    # the donation audit already lowered this exact
-                    # program — don't pay a second GSPMD lowering
-                    compiled = lowered.compile()
-                else:
-                    compiled, _cost = lowered_cost_analysis(
-                        target, *args, **kwargs)
+        target = (built.get("compile_fn") or lower_fn or fn)
+        with _mesh_ctx(mesh):
+            if target is lower_fn and lowered is not None:
+                # the donation audit already lowered this exact
+                # program — don't pay a second GSPMD lowering
+                compiled = lowered.compile()
+                cost = cost_analysis_dict(compiled)
+                memory = memory_analysis_dict(compiled)
+            else:
+                if not callable(getattr(target, "lower", None)):
+                    # plain closure (the generate-style wrapper):
+                    # jit at the audit boundary to get an AOT handle
+                    target = jax.jit(target)
+                compiled, cost, memory = lowered_program_analysis(
+                    target, *args, **kwargs)
+    except Exception as e:  # noqa: BLE001 — a program the meter
+        # cannot compile must fail the gate named, not crash the check
+        add("GM100",
+            f"compile for metering failed: {type(e).__name__}: {e}")
+        if built.get("compile"):
+            add("GC103", f"compile failed: {type(e).__name__}: {e}")
+    else:
+        from .meter import costs_record
+
+        record["costs"] = costs_record(cost, memory)
+
+    if built.get("compile") and compiled is not None:
+        try:
             text = compiled.as_text()
         except Exception as e:  # noqa: BLE001
             add("GC103", f"compile failed: {type(e).__name__}: {e}")

@@ -87,6 +87,13 @@ RULES: Dict[str, str] = {
              "silent lie on the timeline (emit at host boundaries — "
              "drain, admission, metric fetch; bare time.* reads are "
              "GL103's)",
+    "GL113": "profiler misuse: jax.profiler.start_trace with no "
+             "reachable stop_trace (an unstopped trace buffers "
+             "forever and the .xplane.pb never flushes — the grant "
+             "window ends with NO artifact), or profiler trace "
+             "control (utils.profiler.trace / jax.profiler.start_"
+             "trace) inside jit-traced code (runs once at trace "
+             "time; the profiled region is a lie)",
 }
 
 # wrappers that COMPILE (jit family) — GL105/106/107/108 anchor on these
@@ -713,6 +720,20 @@ def _check_jit_scoped_body(fn: _Func, out: List[Finding]):
                         "as a trace-time constant (the datetime "
                         "spelling of GL103's wall-clock rule)")
                     continue
+                # ---- GL113: profiler control from inside the trace —
+                # start/stop_trace and the utils.profiler.trace ctx
+                # manager run ONCE at trace time, so the "profiled"
+                # region covers tracing, not execution
+                if (d in ("jax.profiler.start_trace",
+                          "jax.profiler.stop_trace")
+                        or (len(parts) >= 2 and parts[-2] == "profiler"
+                            and parts[-1] == "trace")):
+                    add(node, "GL113",
+                        f"profiler trace control ({parts[-1]}) in "
+                        f"jit-traced `{fn.qual}` runs once at trace "
+                        "time — profile around the jitted call, not "
+                        "inside it")
+                    continue
             continue
         # ---- GL104: captured-container mutation. Only BARE statement
         # calls (result discarded) — a used return value means a
@@ -975,6 +996,39 @@ def _check_swallowed_except(file: _File, out: List[Finding]):
                 "hides exactly the failures graftfault injects)"))
 
 
+def _check_unpaired_trace(file: _File, out: List[Finding]):
+    """GL113 (host half) — ``jax.profiler.start_trace`` in a file with
+    NO reachable ``stop_trace``. Reachability is approximated at file
+    granularity (a paired stop in the same function, a finally block,
+    or a sibling wrapper method all count): the bug class this catches
+    is the stop being FORGOTTEN entirely, which leaves the trace
+    buffering until process exit and never flushes an .xplane.pb —
+    a whole grant window's profiling silently lost. Starts inside
+    jit-traced scope are the trace-time-misuse half's (skipped here
+    so one line never double-reports)."""
+    stop_seen = False
+    starts = []
+    for node in ast.walk(file.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = _dotted(node.func, file)
+        if d == "jax.profiler.stop_trace":
+            stop_seen = True
+        elif d == "jax.profiler.start_trace":
+            owner = file.owner.get(id(node))
+            if owner is None or not owner.jit_scoped:
+                starts.append(node)
+    if stop_seen:
+        return
+    for node in starts:
+        out.append(Finding(
+            file.path, node.lineno, node.col_offset, "GL113",
+            "jax.profiler.start_trace with no reachable stop_trace in "
+            "this file — an unstopped trace buffers until process "
+            "exit and never flushes its .xplane.pb (use "
+            "utils.profiler.trace, a try/finally, or call stop_trace)"))
+
+
 def _check_jit_in_loop(file: _File, out: List[Finding]):
     """GL105: jax.jit(...) lexically inside a for/while body."""
     loops: List[ast.AST] = [n for n in ast.walk(file.tree)
@@ -1104,6 +1158,7 @@ def analyze_files(paths: Sequence[str],
         _check_jit_in_loop(f, findings)
         _check_pspec_axes(f, axes, findings)
         _check_swallowed_except(f, findings)
+        _check_unpaired_trace(f, findings)
         for fn in f.funcs:
             if fn.jit_scoped:
                 _check_jit_scoped_body(fn, findings)

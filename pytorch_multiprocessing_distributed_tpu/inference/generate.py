@@ -758,6 +758,33 @@ def beam_search(
     return jnp.concatenate([prompt_k, history], axis=2), scores
 
 
+# ----------------------------------------------------------- graftmeter
+
+def generate_kv_bytes(model, batch: int, s_max: int) -> int:
+    """Worst-case K+V cache bytes one :func:`generate` call holds
+    resident: the exact ``[L, B, s_max, H, Dh]`` x2 allocation
+    ``_prefill`` makes — ``batch`` rows of the SAME per-slot product
+    the serving pool allocates, so the ONE copy of the shape x dtype
+    math lives in ``SlotPool.per_slot_kv_bytes`` (a KV-layout change
+    there moves the planner's ``max_generate_batch`` and this ledger
+    entry together). Lazy import: ``serving`` imports this module."""
+    from ..serving.kv_slots import SlotPool
+
+    return int(batch) * SlotPool.per_slot_kv_bytes(model, int(s_max))
+
+
+def register_generate_hbm(model, batch: int, s_max: int) -> None:
+    """Ledger one generate call's KV residency (host boundary —
+    :func:`generate` itself is jitted, so the allocation site's
+    bookkeeping lives here and the CLIs call it right before the
+    decode; disarmed: one global read)."""
+    from ..runtime import hbm
+
+    hbm.register("inference.kv_cache",
+                 generate_kv_bytes(model, batch, s_max),
+                 category="kv", batch=int(batch), s_max=int(s_max))
+
+
 # ----------------------------------------------------------- graftcheck
 
 def audit_programs():

@@ -14,7 +14,11 @@ control plane — rendezvous, barriers, health keys — is C++:
 - :mod:`.scope` — graftscope: the zero-host-sync structured event bus
   (spans/instants at host boundaries), flight recorder, and the
   Chrome-trace / JSONL / Prometheus exporters. Every injected fault,
-  retry and watchdog trip lands on its timeline.
+  retry and watchdog trip lands on its timeline;
+- :mod:`.hbm` — graftmeter's live HBM ledger: allocation-site
+  registered device-byte entries (params, optimizer state, KV slot
+  pool, per-bucket decode temps), exposed as ``hbm_*`` gauges on the
+  stats endpoints. Host metadata only — never a device read.
 """
 
 from .faults import (DeadlineExceeded, FaultInjected, FaultPlan,

@@ -508,9 +508,10 @@ def start_stats_server(snapshot_fn: Callable[[], Dict], port: int = 0,
 
 def add_cli_args(parser, stats_port: bool = False) -> None:
     """The shared graftscope flag set (``serve_lm.py`` /
-    ``train_lm.py`` / ``main.py`` all take the same three; only the
-    serving CLI adds ``--stats_port``). Any one of them arms a
-    full-log scope for the run."""
+    ``train_lm.py`` / ``main.py`` all take the same three and all
+    opt into ``--stats_port`` — live serving/training gauges plus the
+    graftmeter ``hbm_*`` ledger). Any one of them arms a full-log
+    scope for the run."""
     g = parser.add_argument_group("graftscope")
     g.add_argument("--trace_out", default="", type=str, metavar="JSON",
                    help="write a Chrome-trace/Perfetto JSON timeline "

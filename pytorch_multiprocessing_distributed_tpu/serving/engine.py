@@ -116,6 +116,7 @@ from ..analysis.sentinels import expected_transfer
 from ..inference.generate import (
     _LN_EPS, _block_chunk_prefill, _decode_horizon, _embed_at,
     _logits, _make_cs, _prefill, _sample)
+from ..runtime import hbm
 from ..runtime import scope as graftscope
 from ..runtime.faults import (DeadlineExceeded, FaultInjected,
                               FaultTimeout, GraftFaultError,
@@ -411,6 +412,17 @@ class ServingEngine:
         self._evict_jit = jax.jit(
             self._evict_fn, out_shardings=evict_out,
             donate_argnums=(0, 1) if donate_cache else ())
+        # graftmeter: resident params on the ledger (disarmed: ONE
+        # global read — the tree walk too stays behind the check;
+        # bytes from host metadata, no device touch). The pool
+        # registered its own KV residency at allocation.
+        if hbm.active_ledger() is not None:
+            hbm.register("serving.params", hbm.tree_nbytes(params),
+                         category="params")
+        # static cost/memory per compiled decode program, measured
+        # lazily the step a (window, horizon) signature first compiles
+        # (never on the steady-state path) — see _note_decode_program
+        self._program_costs: Dict[Tuple[int, int], dict] = {}
 
     def _build_buckets(self, decode_buckets) -> Tuple[int, ...]:
         """Normalize the decode-window ladder: ascending, capped by and
@@ -725,6 +737,70 @@ class ServingEngine:
                         f"{request.deadline_s:.3g}s deadline after "
                         f"{len(request.tokens)} token(s)"),
                     reason="deadline", slot=slot)
+
+    # ---- graftmeter: static decode-program analysis -------------------
+    def decode_program_analysis(self, window: int, horizon: int) -> dict:
+        """XLA's cost + memory analyses of the ``(window, horizon)``
+        decode program — the graftmeter record serving efficiency is
+        attributed against (``serving_bench`` MFU, the ledger's
+        per-bucket temp gauges). AOT lowering on abstract shapes:
+        compiles but never executes, never enters the jit trace cache
+        (the recompile sentinels cannot see it), and is memoized per
+        signature. On TPU the persistent compilation cache makes the
+        duplicate compile ~free; on the hot path it is only reached
+        the step a signature FIRST compiles anyway."""
+        key = (int(window), int(horizon))
+        if key not in self._program_costs:
+            from ..analysis.meter import costs_record
+            from ..utils.compile_cache import lowered_program_analysis
+
+            pool = self.pool
+            # under TP the executed program's GSPMD partition is part
+            # of its identity: carry each arg's real sharding into the
+            # abstract avals, or the metered program (collectives,
+            # temp allocation) would be a replicated-input variant of
+            # the one the dispatcher actually runs
+            keep_sharding = self.mesh is not None
+
+            def sds(x):
+                sharding = (getattr(x, "sharding", None)
+                            if keep_sharding else None)
+                if sharding is not None:
+                    return jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                                sharding=sharding)
+                return jax.ShapeDtypeStruct(x.shape, x.dtype)
+
+            args = (jax.tree.map(sds, self.params), sds(pool.k_caches),
+                    sds(pool.v_caches), sds(pool.positions),
+                    sds(pool.last_tokens), sds(pool.active),
+                    sds(pool.budgets), sds(pool.eos_ids),
+                    jax.ShapeDtypeStruct((2,), jnp.uint32))
+            _compiled, cost, memory = lowered_program_analysis(
+                self._decode, *args, window=key[0], horizon=key[1])
+            self._program_costs[key] = costs_record(cost, memory)
+        return self._program_costs[key]
+
+    def _note_decode_program(self, window: int, horizon: int) -> None:
+        """A decode signature just compiled: put its temp HBM on the
+        armed ledger (per-bucket decode-program temps — the residency
+        the bucket ladder trades against window size). Best-effort BY
+        CONTRACT: a failed measurement must never take down a dispatch
+        that already succeeded — reported to stderr, never raised."""
+        if hbm.active_ledger() is None:
+            return
+        try:
+            costs = self.decode_program_analysis(window, horizon)
+            mem = costs.get("memory") or {}
+            hbm.register(
+                f"serving.decode_temp_w{window}_h{horizon}",
+                int(mem.get("temp_bytes", 0)), category="temps",
+                window=window, horizon=horizon)
+        except Exception as e:  # noqa: BLE001
+            import sys
+
+            print(f"graftmeter: decode-program metering failed for "
+                  f"(window={window}, horizon={horizon}): "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
 
     # ---- compile counters ---------------------------------------------
     @property
@@ -1145,7 +1221,11 @@ class ServingEngine:
          pool.last_tokens, pool.active,
          pool.budgets) = self._attempted_engine(launch,
                                                 "decode dispatch")
-        record_jit_key(self._decode, ("decode", window, h))
+        if record_jit_key(self._decode, ("decode", window, h)):
+            # this dispatch just paid a compile anyway — the one
+            # moment measuring the program's temp HBM is off the
+            # steady-state path (no-op unless a ledger is armed)
+            self._note_decode_program(window, h)
         self._blocks.append(
             _TokenBlock(tokens, h, window, dict(self._running)))
         self.metrics.record_dispatch(h, overlapped)

@@ -53,6 +53,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..runtime import hbm
+
 
 class SlotPool:
     """Fixed-capacity KV-cache slots + per-slot decode state.
@@ -110,6 +112,22 @@ class SlotPool:
         # docstring): feeds the engine's decode-window choice sync-free
         self._positions_host: List[int] = [0] * self.max_slots
         self._active_host: List[bool] = [False] * self.max_slots
+        # graftmeter HBM ledger (disarmed: ONE global read — the byte
+        # math too stays behind the arming check) — the dense
+        # worst-case KV residency THIS pool just allocated, the number
+        # the paged-KV roadmap item exists to shrink. Bytes from host
+        # metadata only (.nbytes — no device read).
+        if hbm.active_ledger() is not None:
+            hbm.register("serving.kv_pool",
+                         hbm.nbytes_of(self.k_caches)
+                         + hbm.nbytes_of(self.v_caches),
+                         category="kv", slots=self.max_slots,
+                         s_max=s_max, per_slot=self.per_slot_bytes)
+            hbm.register("serving.slot_state",
+                         sum(hbm.nbytes_of(a) for a in (
+                             self.positions, self.last_tokens,
+                             self.active, self.budgets, self.eos_ids)),
+                         category="kv")
 
     def _cache_sharded(self, c):
         if self.mesh is None:
@@ -122,6 +140,42 @@ class SlotPool:
         if self.mesh is None:
             return a
         return jax.device_put(a, NamedSharding(self.mesh, P()))
+
+    # ---- capacity accounting (graftmeter) ------------------------------
+    @staticmethod
+    def per_slot_kv_bytes(model, s_max: int) -> int:
+        """Dense worst-case K+V bytes ONE slot reserves for ``s_max``
+        tokens — the exact shape x dtype product ``__init__``
+        allocates (``2 x layers x s_max x heads x head_dim x
+        itemsize``), so :func:`...analysis.meter.plan_capacity`'s
+        inversion matches real allocation byte-for-byte."""
+        head_dim = model.hidden_size // model.num_heads
+        itemsize = jnp.dtype(model.dtype).itemsize
+        return (2 * model.num_layers * int(s_max) * model.num_heads
+                * head_dim * itemsize)
+
+    @staticmethod
+    def per_slot_state_bytes() -> int:
+        """Per-slot scalar decode state: four int32 rows (position,
+        last token, budget, eos id) + one bool (active)."""
+        return 4 * 4 + 1
+
+    @property
+    def per_slot_bytes(self) -> int:
+        """Worst-case resident bytes per slot (KV + scalar state) —
+        the ledger's ``hbm_per_slot_bytes`` gauge."""
+        return (self.per_slot_kv_bytes(self.model, self.s_max)
+                + self.per_slot_state_bytes())
+
+    @property
+    def hbm_bytes(self) -> int:
+        """Total device bytes this pool holds resident (host metadata
+        only — no device read)."""
+        return (hbm.nbytes_of(self.k_caches)
+                + hbm.nbytes_of(self.v_caches)
+                + sum(hbm.nbytes_of(a) for a in (
+                    self.positions, self.last_tokens, self.active,
+                    self.budgets, self.eos_ids)))
 
     # ---- host-side slot accounting -------------------------------------
     @property

@@ -47,6 +47,7 @@ from .step import (
     make_eval_step_tp,
     make_train_step,
     make_train_step_tp,
+    register_state_hbm,
     shard_state,
 )
 
@@ -150,6 +151,13 @@ class Trainer:
             self.eval_step = make_eval_step(model, mesh, loss_fn=loss_fn)
         self.train_logger = Logger(os.path.join(save_path, "train.log"))
         self.test_logger = Logger(os.path.join(save_path, "test.log"))
+        # graftmeter: resident-state footprint on the armed ledger
+        # (the GSPMD branch already registered inside shard_state —
+        # same entries, same bytes; the DP branch registers here), and
+        # the live throughput gauges main.py --stats_port serves —
+        # updated at the windowed fetch the loop already pays
+        register_state_hbm(self.state)
+        self.live = {}
 
     # ------------------------------------------------------------- epochs
 
@@ -381,6 +389,16 @@ class Trainer:
                     epoch=epoch, steps=len(pending),
                     step_avg_s=batch_time.val)
                 window_start = now
+                # live gauges for --stats_port: host values already in
+                # hand at this (the loop's one) sync boundary
+                global_batch = getattr(self.train_loader,
+                                       "batch_size", 0)
+                self.live.update(
+                    epoch=epoch, batch=i, loss=losses.avg,
+                    prec1=top1.avg, step_time_s=batch_time.val,
+                    images_per_sec=(0.0 if not batch_time.val else
+                                    global_batch / batch_time.val),
+                    steps_skipped=skipped)
                 pending = []
                 if dist.is_primary() and i % self.print_freq == 0:
                     print(

@@ -116,6 +116,51 @@ def cost_analysis_dict(compiled):
         return None
 
 
+def memory_analysis_dict(compiled):
+    """``compiled.memory_analysis()`` normalized to ONE plain dict of
+    ints across jax generations: 0.4.x returns a per-device list (or a
+    bare ``CompiledMemoryStats``) of attribute objects, newer jaxes a
+    dict-like — either way the result is::
+
+        {"argument_bytes", "output_bytes", "temp_bytes",
+         "alias_bytes", "generated_code_bytes", "peak_bytes"}
+
+    ``peak_bytes`` is the program's resident-HBM high-water estimate:
+    arguments + outputs + temporaries + generated code, minus the
+    aliased (donated) bytes the outputs share with the arguments —
+    the number a capacity plan charges per resident program. None when
+    the backend exposes no memory model (never raises — callers treat
+    memory as optional, like :func:`cost_analysis_dict`)."""
+    try:
+        stats = compiled.memory_analysis()
+    except Exception:  # noqa: BLE001  # graftlint: disable=GL111 memory model is optional; None IS the record
+        return None
+    if isinstance(stats, (list, tuple)):
+        stats = stats[0] if stats else None
+    if stats is None:
+        return None
+    fields = {
+        "argument_bytes": "argument_size_in_bytes",
+        "output_bytes": "output_size_in_bytes",
+        "temp_bytes": "temp_size_in_bytes",
+        "alias_bytes": "alias_size_in_bytes",
+        "generated_code_bytes": "generated_code_size_in_bytes",
+    }
+    out = {}
+    for key, attr in fields.items():
+        v = getattr(stats, attr, None)
+        if v is None and isinstance(stats, dict):
+            v = stats.get(attr)
+        if v is None:
+            return None  # a partial memory model is not a budget
+        out[key] = int(v)
+    out["peak_bytes"] = (out["argument_bytes"] + out["output_bytes"]
+                         + out["temp_bytes"]
+                         + out["generated_code_bytes"]
+                         - out["alias_bytes"])
+    return out
+
+
 def get_abstract_mesh():
     """The mesh of the active :func:`set_mesh`/``with mesh:`` context,
     or None when there is none (callers use it to decide whether a

@@ -126,10 +126,25 @@ def lowered_cost_analysis(fn, *args, **kwargs):
     the bench times. Compiles but never executes; raises whatever
     ``lower``/``compile`` raise (callers own the fallback policy).
     """
-    from .compat import cost_analysis_dict
+    compiled, cost, _memory = lowered_program_analysis(fn, *args,
+                                                       **kwargs)
+    return compiled, cost
+
+
+def lowered_program_analysis(fn, *args, **kwargs):
+    """The graftmeter extension of :func:`lowered_cost_analysis`:
+    ``(compiled, cost, memory)`` where ``memory`` is XLA's own
+    compiled-memory breakdown (argument/output/temp/generated-code
+    bytes + the donation-aliased overlap, normalized across jax 0.4.x
+    shapes by ``utils.compat.memory_analysis_dict``) or None when the
+    backend exposes no memory model. Same lowering, same executable —
+    the static memory budget in ``analysis/costs.json``, the bench's
+    roofline stamp, and the auditor's HLO all read ONE program."""
+    from .compat import cost_analysis_dict, memory_analysis_dict
 
     compiled = fn.lower(*args, **kwargs).compile()
-    return compiled, cost_analysis_dict(compiled)
+    return (compiled, cost_analysis_dict(compiled),
+            memory_analysis_dict(compiled))
 
 
 def enable_compilation_cache(

@@ -52,6 +52,70 @@ def draw_plot(save_path: str) -> None:
     plt.close()
 
 
+def draw_hbm_breakdown(breakdown, out_path: str,
+                       title: str = "HBM residency",
+                       budget_bytes: Optional[int] = None) -> str:
+    """Render a graftmeter HBM ledger breakdown as ONE stacked bar.
+
+    ``breakdown`` is ``HbmLedger.breakdown()``'s shape —
+    ``{category: {entry name: bytes}}`` — or a flat
+    ``{entry: bytes}`` dict (treated as one category). Categories
+    stack bottom-up in sorted order, each entry a labeled segment;
+    ``budget_bytes`` (e.g. chip HBM) draws the capacity line the
+    stack is planned against. The ``plot_curves``-parity artifact for
+    memory: one glance answers "who owns the HBM".
+
+    Returns the path written.
+    """
+    import matplotlib
+
+    matplotlib.use("Agg")  # same headless discipline as draw_plot
+    import matplotlib.pyplot as plt
+
+    if breakdown and not isinstance(next(iter(breakdown.values())),
+                                    dict):
+        breakdown = {"hbm": dict(breakdown)}
+    segments = [(cat, name, nbytes)
+                for cat in sorted(breakdown)
+                for name, nbytes in sorted(breakdown[cat].items())]
+    if not segments:
+        raise ValueError("empty HBM breakdown — nothing to draw")
+
+    cats = sorted(breakdown)
+    cmap = plt.get_cmap("tab10")
+    color_of = {c: cmap(i % 10) for i, c in enumerate(cats)}
+    mib = 1 / (1 << 20)
+
+    fig, ax = plt.subplots(figsize=(6, 6))
+    bottom = 0.0
+    for cat, name, nbytes in segments:
+        h = nbytes * mib
+        ax.bar([0], [h], bottom=[bottom], width=0.5,
+               color=color_of[cat], edgecolor="white", linewidth=0.5)
+        if h > 0:
+            ax.text(0.28, bottom + h / 2,
+                    f"{name} ({nbytes * mib:.1f} MiB)",
+                    va="center", fontsize=8)
+        bottom += h
+    if budget_bytes:
+        ax.axhline(budget_bytes * mib, color="red", linestyle="--",
+                   linewidth=1)
+        ax.text(-0.25, budget_bytes * mib,
+                f"budget {budget_bytes * mib:.0f} MiB",
+                va="bottom", fontsize=8, color="red")
+    ax.set_xlim(-0.5, 1.6)
+    ax.set_xticks([])
+    ax.set_ylabel("MiB resident")
+    ax.set_title(title)
+    handles = [plt.Rectangle((0, 0), 1, 1, color=color_of[c], label=c)
+               for c in cats]
+    ax.legend(handles=handles, loc="upper right", fontsize=8)
+    fig.tight_layout()
+    fig.savefig(out_path, dpi=120)
+    plt.close(fig)
+    return out_path
+
+
 def draw_timeline(events_path: str,
                   out_path: Optional[str] = None) -> str:
     """Render a graftscope JSONL event log as a timeline PNG.

@@ -183,6 +183,13 @@ def main():
     # arm BEFORE the engine exists: compile-phase prefill/insert spans
     # are part of the timeline (warm-up cost made visible, not hidden)
     graftscope.arm_from_args(args)
+    from pytorch_multiprocessing_distributed_tpu.runtime import hbm
+
+    if args.stats_port:
+        # graftmeter HBM ledger: armed before the engine so the
+        # params/KV-pool registrations land — /metrics then carries
+        # hbm_* capacity gauges beside the serving meters
+        hbm.arm()
     from pytorch_multiprocessing_distributed_tpu.utils.hostenv import (
         force_cpu_devices_from_env)
 
@@ -245,9 +252,19 @@ def main():
     stats_server = None
     if args.stats_port:
         # live telemetry beside the serving loop: /metrics (Prometheus
-        # text exposition) + /snapshot.json, stdlib http.server only
+        # text exposition) + /snapshot.json, stdlib http.server only;
+        # the graftmeter ledger's hbm_* gauges ride the same snapshot
+
+        def live_snapshot():
+            snap = engine.metrics.snapshot()
+            ledger = hbm.active_ledger()
+            if ledger is not None:
+                snap.update(ledger.snapshot())
+                snap["hbm_per_slot_bytes"] = engine.pool.per_slot_bytes
+            return snap
+
         stats_server = graftscope.start_stats_server(
-            engine.metrics.snapshot, port=args.stats_port)
+            live_snapshot, port=args.stats_port)
         print(f"stats: http://127.0.0.1:"
               f"{stats_server.server_address[1]}/metrics", flush=True)
 
@@ -316,6 +333,9 @@ def main():
     snap["decode_programs"] = [list(p) for p in engine.decode_programs]
     snap["prefill_compiles"] = engine.prefill_compiles
     snap["chunk_prefill_compiles"] = engine.chunk_prefill_compiles
+    if hbm.active_ledger() is not None:
+        snap.update(hbm.active_ledger().snapshot())
+        snap["hbm_per_slot_bytes"] = engine.pool.per_slot_bytes
     print("metrics: " + json.dumps(snap, sort_keys=True), flush=True)
     if args.metrics_out:
         with open(args.metrics_out, "w") as f:

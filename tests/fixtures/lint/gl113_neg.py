@@ -1,0 +1,26 @@
+"""GL113 near-miss: the intended profiler discipline — start/stop
+paired through try/finally (the ``utils.profiler.trace`` shape),
+profiling AROUND the jitted call at the host boundary, and lookalike
+``start_trace`` on a non-jax object."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(x):
+    return jnp.sum(x) * 2
+
+
+def profiled_run(x, logdir):
+    # host side of the dispatch boundary — exactly where traces belong
+    jax.profiler.start_trace(logdir)
+    try:
+        y = step(x)
+    finally:
+        jax.profiler.stop_trace()
+    return y
+
+
+def lookalike(session, logdir):
+    session.profiler.start_trace(logdir)  # not jax's profiler
+    return session

@@ -137,13 +137,19 @@ parser.add_argument('--sample_beams', default=0, type=int,
                     help='> 1: decode --sample tokens with beam search '
                          'of this width instead of greedy (prints the '
                          'best beam)')
-graftscope.add_cli_args(parser)
+graftscope.add_cli_args(parser, stats_port=True)
 
 
 def main(args):
     # arm before any jax work: compile/placement phases belong on the
     # timeline too (zero cost when no graftscope flag is set)
     graftscope.arm_from_args(args)
+    from pytorch_multiprocessing_distributed_tpu.runtime import hbm
+
+    if args.stats_port:
+        # graftmeter: live trainer HBM/throughput gauges are scrapeable
+        # while the run is hot — arm the ledger before any state lands
+        hbm.arm()
     from pytorch_multiprocessing_distributed_tpu.utils.hostenv import (
         force_cpu_devices_from_env)
 
@@ -477,6 +483,32 @@ def main(args):
                 seq_axis='seq' if args.parallel == 'sp' else None,
                 vocab_chunks=args.vocab_chunks)
 
+    # graftmeter: trainer state residency on the armed ledger (the tp
+    # path already registered inside shard_state — same entry names,
+    # same bytes; dp/sp/pp register here). No-op when disarmed.
+    from pytorch_multiprocessing_distributed_tpu.train.step import (
+        register_state_hbm)
+
+    register_state_hbm(state)
+
+    # live gauges for --stats_port: updated at the print boundary (the
+    # loop's one deliberate host sync — no extra fetches), merged with
+    # the hbm_* ledger gauges on /metrics + /snapshot.json
+    live = {}
+    stats_server = None
+    if args.stats_port:
+        def live_snapshot():
+            snap = dict(live)
+            ledger = hbm.active_ledger()
+            if ledger is not None:
+                snap.update(ledger.snapshot())
+            return snap
+
+        stats_server = graftscope.start_stats_server(
+            live_snapshot, port=args.stats_port, prefix="pmdt")
+        print(f"stats: http://127.0.0.1:"
+              f"{stats_server.server_address[1]}/metrics", flush=True)
+
     os.makedirs(args.save_path, exist_ok=True)
     logger = Logger(os.path.join(args.save_path, 'train.log'))
     test_logger = (Logger(os.path.join(args.save_path, 'test.log'))
@@ -552,6 +584,11 @@ def main(args):
                         t_ready = time.perf_counter() if armed else 0.0
                         continue
                     losses, seen = losses + loss, seen + 1
+                    live.update(
+                        epoch=epoch, batch=i, loss=loss,
+                        tokens_per_sec=(args.batch_size * args.seq_len
+                                        * (i + 1)
+                                        / (time.time() - t0)))
                     if dist.is_primary():
                         extra = ''
                         if 'moe_aux' in metrics:
@@ -653,8 +690,13 @@ def main(args):
     if args.sample:
         from pytorch_multiprocessing_distributed_tpu.inference import (
             beam_search, generate)
+        from pytorch_multiprocessing_distributed_tpu.inference.generate import (
+            register_generate_hbm)
 
         dense = model.clone(seq_axis=None)
+        # graftmeter: the decode's KV residency on the ledger (host
+        # boundary — generate itself is jitted); disarmed = no-op
+        register_generate_hbm(dense, 1, args.seq_len + args.sample)
         prompt = jnp.asarray(tokens[: args.seq_len][None, :])
 
         def decode(params, **kw):
@@ -707,6 +749,8 @@ def main(args):
         ck.close()
     if dist.is_primary():
         graftscope.export_from_args(args)
+    if stats_server is not None:
+        stats_server.shutdown()
     dist.destroy_process_group()
 
 
