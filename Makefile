@@ -3,7 +3,7 @@
 
 PYTEST_ENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 
-.PHONY: test test-fast lint check check-update chaos scope meter \
+.PHONY: test test-fast lint check check-update chaos soak scope meter \
         dryrun bench bench-cpu store clean
 
 # graftlint: AST-only jit-hygiene gate (no jax import, milliseconds).
@@ -35,6 +35,14 @@ check-update:
 # operations on every run. Part of tier-1; this target runs it alone.
 chaos:
 	$(PYTEST_ENV) python -m pytest tests/test_graftfault.py tests/test_runtime_store.py -q
+
+# graftheal: the elastic-supervision suite (liveness gate, coordinated
+# abort, supervised restart, graceful drain + redelivery journal) PLUS
+# the slow-marked chaos soak — N requests under a background fault
+# rate with one injected mid-run restart; every request completes
+# token-exact or fails named, journal replay accounted.
+soak:
+	$(PYTEST_ENV) python -m pytest tests/test_graftheal.py -q
 
 # graftscope: observability smoke — a synthetic engine run must emit a
 # Perfetto-loadable Chrome trace, a JSONL event log with COMPLETE

@@ -37,6 +37,20 @@ Three sweeps over the continuous-batching :class:`ServingEngine`:
    cost of surviving a given fault rate is RECORDED, never silently
    eaten.
 
+5. **Drain sweep** (``--sweep drain``, graftheal): the elastic-
+   lifecycle latencies. Point one: **drain latency** — a loaded
+   engine flips to DRAINING mid-serve (the SIGTERM path) and the
+   clock runs until every in-flight request finished (admission
+   closed throughout). Point two: **recovery time-to-first-token**
+   after a supervised restart — an engine with a request-redelivery
+   journal is abandoned mid-run (the crash shape), a fresh engine
+   replays the WAL ON THE CLOCK (journal load + redelivery + prefill)
+   until the first redelivered token lands, and the redelivered
+   streams are asserted token-exact vs the pre-crash prefix. The
+   recorded numbers are the two SLOs a replica router needs: how long
+   a drain holds a slot hostage, and how long a restarted replica
+   takes to resume visible progress.
+
 ``offered=inf`` is the closed-loop limit: every request submitted
 up front, measuring peak engine throughput. CPU-runnable (shapes clamp
 down off-TPU, same convention as ``generate_bench.py``), TPU-ready.
@@ -349,6 +363,106 @@ def run_chaos_sweep(model, params, args, rng):
     return results
 
 
+def run_drain_sweep(model, params, args, rng):
+    """Drain latency + post-restart recovery TTFT (graftheal), both
+    wall-clocked on a loaded engine; the redelivered streams are
+    verified token-exact against the pre-crash prefixes."""
+    import tempfile
+
+    from pytorch_multiprocessing_distributed_tpu.runtime import heal
+    from pytorch_multiprocessing_distributed_tpu.serving import (
+        ServingEngine)
+
+    new_tokens = max(args.new_tokens, 8)
+    prompt_hi = max(2, min(args.prompt_max,
+                           model.max_seq_len - new_tokens) - 1)
+    s_max = min(model.max_seq_len, prompt_hi + new_tokens)
+    slots = int(args.slots.split(",")[0])
+    prompts = [rng.integers(0, model.vocab_size, (int(rng.integers(
+        max(1, prompt_hi // 2), prompt_hi + 1)),)).tolist()
+        for _ in range(2 * slots)]
+    tmpdir = tempfile.mkdtemp(prefix="pmdt_drain_bench_")
+
+    def mk(journal=None):
+        return ServingEngine(model, params, max_slots=slots,
+                             s_max=s_max, decode_horizon=4,
+                             decode_buckets=(), retry_backoff_s=0.0,
+                             journal=journal)
+
+    # ---- point 1: drain latency (the SIGTERM path, no deadline)
+    engine = mk()
+    engine.serve([(prompts[0], 2)])  # compiles off the clock
+    reqs = [engine.submit(p, new_tokens) for p in prompts]
+    engine.step()  # mid-serve: slots resident, queue non-empty
+    engine.begin_drain("bench")
+    t0 = time.perf_counter()
+    engine.drain(None)
+    drain_latency = time.perf_counter() - t0
+    drained = sum(r.state == "done" for r in reqs)
+    point = {
+        "mode": "drain",
+        "slots": slots,
+        "requests": len(prompts),
+        "drain_latency_s": drain_latency,
+        "drained_completed": drained,
+        "drained_failed": sum(r.state == "failed" for r in reqs),
+        "drain_tokens": sum(len(r.tokens) for r in reqs),
+    }
+    print(f"drain    latency={drain_latency:8.3f} s  "
+          f"completed={drained}/{len(prompts)}  "
+          f"tokens={point['drain_tokens']}", flush=True)
+    results = [point]
+
+    # ---- point 2: recovery TTFT after a supervised restart
+    wal = os.path.join(tmpdir, "wal.jsonl")
+    journal = heal.RequestJournal(wal)
+    crashed = mk(journal)
+    pre = [crashed.submit(p, new_tokens) for p in prompts]
+    for _ in range(3):
+        crashed.step()  # partial progress into the WAL
+    prefix = {r.uid: list(r.tokens) for r in pre}
+    del crashed  # abandoned mid-run: the crash shape (WAL not closed)
+
+    t0 = time.perf_counter()  # journal replay ON the clock
+    journal2 = heal.RequestJournal(wal)
+    unfinished = journal2.unfinished()
+    # snapshot NOW: the live entries grow as the fresh engine re-serves
+    replayed_tokens = sum(len(e.tokens) for e in unfinished)
+    fresh = mk(journal2)
+    redelivered = fresh.redeliver(unfinished)
+    t_first = None
+    while fresh.in_flight and t_first is None:
+        for request, _tok, _done in fresh.step():
+            t_first = time.perf_counter()
+            break
+    recovery_ttft = (t_first - t0) if t_first is not None else None
+    fresh.drain(None)
+    # redelivery is token-exact: every pre-crash prefix is a prefix
+    # of the recovered stream (greedy determinism, bench-asserted)
+    for r in redelivered:
+        want = prefix.get(r.uid, [])
+        assert r.tokens[:len(want)] == want, (
+            f"redelivered request {r.uid} diverged from its "
+            "pre-crash prefix")
+    point = {
+        "mode": "recovery",
+        "slots": slots,
+        "redelivered": len(redelivered),
+        "replayed_tokens": replayed_tokens,
+        "recovery_ttft_s": recovery_ttft,
+        "recovered_completed": sum(r.state == "done"
+                                   for r in redelivered),
+    }
+    print(f"recovery ttft={recovery_ttft:8.3f} s  "
+          f"redelivered={len(redelivered)}  "
+          f"replayed_tokens={point['replayed_tokens']}", flush=True)
+    results.append(point)
+    import shutil
+
+    shutil.rmtree(tmpdir, ignore_errors=True)
+    return results
+
+
 def main():
     _common.apply_platform_env()
     p = argparse.ArgumentParser()
@@ -364,7 +478,7 @@ def main():
                         "submitted up front)")
     p.add_argument("--sweep", default="load,length,horizon", type=str,
                    help="which sweeps to run: load, length, horizon, "
-                        "chaos, or any comma list")
+                        "chaos, drain, or any comma list")
     p.add_argument("--chaos_every", default=5, type=int,
                    help="chaos sweep: inject one transient fault every "
                         "K-th dispatch ATTEMPT, K >= 2 (realized "
@@ -419,7 +533,7 @@ def main():
     record = {"platform": platform, "model": args.model,
               "requests": args.requests, "new_tokens": args.new_tokens,
               "s_max": s_max, "load_sweep": [], "length_sweep": [],
-              "horizon_sweep": [], "chaos_sweep": []}
+              "horizon_sweep": [], "chaos_sweep": [], "drain_sweep": []}
     sweeps = args.sweep.split(",")
 
     if "load" in sweeps:
@@ -454,6 +568,10 @@ def main():
 
     if "chaos" in sweeps:
         record["chaos_sweep"] = run_chaos_sweep(model, params, args,
+                                                rng)
+
+    if "drain" in sweeps:
+        record["drain_sweep"] = run_drain_sweep(model, params, args,
                                                 rng)
 
     if args.json_out:

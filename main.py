@@ -134,6 +134,18 @@ parser.add_argument('--torch_export', action='store_true',
                          'torch-loadable state_dict '
                          '(model_{epoch}.torch.pth, reference model '
                          'naming; ResNet family only)')
+parser.add_argument('--max_restarts', default=0, type=int,
+                    help='graftheal supervised restart: catch named-'
+                         'fatal errors (GraftFaultError family — lost '
+                         'peer, poisoned pool, exhausted retries), '
+                         're-run rendezvous, and restart the run '
+                         'resuming from the newest digest-valid '
+                         'checkpoint (--resume auto semantics) — at '
+                         'most N times with exponential backoff '
+                         '(0 = die on first fatal, the old behavior)')
+parser.add_argument('--restart_backoff', default=1.0, type=float,
+                    help='first-restart delay in seconds (doubles per '
+                         'restart, capped at 30s)')
 graftscope.add_cli_args(parser, stats_port=True)
 
 
@@ -392,10 +404,16 @@ def main(args):
         ckpt_async=args.ckpt_async,
     )
     stats_server = None
+    health = None
     if args.stats_port:
         # live trainer telemetry: hbm_* capacity gauges (graftmeter
         # ledger) + the loop's windowed loss/throughput, on /metrics
-        # and /snapshot.json over stdlib http.server
+        # and /snapshot.json over stdlib http.server — plus /healthz
+        # (graftheal): 200 only while the run is up, with last-beat
+        # ages when a PMDT_HEARTBEAT monitor is armed
+        from pytorch_multiprocessing_distributed_tpu.runtime import heal
+
+        health = heal.HealthState()
 
         def live_snapshot():
             snap = dict(trainer.live)
@@ -405,17 +423,30 @@ def main(args):
             return snap
 
         stats_server = graftscope.start_stats_server(
-            live_snapshot, port=args.stats_port, prefix="pmdt")
+            live_snapshot, port=args.stats_port, prefix="pmdt",
+            health_fn=lambda: heal.healthz(health,
+                                           heal.active_monitor()))
         print(f"stats: http://127.0.0.1:"
-              f"{stats_server.server_address[1]}/metrics", flush=True)
+              f"{stats_server.server_address[1]}/metrics "
+              f"(+ /healthz)", flush=True)
+        health.to_ready("training")
 
-    if args.profile:
-        from pytorch_multiprocessing_distributed_tpu.utils.profiler import trace
+    try:
+        if args.profile:
+            from pytorch_multiprocessing_distributed_tpu.utils.profiler import trace
 
-        with trace(args.profile):
+            with trace(args.profile):
+                trainer.fit()
+        else:
             trainer.fit()
-    else:
-        trainer.fit()
+    except BaseException:
+        # the supervised-restart path (--max_restarts) re-enters
+        # main() on the SAME fixed --stats_port: a listener left
+        # behind by the dying run would turn every restart into
+        # EADDRINUSE — release it before the named fatal propagates
+        if stats_server is not None:
+            stats_server.shutdown()
+        raise
 
     if args.torch_export:
         from pytorch_multiprocessing_distributed_tpu.train.checkpoint import (
@@ -439,17 +470,48 @@ def main(args):
     if dist.is_primary():
         graftscope.export_from_args(args)
     if stats_server is not None:
+        if health is not None:
+            health.to_dead("run complete")
         stats_server.shutdown()
     dist.destroy_process_group()
 
 
 def run_model(args):
     """Experiment bring-up (reference ``run_model``, ``main.py:180-188``):
-    create the save dir, snapshot this script into it, run."""
+    create the save dir, snapshot this script into it, run —
+    optionally under graftheal's bounded-restart supervisor
+    (``--max_restarts``): a named fatal (lost peer, poisoned engine
+    state, exhausted retries) tears the pod down, backs off, re-runs
+    rendezvous, and restarts the run with ``--resume auto`` — so every
+    restart resumes from the newest digest-valid checkpoint through
+    ``load_with_fallback``. Restart budget exhaustion fails loudly
+    (``RestartBudgetExhausted``)."""
     if not os.path.exists(args.save_path):
         os.makedirs(args.save_path)
     shutil.copy(__file__, os.path.join(args.save_path, 'main.py'))
-    main(args)
+    if not args.max_restarts:
+        main(args)
+        return
+    from pytorch_multiprocessing_distributed_tpu.runtime import heal
+
+    def target(attempt):
+        if attempt:
+            # resume from the newest digest-valid checkpoint (auto
+            # owns corrupt-artifact fallback; main() re-resolves it)
+            args.resume = "auto"
+        return main(args)
+
+    def rerendezvous():
+        # tear the pod down so the restarted run re-runs bring-up
+        # (init_process is idempotent only while initialized)
+        from pytorch_multiprocessing_distributed_tpu.parallel import (
+            dist)
+
+        dist.destroy_process_group()
+
+    heal.Supervisor(target, max_restarts=args.max_restarts,
+                    backoff_s=args.restart_backoff,
+                    rendezvous=rerendezvous).run()
 
 
 if __name__ == "__main__":

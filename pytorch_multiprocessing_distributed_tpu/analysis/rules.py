@@ -94,6 +94,13 @@ RULES: Dict[str, str] = {
              "control (utils.profiler.trace / jax.profiler.start_"
              "trace) inside jit-traced code (runs once at trace "
              "time; the profiled region is a lie)",
+    "GL114": "signal.signal installing a fresh handler without "
+             "capturing the previous one (no signal.getsignal in "
+             "scope) — the displaced handler is DISCARDED: a second "
+             "registrant (preemption checkpointing, drain, an "
+             "external supervisor's hook) silently stops firing; "
+             "capture with getsignal and CHAIN it, as the trainer's "
+             "_install_preemption_handler does",
 }
 
 # wrappers that COMPILE (jit family) — GL105/106/107/108 anchor on these
@@ -1029,6 +1036,55 @@ def _check_unpaired_trace(file: _File, out: List[Finding]):
             "utils.profiler.trace, a try/finally, or call stop_trace)"))
 
 
+def _check_signal_discard(file: _File, out: List[Finding]):
+    """GL114 — ``signal.signal(sig, handler)`` installing a FRESH
+    handler (a lambda, or a name resolving to a def in this file)
+    from a scope with no ``signal.getsignal`` call: the previous
+    handler is discarded, so whoever registered it (the trainer's
+    preemption checkpointing, the serving drain hook, an external
+    supervisor) silently stops seeing the signal. The clean shape —
+    capture with ``getsignal``, chain in the new handler, restore on
+    teardown — is what ``trainer._install_preemption_handler`` and
+    ``heal.install_drain_handler`` do. Restores are exempt: passing a
+    non-def value (a saved previous handler, ``signal.SIG_DFL``, a
+    conditional of the two) is putting a handler BACK, not displacing
+    one."""
+    def scope_nodes(owner):
+        if owner is not None:
+            return _iter_own(owner.node)
+        # module scope: top-level statements, minus def/class bodies
+        return _iter_own(file.tree)
+
+    for node in ast.walk(file.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _dotted(node.func, file) != "signal.signal":
+            continue
+        if len(node.args) < 2:
+            continue
+        handler = node.args[1]
+        owner = file.owner.get(id(node))
+        fresh = isinstance(handler, ast.Lambda)
+        if isinstance(handler, ast.Name):
+            fresh = _resolve_local(file, handler.id, owner) is not None
+        if not fresh:
+            continue  # restore / passthrough of a saved handler
+        captured = any(
+            isinstance(n, ast.Call)
+            and _dotted(n.func, file) == "signal.getsignal"
+            for n in scope_nodes(owner))
+        if captured:
+            continue
+        out.append(Finding(
+            file.path, node.lineno, node.col_offset, "GL114",
+            "signal.signal installs a fresh handler but the previous "
+            "one is never captured (no signal.getsignal in this "
+            "scope) — it is DISCARDED, and whoever registered it "
+            "(preemption checkpoint, drain hook, supervisor) silently "
+            "stops firing; capture it and chain (see "
+            "trainer._install_preemption_handler)"))
+
+
 def _check_jit_in_loop(file: _File, out: List[Finding]):
     """GL105: jax.jit(...) lexically inside a for/while body."""
     loops: List[ast.AST] = [n for n in ast.walk(file.tree)
@@ -1159,6 +1215,7 @@ def analyze_files(paths: Sequence[str],
         _check_pspec_axes(f, axes, findings)
         _check_swallowed_except(f, findings)
         _check_unpaired_trace(f, findings)
+        _check_signal_discard(f, findings)
         for fn in f.funcs:
             if fn.jit_scoped:
                 _check_jit_scoped_body(fn, findings)

@@ -40,6 +40,37 @@ _SITE_RENDEZVOUS = register_site(
     "multihost rendezvous/barrier on the control plane (store "
     "bring-up, coordinator publish, experiment barriers)")
 
+# graftheal's pre-collective liveness gate (runtime.heal): consulted
+# before every host-level collective boundary this module (and the
+# trainers' windowed-fetch boundaries) own, so a DEAD peer raises a
+# named PeerLostError on every SURVIVOR instead of hanging it at the
+# next psum. Uninstalled cost: one module-global read + None check —
+# the graftfault/graftscope arming discipline.
+_collective_gate = None
+
+
+def install_collective_gate(fn) -> None:
+    """Install ``fn`` (raises :class:`~..runtime.faults.PeerLostError`
+    on a lost peer / poison key) as the pre-collective gate —
+    ``runtime.heal.arm`` does this for its monitor."""
+    global _collective_gate
+    _collective_gate = fn
+
+
+def clear_collective_gate() -> None:
+    global _collective_gate
+    _collective_gate = None
+
+
+def gate_collectives() -> None:
+    """Run the liveness gate if one is armed (no-op otherwise). Call
+    at any host boundary that is about to enter (or dispatch work
+    containing) a collective a dead peer would wedge — the step
+    loops' windowed-fetch boundaries do."""
+    gate = _collective_gate
+    if gate is not None:
+        gate()
+
 
 def _run_with_watchdog(fn, timeout: float, what: str, hint: str):
     """Bounded bring-up: ``jax.distributed.initialize`` (and backend
@@ -177,6 +208,14 @@ def _store_rendezvous(timeout: float):
 
     global _store
     _store = store
+    # graftheal env hook: PMDT_HEARTBEAT="soft:hard[:interval]" (s)
+    # arms a liveness monitor over THIS rendezvous store — every host
+    # beats, and the pre-collective gate turns a silent peer into a
+    # named PeerLostError on every survivor (no-op when unset)
+    from ..runtime import heal
+
+    heal.monitor_from_env(store, str(rank),
+                          [str(i) for i in range(world)])
     return coordinator, world, rank
 
 
@@ -257,6 +296,10 @@ def init_process(
 def destroy_process_group() -> None:
     """Leave the pod (reference ``main.py:84``). No-op on a single host."""
     global _initialized, _store, _store_server
+    # a monitor gating over the store about to close must go first
+    from ..runtime import heal
+
+    heal.disarm()
     if _initialized and jax.process_count() > 1:
         jax.distributed.shutdown()
     if _store is not None:
@@ -290,7 +333,9 @@ def is_primary() -> bool:
 def barrier(name: str = "barrier") -> None:
     """Block until every host arrives (control-plane sync). An
     injected fault here surfaces named (fail fast) — a half-synced
-    fleet must never proceed silently."""
+    fleet must never proceed silently, and with graftheal armed a
+    DEAD peer fails this barrier named BEFORE anyone blocks in it."""
+    gate_collectives()
     maybe_fault(_SITE_RENDEZVOUS)
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils

@@ -18,18 +18,26 @@ control plane — rendezvous, barriers, health keys — is C++:
 - :mod:`.hbm` — graftmeter's live HBM ledger: allocation-site
   registered device-byte entries (params, optimizer state, KV slot
   pool, per-bucket decode temps), exposed as ``hbm_*`` gauges on the
-  stats endpoints. Host metadata only — never a device read.
+  stats endpoints. Host metadata only — never a device read;
+- :mod:`.heal` — graftheal: elastic supervision — heartbeat liveness
+  over the store (pre-collective gate: a dead peer raises a named
+  :class:`~.faults.PeerLostError` on every survivor), coordinated
+  poison-key abort, the bounded-restart :class:`~.heal.Supervisor`,
+  and graceful drain (health state machine + request-redelivery
+  journal) for serving.
 """
 
 from .faults import (DeadlineExceeded, FaultInjected, FaultPlan,
-                     FaultRule, FaultTimeout, GraftFaultError, armed,
-                     maybe_fault, register_site, registered_sites,
-                     retry_with_backoff, run_with_timeout)
-from .store import TCPStore, TCPStoreServer
+                     FaultRule, FaultTimeout, GraftFaultError,
+                     PeerLostError, armed, maybe_fault, register_site,
+                     registered_sites, retry_with_backoff,
+                     run_with_timeout)
+from .store import MemStore, TCPStore, TCPStoreServer
 
 __all__ = [
-    "TCPStore", "TCPStoreServer", "GraftFaultError", "FaultInjected",
-    "FaultTimeout", "DeadlineExceeded", "FaultPlan", "FaultRule",
+    "TCPStore", "TCPStoreServer", "MemStore", "GraftFaultError",
+    "FaultInjected", "FaultTimeout", "DeadlineExceeded",
+    "PeerLostError", "FaultPlan", "FaultRule",
     "armed", "maybe_fault", "register_site", "registered_sites",
     "retry_with_backoff", "run_with_timeout",
 ]

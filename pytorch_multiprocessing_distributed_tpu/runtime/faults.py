@@ -64,7 +64,8 @@ from . import scope as _scope
 
 __all__ = [
     "GraftFaultError", "FaultInjected", "FaultTimeout",
-    "DeadlineExceeded", "PoolPoisonedError", "FaultRule",
+    "DeadlineExceeded", "PoolPoisonedError", "PeerLostError",
+    "FaultRule",
     "FaultPlan", "register_site",
     "registered_sites", "maybe_fault", "arm", "disarm", "armed",
     "active_plan", "retry_with_backoff", "run_with_timeout",
@@ -106,6 +107,20 @@ class PoolPoisonedError(GraftFaultError):
     request (or retrying) would keep operating on deleted buffers and
     crash every later caller with an unnamed deleted-buffer error;
     the holder (e.g. an engine replica) must be discarded/rebuilt."""
+
+
+class PeerLostError(GraftFaultError):
+    """A pod peer went silent (heartbeat hard timeout) or poisoned the
+    run (coordinated abort): every SURVIVING rank raises this — naming
+    ``who`` was lost and ``why`` — before its next collective, instead
+    of hanging in it forever (graftheal's liveness gate,
+    ``runtime.heal``). Named-fatal: the supervisor's restart budget
+    consumes it like any other ``GraftFaultError``."""
+
+    def __init__(self, who: str, why: str):
+        super().__init__(f"peer {who!r} lost: {why}")
+        self.who = who
+        self.why = why
 
 
 # --------------------------------------------------------------- registry

@@ -463,17 +463,27 @@ def prometheus_text(snapshot: Dict, prefix: str = "pmdt_serving"
 
 def start_stats_server(snapshot_fn: Callable[[], Dict], port: int = 0,
                        host: str = "127.0.0.1",
-                       prefix: str = "pmdt_serving"):
+                       prefix: str = "pmdt_serving",
+                       health_fn: Optional[Callable[[], Dict]] = None):
     """Serve live telemetry over stdlib ``http.server`` (daemon
     thread): ``/metrics`` is the Prometheus text exposition of
     ``snapshot_fn()``, ``/snapshot.json`` the raw JSON snapshot.
     ``port=0`` binds an ephemeral port — read it back from
     ``server.server_address[1]``. Call ``server.shutdown()`` to stop.
+
+    ``health_fn`` (graftheal) adds ``/healthz``: the JSON payload of
+    ``health_fn()`` (``runtime.heal.healthz`` — health-machine state +
+    last-beat ages), status **200 only when** ``state == "ready"``,
+    503 otherwise — the liveness/readiness probe a replica router
+    consumes (a DRAINING engine stops receiving traffic the moment it
+    flips, without racing its queue). Without ``health_fn`` the path
+    404s like any other.
     """
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     class Handler(BaseHTTPRequestHandler):
         def do_GET(self):  # noqa: N802 (http.server API)
+            code = 200
             try:
                 if self.path.startswith("/metrics"):
                     body = prometheus_text(snapshot_fn(), prefix)
@@ -481,6 +491,13 @@ def start_stats_server(snapshot_fn: Callable[[], Dict], port: int = 0,
                 elif self.path.startswith("/snapshot.json"):
                     body = json.dumps(snapshot_fn(), sort_keys=True)
                     ctype = "application/json"
+                elif (self.path.startswith("/healthz")
+                        and health_fn is not None):
+                    payload = health_fn()
+                    body = json.dumps(payload, sort_keys=True)
+                    ctype = "application/json"
+                    if payload.get("state") != "ready":
+                        code = 503  # router: stop sending traffic
                 else:
                     self.send_error(404)
                     return
@@ -488,7 +505,7 @@ def start_stats_server(snapshot_fn: Callable[[], Dict], port: int = 0,
                 self.send_error(500, f"{type(e).__name__}: {e}")
                 return
             data = body.encode("utf-8")
-            self.send_response(200)
+            self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(data)))
             self.end_headers()

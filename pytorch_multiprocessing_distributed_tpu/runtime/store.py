@@ -95,6 +95,45 @@ def _load() -> ctypes.CDLL:
         return _lib
 
 
+class MemStore:
+    """In-process store with the client surface graftheal consumes
+    (``set/get/add/delete``): the single-process stand-in for a
+    :class:`TCPStore` — heartbeats, poison keys and drain journals
+    work on one host (and in tests) without the C++ toolchain, and a
+    shared instance across threads models a multi-client store
+    (thread-safe, like N TCP clients of one server). NOT a network
+    store: ``wait``/``barrier`` belong to the real one."""
+
+    def __init__(self):
+        self._kv: dict = {}
+        self._mu = threading.Lock()
+
+    def set(self, key: str, value: bytes) -> None:
+        payload = maybe_fault(_SITE_SET, bytes(value))
+        with self._mu:
+            self._kv[key] = payload
+
+    def get(self, key: str) -> Optional[bytes]:
+        maybe_fault(_SITE_GET)
+        with self._mu:
+            return self._kv.get(key)
+
+    def add(self, key: str, delta: int = 1) -> int:
+        maybe_fault(_SITE_SET)
+        with self._mu:
+            value = int(self._kv.get(key, b"0")) + delta
+            self._kv[key] = str(value).encode("ascii")
+            return value
+
+    def delete(self, key: str) -> bool:
+        maybe_fault(_SITE_SET)
+        with self._mu:
+            return self._kv.pop(key, None) is not None
+
+    def close(self) -> None:  # interface parity with TCPStore
+        pass
+
+
 class TCPStoreServer:
     """Hosts the store (run on the coordinator host, like MASTER_ADDR)."""
 

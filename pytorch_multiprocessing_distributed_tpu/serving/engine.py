@@ -117,6 +117,7 @@ from ..inference.generate import (
     _LN_EPS, _block_chunk_prefill, _decode_horizon, _embed_at,
     _logits, _make_cs, _prefill, _sample)
 from ..runtime import hbm
+from ..runtime import heal
 from ..runtime import scope as graftscope
 from ..runtime.faults import (DeadlineExceeded, FaultInjected,
                               FaultTimeout, GraftFaultError,
@@ -264,6 +265,25 @@ class ServingEngine:
         degradation: smaller blast radius + faster drain while the
         fault domain is suspect); each forced collapse is counted in
         ``ServingMetrics.horizon_collapses``.
+      journal: optional :class:`~..runtime.heal.RequestJournal` — the
+        redelivery WAL behind supervised restart: every admitted
+        request and its emitted tokens are journaled (one fsync'd
+        batch per drained step), so a restarted engine
+        :meth:`redeliver`\\ s the unfinished ones token-exact
+        (prefix-deduped against the already-emitted tokens). Greedy
+        engines only: sampled streams are not replayable, so
+        ``journal`` with ``temperature > 0`` is rejected.
+
+    **Elastic lifecycle (graftheal).** The engine carries a
+    :class:`~..runtime.heal.HealthState` machine (``STARTING`` during
+    construction, ``READY`` when serving, ``DRAINING`` after
+    :meth:`begin_drain` — SIGTERM via
+    ``runtime.heal.install_drain_handler`` flips it — and ``DEAD``
+    after :meth:`drain`): while DRAINING, admission raises
+    ``QueueFull`` naming the drain, in-flight requests finish up to
+    the drain deadline, and overdue ones are failed named —
+    ``/healthz`` (``--stats_port``) serves 200 only in READY, so a
+    replica router routes around the drain the moment it starts.
     """
 
     def __init__(self, model, params, *, max_slots: int,
@@ -279,7 +299,16 @@ class ServingEngine:
                  dispatch_retries: int = 3,
                  retry_backoff_s: float = 0.02,
                  readback_timeout_s: Optional[float] = None,
-                 fault_cooldown: int = 8):
+                 fault_cooldown: int = 8,
+                 journal=None):
+        # health first: an engine that dies mid-construction reports
+        # STARTING on /healthz, never a stale READY
+        self.health = heal.HealthState()
+        if journal is not None and temperature > 0.0:
+            raise ValueError(
+                "journal redelivery requires deterministic (greedy) "
+                "decode — a sampled stream cannot be replayed "
+                "token-exact (temperature > 0 with a journal)")
         if getattr(model, "seq_axis", None) is not None:
             raise NotImplementedError(
                 "the engine wants the dense view of an SP model — pass "
@@ -423,6 +452,8 @@ class ServingEngine:
         # lazily the step a (window, horizon) signature first compiles
         # (never on the steady-state path) — see _note_decode_program
         self._program_costs: Dict[Tuple[int, int], dict] = {}
+        self.journal = journal
+        self.health.to_ready()
 
     def _build_buckets(self, decode_buckets) -> Tuple[int, ...]:
         """Normalize the decode-window ladder: ascending, capped by and
@@ -677,6 +708,10 @@ class ServingEngine:
         self.scheduler.fail(request, error, reason)
         request.finish_time = time.perf_counter()
         self.metrics.record_failure()
+        if self.journal is not None:
+            # terminal in the WAL too: a quarantined request is
+            # accounted, never redelivered as if the crash ate it
+            self.journal.record_failed(request)
         graftscope.emit("request.failed", cat="request",
                         req=request.uid, reason=reason,
                         error=type(error).__name__,
@@ -903,6 +938,19 @@ class ServingEngine:
         is part of the degradation ladder, not a silent drop."""
         if request.submit_time is None:
             request.submit_time = time.perf_counter()
+        if not self.health.ready:
+            # graftheal: admission is CLOSED outside READY — a
+            # draining/dead engine sheds instead of accepting work it
+            # cannot promise to finish (QueueFull is the backpressure
+            # signal callers already handle; the reason names the
+            # drain so a retry loop knows not to spin on this replica)
+            self.metrics.record_shed()
+            graftscope.emit("request.shed", cat="request",
+                            req=request.uid,
+                            reason=self.health.state)
+            raise QueueFull(
+                f"admission closed: engine {self.health.state.upper()}"
+                f" ({self.health.reason}); submit to another replica")
         if request.deadline_s is not None:
             self._deadlines_seen = True
         if request.prompt and (
@@ -918,6 +966,11 @@ class ServingEngine:
             graftscope.emit("request.shed", cat="request",
                             req=request.uid)
             raise
+        if self.journal is not None:
+            # WAL the admission BEFORE any work happens on it: a crash
+            # from here on redelivers the request (idempotent by uid —
+            # a redelivered request re-admitting appends nothing)
+            self.journal.record_admit(submitted)
         graftscope.emit("request.submit", cat="request",
                         req=request.uid,
                         prompt_len=len(request.prompt),
@@ -1343,6 +1396,9 @@ class ServingEngine:
                                 error=type(e).__name__)
                 graftscope.flight_dump(
                     f"engine step: {type(e).__name__}: {e}")
+            # /healthz flips with the crash: a replica router must see
+            # this replica dead the moment its step loop is
+            self.health.to_dead(type(e).__name__)
             raise
 
     def _step_inner(self) -> List[Tuple[Request, int, bool]]:
@@ -1362,6 +1418,12 @@ class ServingEngine:
                 dt, emitted, occupancy, self.scheduler.queue_depth,
                 window)
         self._step_idx += 1
+        if self.journal is not None and events:
+            # one fsync'd WAL batch per step, at the drain boundary
+            # the host already synced; replay-prefix tokens dedup
+            # (and verify) inside — a journal failure is engine-fatal
+            # through step()'s flight-dump path, never silent
+            self.journal.note_events(events)
         return events
 
     @property
@@ -1378,6 +1440,124 @@ class ServingEngine:
         streaming token events."""
         while self.in_flight:
             yield from self.step()
+
+    # ---- graftheal: drain + redelivery --------------------------------
+    def begin_drain(self, reason: str = "drain") -> None:
+        """Flip the health machine to DRAINING (idempotent; signal-
+        handler-safe — it only writes host state): admission closes
+        (``enqueue`` raises ``QueueFull`` naming the drain), /healthz
+        starts serving 503, and the drive loop finishes in-flight work
+        through :meth:`drain`. SIGTERM is wired here by
+        ``runtime.heal.install_drain_handler``."""
+        if self.health.state in (heal.DRAINING, heal.DEAD):
+            return
+        self.health.to_draining(reason)
+        graftscope.emit("engine.draining", cat="serving", reason=reason,
+                        in_flight=self.in_flight)
+
+    def drain(self, deadline_s: Optional[float] = None
+              ) -> List[Tuple[Request, int, bool]]:
+        """Finish every in-flight request (admission stays closed),
+        bounded by ``deadline_s``: past it, every unfinished request —
+        queued, mid-chunked-prefill, or running — is failed NAMED
+        (``DeadlineExceeded``, reason ``"drain"``), never silently
+        dropped. The engine lands DEAD, its journal (if any) is
+        compacted + closed (a clean full drain leaves it empty), and
+        the step's token events are returned for delivery."""
+        self.begin_drain("drain")
+        t0 = time.perf_counter()
+        events: List[Tuple[Request, int, bool]] = []
+        with graftscope.span("engine.drain", cat="serving",
+                             deadline_s=deadline_s) as drain_span:
+            overdue = 0
+            while self.in_flight:
+                if (deadline_s is not None
+                        and time.perf_counter() - t0 > deadline_s):
+                    overdue = self._fail_unfinished(deadline_s)
+                    break
+                events.extend(self.step())
+            drain_span.note(drained=len(events), overdue=overdue)
+        self.health.to_dead("drained")
+        if self.journal is not None:
+            self.journal.close()
+        return events
+
+    def _fail_unfinished(self, deadline_s: float) -> int:
+        """Drain-deadline eviction: fail everything still in flight,
+        named. In-flight token blocks are dropped undrained (their
+        requests are being failed and the pool dies with the engine);
+        running slots are scrubbed like any quarantine."""
+        self._blocks.clear()
+        failed = 0
+
+        def overdue_error(request, where):
+            return DeadlineExceeded(
+                f"request {request.uid} still {where} at the drain "
+                f"deadline ({deadline_s:.3g}s): failed named, not "
+                "silently dropped — resubmit to another replica (the "
+                "journal records it terminal, so a restart will not "
+                "double-serve it)")
+
+        while True:
+            request = self.scheduler.next_to_admit()
+            if request is None:
+                break
+            self._quarantine(request, overdue_error(request, "queued"),
+                             reason="drain")
+            failed += 1
+        pend = self._pending
+        if pend is not None:
+            self._pending = None
+            self._quarantine(
+                pend.request,
+                overdue_error(pend.request, "mid-chunked-prefill"),
+                reason="drain")
+            failed += 1
+        for slot, request in list(self._running.items()):
+            self._quarantine(request, overdue_error(request, "running"),
+                             reason="drain", slot=slot)
+            failed += 1
+        return failed
+
+    def redeliver(self, entries,
+                  events_out: Optional[list] = None) -> List[Request]:
+        """Re-submit journaled unfinished requests (supervised-restart
+        recovery): each :class:`~..runtime.heal.JournalEntry` re-enters
+        admission under its ORIGINAL uid — the journal recognizes it
+        (no duplicate WAL record) and prefix-dedups its already-emitted
+        tokens as the deterministic decode regenerates them, so the
+        recovered run is token-exact and nothing is double-journaled.
+
+        A crash can leave MORE unfinished entries than the bounded
+        queue admits (running + queued at crash time vs a fresh empty
+        engine), so ``QueueFull`` here is absorbed by stepping the
+        engine between attempts — the same backpressure discipline as
+        ``submit_retrying`` — never a crashed recovery (the drain
+        steps' token events land in ``events_out`` when given).
+        Returns the redelivered ``Request`` records in journal order."""
+        out: List[Request] = []
+        for entry in entries:
+            request = Request(entry.prompt, entry.max_new_tokens,
+                              entry.eos_id, uid=entry.uid)
+            while True:
+                try:
+                    self.enqueue(request)
+                    break
+                except QueueFull:
+                    if not self.health.ready:
+                        raise  # draining/dead: admission closed for good
+                    # bounded queue at capacity: serve a step so it
+                    # drains (guaranteed progress — a full queue means
+                    # work is resident), then re-enqueue
+                    events = self.step()
+                    if events_out is not None:
+                        events_out.extend(events)
+            self.metrics.record_redelivery()
+            graftscope.emit("request.redelivered", cat="request",
+                            req=entry.uid,
+                            replayed_tokens=len(entry.tokens))
+            out.append(request)
+        return out
 
     def serve(self, requests: Iterable[Tuple[Sequence[int], int]]
               ) -> List[Request]:

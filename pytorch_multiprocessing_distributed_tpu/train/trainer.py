@@ -363,6 +363,12 @@ class Trainer:
             # step's dispatch overlaps this one's execution.
             pending.append(metrics)
             if i % self.print_freq == 0 or i == n_batches - 1:
+                # graftheal: the liveness gate sits at the SAME window
+                # boundary as the preemption check — a dead peer
+                # raises a named PeerLostError here, before this host
+                # dispatches more steps whose collectives would hang
+                # on it (one global read when no monitor is armed)
+                dist.gate_collectives()
                 self._checkpoint_if_preempted(epoch)
                 with graftscope.span("train.metrics_fetch", cat="train",
                                      epoch=epoch, steps=len(pending)):

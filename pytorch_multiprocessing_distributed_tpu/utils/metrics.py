@@ -155,6 +155,7 @@ class ServingMetrics:
         self.dispatch_retries = 0
         self.requests_failed = 0
         self.requests_shed = 0
+        self.requests_redelivered = 0
         self.watchdog_trips = 0
         self.horizon_collapses = 0
         self._elapsed = 0.0
@@ -220,8 +221,15 @@ class ServingMetrics:
         self.requests_failed += 1
 
     def record_shed(self) -> None:
-        """One submission rejected at the queue bound (QueueFull)."""
+        """One submission rejected at the queue bound (QueueFull) or
+        at a closed (DRAINING/DEAD) admission door."""
         self.requests_shed += 1
+
+    def record_redelivery(self) -> None:
+        """One journaled unfinished request re-submitted after a
+        supervised restart (graftheal) — recovery work is visible,
+        never mistaken for fresh traffic."""
+        self.requests_redelivered += 1
 
     def record_watchdog_trip(self) -> None:
         """One hung horizon readback detected and failed fast."""
@@ -264,6 +272,7 @@ class ServingMetrics:
             "dispatch_retries": self.dispatch_retries,
             "requests_failed": self.requests_failed,
             "requests_shed": self.requests_shed,
+            "requests_redelivered": self.requests_redelivered,
             "watchdog_trips": self.watchdog_trips,
             "horizon_collapses": self.horizon_collapses,
         }
@@ -281,7 +290,8 @@ class ServingMetrics:
     # counters whose deltas snapshot_delta reports
     _DELTA_COUNTERS = (
         "tokens_generated", "decode_tokens", "requests_completed",
-        "requests_failed", "requests_shed", "dispatches", "host_syncs",
+        "requests_failed", "requests_shed", "requests_redelivered",
+        "dispatches", "host_syncs",
         "dispatch_retries", "horizon_collapses", "watchdog_trips",
     )
 

@@ -27,10 +27,19 @@ Request sources (first match wins):
 Observability (graftscope): ``--trace_out t.json`` (Chrome-trace/
 Perfetto timeline), ``--events_out e.jsonl`` (raw event log with one
 ``request.timeline`` lifecycle summary per request), ``--stats_port N``
-(live Prometheus ``/metrics`` + ``/snapshot.json`` over stdlib
-http.server), ``--flight_path f.jsonl`` (flight-recorder dump on
+(live Prometheus ``/metrics`` + ``/snapshot.json`` + ``/healthz`` over
+stdlib http.server), ``--flight_path f.jsonl`` (flight-recorder dump on
 engine-fatal errors). The final metrics snapshot carries p50/p90/
 p95/p99 for TTFT, queue wait, and decode step beside the averages.
+
+Elastic runtime (graftheal): SIGTERM drains gracefully — admission
+closes (``/healthz`` flips to 503 for the replica router), in-flight
+requests finish up to ``--drain_deadline_s``, overdue ones fail named,
+exit is 0. ``--journal wal.jsonl`` WALs every admitted request + its
+emitted tokens so a restart redelivers the unfinished ones token-exact;
+``--max_restarts N --restart_backoff S`` wraps the whole loop in the
+bounded-backoff supervisor (named fatals rebuild the engine and replay
+the journal; budget exhaustion fails loudly).
 
 Examples (CPU mesh):
   PMDT_FORCE_CPU_DEVICES=8 python serve_lm.py --model gpt_tiny \\
@@ -45,7 +54,7 @@ import json
 import sys
 
 from pytorch_multiprocessing_distributed_tpu.runtime import (
-    scope as graftscope)
+    heal, scope as graftscope)
 from pytorch_multiprocessing_distributed_tpu.utils.compile_cache import (
     enable_compilation_cache)
 
@@ -126,6 +135,29 @@ parser.add_argument('--metrics_out', default='', type=str,
                     help='write the final metrics snapshot as JSON')
 parser.add_argument('--quiet', action='store_true',
                     help='suppress per-token streaming lines')
+# --- graftheal: elastic runtime ---
+parser.add_argument('--drain_deadline_s', default=0.0, type=float,
+                    help='graceful-drain bound: on SIGTERM (or source '
+                         'exhaustion) in-flight requests get this many '
+                         'seconds to finish; overdue ones are FAILED '
+                         'named, then the engine exits 0 '
+                         '(0 = unbounded drain)')
+parser.add_argument('--journal', default='', type=str, metavar='JSONL',
+                    help='request-redelivery WAL: admitted-but-'
+                         'unfinished requests are journaled (fsync\'d '
+                         'appends, atomic compaction) and a restarted '
+                         'engine re-submits them token-exact — the '
+                         'supervised-restart recovery path (greedy '
+                         'decode only)')
+parser.add_argument('--max_restarts', default=0, type=int,
+                    help='supervised restart budget: catch named-fatal '
+                         'errors (GraftFaultError family), rebuild the '
+                         'engine, replay the --journal, and keep '
+                         'serving — at most N times, with exponential '
+                         '--restart_backoff (0 = die on first fatal)')
+parser.add_argument('--restart_backoff', default=1.0, type=float,
+                    help='first-restart delay in seconds (doubles per '
+                         'restart, capped at 30s)')
 graftscope.add_cli_args(parser, stats_port=True)
 
 
@@ -233,40 +265,23 @@ def main():
     else:
         decode_buckets = [int(b) for b in args.decode_buckets.split(',')]
 
-    engine = ServingEngine(
-        model, params,
-        max_slots=args.max_slots,
-        s_max=args.s_max or None,
-        mesh=mesh,
-        max_queue=args.max_queue or None,
-        temperature=args.temperature, top_k=args.top_k,
-        top_p=args.top_p,
-        rng=(jax.random.PRNGKey(args.seed)
-             if args.temperature > 0 else None),
-        eos_id=None if args.eos < 0 else args.eos,
-        decode_buckets=decode_buckets,
-        prefill_chunk=args.prefill_chunk or None,
-        decode_horizon=args.decode_horizon,
-        decode_attn=args.decode_attn)
-
-    stats_server = None
-    if args.stats_port:
-        # live telemetry beside the serving loop: /metrics (Prometheus
-        # text exposition) + /snapshot.json, stdlib http.server only;
-        # the graftmeter ledger's hbm_* gauges ride the same snapshot
-
-        def live_snapshot():
-            snap = engine.metrics.snapshot()
-            ledger = hbm.active_ledger()
-            if ledger is not None:
-                snap.update(ledger.snapshot())
-                snap["hbm_per_slot_bytes"] = engine.pool.per_slot_bytes
-            return snap
-
-        stats_server = graftscope.start_stats_server(
-            live_snapshot, port=args.stats_port)
-        print(f"stats: http://127.0.0.1:"
-              f"{stats_server.server_address[1]}/metrics", flush=True)
+    def build_engine(journal):
+        return ServingEngine(
+            model, params,
+            max_slots=args.max_slots,
+            s_max=args.s_max or None,
+            mesh=mesh,
+            max_queue=args.max_queue or None,
+            temperature=args.temperature, top_k=args.top_k,
+            top_p=args.top_p,
+            rng=(jax.random.PRNGKey(args.seed)
+                 if args.temperature > 0 else None),
+            eos_id=None if args.eos < 0 else args.eos,
+            decode_buckets=decode_buckets,
+            prefill_chunk=args.prefill_chunk or None,
+            decode_horizon=args.decode_horizon,
+            decode_attn=args.decode_attn,
+            journal=journal)
 
     def emit(events):
         if args.quiet:
@@ -280,47 +295,157 @@ def main():
                 print(f"req={request.uid} tokens={request.tokens}",
                       flush=True)
 
-    rejected = 0
+    rejected = [0]
     skipped = []
     served = []
-    # a crash anywhere in the drive loop leaves the flight ring on
-    # disk before propagating (engine-internal fatals already dump;
-    # this covers the CLI's own loop)
-    with graftscope.flight_recorder("serve_lm drive loop"):
-        for prompt, max_new in _load_requests(args, model.vocab_size,
-                                              skipped):
-            request = Request(prompt, max_new, engine.eos_id)
-            while True:
-                try:
-                    engine.enqueue(request)
-                    served.append(request)
-                    break
-                except QueueFull:
-                    # finite source + bounded queue = backpressure,
-                    # not load shedding: drain a step, then
-                    # re-enqueue the SAME request (its submit_time —
-                    # and so its TTFT — keeps the first attempt's
-                    # stamp)
-                    emit(engine.step())
-                except ValueError as e:
-                    rejected += 1
-                    print(f"rejected: {e}", file=sys.stderr)
-                    break
-            if args.stdin:
-                # online source: serve while the producer is still
-                # typing (an offline file bulk-admits + drains below)
-                emit(engine.step())
+    # ONE source across restart attempts: a request consumed before a
+    # crash is in the journal (redelivered), the rest stay unconsumed
+    # here — an in-process restart never double-submits. Source
+    # requests also get DETERMINISTIC uids (src-<index>, counted
+    # across attempts), so a whole-PROCESS restart re-reading the same
+    # source skips everything the journal already knows (done or
+    # redelivered) instead of double-serving it.
+    source = _load_requests(args, model.vocab_size, skipped)
+    src_idx = [0]
+    # the one item consumed from the generator but not yet admitted:
+    # retained across restart attempts — a fatal striking between
+    # next(source) and a successful enqueue must not make the request
+    # vanish (the generator will never yield it again)
+    pending_src = [None]
 
-        for event in engine.run():
-            emit([event])
+    def serve_once(attempt):
+        """One engine incarnation: build (replaying the journal's
+        unfinished requests token-exact), serve the source, drain
+        gracefully. SIGTERM flips the engine to DRAINING — admission
+        closes, in-flight work finishes up to --drain_deadline_s,
+        exit is a clean 0. A named fatal propagates to the
+        supervisor, which rebuilds and replays (--max_restarts)."""
+        journal = (heal.RequestJournal(args.journal) if args.journal
+                   else None)
+        engine = build_engine(journal)
+        if attempt:
+            print(f"graftheal: restart {attempt}: engine rebuilt"
+                  + (f", replaying {len(journal.unfinished())} "
+                     f"journaled request(s)" if journal else ""),
+                  flush=True)
+        prev_handler = heal.install_drain_handler(engine)
+        stats_server = None
+        if args.stats_port:
+            # live telemetry beside the serving loop: /metrics
+            # (Prometheus) + /snapshot.json + /healthz (200 only while
+            # READY — the replica router's probe); the graftmeter
+            # hbm_* gauges ride the same snapshot
+
+            def live_snapshot():
+                snap = engine.metrics.snapshot()
+                ledger = hbm.active_ledger()
+                if ledger is not None:
+                    snap.update(ledger.snapshot())
+                    snap["hbm_per_slot_bytes"] = \
+                        engine.pool.per_slot_bytes
+                return snap
+
+            stats_server = graftscope.start_stats_server(
+                live_snapshot, port=args.stats_port,
+                health_fn=lambda: heal.healthz(
+                    engine.health, heal.active_monitor()))
+            print(f"stats: http://127.0.0.1:"
+                  f"{stats_server.server_address[1]}/metrics "
+                  f"(+ /healthz)", flush=True)
+        try:
+            # a crash anywhere in the drive loop leaves the flight
+            # ring on disk before propagating (engine-internal fatals
+            # already dump; this covers the CLI's own loop)
+            with graftscope.flight_recorder("serve_lm drive loop"):
+                if journal is not None:
+                    replay_events = []
+                    served.extend(engine.redeliver(
+                        journal.unfinished(),
+                        events_out=replay_events))
+                    emit(replay_events)
+                while not engine.health.draining:
+                    if pending_src[0] is None:
+                        try:
+                            prompt, max_new = next(source)
+                        except StopIteration:
+                            break
+                        pending_src[0] = (f"src-{src_idx[0]}", prompt,
+                                          max_new)
+                        src_idx[0] += 1
+                    uid, prompt, max_new = pending_src[0]
+                    if journal is not None and journal.known(uid):
+                        pending_src[0] = None  # served/redelivered
+                        continue
+                    request = Request(prompt, max_new, engine.eos_id,
+                                      uid=uid)
+                    handled = False
+                    while True:
+                        try:
+                            engine.enqueue(request)
+                            served.append(request)
+                            handled = True
+                            break
+                        except QueueFull:
+                            if engine.health.draining:
+                                # admission CLOSED for good this
+                                # incarnation — the item stays pending
+                                # for a restart to pick up
+                                break
+                            # finite source + bounded queue =
+                            # backpressure, not load shedding: drain a
+                            # step, then re-enqueue the SAME request
+                            # (its submit_time — and so its TTFT —
+                            # keeps the first attempt's stamp)
+                            emit(engine.step())
+                        except ValueError as e:
+                            rejected[0] += 1
+                            print(f"rejected: {e}", file=sys.stderr)
+                            handled = True  # permanently invalid
+                            break
+                    if handled:
+                        pending_src[0] = None
+                    if engine.health.draining:
+                        break
+                    if args.stdin:
+                        # online source: serve while the producer is
+                        # still typing (an offline file bulk-admits +
+                        # drains below)
+                        emit(engine.step())
+                # serve while READY (healthz 200, admission open —
+                # the replica is routable until the work is done or a
+                # SIGTERM flips it); then the terminal drain: finish
+                # anything still in flight up to the deadline, fail
+                # overdue ones NAMED, compact the journal (empty
+                # after a clean full drain), land DEAD, exit 0
+                while engine.in_flight and not engine.health.draining:
+                    emit(engine.step())
+                emit(engine.drain(args.drain_deadline_s or None))
+        finally:
+            heal.restore_drain_handler(prev_handler)
+            if stats_server is not None:
+                stats_server.shutdown()
+        return engine
+
+    if args.max_restarts:
+        engine = heal.Supervisor(
+            serve_once, max_restarts=args.max_restarts,
+            backoff_s=args.restart_backoff).run()
+    else:
+        engine = serve_once(0)
     for msg in skipped:
         print(f"rejected: {msg}", file=sys.stderr)
-    rejected += len(skipped)
+    rejected = rejected[0] + len(skipped)
     # one lifecycle summary event per terminal request: a JSONL
     # consumer reads complete per-request stories (queue wait, TTFT,
     # decode tail, finish reason) without re-deriving them from the
-    # raw span stream
+    # raw span stream. By uid, LAST record wins: a restart leaves the
+    # crashed incarnation's stale non-terminal Request in `served`
+    # and appends the redelivered one — two timelines for one uid
+    # would be a contradictory lifecycle
+    by_uid = {}
     for request in served:
+        by_uid[request.uid] = request
+    for request in by_uid.values():
         graftscope.emit("request.timeline", cat="request",
                         **request.timeline())
 
@@ -341,8 +466,6 @@ def main():
         with open(args.metrics_out, "w") as f:
             json.dump(snap, f, indent=2, sort_keys=True)
     graftscope.export_from_args(args)
-    if stats_server is not None:
-        stats_server.shutdown()
 
 
 if __name__ == "__main__":

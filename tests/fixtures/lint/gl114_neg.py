@@ -1,0 +1,38 @@
+"""GL114 near-miss negatives: the chaining discipline (capture with
+getsignal, chain in the new handler), handler RESTORES, and
+lookalike ``.signal`` calls on non-signal objects."""
+import signal
+
+
+def install_chaining(cb):
+    # the intended shape: previous handler captured AND chained
+    prev = signal.getsignal(signal.SIGTERM)
+
+    def handler(signum, frame):
+        cb()
+        if callable(prev) and prev not in (signal.SIG_IGN,
+                                           signal.SIG_DFL, handler):
+            prev(signum, frame)
+
+    signal.signal(signal.SIGTERM, handler)
+    return prev
+
+
+def restore_saved(prev_handler):
+    # putting a SAVED handler back displaces nothing
+    signal.signal(
+        signal.SIGTERM,
+        signal.SIG_DFL if prev_handler is None else prev_handler)
+
+
+def restore_name(prev):
+    signal.signal(signal.SIGTERM, prev)
+
+
+def reset_to_default():
+    signal.signal(signal.SIGINT, signal.SIG_IGN)
+
+
+def lookalike(router, on_change):
+    # not the stdlib signal module
+    router.signal.signal("route-change", lambda *a: on_change())

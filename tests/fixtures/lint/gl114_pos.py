@@ -1,0 +1,22 @@
+"""GL114 positives: signal.signal installing a fresh handler while
+the previous one is never captured — whoever registered it (the
+preemption checkpointer, a drain hook, an external supervisor's
+harness) silently stops seeing the signal."""
+import signal
+
+
+def install_discarding(cb):
+    def handler(signum, frame):
+        cb()
+    signal.signal(signal.SIGTERM, handler)         # <- GL114
+
+
+def install_lambda(cb):
+    signal.signal(signal.SIGINT, lambda s, f: cb())  # <- GL114
+
+
+def module_level_handler(signum, frame):
+    raise SystemExit(0)
+
+
+signal.signal(signal.SIGUSR1, module_level_handler)  # <- GL114

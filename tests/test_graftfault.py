@@ -35,7 +35,9 @@ from pytorch_multiprocessing_distributed_tpu.serving import (
 
 # importing these registers the non-serving sites the matrix sweeps
 from pytorch_multiprocessing_distributed_tpu.parallel import dist  # noqa: F401
+from pytorch_multiprocessing_distributed_tpu.runtime import heal
 from pytorch_multiprocessing_distributed_tpu.runtime import store  # noqa: F401
+from pytorch_multiprocessing_distributed_tpu.runtime.store import MemStore
 from pytorch_multiprocessing_distributed_tpu.train import (  # noqa: F401
     checkpoint as ckpt_mod, orbax_ckpt)
 
@@ -373,6 +375,90 @@ def _scenario_rendezvous(chaos):
     dist.barrier("chaos")  # disarmed: no-op on one host
 
 
+def _scenario_heartbeat_write(chaos):
+    """error x1 at the beat publish: absorbed by bounded retry (the
+    beat still lands, monotone); a persistent failure fails fast
+    named — a host that cannot reach the store must look dead to its
+    peers, never silently healthy."""
+    mem = MemStore()
+    hb = heal.Heartbeat(mem, "h0", backoff_s=0.0)
+    plan = FaultPlan([FaultRule("heartbeat.write", "error", times=1)])
+    with armed(plan):
+        assert hb.beat() == 1
+    assert plan.triggered() == 1
+    assert mem.get("heal/beat/h0") == b"1"  # recovered write landed
+    with armed(FaultPlan([FaultRule("heartbeat.write", "error",
+                                    times=0)])):
+        with pytest.raises(FaultInjected):
+            hb.beat()
+
+
+def _scenario_heartbeat_read(chaos):
+    """error x1 at the liveness poll: recovered — the retried read
+    still observes the peer's beat (no false SUSPECT/DEAD from a
+    transient store flake)."""
+    mem = MemStore()
+    monitor = heal.HeartbeatMonitor(
+        mem, "0", ["0", "1"], soft_timeout_s=5.0, hard_timeout_s=10.0,
+        backoff_s=0.0)
+    heal.Heartbeat(mem, "1", backoff_s=0.0).beat()
+    plan = FaultPlan([FaultRule("heartbeat.read", "error", times=1)])
+    with armed(plan):
+        states = monitor.poll()
+    assert plan.triggered() == 1
+    assert states == {"1": "alive"}
+
+
+def _scenario_journal_write(chaos):
+    """error x1 at the WAL append: recovered (the record is durable —
+    a reopened journal replays it); exhausted retries fail loudly
+    NAMED — a WAL that silently stops recording voids the redelivery
+    guarantee."""
+    import tempfile
+    from types import SimpleNamespace
+
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "wal.jsonl")
+        journal = heal.RequestJournal(path, backoff_s=0.0)
+        req = SimpleNamespace(uid=1, prompt=[1, 2, 3],
+                              max_new_tokens=4, eos_id=None)
+        plan = FaultPlan([FaultRule("heal.journal_write", "error",
+                                    times=1)])
+        with armed(plan):
+            journal.record_admit(req)
+        assert plan.triggered() == 1
+        replayed = heal.RequestJournal(path, backoff_s=0.0)
+        assert [e.uid for e in replayed.unfinished()] == [1]
+        req2 = SimpleNamespace(uid=2, prompt=[4], max_new_tokens=2,
+                               eos_id=None)
+        with armed(FaultPlan([FaultRule("heal.journal_write", "error",
+                                        times=0)])):
+            with pytest.raises(GraftFaultError, match="journal"):
+                journal.record_admit(req2)
+
+
+def _scenario_restart(chaos):
+    """error x1 injected AT a supervised restart: the failed restart
+    consumes budget like any named fatal (tracked, bounded — never an
+    untracked crash loop), and the next attempt completes."""
+    calls = []
+
+    def target(attempt):
+        calls.append(attempt)
+        if attempt == 0:
+            raise GraftFaultError("boom")
+        return "ok"
+
+    plan = FaultPlan([FaultRule("heal.restart", "error", times=1)])
+    with armed(plan):
+        sup = heal.Supervisor(target, max_restarts=2, backoff_s=0.0,
+                              sleep=lambda s: None)
+        assert sup.run() == "ok"
+    assert plan.triggered() == 1
+    assert sup.restarts == 2  # the faulted restart consumed budget
+    assert calls == [0, 2]
+
+
 SCENARIOS = {
     "serving.decode_dispatch": _scenario_dispatch,
     "serving.horizon_readback": _scenario_readback,
@@ -385,6 +471,10 @@ SCENARIOS = {
     "train.checkpoint_write": _scenario_checkpoint_write,
     "train.orbax_save": _scenario_orbax,
     "runtime.rendezvous": _scenario_rendezvous,
+    "heartbeat.write": _scenario_heartbeat_write,
+    "heartbeat.read": _scenario_heartbeat_read,
+    "heal.journal_write": _scenario_journal_write,
+    "heal.restart": _scenario_restart,
 }
 
 

@@ -1,0 +1,773 @@
+"""graftheal: elastic supervision — the acceptance pins.
+
+- **Liveness-gated collectives**: with a DEAD peer simulated through
+  the store (its beat stops), every SURVIVING rank's gate raises a
+  named ``PeerLostError`` within the hard timeout — no hang — and the
+  poison key makes every other host converge on the SAME (who, why).
+  Pinned on the in-process ``MemStore`` (a shared store, N monitor
+  clients) and on the real C++ TCP store with one client per "host"
+  (the multi-client store harness), plus the ``dist.barrier`` gate.
+- **Supervised restart end-to-end**: an injected engine-fatal
+  mid-serve -> the supervisor rebuilds the engine, the journal's
+  unfinished requests are redelivered, and every request's final
+  tokens are byte-identical to an uninterrupted run (dense AND TP,
+  decode horizon H>1) — with the restart budget's exhaustion failing
+  loudly named.
+- **Graceful drain**: SIGTERM (through the REAL chaining handler)
+  flips the engine to DRAINING — admission closes with a QueueFull
+  naming the drain, /healthz flips to 503, in-flight requests finish
+  up to the deadline, overdue ones fail NAMED, the journal compacts.
+- **Chaos soak** (slow-marked, ``make soak``): N requests through an
+  engine under a background fault rate AND one injected mid-run
+  restart — every request either completes token-exact or fails
+  named, journal replay accounted.
+"""
+
+import json
+import os
+import shutil
+import signal
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pytorch_multiprocessing_distributed_tpu import models
+from pytorch_multiprocessing_distributed_tpu.parallel import dist
+from pytorch_multiprocessing_distributed_tpu.runtime import heal
+from pytorch_multiprocessing_distributed_tpu.runtime.faults import (
+    FaultPlan, FaultRule, GraftFaultError, PeerLostError, armed)
+from pytorch_multiprocessing_distributed_tpu.runtime.store import (
+    MemStore)
+from pytorch_multiprocessing_distributed_tpu.serving import (
+    DONE, FAILED, QueueFull, ServingEngine, init_params)
+
+
+def _tiny(**kw):
+    return models.GPT(vocab_size=61, max_seq_len=64, hidden_size=32,
+                      num_layers=2, num_heads=2, mlp_dim=64,
+                      attn_impl="xla", **kw)
+
+
+# ------------------------------------------------------ liveness tracker
+
+class TestLivenessTracker:
+    def test_transitions_on_injectable_clock(self):
+        clock = {"t": 0.0}
+        tr = heal.LivenessTracker(["a"], soft_timeout_s=1.0,
+                                  hard_timeout_s=3.0,
+                                  clock=lambda: clock["t"])
+        tr.observe("a", 1)
+        assert tr.state("a") == heal.ALIVE
+        clock["t"] = 1.5  # past soft, not hard
+        assert tr.state("a") == heal.SUSPECT
+        clock["t"] = 3.5
+        assert tr.state("a") == heal.DEAD_PEER
+        assert tr.dead() == ["a"]
+        # a beat ADVANCE resurrects; the same value does not
+        tr.observe("a", 1)
+        assert tr.state("a") == heal.DEAD_PEER
+        tr.observe("a", 2)
+        assert tr.state("a") == heal.ALIVE
+        assert tr.age("a") == 0.0
+
+    def test_never_beaten_peer_ages_from_construction(self):
+        clock = {"t": 10.0}
+        tr = heal.LivenessTracker(["ghost"], soft_timeout_s=1.0,
+                                  hard_timeout_s=2.0,
+                                  clock=lambda: clock["t"])
+        tr.observe("ghost", None)
+        clock["t"] = 12.5
+        assert tr.state("ghost") == heal.DEAD_PEER
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="hard_timeout"):
+            heal.LivenessTracker([], soft_timeout_s=2.0,
+                                 hard_timeout_s=1.0)
+        with pytest.raises(ValueError, match="> 0"):
+            heal.LivenessTracker([], soft_timeout_s=0.0,
+                                 hard_timeout_s=1.0)
+
+
+# -------------------------------------------- gate + poison convergence
+
+def _monitors(store, n, clock, **kw):
+    peers = [str(i) for i in range(n)]
+    kw.setdefault("soft_timeout_s", 1.0)
+    kw.setdefault("hard_timeout_s", 3.0)
+    kw.setdefault("backoff_s", 0.0)
+    return [heal.HeartbeatMonitor(store, p, peers, clock=clock, **kw)
+            for p in peers]
+
+
+class TestLivenessGate:
+    def test_dead_peer_raises_named_on_every_survivor(self):
+        """The headline pin on the shared in-process store: host 2's
+        beat stops; BOTH survivors raise PeerLostError naming it —
+        one by direct detection, the other by poison convergence —
+        within one gate poll past the hard timeout. No hang."""
+        store = MemStore()
+        clock = {"t": 0.0}
+        m0, m1, m2 = _monitors(store, 3, lambda: clock["t"])
+        # two healthy rounds so every monitor has SEEN every beat
+        for t in (0.1, 0.6):
+            clock["t"] = t
+            for m in (m0, m1, m2):
+                m.gate()
+        # host 2 goes silent; survivors keep gating
+        for t in (1.2, 2.2, 3.2):
+            clock["t"] = t
+            m0.gate()
+            m1.gate()
+        assert m0.tracker.state("2") == heal.SUSPECT
+        # m0 last OBSERVED 2's beat advance at t=1.2 (the 0.6 beat,
+        # seen one round later); hard timeout 3.0 -> dead past 4.2
+        clock["t"] = 4.5
+        with pytest.raises(PeerLostError, match="'2'") as e0:
+            m0.gate()
+        assert e0.value.who == "2"
+        # the second survivor converges on the SAME named error via
+        # the poison key (its own tracker may lag)
+        with pytest.raises(PeerLostError, match="'2'") as e1:
+            m1.gate()
+        assert e1.value.who == e0.value.who
+        assert e1.value.why == e0.value.why
+        poison = heal.check_poison(store)
+        assert poison["who"] == "2" and poison["by"] == "0"
+
+    def test_local_fatal_poisons_the_fleet(self):
+        """post_poison (a local fatal's coordinated abort): every
+        OTHER host's next gate raises the same named error; the first
+        poison wins ATOMICALLY (the claim is a store-side add, not a
+        racy get-then-set) — a second never overwrites it."""
+        store = MemStore()
+        clock = {"t": 0.0}
+        m0, m1 = _monitors(store, 2, lambda: clock["t"])
+        clock["t"] = 0.1
+        m0.gate()
+        heal.post_poison(store, "0", "simulated engine-fatal", by="0")
+        heal.post_poison(store, "1", "late duplicate", by="1")
+        assert heal.check_poison(store)["who"] == "0"  # first claim won
+        clock["t"] = 0.2
+        with pytest.raises(PeerLostError, match="engine-fatal"):
+            m1.gate()
+        heal.clear_poison(store)
+        clock["t"] = 0.3
+        m1.gate()  # cleared: healthy again
+        # the claim reset with the poison: a NEW abort is claimable
+        heal.post_poison(store, "1", "second generation", by="1")
+        assert heal.check_poison(store)["who"] == "1"
+
+    def test_gate_interval_rate_limits_polls(self):
+        store = MemStore()
+        clock = {"t": 0.0}
+        (m,) = _monitors(store, 1, lambda: clock["t"], interval_s=1.0)
+        clock["t"] = 0.5
+        m.gate()
+        assert m.heartbeat.count == 1
+        clock["t"] = 0.9  # inside the interval: no store traffic
+        m.gate()
+        assert m.heartbeat.count == 1
+        clock["t"] = 1.6
+        m.gate()
+        assert m.heartbeat.count == 2
+
+    def test_dist_barrier_and_gate_collectives(self):
+        """The dist wiring: an armed gate fails barrier/-boundary
+        calls named BEFORE any collective; uninstalled = no-op."""
+        def dead_gate():
+            raise PeerLostError("7", "unit-test gate")
+
+        dist.install_collective_gate(dead_gate)
+        try:
+            with pytest.raises(PeerLostError, match="'7'"):
+                dist.gate_collectives()
+            with pytest.raises(PeerLostError, match="'7'"):
+                dist.barrier("heal-gate-test")
+        finally:
+            dist.clear_collective_gate()
+        dist.gate_collectives()  # uninstalled: no-op
+        dist.barrier("heal-gate-test")
+
+    def test_arm_installs_dist_gate_and_disarm_clears(self):
+        store = MemStore()
+        clock = {"t": 0.0}
+        (monitor,) = _monitors(store, 1, lambda: clock["t"])
+        heal.arm(monitor)
+        try:
+            assert heal.active_monitor() is monitor
+            clock["t"] = 0.5
+            dist.gate_collectives()  # routes through monitor.gate
+            assert monitor.heartbeat.count == 1
+        finally:
+            heal.disarm()
+        assert heal.active_monitor() is None
+        dist.gate_collectives()  # cleared
+
+
+@pytest.mark.skipif(
+    shutil.which("g++") is None and shutil.which("make") is None,
+    reason="no C++ toolchain")
+def test_liveness_gate_over_real_tcp_store():
+    """The multi-client store harness on the REAL C++ store: three
+    'hosts' (one TCPStore client each, like three processes), host 2
+    beats twice and goes silent; BOTH survivors raise a PeerLostError
+    naming host 2 within the hard timeout — wall-clocked, no hang."""
+    from pytorch_multiprocessing_distributed_tpu.runtime import (
+        TCPStore, TCPStoreServer)
+
+    peers = ["0", "1", "2"]
+    with TCPStoreServer(port=0) as srv:
+        clients = [TCPStore(port=srv.port, backoff_s=0.0)
+                   for _ in peers]
+        try:
+            monitors = [heal.HeartbeatMonitor(
+                c, p, peers, soft_timeout_s=0.15, hard_timeout_s=0.4,
+                backoff_s=0.0) for c, p in zip(clients, peers)]
+            deadline = time.monotonic() + 10.0
+            # healthy rounds: everyone observes everyone
+            for _ in range(2):
+                for m in monitors:
+                    m.gate()
+                time.sleep(0.05)
+            # host 2 dies; survivors gate in their own threads (the
+            # per-process shape) until each raises or times out
+            errors = {}
+
+            def survivor(m):
+                while time.monotonic() < deadline:
+                    try:
+                        m.gate()
+                    except PeerLostError as e:
+                        errors[m.host] = e
+                        return
+                    time.sleep(0.05)
+
+            threads = [threading.Thread(target=survivor, args=(m,))
+                       for m in monitors[:2]]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=12.0)
+            assert not any(t.is_alive() for t in threads), \
+                "a survivor hung instead of failing named"
+            assert set(errors) == {"0", "1"}
+            assert all(e.who == "2" for e in errors.values()), errors
+        finally:
+            for c in clients:
+                c.close()
+
+
+# ------------------------------------------------ health state machine
+
+class TestHealthState:
+    def test_forward_only_transitions(self):
+        h = heal.HealthState()
+        assert h.state == heal.STARTING
+        h.to_ready()
+        assert h.ready and not h.draining
+        h.to_draining("sigterm")
+        assert h.draining and h.reason == "sigterm"
+        h.to_draining("again")  # re-enter: no-op, reason keeps first
+        assert h.reason == "sigterm"
+        h.to_dead("drained")
+        assert h.dead
+        with pytest.raises(ValueError, match="backward"):
+            h.to_ready()
+
+    def test_healthz_payload_and_http_codes(self):
+        """/healthz on the stats server: 200 + state json while READY,
+        503 the moment the machine leaves READY — the replica
+        router's probe contract."""
+        from pytorch_multiprocessing_distributed_tpu.runtime import (
+            scope as graftscope)
+
+        health = heal.HealthState()
+        health.to_ready("test")
+        store = MemStore()
+        monitor = heal.HeartbeatMonitor(
+            store, "0", ["0", "1"], soft_timeout_s=1.0,
+            hard_timeout_s=2.0, backoff_s=0.0)
+        monitor.heartbeat.beat()
+        server = graftscope.start_stats_server(
+            lambda: {"x": 1}, port=0,
+            health_fn=lambda: heal.healthz(health, monitor))
+        try:
+            port = server.server_address[1]
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz") as r:
+                assert r.status == 200
+                payload = json.loads(r.read())
+            assert payload["state"] == "ready"
+            assert payload["beat"] == 1
+            assert "1" in payload["last_beat_age_s"]
+            health.to_draining("sigterm")
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz")
+            assert err.value.code == 503
+            assert json.loads(err.value.read())["state"] == "draining"
+        finally:
+            server.shutdown()
+
+
+# ------------------------------------------------------------ supervisor
+
+class TestSupervisor:
+    def test_backoff_doubles_and_caps(self):
+        naps = []
+        calls = {"n": 0}
+
+        def target(attempt):
+            calls["n"] += 1
+            if attempt < 4:
+                raise GraftFaultError("again")
+            return attempt
+
+        sup = heal.Supervisor(target, max_restarts=4, backoff_s=1.0,
+                              max_backoff_s=5.0, sleep=naps.append)
+        assert sup.run() == 4
+        assert naps == [1.0, 2.0, 4.0, 5.0]  # doubling, capped
+
+    def test_budget_exhaustion_is_loud_and_chained(self):
+        def always(attempt):
+            raise PeerLostError("3", "gone")
+
+        with pytest.raises(heal.RestartBudgetExhausted,
+                           match="2 restart") as err:
+            heal.Supervisor(always, max_restarts=2, backoff_s=0.0,
+                            sleep=lambda s: None).run()
+        assert isinstance(err.value.__cause__, PeerLostError)
+
+    def test_rendezvous_hook_runs_before_each_restart(self):
+        order = []
+
+        def target(attempt):
+            order.append(("run", attempt))
+            if attempt < 2:
+                raise GraftFaultError("x")
+            return "ok"
+
+        sup = heal.Supervisor(target, max_restarts=2, backoff_s=0.0,
+                              rendezvous=lambda: order.append(("rdv",)),
+                              sleep=lambda s: None)
+        assert sup.run() == "ok"
+        assert order == [("run", 0), ("rdv",), ("run", 1), ("rdv",),
+                         ("run", 2)]
+
+    def test_non_fatal_exceptions_propagate_unconsumed(self):
+        def bug(attempt):
+            raise KeyError("logic bug")
+
+        sup = heal.Supervisor(bug, max_restarts=5, sleep=lambda s: None)
+        with pytest.raises(KeyError):
+            sup.run()
+        assert sup.restarts == 0
+
+        def clean_exit(attempt):
+            raise SystemExit(0)
+
+        with pytest.raises(SystemExit):
+            heal.Supervisor(clean_exit, max_restarts=5,
+                            sleep=lambda s: None).run()
+
+
+# --------------------------------------------------------------- journal
+
+class TestRequestJournal:
+    def _req(self, uid, prompt=(1, 2, 3), max_new=4, eos=None):
+        from types import SimpleNamespace
+
+        return SimpleNamespace(uid=uid, prompt=list(prompt),
+                               max_new_tokens=max_new, eos_id=eos,
+                               state=DONE, finish_reason="eos")
+
+    def test_wal_roundtrip_and_unfinished(self, tmp_path):
+        path = str(tmp_path / "wal.jsonl")
+        j = heal.RequestJournal(path, backoff_s=0.0)
+        a, b = self._req(1), self._req(2, prompt=(9,), max_new=2)
+        j.record_admit(a)
+        j.record_admit(b)
+        j.note_events([(a, 7, False), (a, 8, False), (b, 5, True)])
+        # crash: reopen WITHOUT close — replay sees a's progress, b done
+        j2 = heal.RequestJournal(path, backoff_s=0.0)
+        unfin = j2.unfinished()
+        assert [e.uid for e in unfin] == [1]
+        assert unfin[0].tokens == [7, 8]
+        assert unfin[0].prompt == [1, 2, 3]
+        assert j2.known(2) and j2.known(1) and not j2.known(3)
+
+    def test_torn_tail_tolerated(self, tmp_path, capsys):
+        path = str(tmp_path / "wal.jsonl")
+        j = heal.RequestJournal(path, backoff_s=0.0)
+        j.record_admit(self._req(1))
+        j._fh.close()
+        with open(path, "a") as fh:
+            fh.write('{"op": "tok", "uid": 1, "tok')  # torn append
+        j2 = heal.RequestJournal(path, backoff_s=0.0)
+        assert [e.uid for e in j2.unfinished()] == [1]
+        assert "torn" in capsys.readouterr().err
+
+    def test_reopen_after_torn_tail_keeps_new_records(self, tmp_path,
+                                                      capsys):
+        """Appending after a torn tail must NOT merge the next record
+        into the torn line: reopen newline-terminates the tail, and a
+        SECOND crash's replay still sees every record incarnation 2
+        wrote (replay skips the torn line, never stops at it)."""
+        path = str(tmp_path / "wal.jsonl")
+        j = heal.RequestJournal(path, backoff_s=0.0)
+        j.record_admit(self._req(1))
+        j._fh.close()
+        with open(path, "a") as fh:
+            fh.write('{"op": "tok", "uid": 1, "tok')  # crash 1: torn
+        j2 = heal.RequestJournal(path, backoff_s=0.0)
+        a = self._req(1)
+        j2.record_admit(a)  # idempotent no-op
+        j2.record_admit(self._req(2))  # incarnation 2's new record
+        j2.note_events([(a, 7, False)])
+        # crash 2: reopen without close — BOTH incarnations replay
+        j3 = heal.RequestJournal(path, backoff_s=0.0)
+        assert [e.uid for e in j3.unfinished()] == [1, 2]
+        assert j3.unfinished()[0].tokens == [7]
+        assert capsys.readouterr().err.count("torn") >= 1
+
+    def test_replay_prefix_dedup_and_divergence(self, tmp_path):
+        path = str(tmp_path / "wal.jsonl")
+        j = heal.RequestJournal(path, backoff_s=0.0)
+        a = self._req(1)
+        j.record_admit(a)
+        j.note_events([(a, 7, False)])
+        j2 = heal.RequestJournal(path, backoff_s=0.0)
+        (entry,) = j2.unfinished()
+        a2 = self._req(1)
+        j2.record_admit(a2)  # idempotent: no duplicate admit
+        # replayed token 7 is verified + deduped; 9 is new and appended
+        j2.note_events([(a2, 7, False), (a2, 9, False)])
+        j3 = heal.RequestJournal(path, backoff_s=0.0)
+        assert j3.unfinished()[0].tokens == [7, 9]
+        # divergence on the journaled prefix fails NAMED
+        j4 = heal.RequestJournal(path, backoff_s=0.0)
+        a3 = self._req(1)
+        j4.record_admit(a3)
+        with pytest.raises(GraftFaultError, match="diverged"):
+            j4.note_events([(a3, 6, False)])
+
+    def test_close_compacts_atomically(self, tmp_path):
+        path = str(tmp_path / "wal.jsonl")
+        j = heal.RequestJournal(path, backoff_s=0.0)
+        a, b = self._req(1), self._req(2)
+        j.record_admit(a)
+        j.record_admit(b)
+        j.note_events([(a, 7, True), (b, 5, False)])
+        j.close()
+        lines = [json.loads(x) for x in open(path) if x.strip()]
+        # finished entry dropped; unfinished one kept with its tokens
+        assert [x["op"] for x in lines] == ["admit", "tok"]
+        assert lines[0]["uid"] == 2 and lines[1]["tokens"] == [5]
+
+    def test_record_failed_is_terminal(self, tmp_path):
+        path = str(tmp_path / "wal.jsonl")
+        j = heal.RequestJournal(path, backoff_s=0.0)
+        a = self._req(1)
+        a.state = FAILED
+        a.finish_reason = "error"
+        j.record_admit(a)
+        j.record_failed(a)
+        j2 = heal.RequestJournal(path, backoff_s=0.0)
+        assert j2.unfinished() == []  # never redelivered as lost
+
+
+# -------------------------------------------- engine drain + redelivery
+
+@pytest.fixture(scope="module")
+def served():
+    """One engine + its fault-free baseline, shared by the drain and
+    restart tests (engine construction/compile is the expensive part;
+    the graftfault module uses the same discipline)."""
+    model = _tiny()
+    params = init_params(model, 1)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, model.vocab_size, (n,)).tolist()
+               for n in (3, 7, 12, 5)]
+    engine = ServingEngine(model, params, max_slots=2, s_max=32,
+                           min_bucket=8, decode_horizon=4,
+                           retry_backoff_s=0.0)
+    baseline = [r.tokens for r in
+                engine.serve([(p, 6) for p in prompts])]
+    return model, params, prompts, baseline, engine
+
+
+def _mk_engine(model, params, journal=None, **kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("s_max", 32)
+    kw.setdefault("min_bucket", 8)
+    kw.setdefault("decode_horizon", 4)
+    kw.setdefault("retry_backoff_s", 0.0)
+    return ServingEngine(model, params, journal=journal, **kw)
+
+
+class TestDrain:
+    def test_sigterm_flips_draining_and_admission_closes(self, served):
+        """The REAL chaining handler: SIGTERM mid-serve -> DRAINING;
+        admission raises QueueFull naming the drain; in-flight
+        requests still finish (no deadline); the chained previous
+        handler fires too; engine lands DEAD with slots recycled."""
+        model, params, prompts, baseline, _ = served
+        engine = _mk_engine(model, params)
+        outer = {"fired": 0}
+
+        def counting_handler(s, f):
+            outer["fired"] += 1
+
+        prev0 = signal.signal(signal.SIGTERM, counting_handler)
+        try:
+            prev = heal.install_drain_handler(engine)
+            reqs = [engine.submit(p, 6) for p in prompts]
+            engine.step()
+            signal.raise_signal(signal.SIGTERM)
+            assert engine.health.draining
+            assert outer["fired"] == 1  # previous handler CHAINED
+            with pytest.raises(QueueFull, match="DRAINING"):
+                engine.submit(prompts[0], 4)
+            assert engine.metrics.requests_shed == 1
+            events = engine.drain(None)
+            assert events  # the drain finished real work
+            assert [r.state for r in reqs] == [DONE] * 4
+            assert [r.tokens for r in reqs] == baseline
+            assert engine.health.dead
+            assert engine.pool.occupancy == 0
+            heal.restore_drain_handler(prev)
+            # restore puts back what install displaced: the counter
+            assert signal.getsignal(signal.SIGTERM) is counting_handler
+        finally:
+            signal.signal(signal.SIGTERM, prev0)
+
+    def test_drain_deadline_fails_overdue_named(self, served):
+        """Overdue-at-deadline requests — queued AND running — are
+        FAILED with reason 'drain' and a DeadlineExceeded recorded,
+        never silently dropped; slots all recycle."""
+        from pytorch_multiprocessing_distributed_tpu.runtime.faults import (
+            DeadlineExceeded)
+
+        model, params, prompts, _, _ = served
+        engine = _mk_engine(model, params)
+        reqs = [engine.submit(p, 20) for p in prompts]
+        engine.step()  # some running, some queued
+        engine.begin_drain("test")
+        engine.drain(0.0)  # immediate deadline
+        assert all(r.state == FAILED for r in reqs)
+        assert all(r.finish_reason == "drain" for r in reqs)
+        assert all(isinstance(r.error, DeadlineExceeded)
+                   for r in reqs)
+        assert engine.pool.occupancy == 0 and engine.in_flight == 0
+        assert engine.health.dead
+
+    def test_sampled_engine_rejects_journal(self, served, tmp_path):
+        import jax
+
+        model, params, _, _, _ = served
+        journal = heal.RequestJournal(str(tmp_path / "wal.jsonl"))
+        with pytest.raises(ValueError, match="greedy"):
+            ServingEngine(model, params, max_slots=2, s_max=32,
+                          temperature=0.7, rng=jax.random.PRNGKey(0),
+                          journal=journal)
+
+
+class TestSupervisedRestart:
+    def test_restart_e2e_dense_token_exact(self, served, tmp_path):
+        """The acceptance pin: engine-fatal mid-serve (injected fatal
+        at decode dispatch) -> supervisor rebuilds -> journaled
+        requests redelivered -> every request's final tokens are
+        byte-identical to the uninterrupted run; restart budget
+        exhaustion (injected fatal every attempt) fails loudly."""
+        model, params, prompts, baseline, _ = served
+        path = str(tmp_path / "wal.jsonl")
+        submitted = {"done": False}
+        finished = {}
+
+        def serve_once(attempt):
+            journal = heal.RequestJournal(path, backoff_s=0.0)
+            engine = _mk_engine(model, params, journal=journal)
+            live = engine.redeliver(journal.unfinished())
+            if not submitted["done"]:
+                live += [engine.submit(p, 6) for p in prompts]
+                submitted["done"] = True
+            events = engine.drain(None)
+            assert events is not None
+            for r in live:
+                finished[r.uid] = r
+            return engine
+
+        # the third dispatch dies fatally (after some tokens are out)
+        plan = FaultPlan([FaultRule("serving.decode_dispatch",
+                                    "fatal", times=1, after=2)])
+        with armed(plan):
+            sup = heal.Supervisor(serve_once, max_restarts=2,
+                                  backoff_s=0.0, sleep=lambda s: None)
+            engine = sup.run()
+        assert plan.triggered() == 1
+        assert sup.restarts == 1  # one fatal, one rebuild
+        got = [finished[uid].tokens
+               for uid in sorted(finished)]
+        assert got == baseline  # token-exact incl. redelivered
+        assert engine.metrics.requests_redelivered > 0
+        assert open(path).read() == ""  # clean drain compacted empty
+
+        # budget exhaustion: every incarnation dies -> ONE loud error
+        submitted["done"] = False
+        finished.clear()
+        os.remove(path)
+        with armed(FaultPlan([FaultRule("serving.decode_dispatch",
+                                        "fatal", times=0)])):
+            with pytest.raises(heal.RestartBudgetExhausted,
+                               match="1 restart"):
+                heal.Supervisor(serve_once, max_restarts=1,
+                                backoff_s=0.0,
+                                sleep=lambda s: None).run()
+
+    def test_redeliver_absorbs_queuefull(self, served, tmp_path):
+        """More unfinished journal entries than the fresh engine's
+        bounded queue admits (running + queued at crash > max_queue):
+        redelivery must absorb QueueFull by stepping the engine — a
+        crashed recovery would strand the rest of the WAL."""
+        model, params, prompts, baseline, _ = served
+        path = str(tmp_path / "wal.jsonl")
+        j = heal.RequestJournal(path, backoff_s=0.0)
+        eng = _mk_engine(model, params, journal=j)
+        [eng.submit(p, 6) for p in prompts]
+        eng.step()  # partial progress, then "crash"
+        j2 = heal.RequestJournal(path, backoff_s=0.0)
+        unfinished = j2.unfinished()
+        assert len(unfinished) > 1
+        tight = _mk_engine(model, params, journal=j2, max_queue=1)
+        events = []
+        red = tight.redeliver(unfinished, events_out=events)
+        assert len(red) == len(unfinished)
+        tight.drain(None)
+        got = {r.uid: r.tokens for r in red}
+        for uid, expect in zip(sorted(got), baseline):
+            assert got[uid] == expect
+
+    def test_restart_e2e_tp_token_exact(self, tmp_path):
+        """The TP half (mesh-sharded params, H>1): same fatal ->
+        rebuild -> redeliver pin, byte-identical to the TP
+        uninterrupted baseline."""
+        from pytorch_multiprocessing_distributed_tpu.inference import (
+            shard_params_for_tp_decode)
+        from pytorch_multiprocessing_distributed_tpu.parallel import (
+            make_mesh)
+
+        model = _tiny()
+        params = init_params(model, 1)
+        mesh = make_mesh(4, 2)
+        tp_params = shard_params_for_tp_decode(params, mesh)
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(0, model.vocab_size, (n,)).tolist()
+                   for n in (3, 7, 9)]
+
+        def mk(journal=None):
+            return ServingEngine(model, tp_params, max_slots=2,
+                                 s_max=32, mesh=mesh, min_bucket=8,
+                                 decode_horizon=4, retry_backoff_s=0.0,
+                                 journal=journal)
+
+        baseline = [r.tokens for r in
+                    mk().serve([(p, 6) for p in prompts])]
+        path = str(tmp_path / "tp_wal.jsonl")
+        submitted = {"done": False}
+        finished = {}
+
+        def serve_once(attempt):
+            journal = heal.RequestJournal(path, backoff_s=0.0)
+            engine = mk(journal)
+            live = engine.redeliver(journal.unfinished())
+            if not submitted["done"]:
+                live += [engine.submit(p, 6) for p in prompts]
+                submitted["done"] = True
+            engine.drain(None)
+            for r in live:
+                finished[r.uid] = r
+            return engine
+
+        plan = FaultPlan([FaultRule("serving.decode_dispatch",
+                                    "fatal", times=1, after=2)])
+        with armed(plan):
+            heal.Supervisor(serve_once, max_restarts=2, backoff_s=0.0,
+                            sleep=lambda s: None).run()
+        assert plan.triggered() == 1
+        got = [finished[uid].tokens for uid in sorted(finished)]
+        assert got == baseline
+
+
+# ------------------------------------------------------------ chaos soak
+
+@pytest.mark.slow
+def test_chaos_soak_background_faults_plus_restart(tmp_path):
+    """``make soak``: N requests through an engine under a BACKGROUND
+    transient-fault rate AND one injected mid-run engine-fatal. Every
+    request either completes token-exact vs the fault-free baseline
+    or fails NAMED; the journal accounts for every redelivery; the
+    final WAL is empty (clean drain)."""
+    model = _tiny()
+    params = init_params(model, 1)
+    rng = np.random.default_rng(3)
+    n = 12
+    prompts = [rng.integers(0, model.vocab_size, (m,)).tolist()
+               for m in rng.integers(3, 14, size=n)]
+
+    def mk(journal=None):
+        return ServingEngine(model, params, max_slots=3, s_max=32,
+                             min_bucket=8, decode_horizon=4,
+                             prefill_chunk=4, retry_backoff_s=0.0,
+                             journal=journal)
+
+    baseline = [r.tokens for r in
+                mk().serve([(p, 6) for p in prompts])]
+
+    path = str(tmp_path / "soak_wal.jsonl")
+    submitted = {"done": False}
+    finished = {}
+
+    def serve_once(attempt):
+        journal = heal.RequestJournal(path, backoff_s=0.0)
+        engine = mk(journal)
+        live = engine.redeliver(journal.unfinished())
+        if not submitted["done"]:
+            live += [engine.submit(p, 6) for p in prompts]
+            submitted["done"] = True
+        engine.drain(None)
+        for r in live:
+            finished[r.uid] = r
+        return engine
+
+    # a background 1-in-6 transient rate on the hot dispatch + one
+    # mid-run fatal: retries absorb the rate, the supervisor absorbs
+    # the fatal, the journal carries the in-flight work across
+    plan = FaultPlan([
+        FaultRule("serving.decode_dispatch", "error", times=0,
+                  every=6, after=1),
+        FaultRule("serving.horizon_readback", "fatal", times=1,
+                  after=4),
+    ], seed=11)
+    with armed(plan):
+        sup = heal.Supervisor(serve_once, max_restarts=3,
+                              backoff_s=0.0, sleep=lambda s: None)
+        engine = sup.run()
+    assert sup.restarts >= 1  # the fatal really fired mid-run
+    assert plan.triggered("serving.horizon_readback") == 1
+    assert plan.triggered("serving.decode_dispatch") > 0
+    assert len(finished) == n
+    for uid, expect in zip(sorted(finished), baseline):
+        request = finished[uid]
+        if request.state == DONE:
+            assert request.tokens == expect, f"uid {uid} not token-exact"
+        else:
+            assert request.state == FAILED
+            assert request.error is not None  # named, never silent
+    # every request completed (transient rate + one fatal is fully
+    # recoverable here) and the clean final drain compacted the WAL
+    assert all(finished[u].state == DONE for u in finished)
+    assert engine.metrics.requests_redelivered > 0
+    assert open(path).read() == ""

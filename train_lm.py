@@ -137,10 +137,46 @@ parser.add_argument('--sample_beams', default=0, type=int,
                     help='> 1: decode --sample tokens with beam search '
                          'of this width instead of greedy (prints the '
                          'best beam)')
+parser.add_argument('--max_restarts', default=0, type=int,
+                    help='graftheal supervised restart: catch named-'
+                         'fatal errors (GraftFaultError family), '
+                         're-run rendezvous, restart the run with '
+                         '--resume auto (newest digest-valid '
+                         'checkpoint) — at most N times with '
+                         'exponential backoff (0 = die on first '
+                         'fatal)')
+parser.add_argument('--restart_backoff', default=1.0, type=float,
+                    help='first-restart delay in seconds (doubles per '
+                         'restart, capped at 30s)')
 graftscope.add_cli_args(parser, stats_port=True)
 
 
 def main(args):
+    """Run the training CLI — under graftheal's bounded-restart
+    supervisor when ``--max_restarts`` is set (restarts resume from
+    the newest digest-valid checkpoint via ``--resume auto``; budget
+    exhaustion raises the named ``RestartBudgetExhausted``)."""
+    if not args.max_restarts:
+        return _run(args)
+    from pytorch_multiprocessing_distributed_tpu.runtime import heal
+
+    def target(attempt):
+        if attempt:
+            args.resume = 'auto'
+        return _run(args)
+
+    def rerendezvous():
+        from pytorch_multiprocessing_distributed_tpu.parallel import (
+            dist)
+
+        dist.destroy_process_group()
+
+    return heal.Supervisor(target, max_restarts=args.max_restarts,
+                           backoff_s=args.restart_backoff,
+                           rendezvous=rerendezvous).run()
+
+
+def _run(args):
     # arm before any jax work: compile/placement phases belong on the
     # timeline too (zero cost when no graftscope flag is set)
     graftscope.arm_from_args(args)
@@ -493,10 +529,17 @@ def main(args):
 
     # live gauges for --stats_port: updated at the print boundary (the
     # loop's one deliberate host sync — no extra fetches), merged with
-    # the hbm_* ledger gauges on /metrics + /snapshot.json
+    # the hbm_* ledger gauges on /metrics + /snapshot.json; /healthz
+    # (graftheal) serves 200 only while the run is up, with last-beat
+    # ages when a PMDT_HEARTBEAT monitor is armed
+    from pytorch_multiprocessing_distributed_tpu.runtime import heal
+
     live = {}
     stats_server = None
+    health = None
     if args.stats_port:
+        health = heal.HealthState()
+
         def live_snapshot():
             snap = dict(live)
             ledger = hbm.active_ledger()
@@ -505,9 +548,13 @@ def main(args):
             return snap
 
         stats_server = graftscope.start_stats_server(
-            live_snapshot, port=args.stats_port, prefix="pmdt")
+            live_snapshot, port=args.stats_port, prefix="pmdt",
+            health_fn=lambda: heal.healthz(health,
+                                           heal.active_monitor()))
         print(f"stats: http://127.0.0.1:"
-              f"{stats_server.server_address[1]}/metrics", flush=True)
+              f"{stats_server.server_address[1]}/metrics "
+              f"(+ /healthz)", flush=True)
+        health.to_ready("training")
 
     os.makedirs(args.save_path, exist_ok=True)
     logger = Logger(os.path.join(args.save_path, 'train.log'))
@@ -559,6 +606,12 @@ def main(args):
                             (jnp.asarray(batch),), mesh)
                     state, metrics = step(state, tok_sharded)
                 if i % args.print_freq == 0 or i == len(loader) - 1:
+                    # graftheal liveness gate at the window boundary
+                    # (one global read unless a monitor is armed): a
+                    # dead peer raises a named PeerLostError before
+                    # this host dispatches more collective-bearing
+                    # steps that would hang on it
+                    dist.gate_collectives()
                     # the print boundary is the loop's ONE deliberate
                     # host sync — the same boundary graftscope stamps
                     with graftscope.span("train.metrics_fetch",
@@ -639,8 +692,15 @@ def main(args):
     # a crash unwinding the epoch loop dumps the flight ring first —
     # the postmortem starts with the last windows' spans, not a bare
     # stack trace
-    with graftscope.flight_recorder("train_lm epoch loop"):
-        train_epochs()
+    try:
+        with graftscope.flight_recorder("train_lm epoch loop"):
+            train_epochs()
+    except BaseException:
+        # --max_restarts re-enters _run on the SAME --stats_port: a
+        # listener surviving the dying run = EADDRINUSE on restart
+        if stats_server is not None:
+            stats_server.shutdown()
+        raise
     if args.hf_export:
         from pytorch_multiprocessing_distributed_tpu.train.checkpoint import (
             _gather_for_host)
@@ -750,6 +810,8 @@ def main(args):
     if dist.is_primary():
         graftscope.export_from_args(args)
     if stats_server is not None:
+        if health is not None:
+            health.to_dead("run complete")
         stats_server.shutdown()
     dist.destroy_process_group()
 
