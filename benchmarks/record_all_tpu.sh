@@ -35,6 +35,13 @@ python benchmarks/serving_bench.py \
     > benchmarks/serving_bench_tpu.txt 2>&1
 tail -20 benchmarks/serving_bench_tpu.txt >&2
 
+note "serving bench (paged KV + prefix cache: dense vs paged at fixed HBM)"
+python benchmarks/serving_bench.py \
+    --sweep paged \
+    --json_out benchmarks/serving_bench_paged_tpu.json \
+    > benchmarks/serving_bench_paged_tpu.txt 2>&1
+tail -16 benchmarks/serving_bench_paged_tpu.txt >&2
+
 note "MFU tune sweep (resnet50 north star)"
 python benchmarks/mfu_tune.py --config resnet50_imagenet
 

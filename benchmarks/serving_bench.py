@@ -37,7 +37,21 @@ Three sweeps over the continuous-batching :class:`ServingEngine`:
    cost of surviving a given fault rate is RECORDED, never silently
    eaten.
 
-5. **Drain sweep** (``--sweep drain``, graftheal): the elastic-
+5. **Paged sweep** (``--sweep paged``, graftpage): dense slots vs the
+   paged KV cache at a FIXED HBM budget (the dense pool's own KV
+   bytes), across short/long/mixed length distributions and prefix-
+   hit rates {0, 0.5, 0.9}. Two points of record per cell: (a)
+   **resident requests at fixed HBM** — peak concurrent occupancy
+   when the backlog saturates the pool, dense vs paged (the paged
+   pool holds MORE requests in the same bytes because a request pins
+   ``ceil(total / page_size)`` pages, not ``s_max`` columns; the
+   planner's prediction is pinned byte-exact against the real
+   allocation); (b) **TTFT under prefix hits** — closed-loop
+   single-request serves at each hit rate, TTFT split hit vs miss (a
+   full hit skips prefill entirely: state splice + at most one COW
+   page fork). Paged streams are asserted token-exact vs dense.
+
+6. **Drain sweep** (``--sweep drain``, graftheal): the elastic-
    lifecycle latencies. Point one: **drain latency** — a loaded
    engine flips to DRAINING mid-serve (the SIGTERM path) and the
    clock runs until every in-flight request finished (admission
@@ -190,6 +204,7 @@ def run_point(model, params, prompts, new_tokens, slots, offered_rps,
         "host_syncs_per_token": snap["host_syncs_per_token"],
         "overlapped_dispatches": snap["overlapped_dispatches"],
         "occupancy_avg": engine.metrics.occupancy.avg,
+        "occupancy_max": snap["occupancy_max"],
         "queue_depth_avg": engine.metrics.queue_depth.avg,
         "decode_compiles": engine.decode_step_compiles,
         "decode_windows": list(engine.decode_windows),
@@ -363,6 +378,166 @@ def run_chaos_sweep(model, params, args, rng):
     return results
 
 
+def _hit_prompts(rng, model, dist, n, lo, hi, hit_rate):
+    """Request stream at a prefix-hit rate: ``hit_rate`` of the
+    requests re-use one of two "popular" prompts (identical full
+    prompts — FULL hits once cached), the rest are unique."""
+    lengths = _draw_lengths(rng, dist, n + 2, lo, hi)
+    popular = [rng.integers(0, model.vocab_size, (lengths[i],)).tolist()
+               for i in range(2)]
+    prompts = []
+    for i in range(n):
+        if rng.random() < hit_rate:
+            prompts.append(list(popular[i % 2]))
+        else:
+            prompts.append(rng.integers(
+                0, model.vocab_size, (lengths[2 + i],)).tolist())
+    return prompts
+
+
+def run_paged_sweep(model, params, args, rng):
+    """Dense vs paged at fixed HBM x length dist x prefix-hit rate.
+    See the module docstring (sweep 5); CPU-runnable, TPU-ready."""
+    from pytorch_multiprocessing_distributed_tpu.analysis.meter import (
+        plan_capacity)
+    from pytorch_multiprocessing_distributed_tpu.runtime import (
+        hbm as hbm_ledger)
+    from pytorch_multiprocessing_distributed_tpu.serving import (
+        ServingEngine, SlotPool)
+
+    new_tokens = args.new_tokens
+    # the pool must ADMIT up to the model's own max length (that is
+    # what s_max is for); traffic runs mostly shorter — exactly the
+    # gap dense slots pay worst-case for and pages do not
+    s_max = model.max_seq_len
+    prompt_hi = max(2, min(args.prompt_max, s_max - new_tokens) - 1)
+    slots_dense = int(args.slots.split(",")[0])
+    page_size = max(4, args.page_size)
+    # FIXED budget: params + exactly the dense pool's worst-case KV
+    # bytes — the planner charges params first, so the page pool gets
+    # precisely the bytes the dense slots occupied
+    kv_budget = slots_dense * SlotPool.per_slot_kv_bytes(model, s_max)
+    budget = hbm_ledger.tree_nbytes(params) + kv_budget
+    results = []
+    for dist in args.len_dist.split(","):
+        lengths = _draw_lengths(rng, dist, args.requests,
+                                max(1, prompt_hi // 8), prompt_hi)
+        prompts = [rng.integers(0, model.vocab_size, (n,)).tolist()
+                   for n in lengths]
+        plan = plan_capacity(
+            model, s_max, budget, params=params, page_size=page_size,
+            length_dist=[n + new_tokens for n in lengths])
+        num_pages = plan["max_pages"] + 1  # + scratch
+        paged_slots = max(slots_dense + 1,
+                          min(plan["expected_resident_requests"] + 2,
+                              args.requests))
+
+        # ---- point (a): resident requests at the fixed budget
+        dense = run_point(model, params, prompts, new_tokens,
+                          slots_dense, float("inf"), s_max)
+        paged = run_point(model, params, prompts, new_tokens,
+                          paged_slots, float("inf"), s_max,
+                          kv_layout="paged", page_size=page_size,
+                          num_pages=num_pages)
+        # planner-vs-allocation byte-exactness pin (the graftmeter
+        # contract): a real pool of the planned page count holds
+        # exactly the planned KV bytes
+        with hbm_ledger.scoped_ledger() as ledger:
+            from pytorch_multiprocessing_distributed_tpu.serving import (
+                PagePool)
+
+            pool = PagePool(model, paged_slots, s_max,
+                            page_size=page_size, num_pages=num_pages)
+            kv_entry = ledger.entries()["serving.kv_pages"]
+        assert kv_entry[1] == plan["paged_kv_bytes_at_max"], (
+            "planner and PagePool disagree on the page bytes")
+        del pool
+        for mode, r, eng_slots in (("dense", dense, slots_dense),
+                                   ("paged", paged, paged_slots)):
+            r.update(mode=mode, dist=dist, slots=eng_slots,
+                     hbm_budget_bytes=budget,
+                     hbm_kv_budget_bytes=kv_budget, s_max=s_max,
+                     page_size=(page_size if mode == "paged" else 0),
+                     num_pages=(num_pages if mode == "paged" else 0),
+                     resident_requests=r["occupancy_max"],
+                     planner_expected_resident=plan[
+                         "expected_resident_requests"])
+            results.append(r)
+        gain = (paged["occupancy_max"] / dense["occupancy_max"]
+                if dense["occupancy_max"] else 0.0)
+        print(f"paged dist={dist:6s}  resident dense="
+              f"{dense['occupancy_max']:3d} paged="
+              f"{paged['occupancy_max']:3d} ({gain:.1f}x at "
+              f"{budget / (1 << 20):.1f} MiB KV)  "
+              f"planner={plan['expected_resident_requests']}",
+              flush=True)
+
+        # ---- point (b): TTFT at prefix-hit rates (closed loop: one
+        # request in flight, so TTFT is the prefill-side latency the
+        # prefix cache actually removes)
+        for hit_rate in (0.0, 0.5, 0.9):
+            prompts_h = _hit_prompts(rng, model, dist, args.requests,
+                                     max(1, prompt_hi // 8), prompt_hi,
+                                     hit_rate)
+            engine = ServingEngine(
+                model, params, max_slots=paged_slots, s_max=s_max,
+                kv_layout="paged", page_size=page_size,
+                num_pages=num_pages, prefix_cache=16)
+            ref = ServingEngine(model, params, max_slots=slots_dense,
+                                s_max=s_max)
+            # warm compiles off the clock (one throwaway miss)
+            engine.serve([(prompts_h[0], new_tokens)])
+            finished = []
+            for p in prompts_h:
+                finished.append(engine.serve([(p, new_tokens)])[0])
+            ttft = {"hit": [], "miss": []}
+            for r in finished:
+                key = "hit" if r.prefix_hit == "full" else "miss"
+                ttft[key].append(r.first_token_time - r.submit_time)
+            # token-exactness vs the dense engine, per unique prompt
+            for p, r in list(zip(prompts_h, finished))[:4]:
+                (d,) = ref.serve([(p, new_tokens)])
+                assert r.tokens == d.tokens, (
+                    "paged stream diverged from dense")
+            snap = engine.metrics.snapshot()
+            point = {
+                "mode": "ttft", "dist": dist, "hit_rate": hit_rate,
+                "page_size": page_size,
+                "requests": len(prompts_h),
+                "prefix_hits": snap["prefix_hits"],
+                "prefix_partial_hits": snap["prefix_partial_hits"],
+                "prefix_misses": snap["prefix_misses"],
+                # None, not 0, when a rate produced no samples of a
+                # kind (e.g. every popular prompt shorter than one
+                # page -> no hits; hit_rate ~1 -> possibly no misses)
+                "ttft_hit_p50_ms": (1e3 * _percentile(ttft["hit"], 50)
+                                    if ttft["hit"] else None),
+                "ttft_hit_p95_ms": (1e3 * _percentile(ttft["hit"], 95)
+                                    if ttft["hit"] else None),
+                "ttft_miss_p50_ms": (1e3 * _percentile(ttft["miss"], 50)
+                                     if ttft["miss"] else None),
+                "ttft_miss_p95_ms": (1e3 * _percentile(ttft["miss"], 95)
+                                     if ttft["miss"] else None),
+                "hbm_per_slot_bytes": engine.pool.per_slot_bytes,
+            }
+            ratio = (point["ttft_hit_p50_ms"]
+                     / point["ttft_miss_p50_ms"]
+                     if point["ttft_hit_p50_ms"] is not None
+                     and point["ttft_miss_p50_ms"] else None)
+            point["ttft_hit_over_miss_p50"] = ratio
+
+            def ms(v):
+                return "     n/a" if v is None else f"{v:8.2f}"
+
+            results.append(point)
+            print(f"paged dist={dist:6s} hit={hit_rate:.1f}  "
+                  f"ttft p50 hit={ms(point['ttft_hit_p50_ms'])} ms "
+                  f"miss={ms(point['ttft_miss_p50_ms'])} ms  "
+                  f"(ratio={ratio if ratio is None else round(ratio, 3)}"
+                  f", hits={snap['prefix_hits']})", flush=True)
+    return results
+
+
 def run_drain_sweep(model, params, args, rng):
     """Drain latency + post-restart recovery TTFT (graftheal), both
     wall-clocked on a loaded engine; the redelivered streams are
@@ -491,6 +666,8 @@ def main():
                         "(0 = whole-prompt)")
     p.add_argument("--horizons", default="1,4,8", type=str,
                    help="horizon-sweep decode_horizon values")
+    p.add_argument("--page_size", default=8, type=int,
+                   help="paged sweep: KV page size (columns per page)")
     p.add_argument("--horizon_repeats", default=3, type=int,
                    help="horizon sweep: best-of-N runs per point "
                         "(host-noise suppression)")
@@ -533,7 +710,8 @@ def main():
     record = {"platform": platform, "model": args.model,
               "requests": args.requests, "new_tokens": args.new_tokens,
               "s_max": s_max, "load_sweep": [], "length_sweep": [],
-              "horizon_sweep": [], "chaos_sweep": [], "drain_sweep": []}
+              "horizon_sweep": [], "chaos_sweep": [], "drain_sweep": [],
+              "paged_sweep": []}
     sweeps = args.sweep.split(",")
 
     if "load" in sweeps:
@@ -565,6 +743,10 @@ def main():
     if "horizon" in sweeps:
         record["horizon_sweep"] = run_horizon_sweep(
             model, params, args, rng)
+
+    if "paged" in sweeps:
+        record["paged_sweep"] = run_paged_sweep(model, params, args,
+                                                rng)
 
     if "chaos" in sweeps:
         record["chaos_sweep"] = run_chaos_sweep(model, params, args,

@@ -222,7 +222,9 @@ def compare_costs(records: Dict[str, dict],
 
 def plan_capacity(model, s_max: int, hbm_budget: int, *,
                   params=None, optimizer_moments: int = 0,
-                  reserved_bytes: int = 0) -> dict:
+                  reserved_bytes: int = 0,
+                  page_size: Optional[int] = None,
+                  length_dist: Optional[Sequence[int]] = None) -> dict:
     """Invert the HBM ledger: how much serving capacity fits a chip.
 
     Args:
@@ -237,6 +239,19 @@ def plan_capacity(model, s_max: int, hbm_budget: int, *,
         each costs another ``params_bytes``.
       reserved_bytes: extra fixed reservation (decode-program temps,
         runtime overhead) charged before slots are counted.
+      page_size: PAGED mode (graftpage): plan a
+        :class:`~..serving.kv_pages.PagePool` instead of dense slots.
+        Adds ``page_bytes`` (the exact per-page shape x dtype product
+        the pool allocates — byte-exact against a real allocation, the
+        same pin style as the dense planner), ``max_pages`` (pages the
+        budget holds BESIDE the scratch page; pass
+        ``num_pages=plan["max_pages"] + 1`` to ``PagePool`` and its
+        ``hbm_bytes`` matches the planned KV bytes exactly),
+        ``pages_per_slot_worst`` and — with ``length_dist`` —
+        ``expected_pages_per_request`` / ``expected_resident_requests``.
+      length_dist: per-request TOTAL token counts (prompt + generated)
+        of the traffic to plan for; paged mode averages their page
+        demand to predict resident requests at the budget.
 
     Returns the plan dict: ``params_bytes``, ``opt_state_bytes``,
     ``per_slot_bytes`` (dense worst-case KV + per-slot scalar state —
@@ -269,7 +284,7 @@ def plan_capacity(model, s_max: int, hbm_budget: int, *,
     free = hbm_budget - fixed
     max_slots = max(0, free // per_slot)
     per_row = SlotPool.per_slot_kv_bytes(model, s_max)
-    return {
+    plan = {
         "hbm_budget": int(hbm_budget),
         "params_bytes": params_bytes,
         "opt_state_bytes": opt_bytes,
@@ -282,6 +297,30 @@ def plan_capacity(model, s_max: int, hbm_budget: int, *,
         "s_max": int(s_max),
         "fits": fixed <= hbm_budget,
     }
+    if page_size is None:
+        return plan
+    # ---- paged mode (graftpage): same inversion, page-granular.
+    # page_bytes is the ONE shape x dtype product PagePool allocates,
+    # so planner == allocator byte-for-byte (pinned in the meter
+    # smoke); the scratch page is charged before pages are counted.
+    from ..serving.kv_pages import PagePool
+
+    page_bytes = PagePool.page_kv_bytes(model, page_size)
+    max_pages = max(0, (free - page_bytes) // page_bytes)  # - scratch
+    plan.update({
+        "page_size": int(page_size),
+        "page_bytes": int(page_bytes),
+        "max_pages": int(max_pages),
+        "pages_per_slot_worst": PagePool.pages_for(s_max, page_size),
+        "paged_kv_bytes_at_max": int((max_pages + 1) * page_bytes),
+    })
+    if length_dist:
+        demand = [PagePool.pages_for(t, page_size)
+                  for t in length_dist]
+        expected = sum(demand) / len(demand)
+        plan["expected_pages_per_request"] = expected
+        plan["expected_resident_requests"] = int(max_pages // expected)
+    return plan
 
 
 # --------------------------------------------------- roofline join
@@ -384,6 +423,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser.add_argument("--s_max", default=2048, type=int)
     parser.add_argument("--hbm_gb", default=16.0, type=float,
                         help="per-chip HBM budget in GiB for --plan")
+    parser.add_argument("--page_size", default=None, type=int,
+                        help="--plan in PAGED mode: pages-per-chip at "
+                             "this page size (graftpage)")
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -396,7 +438,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
         model = get_model(args.plan)
         plan = plan_capacity(model, min(args.s_max, model.max_seq_len),
-                             int(args.hbm_gb * (1 << 30)))
+                             int(args.hbm_gb * (1 << 30)),
+                             page_size=args.page_size)
         if args.as_json:
             print(json.dumps(plan, indent=2, sort_keys=True))
         else:
@@ -410,6 +453,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             print(f"  max generate batch {plan['max_generate_batch']:9d}")
             print(f"  headroom          "
                   f"{plan['headroom_bytes'] / (1 << 20):10.1f} MiB")
+            if args.page_size:
+                print(f"  per KV page       "
+                      f"{plan['page_bytes'] / (1 << 20):10.3f} MiB "
+                      f"(page_size={plan['page_size']})")
+                print(f"  pages per chip     {plan['max_pages']:9d}")
         return 0
 
     try:

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.pallas.decode_attention import (decode_attention,
+                                           paged_decode_attention,
                                            xla_decode_attention)
 
 # flax-default fallback for models predating the ln_eps field; every
@@ -196,7 +197,8 @@ def _block_decode(p, x_t, k_cache, v_cache, pos, h, dtype, eps,
 def _block_decode_slots(p, x_t, k_cache, v_cache, positions, h, dtype,
                         eps, cs=_no_cs, top_k=1, window=None,
                         attn_impl="xla", block_k=256, interpret=None,
-                        kv_valid=None, uniform_positions=False):
+                        kv_valid=None, uniform_positions=False,
+                        page_table=None, page_size=None):
     """Vector-position variant of :func:`_block_decode` — the shared
     decode body (:func:`_decode_horizon`). Each row (slot) writes its
     pending token's K/V at its OWN position, then attends over the
@@ -220,6 +222,22 @@ def _block_decode_slots(p, x_t, k_cache, v_cache, positions, h, dtype,
     stays the cheap ``dynamic_update_slice`` instead of a per-row
     scatter — on TPU the scatter is markedly slower, and this is the
     hottest loop in the framework.
+
+    **Paged mode** (``page_table`` + ``page_size``, graftpage):
+    ``k_cache``/``v_cache`` are one layer's PAGE storage
+    ``[num_pages, H, page_size, Dh]`` and each row's logical column
+    ``p`` lives at ``(page_table[row, p // page_size], p %
+    page_size)``. The write scatters through the table; attention
+    gathers through it (:func:`...ops.pallas.decode_attention.
+    paged_decode_attention` — take-based XLA reference, or the Pallas
+    kernel whose index map does the indirection before the DMA). A
+    released slot's table row points at the scratch page 0, so the
+    frozen-row re-write invariant (masked rows re-hit "their own
+    column" each step) lands in scratch instead of a page since
+    re-allocated to another tenant. Composes with ``window`` (the
+    table is sliced to ``ceil(window / page_size)`` entries by the
+    caller) and NOT with ``kv_valid``/``uniform_positions`` (serving
+    slots only).
     """
     n = x_t.shape[0]
     hn = _ln(x_t, p["ln1"], eps).astype(dtype)
@@ -227,6 +245,30 @@ def _block_decode_slots(p, x_t, k_cache, v_cache, positions, h, dtype,
     q = cs(_split_heads(q, h), None, None, "model", None)
     k = cs(_split_heads(k, h), None, None, "model", None)
     v = cs(_split_heads(v, h), None, None, "model", None)
+    if page_table is not None:
+        if kv_valid is not None or uniform_positions:
+            raise ValueError(
+                "paged decode composes with neither kv_valid nor "
+                "uniform_positions (serving slots only)")
+        ps = int(page_size)
+        page_ids = jnp.take_along_axis(
+            page_table, (positions // ps)[:, None], axis=1)[:, 0]
+        offs = positions % ps
+        # per-row write through the table: row j's K/V lands at its
+        # own (page, offset) — pages [P, H, ps, Dh], k[:, 0] [N, H, Dh]
+        k_cache = k_cache.at[page_ids, :, offs].set(k[:, 0])
+        v_cache = v_cache.at[page_ids, :, offs].set(v[:, 0])
+        n_win = (-(-int(window) // ps) if window is not None
+                 else page_table.shape[1])
+        ids = jax.lax.slice_in_dim(page_table, 0,
+                                   min(n_win, page_table.shape[1]),
+                                   axis=1)
+        att = paged_decode_attention(
+            q, k_cache, v_cache, ids, positions, window=window,
+            impl=attn_impl, interpret=interpret)
+        att = att.reshape(n, 1, -1).astype(dtype)
+        x_t = x_t + _dense(att, p["attn"]["wo"], dtype)
+        return (x_t + _ffn(p, x_t, dtype, eps, top_k), k_cache, v_cache)
     if uniform_positions:
         k_cache = jax.lax.dynamic_update_slice(
             k_cache, k, (0, positions[0], 0, 0))
@@ -270,7 +312,8 @@ def _decode_horizon(model, params, k_caches, v_caches, positions,
                     cs=_no_cs, cs_cache=None, window=None,
                     attn_impl="xla", block_k=256, temperature=0.0,
                     top_k=0, top_p=0.0, offsets=None, kv_valid=None,
-                    uniform_positions=False):
+                    uniform_positions=False, page_table=None,
+                    page_size=None):
     """THE fused multi-step decode loop: ``H = keys.shape[0]`` cached
     decode steps as one ``lax.scan`` — one dispatch, zero host
     round-trips inside. Both decode callers run on this core:
@@ -309,6 +352,12 @@ def _decode_horizon(model, params, k_caches, v_caches, positions,
         genuinely divergent positions and take the scatter).
       offsets: ``[N]`` int32 left-pad offsets for ragged ``generate``
         (position-embedding ids become ``max(positions - offsets, 0)``).
+      page_table / page_size: paged-KV mode (graftpage): ``k_caches``/
+        ``v_caches`` are ``[L, num_pages, H, page_size, Dh]`` page
+        storage and ``page_table`` ``[N, pages_per_slot]`` int32 maps
+        each slot's logical columns onto pages (read-only inside the
+        scan — allocation is host-side, pre-jit). See
+        :func:`_block_decode_slots`.
 
     Returns ``(tokens, carry)``: ``tokens`` ``[H, N]`` int32 (``-1``
     where the row was frozen BEFORE the step), ``carry`` the updated
@@ -339,7 +388,8 @@ def _decode_horizon(model, params, k_caches, v_caches, positions,
                 params[f"block_{i}"], x_t, k_caches[i], v_caches[i],
                 positions, h, dtype, eps, cs, moe_k, window=window,
                 attn_impl=attn_impl, block_k=block_k, kv_valid=kv_valid,
-                uniform_positions=uniform_positions)
+                uniform_positions=uniform_positions,
+                page_table=page_table, page_size=page_size)
             new_k.append(kc)
             new_v.append(vc)
         logits = _logits(params, x_t, eps, cs)[:, 0]

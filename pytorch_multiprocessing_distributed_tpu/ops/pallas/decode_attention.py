@@ -40,6 +40,9 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .flash_attention import NEG_INF
 
+__all__ = ["decode_attention", "paged_decode_attention",
+           "xla_decode_attention", "xla_paged_decode_attention"]
+
 
 def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, acc, m_scr,
                    l_scr, *, scale, block_k):
@@ -128,6 +131,173 @@ def _pallas_decode(q, k, v, positions, scale, block_k, interpret):
         interpret=interpret,
     )(pos_bh, q3, k3, v3)
     return jnp.moveaxis(out.reshape(b, h, 1, d), 1, 2)  # [B, 1, H, Dh]
+
+
+def _paged_decode_kernel(pos_ref, tab_ref, q_ref, k_ref, v_ref, o_ref,
+                         acc, m_scr, l_scr, *, scale, page_size, heads):
+    """One (slot*head, page) grid cell of the PAGED flash-decode: the
+    same online-softmax recurrence as :func:`_decode_kernel`, but the
+    K/V block for step ``kb`` is whatever PAGE the scalar-prefetched
+    table maps column-block ``kb`` to — the index map does the
+    indirection BEFORE the DMA, so the stream through VMEM is still
+    one pass over exactly the pages the slot owns (never a gathered
+    contiguous copy in HBM)."""
+    i = pl.program_id(0)
+    kb = pl.program_id(1)
+    n_k = pl.num_programs(1)
+
+    @pl.when(kb == 0)
+    def _():
+        acc[:] = jnp.zeros_like(acc)
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    pos = pos_ref[i // heads]
+
+    # page entirely beyond the slot's position -> skip (same per-slot
+    # cost gate as the dense kernel's block gate; unallocated table
+    # entries point at the scratch page, whose values this gate and
+    # the column mask keep out of the softmax)
+    @pl.when(kb * page_size <= pos)
+    def _():
+        q = q_ref[0]             # [1, d]
+        kblk = k_ref[0, 0]       # [ps, d]
+        vblk = v_ref[0, 0]
+        s = jnp.dot(q, kblk.T,
+                    preferred_element_type=jnp.float32) * scale
+        col = kb * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1)
+        s = jnp.where(col <= pos, s, NEG_INF)
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        m_scr[:] = m_new
+        l_scr[:] = l_scr[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc[:] = acc[:] * corr + jnp.dot(
+            p.astype(vblk.dtype), vblk,
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kb == n_k - 1)
+    def _():
+        o_ref[0] = acc[:] / jnp.maximum(l_scr[:], 1e-30)
+
+
+def _pallas_paged_decode(q, k_pages, v_pages, page_table, positions,
+                         scale, interpret):
+    """q [B, 1, H, Dh]; k/v pages [P, H, ps, Dh]; page_table
+    [B, n_win] int32; positions [B] -> f32 [B, 1, H, Dh]. Grid is
+    (slot*head, page); the table rides in SMEM via scalar prefetch and
+    steers each page block's DMA."""
+    b, _, h, d = q.shape
+    ps = k_pages.shape[2]
+    n_win = page_table.shape[1]
+    q3 = jnp.moveaxis(q, 2, 1).reshape(b * h, 1, d)  # [B*H, 1, Dh]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # positions, page table
+        grid=(b * h, n_win),
+        in_specs=[
+            pl.BlockSpec((1, 1, d),
+                         lambda i, kb, pos, tab: (i, 0, 0)),
+            pl.BlockSpec((1, 1, ps, d),
+                         lambda i, kb, pos, tab:
+                         (tab[i // h, kb], i % h, 0, 0)),
+            pl.BlockSpec((1, 1, ps, d),
+                         lambda i, kb, pos, tab:
+                         (tab[i // h, kb], i % h, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, d),
+                               lambda i, kb, pos, tab: (i, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((1, d), jnp.float32),   # output accumulator
+            pltpu.VMEM((1, 1), jnp.float32),   # running max
+            pltpu.VMEM((1, 1), jnp.float32),   # running denominator
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_decode_kernel, scale=scale,
+                          page_size=ps, heads=h),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b * h, 1, d), jnp.float32),
+        interpret=interpret,
+    )(positions.astype(jnp.int32), page_table.astype(jnp.int32),
+      q3, k_pages, v_pages)
+    return jnp.moveaxis(out.reshape(b, h, 1, d), 1, 2)  # [B, 1, H, Dh]
+
+
+def xla_paged_decode_attention(q, k_pages, v_pages, page_table,
+                               positions, window: Optional[int] = None):
+    """Reference paged path: ``take``-gather the windowed pages into
+    the contiguous ``[B, W, H, Dh]`` view and run the EXACT dense
+    reference math (:func:`xla_decode_attention`) — bit-identical to
+    the dense-slot engine on the same logical columns, which is the
+    seam the paged==dense equivalence pin rests on."""
+    b = q.shape[0]
+    h, d = q.shape[2], q.shape[3]
+    ps = k_pages.shape[2]
+    n_win = page_table.shape[1]
+
+    def gather(pages):
+        g = jnp.take(pages, page_table, axis=0)  # [B, n_win, H, ps, Dh]
+        g = jnp.moveaxis(g, 3, 2).reshape(b, n_win * ps, h, d)
+        if window is not None and window < n_win * ps:
+            g = jax.lax.slice_in_dim(g, 0, window, axis=1)
+        return g
+
+    k_win, v_win = gather(k_pages), gather(v_pages)
+    mask = (jnp.arange(k_win.shape[1])[None, :] <= positions[:, None])
+    return xla_decode_attention(q, k_win, v_win, mask)
+
+
+def paged_decode_attention(
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    page_table: jax.Array,
+    positions: jax.Array,
+    *,
+    window: Optional[int] = None,
+    impl: str = "auto",
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Single-step cached attention through a page table (graftpage).
+
+    Args:
+      q: ``[B, 1, H, Dh]`` — one pending query token per slot.
+      k_pages, v_pages: ``[P, H, page_size, Dh]`` page storage (ONE
+        layer's pages — heads before the column offset so the Pallas
+        block's trailing dims are the tileable ``[page_size, Dh]``).
+      page_table: ``[B, n_win]`` int32 — slot ``b``'s logical column
+        block ``kb`` lives in page ``page_table[b, kb]``. Callers pass
+        the WINDOWED slice of the full table (``ceil(window /
+        page_size)`` entries); unallocated entries point at the
+        scratch page 0, whose contents the position mask keeps out of
+        the softmax.
+      positions: ``[B]`` int — slot ``b`` attends columns
+        ``[0, positions[b]]`` inclusive.
+      window: optional logical column bound (< ``n_win * page_size``
+        trims the gathered tail on the XLA path; the Pallas path's
+        column mask makes it a no-op there).
+      impl / interpret: as :func:`decode_attention`.
+
+    Returns ``[B, 1, H, Dh]`` f32 attention output (caller casts).
+    """
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if impl == "pallas":
+        if interpret is None:
+            from . import default_interpret
+
+            interpret = default_interpret()
+        scale = q.shape[-1] ** -0.5
+        return _pallas_paged_decode(q, k_pages, v_pages, page_table,
+                                    positions, scale, bool(interpret))
+    if impl != "xla":
+        raise ValueError(
+            f"impl must be 'pallas', 'xla' or 'auto', got {impl!r}")
+    return xla_paged_decode_attention(q, k_pages, v_pages, page_table,
+                                      positions, window)
 
 
 def xla_decode_attention(q, k, v, mask):

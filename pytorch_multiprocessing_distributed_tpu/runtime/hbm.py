@@ -35,7 +35,8 @@ from typing import Dict, Optional
 
 __all__ = [
     "HbmLedger", "arm", "disarm", "active_ledger", "scoped_ledger",
-    "register", "update", "release", "nbytes_of", "tree_nbytes",
+    "register", "update", "release", "set_gauge", "nbytes_of",
+    "tree_nbytes",
 ]
 
 
@@ -80,6 +81,7 @@ class HbmLedger:
 
     def __init__(self):
         self._entries: Dict[str, tuple] = {}
+        self._gauges: Dict[str, int] = {}
         self._mu = threading.Lock()
 
     def register(self, name: str, nbytes: int, category: str = "other",
@@ -105,6 +107,15 @@ class HbmLedger:
         disarmed phase never registered — is not an error)."""
         with self._mu:
             self._entries.pop(name, None)
+
+    def set_gauge(self, name: str, value: int) -> None:
+        """A UTILIZATION gauge riding beside the byte entries
+        (graftpage's ``pages_in_use`` etc.): exported verbatim by
+        ``snapshot()`` but NEVER summed into ``hbm_total_bytes`` — a
+        page in use is already counted by the pool's capacity entry,
+        and a ledger that double-counts is worse than none."""
+        with self._mu:
+            self._gauges[name] = int(value)
 
     def entries(self) -> Dict[str, tuple]:
         with self._mu:
@@ -141,6 +152,9 @@ class HbmLedger:
                 snap[f"hbm_{safe(cat)}_{safe(name)}_bytes"] = nbytes
         snap["hbm_total_bytes"] = total
         snap["hbm_entries"] = len(self.entries())
+        with self._mu:
+            for name, value in self._gauges.items():
+                snap[f"hbm_{safe(name)}"] = value
         return snap
 
 
@@ -200,3 +214,10 @@ def release(name: str) -> None:
     if ledger is None:
         return
     ledger.release(name)
+
+
+def set_gauge(name: str, value: int) -> None:
+    ledger = _LEDGER
+    if ledger is None:
+        return
+    ledger.set_gauge(name, value)

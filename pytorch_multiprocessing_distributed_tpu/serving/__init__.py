@@ -16,13 +16,15 @@ CLI: repo-root ``serve_lm.py``.
 """
 
 from .engine import ServingEngine
+from .kv_pages import PagePool, PagePoolExhausted, PrefixCache
 from .kv_slots import SlotPool
 from .params import init_params, load_params
 from .scheduler import (DONE, FAILED, FIFOScheduler, PrefillPlan,
                         QueueFull, Request, bucket_length, pick_horizon)
 
 __all__ = [
-    "ServingEngine", "SlotPool", "FIFOScheduler", "PrefillPlan",
+    "ServingEngine", "SlotPool", "PagePool", "PagePoolExhausted",
+    "PrefixCache", "FIFOScheduler", "PrefillPlan",
     "QueueFull", "Request", "bucket_length", "init_params",
     "load_params", "pick_horizon", "DONE", "FAILED",
 ]

@@ -127,6 +127,7 @@ from ..runtime.faults import (DeadlineExceeded, FaultInjected,
 from ..utils.compile_cache import (jit_cache_keys, jit_cache_size,
                                    record_jit_key)
 from ..utils.metrics import ServingMetrics
+from .kv_pages import PagePool, PagePoolExhausted, PrefixCache
 from .kv_slots import SlotPool
 from .scheduler import (DONE, FAILED, FIFOScheduler, PrefillPlan,
                         QueueFull, Request, bucket_length, pick_horizon)
@@ -178,15 +179,45 @@ class _TokenBlock:
 class _PendingPrefill:
     """Host-side state of the one request currently mid-chunked-prefill:
     its chunk plan plus the standalone caches the chunks accumulate
-    into (spliced into a pool slot after the last chunk)."""
+    into (spliced into a pool slot after the last chunk). ``prep`` is
+    the paged engine's page reservation (None on the dense engine)."""
 
-    __slots__ = ("request", "plan", "k_pref", "v_pref")
+    __slots__ = ("request", "plan", "k_pref", "v_pref", "prep")
 
-    def __init__(self, request, plan, k_pref, v_pref):
+    def __init__(self, request, plan, k_pref, v_pref, prep=None):
         self.request = request
         self.plan = plan
         self.k_pref = k_pref
         self.v_pref = v_pref
+        self.prep = prep
+
+
+class _PagedPrep:
+    """One paged admission's page reservation, made BEFORE the FIFO
+    head is popped (host-only: free-list pops + refcount bumps — no
+    device work, graftfault-safe). Holds one reference per page until
+    the splice transfers ownership to the slot's table row
+    (``bind_slot``) or the admission aborts (``ServingEngine.
+    _abort_prep`` — quarantine, finished-at-first-token, failed
+    prefill)."""
+
+    __slots__ = ("mode", "entry", "k", "shared_ids", "fresh_ids",
+                 "fork_src", "n_total")
+
+    def __init__(self, mode, entry, k, shared_ids, fresh_ids, fork_src,
+                 n_total):
+        self.mode = mode            # "miss" | "partial" | "full"
+        self.entry = entry          # PrefixEntry (hits only)
+        self.k = k                  # shared full pages reused
+        self.shared_ids = shared_ids
+        self.fresh_ids = fresh_ids  # freshly allocated, column order
+        self.fork_src = fork_src    # COW source (entry partial page)
+        self.n_total = n_total      # pages the request pins in total
+
+    @property
+    def page_ids(self):
+        """The slot's column-ordered table row."""
+        return list(self.shared_ids) + list(self.fresh_ids)
 
 
 class ServingEngine:
@@ -265,6 +296,35 @@ class ServingEngine:
         degradation: smaller blast radius + faster drain while the
         fault domain is suspect); each forced collapse is counted in
         ``ServingMetrics.horizon_collapses``.
+      kv_layout: ``"dense"`` (the :class:`~.kv_slots.SlotPool` —
+        worst-case ``s_max`` columns reserved per slot) or ``"paged"``
+        (graftpage: a :class:`~.kv_pages.PagePool` of fixed-size pages
+        mapped per slot through an ``[max_slots, pages_per_slot]``
+        page table — a request pins ``ceil((L + max_new) /
+        page_size)`` pages, so ``num_pages`` sizes HBM to the expected
+        length distribution while ``max_slots`` raises concurrency
+        past the dense worst case). Token-exact with the dense engine
+        and ``generate()`` (test-pinned); the page table rides as ONE
+        extra jit-traced operand, so the decode compile ladder does
+        NOT grow (still ``buckets x {1, H}``).
+      page_size: paged mode's columns per page (default:
+        ``min_bucket``; multiples of 8 for the TPU Pallas kernel).
+      num_pages: paged mode's total page count INCLUDING the reserved
+        scratch page (default: dense worst-case parity,
+        ``max_slots * ceil(s_max / page_size) + 1``). When the FIFO
+        head needs more free pages than exist, admission HOLDS it
+        (``ServingMetrics.page_holds``; prefix-cache entries are shed
+        LRU-first) until running work frees pages — it fails named
+        (:class:`~.kv_pages.PagePoolExhausted`) only when nothing in
+        flight could ever free enough.
+      prefix_cache: > 0 arms the shared-prefix cache with that many
+        LRU entries (paged + greedy only — the cached first token is
+        replayed, which only a deterministic stream allows). A
+        prompt's page-aligned prefix is prefilled ONCE; identical
+        prompts are FULL hits (no prefill compute — TTFT drops to a
+        state splice plus at most one copy-on-write page fork), and
+        prompts sharing a prefix re-use its pages read-only and
+        chunk-prefill only their suffix.
       journal: optional :class:`~..runtime.heal.RequestJournal` — the
         redelivery WAL behind supervised restart: every admitted
         request and its emitted tokens are journaled (one fsync'd
@@ -300,7 +360,11 @@ class ServingEngine:
                  retry_backoff_s: float = 0.02,
                  readback_timeout_s: Optional[float] = None,
                  fault_cooldown: int = 8,
-                 journal=None):
+                 journal=None,
+                 kv_layout: str = "dense",
+                 page_size: Optional[int] = None,
+                 num_pages: Optional[int] = None,
+                 prefix_cache: int = 0):
         # health first: an engine that dies mid-construction reports
         # STARTING on /healthz, never a stale READY
         self.health = heal.HealthState()
@@ -358,12 +422,41 @@ class ServingEngine:
         if fault_cooldown < 0:
             raise ValueError(
                 f"fault_cooldown must be >= 0, got {fault_cooldown}")
+        if kv_layout not in ("dense", "paged"):
+            raise ValueError(
+                f"kv_layout must be 'dense' or 'paged', got "
+                f"{kv_layout!r}")
+        if kv_layout == "dense" and (page_size is not None
+                                     or num_pages is not None
+                                     or prefix_cache):
+            raise ValueError(
+                "page_size/num_pages/prefix_cache apply only with "
+                "kv_layout='paged'")
+        if prefix_cache < 0:
+            raise ValueError(
+                f"prefix_cache must be >= 0, got {prefix_cache}")
+        if prefix_cache and temperature > 0.0:
+            raise ValueError(
+                "prefix_cache requires deterministic (greedy) decode — "
+                "a cached first token cannot be replayed into a "
+                "sampled stream (temperature > 0)")
         self.model = model
         self.params = params
         self.mesh = mesh
         self.eos_id = eos_id
         self.min_bucket = int(min_bucket)
-        self.pool = SlotPool(model, max_slots, s_max, mesh)
+        self._paged = kv_layout == "paged"
+        if self._paged:
+            self.pool = PagePool(
+                model, max_slots, s_max, mesh,
+                page_size=int(page_size if page_size is not None
+                              else min_bucket),
+                num_pages=num_pages)
+        else:
+            self.pool = SlotPool(model, max_slots, s_max, mesh)
+        self._prefix_cache = (PrefixCache(self.pool, prefix_cache)
+                              if prefix_cache else None)
+        self._held_uid = None  # FIFO head currently held for pages
         self.scheduler = FIFOScheduler(self.pool.s_max, max_queue)
         self.metrics = ServingMetrics()
         self._rng = (rng if rng is not None
@@ -407,22 +500,35 @@ class ServingEngine:
         # second call silently specializes a second executable,
         # breaking the bucketed compile budget on a mesh
         if mesh is not None:
+            # dense caches shard heads at axis 3 ([L, N, S, H, Dh]);
+            # pages at axis 2 ([L, P, H, ps, Dh]); the standalone
+            # prefill caches keep the dense layout in BOTH modes
             cache_sh = NamedSharding(
+                mesh,
+                P(None, None, "model", None, None) if self._paged
+                else P(None, None, None, "model", None))
+            pref_sh = NamedSharding(
                 mesh, P(None, None, None, "model", None))
             rep = NamedSharding(mesh, P())
             decode_out = (rep, cache_sh, cache_sh, rep, rep, rep, rep)
             insert_out = (cache_sh, cache_sh, rep, rep, rep, rep, rep)
-            prefill_out = (rep, cache_sh, cache_sh)
-            chunk_out = (rep, cache_sh, cache_sh)
+            prefill_out = (rep, pref_sh, pref_sh)
+            chunk_out = (rep, pref_sh, pref_sh)
             tok0_out = rep
             evict_out = (rep, rep)
+            state_insert_out = (rep, rep, rep, rep, rep)
+            copy_out = (cache_sh, cache_sh)
+            gather_out = (pref_sh, pref_sh)
         else:
             decode_out = insert_out = prefill_out = None
             chunk_out = tok0_out = evict_out = None
+            state_insert_out = copy_out = gather_out = None
         self._decode = jax.jit(
             self._make_decode_horizon(), out_shardings=decode_out,
             static_argnames=("window", "horizon"),
-            donate_argnums=(1, 2, 3, 4, 5, 6) if donate_cache else ())
+            donate_argnums=(((1, 2, 4, 5, 6, 7) if self._paged
+                             else (1, 2, 3, 4, 5, 6))
+                            if donate_cache else ()))
         self._prefill_jit = jax.jit(self._make_prefill(),
                                     out_shardings=prefill_out)
         self._chunk_jit = jax.jit(
@@ -431,9 +537,27 @@ class ServingEngine:
         self._tok0_jit = jax.jit(self._make_tok0(),
                                  out_shardings=tok0_out)
         self._insert_jit = jax.jit(
-            self._insert_fn, out_shardings=insert_out,
+            self._paged_insert_fn if self._paged else self._insert_fn,
+            out_shardings=insert_out,
             donate_argnums=(0, 1, 2, 3, 4, 5, 6) if donate_cache
             else ())
+        if self._paged:
+            # graftpage's three host-boundary helpers. State-only
+            # splice (full prefix hits: the cached pages already hold
+            # every prefill column); COW page fork (one page copy —
+            # compiles once, traced src/dst); page gather (prefix
+            # pages -> the standalone chunk-prefill cache on a partial
+            # hit; compiles per (pages, width) pair, pages NOT donated
+            # — the shared prefix must survive).
+            self._state_insert_jit = jax.jit(
+                self._state_insert_fn, out_shardings=state_insert_out,
+                donate_argnums=(0, 1, 2, 3, 4) if donate_cache else ())
+            self._copy_page_jit = jax.jit(
+                self._copy_page_fn, out_shardings=copy_out,
+                donate_argnums=(0, 1) if donate_cache else ())
+            self._gather_jit = jax.jit(
+                self._gather_pages_fn, out_shardings=gather_out,
+                static_argnames=("width",))
         # quarantine/deadline eviction: clear a slot's on-device finish
         # gates so the frozen row stops advancing. Compiled lazily on
         # the FIRST eviction — the fault-free path never traces it
@@ -490,13 +614,17 @@ class ServingEngine:
         temperature, top_k, top_p = self._sampling
         attn_impl = self._attn_impl
         block_k = self._decode_block_k
+        paged = self._paged
+        page_size = self.pool.page_size if paged else None
 
         def cs_cache(c):
+            if paged:  # pages: [L, P, H, ps, Dh] — heads at axis 2
+                return cs(c, None, None, "model", None, None)
             return cs(c, None, None, None, "model", None)
 
         def horizon_step(params, k_caches, v_caches, positions,
                          last_tokens, active, remaining, eos_ids, key,
-                         *, window, horizon):
+                         *, window, horizon, page_table=None):
             if temperature > 0.0:
                 keys = jax.random.split(key, horizon)
             else:  # greedy ignores keys; keep ONE signature per ladder
@@ -506,10 +634,27 @@ class ServingEngine:
                 last_tokens, active, remaining, eos_ids, keys, cs=cs,
                 cs_cache=cs_cache, window=window, attn_impl=attn_impl,
                 block_k=block_k, temperature=temperature, top_k=top_k,
-                top_p=top_p)
+                top_p=top_p, page_table=page_table,
+                page_size=page_size)
             return (tokens,) + carry
 
-        return horizon_step
+        if not paged:
+            return horizon_step
+
+        def paged_horizon_step(params, k_pages, v_pages, page_table,
+                               positions, last_tokens, active,
+                               remaining, eos_ids, key, *, window,
+                               horizon):
+            # the table is ONE extra traced operand — same (window,
+            # horizon) static signature, so the compile ladder stays
+            # buckets x {1, H}; the table itself is read-only inside
+            # the scan (allocation is host-side, pre-jit)
+            return horizon_step(params, k_pages, v_pages, positions,
+                                last_tokens, active, remaining,
+                                eos_ids, key, window=window,
+                                horizon=horizon, page_table=page_table)
+
+        return paged_horizon_step
 
     def _make_prefill(self):
         """Whole-prompt prefill-on-join: the SHARED ``_prefill`` pass on
@@ -615,6 +760,89 @@ class ServingEngine:
         eos_ids = eos_ids.at[slot].set(eos)
         return (k_caches, v_caches, positions, last_tokens, active,
                 budgets, eos_ids)
+
+    @staticmethod
+    def _paged_insert_fn(k_pages, v_pages, positions, last_tokens,
+                         active, budgets, eos_ids, k_pref, v_pref,
+                         write_ids, slot, length, tok0, budget, eos):
+        """Paged splice (graftpage): the standalone prefill cache
+        ``[L, 1, W, H, Dh]`` is re-tiled into page blocks and
+        scattered at ``write_ids`` — the column-ordered page targets
+        the HOST chose (fresh pages for the columns this request
+        computed; the SCRATCH page 0 for columns a shared prefix
+        already holds — their stale re-write is discarded — and for
+        pure-pad overshoot). The slot's decode state arms exactly as
+        the dense splice. Compiles once per prefill width (the
+        ``write_ids`` length is width-derived), like the dense
+        per-bucket splice."""
+        ps = k_pages.shape[3]
+        n = write_ids.shape[0]
+        w = k_pref.shape[2]
+        pad = n * ps - w
+        if pad:  # width not a page multiple: pad-only columns
+            cfg = ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0))
+            k_pref = jnp.pad(k_pref, cfg)
+            v_pref = jnp.pad(v_pref, cfg)
+
+        def to_pages(c):  # [L, 1, n*ps, H, Dh] -> [L, n, H, ps, Dh]
+            l, _, _, h, d = c.shape
+            return jnp.moveaxis(c.reshape(l, n, ps, h, d), 2, 3)
+
+        k_pages = k_pages.at[:, write_ids].set(to_pages(k_pref))
+        v_pages = v_pages.at[:, write_ids].set(to_pages(v_pref))
+        positions = positions.at[slot].set(length)
+        last_tokens = last_tokens.at[slot].set(tok0)
+        active = active.at[slot].set(True)
+        budgets = budgets.at[slot].set(budget)
+        eos_ids = eos_ids.at[slot].set(eos)
+        return (k_pages, v_pages, positions, last_tokens, active,
+                budgets, eos_ids)
+
+    @staticmethod
+    def _state_insert_fn(positions, last_tokens, active, budgets,
+                         eos_ids, slot, length, tok0, budget, eos):
+        """FULL prefix hit (graftpage): every prefill column already
+        lives in cached pages, so the splice touches only the slot's
+        scalar decode state — the near-zero-TTFT path."""
+        positions = positions.at[slot].set(length)
+        last_tokens = last_tokens.at[slot].set(tok0)
+        active = active.at[slot].set(True)
+        budgets = budgets.at[slot].set(budget)
+        eos_ids = eos_ids.at[slot].set(eos)
+        return positions, last_tokens, active, budgets, eos_ids
+
+    @staticmethod
+    def _copy_page_fn(k_pages, v_pages, src, dst):
+        """Copy-on-write fork: duplicate ONE page (the shared
+        prefix's partial last page) into a private page the joiner's
+        first divergent write (its column ``L``) may land in. One
+        compiled program (``src``/``dst`` traced); the only data moved
+        is the single page — everything else about a prefix hit is
+        copy-free table wiring (cf. arXiv:2112.01075 on keeping
+        redistribution gather-free)."""
+        def one(pages):
+            blk = jax.lax.dynamic_slice_in_dim(pages, src, 1, axis=1)
+            return jax.lax.dynamic_update_slice(
+                pages, blk, (0, dst, 0, 0, 0))
+
+        return one(k_pages), one(v_pages)
+
+    @staticmethod
+    def _gather_pages_fn(k_pages, v_pages, ids, *, width):
+        """PARTIAL prefix hit: materialize the ``len(ids)`` shared
+        prefix pages into the leading columns of a standalone
+        chunk-prefill cache of ``width`` columns (the suffix chunks
+        attend over it, then the splice writes ONLY the suffix pages
+        back). Pages are NOT donated — the shared prefix lives on."""
+        def one(pages):
+            l, _, h, ps, d = pages.shape
+            g = jnp.take(pages, ids, axis=1)     # [L, k, H, ps, Dh]
+            g = jnp.moveaxis(g, 2, 3).reshape(l, 1, -1, h, d)
+            pad = width - g.shape[2]
+            return jnp.pad(
+                g, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0)))
+
+        return one(k_pages), one(v_pages)
 
     @staticmethod
     def _evict_fn(active, budgets, slot):
@@ -755,7 +983,7 @@ class ServingEngine:
                 reason="deadline")
         pend = self._pending
         if pend is not None and pend.request.overdue(now):
-            self._pending = None
+            self._drop_pending()
             self._quarantine(
                 pend.request,
                 DeadlineExceeded(
@@ -805,11 +1033,20 @@ class ServingEngine:
                                                 sharding=sharding)
                 return jax.ShapeDtypeStruct(x.shape, x.dtype)
 
-            args = (jax.tree.map(sds, self.params), sds(pool.k_caches),
-                    sds(pool.v_caches), sds(pool.positions),
-                    sds(pool.last_tokens), sds(pool.active),
-                    sds(pool.budgets), sds(pool.eos_ids),
-                    jax.ShapeDtypeStruct((2,), jnp.uint32))
+            if self._paged:
+                args = (jax.tree.map(sds, self.params),
+                        sds(pool.k_pages), sds(pool.v_pages),
+                        sds(pool.device_table()), sds(pool.positions),
+                        sds(pool.last_tokens), sds(pool.active),
+                        sds(pool.budgets), sds(pool.eos_ids),
+                        jax.ShapeDtypeStruct((2,), jnp.uint32))
+            else:
+                args = (jax.tree.map(sds, self.params),
+                        sds(pool.k_caches), sds(pool.v_caches),
+                        sds(pool.positions), sds(pool.last_tokens),
+                        sds(pool.active), sds(pool.budgets),
+                        sds(pool.eos_ids),
+                        jax.ShapeDtypeStruct((2,), jnp.uint32))
             _compiled, cost, memory = lowered_program_analysis(
                 self._decode, *args, window=key[0], horizon=key[1])
             self._program_costs[key] = costs_record(cost, memory)
@@ -959,6 +1196,18 @@ class ServingEngine:
             raise ValueError(
                 f"prompt token ids must be in [0, vocab_size="
                 f"{self.model.vocab_size})")
+        if self._paged and request.prompt:
+            # never-fits for the PAGE pool is a submission error, like
+            # the scheduler's s_max check (transient pressure is the
+            # admission gate's hold, not this)
+            need = PagePool.pages_for(
+                len(request.prompt) + request.max_new_tokens,
+                self.pool.page_size)
+            if need > self.pool.num_pages - 1:
+                raise ValueError(
+                    f"request needs {need} page(s); the pool holds "
+                    f"{self.pool.num_pages - 1} allocatable "
+                    f"(num_pages={self.pool.num_pages} incl. scratch)")
         try:
             submitted = self.scheduler.submit(request)
         except QueueFull:
@@ -1046,13 +1295,310 @@ class ServingEngine:
             return self._admit_whole()
         return self._admit_chunked()
 
+    # ---- paged admission (graftpage) ----------------------------------
+    def _paged_prep_head(self):
+        """Reserve pages for the FIFO head BEFORE popping it. Returns
+        a :class:`_PagedPrep` (pages + prefix-cache outcome reserved),
+        ``None`` (queue empty), ``"hold"`` (not enough free pages —
+        the head STAYS QUEUED; prefix-cache entries were already shed
+        LRU-first; running work frees pages at every completion), or
+        ``"retry"`` (the head could NEVER be satisfied — quarantined
+        named ``PagePoolExhausted`` — and admission may look at the
+        next head). Host-only: free-list pops and refcounts, no device
+        work."""
+        pool = self.pool
+        head = self.scheduler.peek()
+        if head is None:
+            return None
+        n_total = PagePool.pages_for(
+            len(head.prompt) + head.max_new_tokens, pool.page_size)
+        while True:
+            entry, k = ((None, 0) if self._prefix_cache is None
+                        else self._prefix_cache.lookup(head.prompt))
+            full = (entry is not None
+                    and entry.tokens == tuple(head.prompt)
+                    and entry.tok0 is not None)
+            if not full:
+                # a partial hit must leave >= 1 suffix token to
+                # prefill (it provides tok0); a prompt that IS a
+                # page-aligned prefix of a longer cached one caps here
+                k = min(k, (len(head.prompt) - 1) // pool.page_size)
+            needed = n_total - k
+            if pool.free_pages >= needed:
+                break
+            # shed cache before holding traffic: LRU entries whose
+            # pages no live slot shares actually free pages. Re-run
+            # the lookup after each eviction — the shed may have taken
+            # the very entry the hit planned to reuse (lookups keep it
+            # MRU, so it goes last).
+            if not (self._prefix_cache is not None
+                    and self._prefix_cache.evict_lru()):
+                break
+        if pool.free_pages < needed:
+            if (not self._running and self._pending is None
+                    and not self._blocks
+                    and not (self._prefix_cache
+                             and len(self._prefix_cache))):
+                # nothing in flight will ever free a page: fail the
+                # head NAMED, keep serving the queue behind it
+                request = self._pop_admission()
+                self._quarantine(request, PagePoolExhausted(
+                    f"request {request.uid} needs {needed} page(s); "
+                    f"only {pool.free_pages} exist free with nothing "
+                    "in flight to free more (num_pages="
+                    f"{pool.num_pages})"), reason="pages")
+                return "retry"
+            if self._held_uid != head.uid:
+                # count (and timeline) the TRANSITION into held, not
+                # every step the head stays there — one deferred
+                # admission is one hold, however long the wait
+                self._held_uid = head.uid
+                self.metrics.record_page_hold()
+                graftscope.emit("request.held", cat="request",
+                                req=head.uid, pages_needed=needed,
+                                pages_free=pool.free_pages)
+            return "hold"
+        self._held_uid = None  # the head is getting pages
+        shared = list(entry.shared_ids[:k]) if entry is not None else []
+        pool.incref(shared)
+        fork_src = None
+        if full and len(head.prompt) % pool.page_size:
+            fork_src = entry.partial_id
+            pool.incref([fork_src])
+        fresh = pool.alloc_pages(needed)
+        mode = "full" if full else ("partial" if k else "miss")
+        return _PagedPrep(mode, entry, k, shared, fresh, fork_src,
+                          n_total)
+
+    def _abort_prep(self, prep) -> None:
+        """Return a reservation's pages (quarantined admission,
+        finished-at-first-token, failed prefill)."""
+        if prep is None:
+            return
+        pool = self.pool
+        pool.decref(prep.shared_ids)
+        pool.decref(prep.fresh_ids)
+        if prep.fork_src is not None:
+            pool.decref([prep.fork_src])
+        prep.shared_ids, prep.fresh_ids, prep.fork_src = [], [], None
+
+    def _drop_pending(self) -> Optional[_PendingPrefill]:
+        """Detach the in-flight chunked prefill, returning its pages
+        first (every quarantine/drain path that clears ``_pending``
+        goes through here)."""
+        pend = self._pending
+        self._pending = None
+        if pend is not None and pend.prep is not None:
+            self._abort_prep(pend.prep)
+        return pend
+
+    def _copy_page(self, src: int, dst: int) -> None:
+        """One COW page fork on the device (donated pages — engine-
+        fatal if it dies mid-flight, like every pool-donating
+        program)."""
+        pool = self.pool
+
+        def copy_once():
+            with expected_transfer("page-fork control upload "
+                                   "(scalar H2D, prefix-hit path)"):
+                return self._donated(lambda: self._copy_page_jit(
+                    pool.k_pages, pool.v_pages, jnp.int32(src),
+                    jnp.int32(dst)))
+
+        pool.k_pages, pool.v_pages = self._attempted(copy_once)
+
+    def _admit_full_hit(self, request: Request, prep: _PagedPrep,
+                        events: List) -> None:
+        """FULL prefix hit: zero prefill compute. The cached first
+        token is replayed (greedy — enforced at construction), the
+        prompt's pages are referenced read-only, the partial last page
+        (if any) is COW-forked, and only the scalar slot state is
+        spliced. TTFT ~ one tiny state program + at most one page
+        copy."""
+        pool = self.pool
+        entry = prep.entry
+        with graftscope.span("serving.prefix_hit", cat="serving",
+                             req=request.uid, pages_shared=prep.k,
+                             mode="full"):
+            slot = self._first_token(request, int(entry.tok0), events)
+            if slot is None:  # finished at its first token
+                self._abort_prep(prep)
+                return
+            length = len(request.prompt)
+            eos = -1 if request.eos_id is None else int(request.eos_id)
+
+            def splice_once():
+                maybe_fault(_SITE_INSERT)
+                if prep.fork_src is not None:
+                    # COW fork FIRST: the forked page must hold the
+                    # partial prefix columns before any decode write
+                    self._copy_page(prep.fork_src, prep.fresh_ids[0])
+                    pool.decref([prep.fork_src])
+                    prep.fork_src = None
+                with expected_transfer("slot-state control upload at "
+                                       "prefix-hit admission (scalar "
+                                       "H2D)"):
+                    return self._donated(
+                        lambda: self._state_insert_jit(
+                            pool.positions, pool.last_tokens,
+                            pool.active, pool.budgets, pool.eos_ids,
+                            jnp.int32(slot), jnp.int32(length),
+                            jnp.int32(int(entry.tok0)),
+                            jnp.int32(request.max_new_tokens - 1),
+                            jnp.int32(eos)))
+
+            try:
+                (pool.positions, pool.last_tokens, pool.active,
+                 pool.budgets, pool.eos_ids) = self._attempted(
+                    splice_once)
+            except Exception as e:
+                self._abort_prep(prep)
+                self._poisoned(request, e, slot=slot)
+                return
+            pool.bind_slot(slot, prep.page_ids)
+            prep.shared_ids, prep.fresh_ids = [], []
+            pool.note_insert(slot, length)
+
+    def _seed_partial_pending(self, request: Request, prep: _PagedPrep,
+                              chunk: int) -> _PendingPrefill:
+        """PARTIAL prefix hit: build the chunked-prefill state with
+        the shared prefix pages gathered into the standalone cache and
+        a plan that starts at the first uncached column — the suffix
+        is the only prefill compute left."""
+        pool = self.pool
+        start_at = prep.k * pool.page_size
+        plan = PrefillPlan(request, chunk, self.min_bucket, pool.s_max,
+                           start_at=start_at)
+
+        def gather_once():
+            with expected_transfer("prefix-page gather control upload "
+                                   "(partial-hit admission)"):
+                return self._gather_jit(
+                    pool.k_pages, pool.v_pages,
+                    jnp.asarray(prep.shared_ids, jnp.int32),
+                    width=plan.width)
+
+        with graftscope.span("serving.prefix_hit", cat="serving",
+                             req=request.uid, pages_shared=prep.k,
+                             mode="partial"):
+            k_pref, v_pref = self._attempted(gather_once)
+        return _PendingPrefill(request, plan, k_pref, v_pref, prep)
+
+    def _drive_pending(self, pend: _PendingPrefill,
+                       events: List) -> bool:
+        """Advance a pending chunked prefill by ONE chunk; on the last
+        chunk, sample tok0 and splice. Returns True while more chunks
+        remain. Shared by chunked admission (one call per step) and
+        the whole-prompt engine's partial-hit path (driven to
+        completion in a loop)."""
+        start, valid, is_last = pend.plan.next_chunk()
+        chunk = pend.plan.chunk
+        padded = np.zeros((1, chunk), np.int32)
+        padded[0, :valid] = pend.request.prompt[start:start + valid]
+
+        def chunk_once():
+            # site before the jitted call (donated prefill caches):
+            # injected retries are always safe, see _insert's note
+            maybe_fault(_SITE_CHUNK)
+            with expected_transfer("chunk upload (fixed [1, chunk] "
+                                   "shape)"):
+                return self._chunk_jit(
+                    self.params, pend.k_pref, pend.v_pref,
+                    jnp.asarray(padded), jnp.int32(start))
+
+        try:
+            with graftscope.span("serving.prefill_chunk", cat="serving",
+                                 req=pend.request.uid, start=start,
+                                 chunk=chunk):
+                x, pend.k_pref, pend.v_pref = self._attempted(
+                    chunk_once)
+        except Exception as e:
+            if self._pending is pend:
+                self._drop_pending()
+            else:
+                self._abort_prep(pend.prep)
+            self._poisoned(pend.request, e)
+            return False
+        record_jit_key(self._chunk_jit,
+                       ("prefill_chunk", chunk, pend.plan.width))
+        if not is_last:
+            return True
+        if self._pending is pend:
+            self._pending = None  # prep ownership moves to the splice
+        key = self._next_key()
+
+        def tok0_once():
+            # same fault domain as the whole-prompt path's first-token
+            # readback (there it lives inside serving.prefill):
+            # per-request work — retry, then quarantine just this
+            # request. _tok0_jit donates nothing, so retries are safe.
+            maybe_fault(_SITE_TOK0)
+            with expected_transfer("first-token readback (the TTFT "
+                                   "boundary)"):
+                t = self._tok0_jit(
+                    self.params, x,
+                    jnp.int32(pend.plan.length - 1 - start), key)
+                return t, int(t)
+
+        try:
+            with graftscope.span("serving.prefill_tok0", cat="serving",
+                                 req=pend.request.uid):
+                tok0, tok0_host = self._attempted(tok0_once)
+        except Exception as e:
+            self._abort_prep(pend.prep)
+            self._poisoned(pend.request, e)
+            return False
+        slot = self._first_token(pend.request, tok0_host, events)
+        if slot is None:
+            self._abort_prep(pend.prep)
+            return False
+        try:
+            self._insert(pend.request, slot, pend.k_pref, pend.v_pref,
+                         pend.plan.length, tok0, prep=pend.prep)
+        except Exception as e:
+            self._abort_prep(pend.prep)
+            self._poisoned(pend.request, e, slot=slot)
+        return False
+
     def _admit_whole(self) -> List[Tuple[Request, int, bool]]:
         events: List[Tuple[Request, int, bool]] = []
         pool = self.pool
         while pool.free_slots > 0:
+            prep = None
+            if self._paged:
+                prep = self._paged_prep_head()
+                if prep is None or prep == "hold":
+                    break
+                if prep == "retry":
+                    continue
             request = self._pop_admission()
             if request is None:
                 break
+            if prep is not None:
+                request.prefix_hit = (None if prep.mode == "miss"
+                                      else prep.mode)
+                if self._prefix_cache is not None:
+                    # a miss only counts against an ARMED cache
+                    self.metrics.record_prefix_outcome(
+                        request.prefix_hit)
+                if prep.mode == "full":
+                    self._admit_full_hit(request, prep, events)
+                    continue
+                if prep.mode == "partial":
+                    # suffix-only prefill through the chunk machinery,
+                    # driven to completion within this admission (the
+                    # whole-prompt engine has no pending interleave)
+                    try:
+                        pend = self._seed_partial_pending(
+                            request, prep,
+                            self._prefill_chunk or pool.page_size)
+                    except Exception as e:
+                        self._abort_prep(prep)
+                        self._poisoned(request, e)
+                        continue
+                    while self._drive_pending(pend, events):
+                        pass
+                    continue
             length = len(request.prompt)
             bucket = bucket_length(length, self.min_bucket, pool.s_max)
             padded = np.zeros((1, bucket), np.int32)
@@ -1077,25 +1623,42 @@ class ServingEngine:
                     tok0, k_pref, v_pref, tok0_host = self._attempted(
                         prefill_once)
             except Exception as e:
+                self._abort_prep(prep)
                 self._poisoned(request, e)
                 continue
             slot = self._first_token(request, tok0_host, events)
             if slot is None:
+                self._abort_prep(prep)
                 continue
             try:
                 self._insert(request, slot, k_pref, v_pref, length,
-                             tok0)
+                             tok0, prep=prep)
             except Exception as e:
+                self._abort_prep(prep)
                 self._poisoned(request, e, slot=slot)
         return events
 
     def _insert(self, request: Request, slot: int, k_pref, v_pref,
-                length: int, tok0) -> None:
+                length: int, tok0, prep=None) -> None:
         """Splice a prefilled request into ``slot`` and arm its
         on-device finish gates (budget = decode tokens still owed; the
-        prefill token is already appended, so ``max_new_tokens - 1``)."""
+        prefill token is already appended, so ``max_new_tokens - 1``).
+        Paged mode scatters the standalone cache's page blocks at the
+        reservation's fresh pages (shared-prefix columns and pure-pad
+        overshoot land in scratch) and binds the slot's table row —
+        page ownership transfers from ``prep`` to the row."""
         pool = self.pool
         eos = -1 if request.eos_id is None else int(request.eos_id)
+
+        if prep is not None:
+            width = k_pref.shape[2]
+            ps = pool.page_size
+            n_w = -(-width // ps)
+            write_ids = np.zeros((n_w,), np.int32)
+            for j, page in enumerate(prep.fresh_ids):
+                col = prep.k + j  # column-order page index
+                if col < n_w:
+                    write_ids[col] = page
 
         def insert_once():
             # the injected site fires BEFORE the jitted call, so a
@@ -1105,6 +1668,15 @@ class ServingEngine:
             maybe_fault(_SITE_INSERT)
             with expected_transfer("slot/length/budget control upload "
                                    "at admission (scalar H2D)"):
+                if prep is not None:
+                    return self._donated(lambda: self._insert_jit(
+                        pool.k_pages, pool.v_pages, pool.positions,
+                        pool.last_tokens, pool.active, pool.budgets,
+                        pool.eos_ids, k_pref, v_pref,
+                        jnp.asarray(write_ids), jnp.int32(slot),
+                        jnp.int32(length), tok0,
+                        jnp.int32(request.max_new_tokens - 1),
+                        jnp.int32(eos)))
                 return self._donated(lambda: self._insert_jit(
                     pool.k_caches, pool.v_caches, pool.positions,
                     pool.last_tokens, pool.active, pool.budgets,
@@ -1115,90 +1687,111 @@ class ServingEngine:
 
         with graftscope.span("serving.slot_insert", cat="serving",
                              req=request.uid, slot=slot):
-            (pool.k_caches, pool.v_caches, pool.positions,
-             pool.last_tokens, pool.active, pool.budgets,
-             pool.eos_ids) = self._attempted(insert_once)
+            if prep is not None:
+                (pool.k_pages, pool.v_pages, pool.positions,
+                 pool.last_tokens, pool.active, pool.budgets,
+                 pool.eos_ids) = self._attempted(insert_once)
+                page_ids = prep.page_ids
+                pool.bind_slot(slot, page_ids)
+                # ownership now lives in the table row: neutralize the
+                # reservation so a later abort cannot double-release
+                prep.shared_ids, prep.fresh_ids = [], []
+                self._register_prefix(request, page_ids)
+            else:
+                (pool.k_caches, pool.v_caches, pool.positions,
+                 pool.last_tokens, pool.active, pool.budgets,
+                 pool.eos_ids) = self._attempted(insert_once)
         pool.note_insert(slot, length)
+
+    def _register_prefix(self, request: Request, page_ids) -> None:
+        """Offer a freshly spliced prompt's prefix to the cache (miss
+        and partial-hit admissions — a partial hit registers the now-
+        longer covered prefix). Greedy first token from the request's
+        own stream. BEST-EFFORT by contract: the splice already
+        succeeded, so a failed registration (e.g. the partial-page
+        copy dies) must never take the request down — reported to
+        stderr, never raised. The cache itself skips covered prefixes
+        and degrades to the aligned prefix when no free page exists
+        for the partial copy."""
+        if self._prefix_cache is None or self._sampling[0] > 0.0:
+            return
+        tok0 = request.tokens[0] if request.tokens else None
+        if tok0 is None:
+            return
+        try:
+            self._prefix_cache.register(
+                request.prompt, page_ids, int(tok0), self._copy_page)
+        except GraftFaultError:
+            raise  # a poisoned pool is engine-fatal, never swallowed
+        except Exception as e:  # noqa: BLE001
+            import sys
+
+            # on the telemetry bus too: a cache that silently never
+            # populates (repeated copy failures) must be visible to
+            # the tooling built to catch exactly this
+            graftscope.emit("prefix_cache.register_failed",
+                            cat="serving", req=request.uid,
+                            error=type(e).__name__)
+            print(f"graftpage: prefix registration failed for request "
+                  f"{request.uid}: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+
+    def _pref_sharded(self, c):
+        """Place a standalone prefill cache (dense ``[L, 1, W, H,
+        Dh]`` layout in BOTH kv layouts) head-sharded on the mesh."""
+        if self.mesh is None:
+            return c
+        return jax.device_put(
+            c, NamedSharding(self.mesh,
+                             P(None, None, None, "model", None)))
 
     def _admit_chunked(self) -> List[Tuple[Request, int, bool]]:
         events: List[Tuple[Request, int, bool]] = []
         pool = self.pool
         if self._pending is None and pool.free_slots > 0:
-            request = self._pop_admission()
+            prep = None
+            admit = True
+            if self._paged:
+                prep = self._paged_prep_head()
+                admit = prep is not None and prep not in ("hold",
+                                                          "retry")
+            request = self._pop_admission() if admit else None
             if request is not None:
-                plan = PrefillPlan(request, self._prefill_chunk,
-                                   self.min_bucket, pool.s_max)
-                model = self.model
-                shape = (model.num_layers, 1, plan.width,
-                         model.num_heads,
-                         model.hidden_size // model.num_heads)
-                zeros = jnp.zeros(shape, model.dtype)
-                self._pending = _PendingPrefill(
-                    request, plan, pool._cache_sharded(zeros),
-                    pool._cache_sharded(jnp.zeros(shape, model.dtype)))
+                if prep is not None:
+                    request.prefix_hit = (None if prep.mode == "miss"
+                                          else prep.mode)
+                    if self._prefix_cache is not None:
+                        self.metrics.record_prefix_outcome(
+                            request.prefix_hit)
+                if prep is not None and prep.mode == "full":
+                    self._admit_full_hit(request, prep, events)
+                    return events
+                if prep is not None and prep.mode == "partial":
+                    try:
+                        self._pending = self._seed_partial_pending(
+                            request, prep, self._prefill_chunk)
+                    except Exception as e:
+                        self._abort_prep(prep)
+                        self._poisoned(request, e)
+                        return events
+                else:
+                    plan = PrefillPlan(request, self._prefill_chunk,
+                                       self.min_bucket, pool.s_max)
+                    model = self.model
+                    shape = (model.num_layers, 1, plan.width,
+                             model.num_heads,
+                             model.hidden_size // model.num_heads)
+                    self._pending = _PendingPrefill(
+                        request, plan,
+                        self._pref_sharded(
+                            jnp.zeros(shape, model.dtype)),
+                        self._pref_sharded(
+                            jnp.zeros(shape, model.dtype)),
+                        prep)
         pend = self._pending
         if pend is None:
             return events
-        start, valid, is_last = pend.plan.next_chunk()
-        chunk = pend.plan.chunk
-        padded = np.zeros((1, chunk), np.int32)
-        padded[0, :valid] = pend.request.prompt[start:start + valid]
-
-        def chunk_once():
-            # site before the jitted call (donated prefill caches):
-            # injected retries are always safe, see _insert's note
-            maybe_fault(_SITE_CHUNK)
-            with expected_transfer("chunk upload (fixed [1, chunk] "
-                                   "shape)"):
-                return self._chunk_jit(
-                    self.params, pend.k_pref, pend.v_pref,
-                    jnp.asarray(padded), jnp.int32(start))
-
-        try:
-            with graftscope.span("serving.prefill_chunk", cat="serving",
-                                 req=pend.request.uid, start=start,
-                                 chunk=chunk):
-                x, pend.k_pref, pend.v_pref = self._attempted(
-                    chunk_once)
-        except Exception as e:
-            self._pending = None
-            self._poisoned(pend.request, e)
-            return events
-        record_jit_key(self._chunk_jit,
-                       ("prefill_chunk", chunk, pend.plan.width))
-        if not is_last:
-            return events
-        self._pending = None
-        key = self._next_key()
-
-        def tok0_once():
-            # same fault domain as the whole-prompt path's first-token
-            # readback (there it lives inside serving.prefill):
-            # per-request work — retry, then quarantine just this
-            # request. _tok0_jit donates nothing, so retries are safe.
-            maybe_fault(_SITE_TOK0)
-            with expected_transfer("first-token readback (the TTFT "
-                                   "boundary)"):
-                t = self._tok0_jit(
-                    self.params, x,
-                    jnp.int32(pend.plan.length - 1 - start), key)
-                return t, int(t)
-
-        try:
-            with graftscope.span("serving.prefill_tok0", cat="serving",
-                                 req=pend.request.uid):
-                tok0, tok0_host = self._attempted(tok0_once)
-        except Exception as e:
-            self._poisoned(pend.request, e)
-            return events
-        slot = self._first_token(pend.request, tok0_host, events)
-        if slot is None:
-            return events
-        try:
-            self._insert(pend.request, slot, pend.k_pref, pend.v_pref,
-                         pend.plan.length, tok0)
-        except Exception as e:
-            self._poisoned(pend.request, e, slot=slot)
+        self._drive_pending(pend, events)
         return events
 
     # ---- horizon scheduling / dispatch / drain ------------------------
@@ -1262,18 +1855,30 @@ class ServingEngine:
         window, h = self._pick_schedule()
         key = self._next_key()
 
+        if self._paged:
+            # lazy page-table upload: device_table() re-uploads (under
+            # its own expected_transfer) only when the host mirror
+            # changed at an admission/release boundary — steady state
+            # re-uses the device copy, so the armed-sentinel
+            # 0-transfer pin holds
+            caches = (pool.k_pages, pool.v_pages, pool.device_table())
+        else:
+            caches = (pool.k_caches, pool.v_caches)
+
         def launch():
             maybe_fault(_SITE_DISPATCH)
             return self._donated(lambda: self._decode(
-                self.params, pool.k_caches, pool.v_caches,
-                pool.positions, pool.last_tokens, pool.active,
-                pool.budgets, pool.eos_ids, key, window=window,
-                horizon=h))
+                self.params, *caches, pool.positions,
+                pool.last_tokens, pool.active, pool.budgets,
+                pool.eos_ids, key, window=window, horizon=h))
 
-        (tokens, pool.k_caches, pool.v_caches, pool.positions,
-         pool.last_tokens, pool.active,
-         pool.budgets) = self._attempted_engine(launch,
-                                                "decode dispatch")
+        (tokens, k_out, v_out, pool.positions, pool.last_tokens,
+         pool.active, pool.budgets) = self._attempted_engine(
+            launch, "decode dispatch")
+        if self._paged:
+            pool.k_pages, pool.v_pages = k_out, v_out
+        else:
+            pool.k_caches, pool.v_caches = k_out, v_out
         if record_jit_key(self._decode, ("decode", window, h)):
             # this dispatch just paid a compile anyway — the one
             # moment measuring the program's temp HBM is off the
@@ -1505,9 +2110,8 @@ class ServingEngine:
             self._quarantine(request, overdue_error(request, "queued"),
                              reason="drain")
             failed += 1
-        pend = self._pending
+        pend = self._drop_pending()
         if pend is not None:
-            self._pending = None
             self._quarantine(
                 pend.request,
                 overdue_error(pend.request, "mid-chunked-prefill"),
@@ -1588,7 +2192,15 @@ def audit_programs():
     can ever run has a committed fingerprint: a semantic change to the
     hot decode scan — an extra cache copy, a dropped freeze gate, a
     new f32 upcast — fails tier-1 with the program named, before any
-    TPU time is burned on it."""
+    TPU time is burned on it.
+
+    The PAGED ladder (graftpage) is fingerprinted beside the dense one
+    on a reduced bucket set ({8, 32} x {1, 4} — the structural family;
+    every paged window shares one gather/scatter shape recipe): the
+    committed graftmeter budget records the argument-bytes drop of
+    pages-vs-dense (the pool's num_pages is sized BELOW dense worst
+    case here, as production would), and any drift in the table-driven
+    gather/scatter structure fails the gate."""
     def specs():
         # ONE audit geometry across the LM-family hooks
         from ..analysis.programs import audit_tiny_gpt
@@ -1600,31 +2212,49 @@ def audit_programs():
                                train=False))["params"]
         engine = ServingEngine(model, params, max_slots=4, s_max=32,
                                min_bucket=8, decode_horizon=4)
-        pool = engine.pool
+        # paged twin: 4 slots x 4 pages/slot worst case would be 17
+        # pages; 13 (incl. scratch) is the capacity-lever shape —
+        # same ladder statics, ~25% less KV argument HBM, committed
+        paged = ServingEngine(model, params, max_slots=4, s_max=32,
+                              min_bucket=8, decode_horizon=4,
+                              kv_layout="paged", page_size=8,
+                              num_pages=13, decode_buckets=(8, 32))
 
         def sds(x):
             return jax.ShapeDtypeStruct(x.shape, x.dtype)
 
-        args = (params, sds(pool.k_caches), sds(pool.v_caches),
-                sds(pool.positions), sds(pool.last_tokens),
-                sds(pool.active), sds(pool.budgets), sds(pool.eos_ids),
-                jax.ShapeDtypeStruct((2,), jnp.uint32))
+        def decode_args(eng):
+            pool = eng.pool
+            if eng._paged:
+                return (params, sds(pool.k_pages), sds(pool.v_pages),
+                        sds(pool.device_table()), sds(pool.positions),
+                        sds(pool.last_tokens), sds(pool.active),
+                        sds(pool.budgets), sds(pool.eos_ids),
+                        jax.ShapeDtypeStruct((2,), jnp.uint32))
+            return (params, sds(pool.k_caches), sds(pool.v_caches),
+                    sds(pool.positions), sds(pool.last_tokens),
+                    sds(pool.active), sds(pool.budgets),
+                    sds(pool.eos_ids),
+                    jax.ShapeDtypeStruct((2,), jnp.uint32))
 
         out = []
-        for window in engine.decode_buckets:
-            for horizon in sorted({1, engine.decode_horizon}):
-                def build(w=window, h=horizon):
-                    return {
-                        "fn": engine._decode, "args": args,
-                        "kwargs": {"window": w, "horizon": h},
-                        # single-shard decode moves zero collective
-                        # bytes — that IS the serving cost model
-                        "expect_collectives": {},
-                    }
-                out.append({
-                    "name": f"serving_decode_w{window}_h{horizon}",
-                    "min_devices": 1, "build": build,
-                })
+        for eng, tag in ((engine, ""), (paged, "paged_")):
+            args = decode_args(eng)
+            for window in eng.decode_buckets:
+                for horizon in sorted({1, eng.decode_horizon}):
+                    def build(e=eng, a=args, w=window, h=horizon):
+                        return {
+                            "fn": e._decode, "args": a,
+                            "kwargs": {"window": w, "horizon": h},
+                            # single-shard decode moves zero collective
+                            # bytes — that IS the serving cost model
+                            "expect_collectives": {},
+                        }
+                    out.append({
+                        "name": f"serving_decode_{tag}w{window}"
+                                f"_h{horizon}",
+                        "min_devices": 1, "build": build,
+                    })
         return out
 
     return specs()

@@ -1,0 +1,575 @@
+"""Paged KV cache: fixed-size pages + per-slot page tables (graftpage).
+
+:class:`~.kv_slots.SlotPool` pays worst-case HBM per request — a dense
+``[layers, max_slots, s_max, heads, head_dim]`` block reserves ``s_max``
+columns for a 16-token request. This module replaces the dense block
+with **pages**: K/V live in ``[layers, num_pages, heads, page_size,
+head_dim]`` arrays, and each slot maps its logical columns onto pages
+through an ``[max_slots, pages_per_slot]`` int32 page table. A request
+holding ``L + g`` tokens pins ``ceil((L + g) / page_size)`` pages — so
+``num_pages`` (the real HBM commitment) can be sized to the *expected*
+length distribution while ``max_slots`` (concurrency) grows past the
+dense worst case: the capacity multiplier graftmeter's
+``per_slot_kv_bytes`` ledger exists to measure.
+
+Layout note: pages keep heads BEFORE the column offset
+(``[..., heads, page_size, head_dim]``) so the Pallas paged decode
+kernel's per-(slot, head) block is ``[page_size, head_dim]`` — the
+TPU-tileable trailing pair (:mod:`...ops.pallas.decode_attention`).
+
+Allocation is **host-mirrored**: the free list, refcounts and the page
+table live in host numpy; alloc/free never touch the device. The
+device copy of the table is uploaded lazily — only when the mirror
+changed since the last dispatch (an admission/release boundary where
+the host already synchronizes), so the armed-sentinel steady state
+stays at 0 transfers. All allocation happens PRE-jit (graftfault-safe:
+never on donated buffers mid-flight).
+
+Page 0 is the **scratch page**, never allocated: released slots' table
+rows are reset to 0, so a frozen (inactive) row's idempotent re-write
+of its pinned column lands in scratch instead of poisoning a page that
+has since been re-allocated to another tenant. Garbage in scratch is
+never read — the decode attention masks columns beyond each slot's
+position, and no live table entry points at page 0.
+
+**Shared-prefix reuse** (:class:`PrefixCache`): pages are refcounted,
+so N requests with a common page-aligned prompt prefix can all map
+their leading table entries at ONE set of pages, prefilled once. The
+pages are referenced read-only by construction — a joiner's first
+divergent write (its first decode column, ``L``) lands either in a
+fresh page or in a **copy-on-write fork** of the prefix's partial last
+page; shared pages are only ever written by the request that first
+filled them, before they were shared.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..runtime import hbm
+
+
+class PagePoolExhausted(RuntimeError):
+    """Raised when an allocation asks for more free pages than the
+    pool holds. The ENGINE never lets this escape admission for a
+    request that could eventually fit: it holds the FIFO head queued
+    (backpressure — running requests free pages as they finish, and
+    the prefix cache sheds LRU entries first) and only fails a request
+    named with this error when nothing in flight could ever free
+    enough pages for it."""
+
+
+class PagePool:
+    """Paged KV storage + per-slot decode state for the serving engine.
+
+    Drop-in superset of :class:`~.kv_slots.SlotPool`'s engine surface
+    (``positions``/``last_tokens``/``active``/``budgets``/``eos_ids``,
+    ``acquire``/``release``, the host position mirror) with the dense
+    ``k_caches``/``v_caches`` replaced by ``k_pages``/``v_pages`` and
+    the page table.
+
+    Args:
+      model: the ``GPT`` the caches are shaped for.
+      max_slots: concurrent requests decoded per step (the decode
+        batch dimension, exactly as in ``SlotPool``).
+      s_max: per-slot LOGICAL column capacity (admission bound).
+      page_size: columns per page. Every request pins
+        ``ceil(total_tokens / page_size)`` pages. On a real TPU keep
+        it a multiple of 8 (the Pallas block's sublane tiling); CPU
+        interpret mode takes any value >= 1.
+      num_pages: total pages allocated, INCLUDING the reserved scratch
+        page 0. Default: ``max_slots * pages_per_slot + 1`` — dense
+        worst-case parity. The capacity win comes from passing LESS
+        than worst case while raising ``max_slots``.
+      mesh: optional ``Mesh`` with a ``model`` axis — pages are then
+        resident head-sharded (``[L, P, H/tp, ps, Dh]`` per chip).
+    """
+
+    def __init__(self, model, max_slots: int, s_max: Optional[int] = None,
+                 mesh: Optional[Mesh] = None, *, page_size: int,
+                 num_pages: Optional[int] = None):
+        if max_slots < 1:
+            raise ValueError(f"max_slots must be >= 1, got {max_slots}")
+        s_max = int(s_max or model.max_seq_len)
+        if not 2 <= s_max <= model.max_seq_len:
+            raise ValueError(
+                f"s_max must be in [2, max_seq_len={model.max_seq_len}], "
+                f"got {s_max}")
+        page_size = int(page_size)
+        if page_size < 1:
+            raise ValueError(
+                f"page_size must be >= 1, got {page_size}")
+        self.model = model
+        self.max_slots = int(max_slots)
+        self.s_max = s_max
+        self.mesh = mesh
+        self.page_size = page_size
+        self.pages_per_slot = -(-s_max // page_size)
+        worst = self.max_slots * self.pages_per_slot + 1
+        self.num_pages = int(num_pages) if num_pages is not None else worst
+        if self.num_pages < 2:
+            raise ValueError(
+                f"num_pages must be >= 2 (scratch + 1), got "
+                f"{self.num_pages}")
+        h = model.num_heads
+        shape = (model.num_layers, self.num_pages, h, page_size,
+                 model.hidden_size // h)
+        self.k_pages = self._cache_sharded(jnp.zeros(shape, model.dtype))
+        self.v_pages = self._cache_sharded(jnp.zeros(shape, model.dtype))
+        # per-slot decode state — identical to SlotPool's (the decode
+        # horizon's freeze gates do not care where the columns live)
+        self.positions = self._replicated(
+            jnp.zeros((self.max_slots,), jnp.int32))
+        self.last_tokens = self._replicated(
+            jnp.zeros((self.max_slots,), jnp.int32))
+        self.active = self._replicated(jnp.zeros((self.max_slots,), bool))
+        self.budgets = self._replicated(
+            jnp.zeros((self.max_slots,), jnp.int32))
+        self.eos_ids = self._replicated(
+            jnp.full((self.max_slots,), -1, jnp.int32))
+        # host-mirrored page bookkeeping: table, free list, refcounts.
+        # Page 0 is scratch (never allocated, permanently "referenced")
+        self._table = np.zeros((self.max_slots, self.pages_per_slot),
+                               np.int32)
+        self._free: List[int] = list(range(1, self.num_pages))
+        self._refs = np.zeros((self.num_pages,), np.int64)
+        self._refs[0] = 1  # scratch: never freed
+        self._table_dev = None  # uploaded lazily, see device_table()
+        self._table_dirty = True
+        # slot free list + host position mirror (SlotPool semantics)
+        self._free_slots: List[int] = list(range(self.max_slots))
+        self._positions_host: List[int] = [0] * self.max_slots
+        self._active_host: List[bool] = [False] * self.max_slots
+        # graftmeter: the pool's REAL HBM commitment (num_pages x
+        # page_bytes — the number the dense pool's worst-case
+        # per_slot_kv_bytes shrinks to) + live pages-in-use gauges.
+        # Disarmed: one global read.
+        if hbm.active_ledger() is not None:
+            hbm.register("serving.kv_pages",
+                         hbm.nbytes_of(self.k_pages)
+                         + hbm.nbytes_of(self.v_pages),
+                         category="kv_pages", slots=self.max_slots,
+                         s_max=s_max, page_size=page_size,
+                         num_pages=self.num_pages,
+                         hbm_page_bytes=self.page_bytes)
+            hbm.set_gauge("page_bytes", self.page_bytes)
+            hbm.register("serving.slot_state",
+                         sum(hbm.nbytes_of(a) for a in (
+                             self.positions, self.last_tokens,
+                             self.active, self.budgets, self.eos_ids))
+                         + self._table.nbytes,
+                         category="kv")
+            self._note_pages_ledger()
+
+    def _cache_sharded(self, c):
+        if self.mesh is None:
+            return c
+        # heads live at axis 2 in the paged layout
+        return jax.device_put(
+            c, NamedSharding(self.mesh,
+                             P(None, None, "model", None, None)))
+
+    def _replicated(self, a):
+        if self.mesh is None:
+            return a
+        return jax.device_put(a, NamedSharding(self.mesh, P()))
+
+    # ---- capacity accounting (graftmeter) ------------------------------
+    @staticmethod
+    def page_kv_bytes(model, page_size: int) -> int:
+        """K+V bytes of ONE page — the exact shape x dtype product
+        ``__init__`` allocates per page (``2 x layers x heads x
+        page_size x head_dim x itemsize``), the planner's paged-mode
+        unit (:func:`...analysis.meter.plan_capacity`)."""
+        head_dim = model.hidden_size // model.num_heads
+        itemsize = jnp.dtype(model.dtype).itemsize
+        return (2 * model.num_layers * model.num_heads * int(page_size)
+                * head_dim * itemsize)
+
+    @staticmethod
+    def pages_for(total_tokens: int, page_size: int) -> int:
+        """Pages a request holding ``total_tokens`` columns pins."""
+        return -(-int(total_tokens) // int(page_size))
+
+    @property
+    def page_bytes(self) -> int:
+        return self.page_kv_bytes(self.model, self.page_size)
+
+    @property
+    def per_slot_bytes(self) -> int:
+        """WORST-CASE resident bytes one slot can pin
+        (``pages_per_slot`` pages + scalar state) — the dense-parity
+        upper bound. Actual residency is ``pages_in_use x
+        page_bytes``; the gap between the two is the capacity win the
+        ledger gauges record."""
+        from .kv_slots import SlotPool
+
+        return (self.pages_per_slot * self.page_bytes
+                + SlotPool.per_slot_state_bytes())
+
+    @property
+    def hbm_bytes(self) -> int:
+        """Total device bytes resident (host metadata only)."""
+        return (hbm.nbytes_of(self.k_pages)
+                + hbm.nbytes_of(self.v_pages)
+                + sum(hbm.nbytes_of(a) for a in (
+                    self.positions, self.last_tokens, self.active,
+                    self.budgets, self.eos_ids))
+                + int(self._table.nbytes))
+
+    def _note_pages_ledger(self) -> None:
+        """Refresh the live utilization gauges on the armed ledger
+        (disarmed: one global read — callers gate, this re-checks for
+        safety). Gauge-only: the pool's CAPACITY entry already counts
+        these bytes resident; ``pages_in_use`` must never be summed a
+        second time into ``hbm_total_bytes``."""
+        if hbm.active_ledger() is None:
+            return
+        used = self.pages_in_use
+        hbm.set_gauge("pages_in_use", used)
+        hbm.set_gauge("kv_pages_in_use_bytes", used * self.page_bytes)
+
+    # ---- page allocation (host-only) -----------------------------------
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def pages_in_use(self) -> int:
+        return self.num_pages - 1 - len(self._free)
+
+    def alloc_pages(self, n: int) -> List[int]:
+        """Claim ``n`` free pages (refcount 1 each; lowest-numbered
+        first so tests can pin recycling). Raises
+        :class:`PagePoolExhausted` when fewer are free — the engine's
+        admission gate checks ``free_pages`` first and holds the
+        request instead."""
+        if n > len(self._free):
+            raise PagePoolExhausted(
+                f"asked for {n} page(s), only {len(self._free)} free "
+                f"of {self.num_pages - 1} (admission should hold the "
+                "request until running work frees pages)")
+        ids = self._free[:n]
+        del self._free[:n]
+        for p in ids:
+            self._refs[p] = 1
+        if hbm.active_ledger() is not None:
+            self._note_pages_ledger()
+        return ids
+
+    def incref(self, ids: Sequence[int]) -> None:
+        for p in ids:
+            if p == 0:
+                continue
+            if self._refs[p] <= 0:
+                raise ValueError(f"incref of free page {p}")
+            self._refs[p] += 1
+
+    def decref(self, ids: Sequence[int]) -> None:
+        """Drop one reference per page; a page at zero returns to the
+        free list (sorted — deterministic reuse)."""
+        freed = False
+        for p in ids:
+            if p == 0:
+                continue
+            if self._refs[p] <= 0:
+                raise ValueError(f"decref of free page {p}")
+            self._refs[p] -= 1
+            if self._refs[p] == 0:
+                self._free.append(p)
+                freed = True
+        if freed:
+            self._free.sort()
+            if hbm.active_ledger() is not None:
+                self._note_pages_ledger()
+
+    def page_refcount(self, page: int) -> int:
+        return int(self._refs[page])
+
+    # ---- page table (host mirror + lazy device copy) -------------------
+    def bind_slot(self, slot: int, page_ids: Sequence[int]) -> None:
+        """Point ``slot``'s table row at ``page_ids`` (padded with
+        scratch 0). OWNERSHIP TRANSFER: the row now holds the one
+        reference per real page the caller allocated/increfed —
+        ``release`` drops them."""
+        if len(page_ids) > self.pages_per_slot:
+            raise ValueError(
+                f"{len(page_ids)} pages exceed pages_per_slot="
+                f"{self.pages_per_slot}")
+        row = np.zeros((self.pages_per_slot,), np.int32)
+        row[:len(page_ids)] = page_ids
+        self._table[slot] = row
+        self._table_dirty = True
+
+    def slot_pages(self, slot: int) -> List[int]:
+        """The slot's REAL (non-scratch) table entries, in column
+        order."""
+        return [int(p) for p in self._table[slot] if p != 0]
+
+    def device_table(self):
+        """The page table as a device operand for the jitted decode —
+        re-uploaded ONLY when the host mirror changed (admission/
+        release boundaries), so the steady state makes zero transfers.
+        The upload carries its own ``expected_transfer`` annotation —
+        the dirty condition and the sentinel exemption live in ONE
+        place, so they cannot drift."""
+        if self._table_dirty or self._table_dev is None:
+            from ..analysis.sentinels import expected_transfer
+
+            with expected_transfer("page-table upload after admission/"
+                                   "release (host-mirrored page "
+                                   "alloc)"):
+                self._table_dev = self._replicated(
+                    jnp.asarray(self._table))
+            self._table_dirty = False
+        return self._table_dev
+
+    # ---- slot accounting (SlotPool surface) ----------------------------
+    @property
+    def free_slots(self) -> int:
+        return len(self._free_slots)
+
+    @property
+    def occupancy(self) -> int:
+        return self.max_slots - len(self._free_slots)
+
+    def acquire(self) -> int:
+        if not self._free_slots:
+            raise RuntimeError("no free slots (acquire() without "
+                               "checking free_slots)")
+        return self._free_slots.pop(0)
+
+    def release(self, slot: int) -> None:
+        """Return ``slot`` to the free list AND drop its page
+        references (shared prefix pages survive while the cache or
+        other slots still hold them). The row resets to scratch so
+        the frozen row's masked re-writes land in page 0, never in a
+        page that has been handed to a new tenant."""
+        if slot in self._free_slots or not 0 <= slot < self.max_slots:
+            raise ValueError(f"bad release of slot {slot}")
+        self.decref(self.slot_pages(slot))
+        self._table[slot] = 0
+        self._table_dirty = True
+        self._free_slots.append(slot)
+        self._free_slots.sort()
+        self._active_host[slot] = False
+
+    # ---- host position mirror (decode-window tracking) -----------------
+    def note_insert(self, slot: int, position: int) -> None:
+        self._positions_host[slot] = int(position)
+        self._active_host[slot] = True
+
+    def note_advance_slots(self, realized) -> None:
+        for slot, steps in realized.items():
+            self._positions_host[slot] += int(steps)
+
+    @property
+    def max_active_pos(self) -> int:
+        return max(
+            (p for p, live in zip(self._positions_host,
+                                  self._active_host) if live),
+            default=-1)
+
+
+class PrefixEntry:
+    """One cached shared prefix: ``n_full`` full pages covering
+    ``tokens[: n_full * page_size]`` plus (when the registered prompt
+    was not page-aligned) a cache-OWNED frozen copy of the partial
+    last page, so an identical prompt is a FULL hit — no prefill
+    compute at all. ``tok0`` is the greedy first token the creator
+    sampled (host int): a full hit's TTFT is a state splice plus at
+    most one page copy."""
+
+    __slots__ = ("tokens", "n_full", "shared_ids", "partial_id", "tok0",
+                 "hits")
+
+    def __init__(self, tokens: Tuple[int, ...], n_full: int,
+                 shared_ids: List[int], partial_id: Optional[int],
+                 tok0: Optional[int]):
+        self.tokens = tokens
+        self.n_full = n_full
+        self.shared_ids = shared_ids
+        self.partial_id = partial_id
+        self.tok0 = tok0
+        self.hits = 0
+
+    @property
+    def covered(self) -> int:
+        """Cached K/V columns: the full prompt when the partial page
+        was copied (or the prompt was page-aligned), else the aligned
+        prefix only."""
+        return len(self.tokens)
+
+
+class PrefixCache:
+    """Host-side index of prefilled prompt prefixes over a
+    :class:`PagePool`, keyed on prompt-token hash.
+
+    An entry is registered after a MISS finishes its prefill: the
+    slot's leading full pages are increfed (shared read-only from then
+    on — the creator's decode writes only columns ``>= L``, which live
+    past them) and the partial last page, if any, is copied into a
+    cache-owned page. Lookups walk page-aligned prefixes longest-first
+    and verify tokens (hashes only route). LRU-bounded
+    (``max_entries``); eviction — explicit, LRU under page pressure
+    (the engine sheds cache before holding admission), or
+    ``clear()`` — drops the cache's page references.
+    """
+
+    def __init__(self, pool: PagePool, max_entries: int = 64):
+        if max_entries < 1:
+            raise ValueError(
+                f"max_entries must be >= 1, got {max_entries}")
+        self.pool = pool
+        self.max_entries = int(max_entries)
+        self._lru: "OrderedDict[int, PrefixEntry]" = OrderedDict()
+        self._by_prefix: Dict[Tuple[int, int], PrefixEntry] = {}
+        self._full: Dict[int, PrefixEntry] = {}
+        # longest registered prefix (in pages): bounds lookup's
+        # longest-first walk so a long miss prompt pays O(max
+        # registered) prefix hashes, not O(its own length)
+        self._max_full = 0
+
+    def __len__(self) -> int:
+        return len(self._lru)
+
+    @staticmethod
+    def _key(tokens: Sequence[int]) -> int:
+        return hash(tuple(tokens))
+
+    def lookup(self, prompt: Sequence[int]
+               ) -> Tuple[Optional[PrefixEntry], int]:
+        """Longest usable cached prefix of ``prompt``: ``(entry, k)``
+        with ``k`` full shared pages, or ``(None, 0)``. A FULL hit
+        (the entry covers the entire prompt and carries ``tok0``) is
+        recognized by ``entry.tokens == tuple(prompt)``."""
+        ps = self.pool.page_size
+        n = len(prompt)
+        if not self._lru:
+            return None, 0
+        entry = self._full.get(self._key(prompt))
+        if (entry is not None and entry.tokens == tuple(prompt)
+                and entry.tok0 is not None):
+            self._touch(entry)
+            return entry, entry.n_full
+        for k in range(min(n // ps, self._max_full), 0, -1):
+            entry = self._by_prefix.get((k, self._key(prompt[:k * ps])))
+            if (entry is not None
+                    and entry.tokens[:k * ps] == tuple(prompt[:k * ps])):
+                self._touch(entry)
+                return entry, k
+        return None, 0
+
+    def _touch(self, entry: PrefixEntry) -> None:
+        entry.hits += 1
+        self._lru.move_to_end(id(entry))
+
+    def has_prefix(self, prompt: Sequence[int]) -> bool:
+        """Would :meth:`register` be a no-op for this prompt? True
+        when an entry already covers its maximal aligned prefix (or
+        the whole prompt)."""
+        entry, k = self.lookup(prompt)
+        if entry is None:
+            return False
+        if entry.tokens == tuple(prompt):
+            return True
+        return k >= len(prompt) // self.pool.page_size
+
+    def register(self, prompt: Sequence[int], page_ids: Sequence[int],
+                 tok0: Optional[int], copy_page) -> Optional[PrefixEntry]:
+        """Cache ``prompt``'s prefix off a freshly spliced slot whose
+        table maps ``page_ids`` (column order). Increfs the leading
+        ``len(prompt) // page_size`` full pages; when the prompt is
+        not page-aligned AND a free page exists, allocates a cache-
+        owned destination page and fills it via ``copy_page(src_page,
+        dst_page)`` (a device page copy, no return value; else the
+        entry covers the aligned prefix only and drops ``tok0``).
+        No-op when nothing would be cached or the prefix is already
+        covered. Evicts LRU past ``max_entries``."""
+        ps = self.pool.page_size
+        n = len(prompt)
+        n_full = n // ps
+        if n_full < 1 or self.has_prefix(prompt):
+            return None
+        shared = [int(p) for p in page_ids[:n_full]]
+        if len(shared) < n_full:
+            raise ValueError(
+                f"slot maps {len(page_ids)} page(s); prompt needs "
+                f"{n_full} full page(s)")
+        partial_id = None
+        tokens = tuple(int(t) for t in prompt)
+        if n % ps:
+            if self.pool.free_pages >= 1:
+                (partial_id,) = self.pool.alloc_pages(1)
+                try:
+                    copy_page(int(page_ids[n_full]), partial_id)
+                except BaseException:
+                    self.pool.decref([partial_id])  # no orphaned page
+                    raise
+            else:
+                # best-effort: cache the aligned prefix only
+                tokens = tokens[:n_full * ps]
+                tok0 = None
+        self.pool.incref(shared)
+        entry = PrefixEntry(tokens, n_full, shared, partial_id, tok0)
+        self._lru[id(entry)] = entry
+        self._max_full = max(self._max_full, n_full)
+        for k in range(1, n_full + 1):
+            self._by_prefix.setdefault(
+                (k, self._key(tokens[:k * ps])), entry)
+        if entry.tok0 is not None:
+            self._full.setdefault(self._key(tokens), entry)
+        while len(self._lru) > self.max_entries:
+            self.evict_lru()
+        return entry
+
+    def _drop(self, entry: PrefixEntry) -> None:
+        self._lru.pop(id(entry), None)
+        # rebuild the indexes from the survivors: a key the dropped
+        # entry owned may be coverable by a LATER entry sharing the
+        # same prefix (registration's setdefault kept the older one) —
+        # deleting the key outright would orphan the survivor's pages
+        self._by_prefix.clear()
+        self._full.clear()
+        ps = self.pool.page_size
+        self._max_full = 0
+        for live in self._lru.values():
+            for k in range(1, live.n_full + 1):
+                self._by_prefix.setdefault(
+                    (k, self._key(live.tokens[:k * ps])), live)
+            if live.tok0 is not None:
+                self._full.setdefault(self._key(live.tokens), live)
+            self._max_full = max(self._max_full, live.n_full)
+        self.pool.decref(entry.shared_ids)
+        if entry.partial_id is not None:
+            self.pool.decref([entry.partial_id])
+
+    def evict_lru(self) -> bool:
+        """Drop the least-recently-hit entry (False when empty) —
+        the engine's page-pressure relief valve: cache pages yield to
+        admission before any request is held."""
+        if not self._lru:
+            return False
+        _, entry = next(iter(self._lru.items()))
+        self._drop(entry)
+        return True
+
+    def clear(self) -> None:
+        """Drop everything — without _drop's per-eviction survivor
+        reindex (there are no survivors to reindex)."""
+        entries = list(self._lru.values())
+        self._lru.clear()
+        self._by_prefix.clear()
+        self._full.clear()
+        self._max_full = 0
+        for entry in entries:
+            self.pool.decref(entry.shared_ids)
+            if entry.partial_id is not None:
+                self.pool.decref([entry.partial_id])

@@ -90,19 +90,31 @@ class PrefillPlan:
     land beyond ``L`` where the decode mask — and later overwrites —
     keep them invisible, the same invariant stale tenant columns rely
     on).
+
+    ``start_at`` (graftpage prefix-cache resume) skips the leading
+    columns a shared-prefix hit already holds cached K/V for: chunks
+    cover only ``[start_at, L)`` (``start_at`` must be < ``L`` and is
+    0 for a normal admission). The cache width stays bucket-derived —
+    the chunk program's ``(chunk, width)`` compile key space does not
+    grow with the resume offset (``start`` is traced).
     """
 
     def __init__(self, request: "Request", chunk: int, min_bucket: int,
-                 s_max: int):
+                 s_max: int, start_at: int = 0):
         if chunk < 1:
             raise ValueError(f"chunk must be >= 1, got {chunk}")
         length = len(request.prompt)
+        if not 0 <= start_at < length:
+            raise ValueError(
+                f"start_at must be in [0, {length}), got {start_at}")
         self.request = request
         self.chunk = int(chunk)
         self.length = length
+        self.start_at = int(start_at)
         bucket = bucket_length(length, min_bucket, s_max)
         self.width = -(-bucket // chunk) * chunk
-        self.starts: Tuple[int, ...] = tuple(range(0, length, chunk))
+        self.starts: Tuple[int, ...] = tuple(
+            range(self.start_at, length, chunk))
         self._next = 0
 
     @property
@@ -171,6 +183,10 @@ class Request:
         self.state = QUEUED
         self.tokens: List[int] = []
         self.slot: Optional[int] = None
+        # graftpage: "full" | "partial" | None — whether this request
+        # joined through the shared-prefix cache (the bench splits
+        # TTFT by it)
+        self.prefix_hit: Optional[str] = None
         self.error: Optional[BaseException] = None
         self.submit_time: Optional[float] = None
         self.admit_time: Optional[float] = None
@@ -261,6 +277,14 @@ class FIFOScheduler:
                 f"queue at capacity ({self.max_queue}); resubmit later")
         self._queue.append(request)
         return request
+
+    def peek(self) -> Optional[Request]:
+        """The FIFO head WITHOUT popping it — the paged engine's
+        admission gate inspects the head's page demand (and prefix-
+        cache prospects) before committing to admit it, so a head that
+        must wait for pages stays queued in order instead of being
+        popped-and-requeued."""
+        return self._queue[0] if self._queue else None
 
     def next_to_admit(self) -> Optional[Request]:
         """Pop the FIFO head for admission (engine calls this once per

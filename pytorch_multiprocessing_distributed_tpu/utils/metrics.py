@@ -158,6 +158,13 @@ class ServingMetrics:
         self.requests_redelivered = 0
         self.watchdog_trips = 0
         self.horizon_collapses = 0
+        # graftpage counters: prefix-cache outcomes per admission and
+        # admissions deferred for page pressure (the head HELD queued
+        # — never failed — until running work frees pages)
+        self.prefix_hits = 0
+        self.prefix_partial_hits = 0
+        self.prefix_misses = 0
+        self.page_holds = 0
         self._elapsed = 0.0
         self._occupancy_max = 0
         self._queue_wait_max = 0.0
@@ -239,6 +246,26 @@ class ServingMetrics:
         """One dispatch degraded to H=1 during a post-fault cooldown."""
         self.horizon_collapses += 1
 
+    # ---- paged-KV / prefix-cache counters (graftpage) ----
+    def record_prefix_outcome(self, hit) -> None:
+        """One paged admission's prefix-cache outcome: ``"full"``
+        (prompt fully cached — no prefill compute), ``"partial"``
+        (leading pages reused, suffix prefilled), or None (miss)."""
+        if hit == "full":
+            self.prefix_hits += 1
+        elif hit == "partial":
+            self.prefix_partial_hits += 1
+        else:
+            self.prefix_misses += 1
+
+    def record_page_hold(self) -> None:
+        """One admission deferred because the page pool could not
+        cover the FIFO head's demand — the head stays QUEUED (held,
+        not failed) until running work frees pages. Counted at the
+        TRANSITION into the held state: one deferred admission is one
+        hold, however many steps the wait lasts."""
+        self.page_holds += 1
+
     def snapshot(self) -> dict:
         # decode tokens come from DRAINED blocks (the explicit
         # counter), never re-derived as tokens_generated - ttft.count:
@@ -275,6 +302,10 @@ class ServingMetrics:
             "requests_redelivered": self.requests_redelivered,
             "watchdog_trips": self.watchdog_trips,
             "horizon_collapses": self.horizon_collapses,
+            "prefix_hits": self.prefix_hits,
+            "prefix_partial_hits": self.prefix_partial_hits,
+            "prefix_misses": self.prefix_misses,
+            "page_holds": self.page_holds,
         }
         # graftscope percentile telemetry: the tail IS the SLO
         for name, meter in (("ttft", self.ttft),

@@ -109,6 +109,28 @@ parser.add_argument('--decode_attn', default='auto',
                     help='decode-step attention: fused flash-decode '
                          'Pallas kernel or the XLA reference (auto = '
                          'pallas on single-shard TPU, xla elsewhere)')
+parser.add_argument('--kv_layout', default='dense',
+                    choices=['dense', 'paged'],
+                    help='KV cache layout: dense slots (worst-case '
+                         's_max columns per slot) or graftpage paged '
+                         'pages + per-slot page table — a request '
+                         'pins ceil(total/page_size) pages, so HBM '
+                         'follows real lengths and more requests fit '
+                         'per chip (token-exact with dense)')
+parser.add_argument('--page_size', default=0, type=int,
+                    help='paged mode: columns per KV page (0 = '
+                         'min_bucket; multiples of 8 on TPU)')
+parser.add_argument('--num_pages', default=0, type=int,
+                    help='paged mode: total pages incl. the scratch '
+                         'page (0 = dense worst-case parity; size it '
+                         'with `python -m ...analysis.meter --plan '
+                         'MODEL --page_size N` to the real HBM '
+                         'budget)')
+parser.add_argument('--prefix_cache', default=0, type=int,
+                    help='paged+greedy mode: LRU entries of the '
+                         'shared-prefix cache — identical prompts '
+                         'prefill ONCE and re-join copy-on-write '
+                         '(TTFT(hit) ~ one decode step); 0 = off')
 parser.add_argument('--max_new_tokens', default=32, type=int,
                     help='default per-request budget (jsonl requests '
                          'override per line)')
@@ -281,6 +303,13 @@ def main():
             prefill_chunk=args.prefill_chunk or None,
             decode_horizon=args.decode_horizon,
             decode_attn=args.decode_attn,
+            kv_layout=args.kv_layout,
+            page_size=(args.page_size or None
+                       if args.kv_layout == 'paged' else None),
+            num_pages=(args.num_pages or None
+                       if args.kv_layout == 'paged' else None),
+            prefix_cache=(args.prefix_cache
+                          if args.kv_layout == 'paged' else 0),
             journal=journal)
 
     def emit(events):

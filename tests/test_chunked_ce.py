@@ -66,7 +66,9 @@ def test_op_matches_dense_values_and_grads(n_chunks, with_bias):
         np.testing.assert_allclose(cg, dg, rtol=2e-5, atol=1e-6)
 
 
-@pytest.mark.parametrize("head_bias", [True, False])
+@pytest.mark.parametrize(
+    "head_bias",
+    [pytest.param(True, marks=pytest.mark.slow), False])
 def test_lm_step_trajectory_matches_dense(head_bias):
     """3 updates with vocab_chunks=4 == 3 dense updates, leaf for leaf."""
     mesh = make_mesh()

@@ -268,6 +268,10 @@ def test_serving_ladder_fingerprints_cover_decode_programs():
     buckets, horizon = (8, 16, 32), 4  # the hook's engine geometry
     expected = {f"serving_decode_w{w}_h{h}"
                 for w in buckets for h in (1, horizon)}
+    # graftpage: the paged twin's ladder is pinned on the reduced
+    # {8, 32} bucket set (one gather/scatter shape recipe per window)
+    expected |= {f"serving_decode_paged_w{w}_h{h}"
+                 for w in (8, 32) for h in (1, horizon)}
     assert names == expected
     committed = graftcheck.load_fingerprints(
         graftcheck.default_fingerprints_path())

@@ -235,6 +235,7 @@ def test_balance_loss_prevents_expert_collapse():
     )
 
 
+@pytest.mark.slow  # ~49 s convergence behavior, not an exactness pin
 def test_lm_step_trains_against_aux_loss():
     """make_lm_train_step on an MoE GPT reports the moe_aux metric and
     it moves toward 1 (uniform) over steps."""

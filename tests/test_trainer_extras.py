@@ -86,6 +86,8 @@ class TestClipAndEma:
             np.sqrt(delta_sq), lr * clip, rtol=1e-3
         )
 
+    @pytest.mark.slow  # ~27 s recurrence replay; clip test keeps
+    # the Trainer-extras exactness coverage in tier-1
     def test_ema_tracks_recurrence(self, setup):
         mesh, model, batch = setup
         decay = 0.5
@@ -109,6 +111,8 @@ class TestClipAndEma:
                 e, decay * a + (1 - decay) * b, rtol=1e-5, atol=1e-7
             )
 
+    @pytest.mark.slow  # ~22 s; the EMA-off invariant rides the
+    # recurrence test's machinery
     def test_ema_off_state_untouched(self, setup):
         mesh, model, batch = setup
         opt = sgd(learning_rate=0.1)
