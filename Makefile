@@ -4,7 +4,7 @@
 PYTEST_ENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 
 .PHONY: test test-fast lint check check-update chaos soak scope meter \
-        dryrun bench bench-cpu store clean
+        fleet dryrun bench bench-cpu store clean
 
 # graftlint: AST-only jit-hygiene gate (no jax import, milliseconds).
 # Exit 1 on any non-baselined finding; the tier-1 suite and
@@ -64,6 +64,16 @@ scope:
 # `make check`.
 meter:
 	$(PYTEST_ENV) python benchmarks/meter_smoke.py
+
+# graftfleet: cross-host observability smoke — a synthetic 2-rank run
+# over an in-process store must produce ONE merged per-rank timeline
+# (a Chrome-trace lane per rank, clock-aligned), a straggler report
+# NAMING the injected-slow rank with its arrival-skew percentiles,
+# and a goodput fraction on a live /snapshot.json scrape. Same body
+# runs in tier-1 (test_fleet_smoke_end_to_end in
+# tests/test_graftfleet.py).
+fleet:
+	$(PYTEST_ENV) python benchmarks/fleet_smoke.py
 
 # full suite on the virtual 8-device CPU mesh (incl. slow e2e CLI runs)
 test:

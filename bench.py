@@ -474,7 +474,13 @@ def run_bench(config: str, dtype_name: str, batch_size: int,
         config, dtype_name, batch_size, devices, remat=remat,
         vocab_chunks=vocab_chunks,
     )
+    # graftfleet goodput accounting for the bench run itself: compile
+    # seconds vs measured-window seconds vs everything else (warmup,
+    # queue drains, window growth) over the run's wall clock
+    t_run0 = time.perf_counter()
     step, costs = compile_step(step, state, *batch_args)
+    compile_s = time.perf_counter() - t_run0
+    timed_windows = []  # seconds of MEASURED stepping (the goodput)
     flops = float(costs["flops"]) if costs and costs["flops"] else None
     bytes_accessed = (float(costs["bytes_accessed"])
                       if costs and costs["bytes_accessed"] else None)
@@ -496,7 +502,9 @@ def run_bench(config: str, dtype_name: str, batch_size: int,
         for _ in range(n):
             state, m = step(state, *batch_args)
         loss = readback(m)
-        return time.perf_counter() - t0, state, loss
+        t = time.perf_counter() - t0
+        timed_windows.append(t)
+        return t, state, loss
 
     _log(f"warmup x{warmup}")
     for _ in range(max(1, warmup)):
@@ -570,6 +578,33 @@ def run_bench(config: str, dtype_name: str, batch_size: int,
     eff = roofline(flops, bytes_accessed, step_s, peak, peak_bw)
     mfu = eff["mfu"]
 
+    # graftfleet: goodput over this bench run (classified through the
+    # same ledger the CLIs serve) + collective skew when a fleet
+    # monitor is armed — None-safe on a single host, never a fake 0
+    from pytorch_multiprocessing_distributed_tpu.runtime import fleet
+
+    run_wall = time.perf_counter() - t_run0
+    gp_events = [
+        {"name": "bench.run", "ph": "X", "ts": t_run0,
+         "dur": run_wall, "seq": 0},
+        {"name": "compile.lower", "ph": "X", "cat": "compile",
+         "ts": t_run0, "dur": compile_s, "seq": 1},
+    ]
+    gp_events += [
+        {"name": "train.window", "ph": "X", "ts": t_run0, "dur": t,
+         "seq": 2 + i} for i, t in enumerate(timed_windows)]
+    goodput = fleet.GoodputLedger.from_events(gp_events).gauges()
+    collective_skew_p95_s = None
+    collective_straggler_rank = None
+    monitor = fleet.active_fleet()
+    if monitor is not None:
+        report = fleet.FleetCollector(
+            monitor.store, run_uid=monitor.run_uid,
+            prefix=monitor.prefix).straggler_report()
+        if report["collectives"]:
+            collective_skew_p95_s = report["skew_p95_s"]
+            collective_straggler_rank = report["straggler_rank"]
+
     result = {
         "metric": metric_for(config)[0],
         "value": round(per_chip, 2),
@@ -614,6 +649,13 @@ def run_bench(config: str, dtype_name: str, batch_size: int,
             "roofline_bound": eff["roofline_bound"],
             "roofline_frac": eff["roofline_frac"],
             "hbm_memory": (costs or {}).get("memory"),
+            # ---- graftfleet: where the RUN's wall went (compile vs
+            # measured stepping vs overhead) + cross-rank skew
+            "goodput_frac": round(goodput["goodput_frac"], 4),
+            "goodput_compile_s": round(goodput["goodput_compile_s"], 3),
+            "goodput_wall_s": round(goodput["goodput_wall_s"], 3),
+            "collective_skew_p95_s": collective_skew_p95_s,
+            "collective_straggler_rank": collective_straggler_rank,
         },
     }
     if note:

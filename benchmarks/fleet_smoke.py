@@ -1,0 +1,198 @@
+"""graftfleet smoke: a synthetic 2-rank run must produce the whole
+cross-host observability surface, and every artifact must PARSE.
+
+The ``make fleet`` target (and the tier-1 test that drives this module
+in-process) runs two synthetic "ranks" over one in-process control
+store (``MemStore`` — the same client surface the real C++ ``TCPStore``
+serves), with rank 1 artificially slowed, then asserts end-to-end:
+
+1. **merged per-rank timeline** — the :class:`FleetCollector` scrapes
+   every rank's ``/events.json`` and emits ONE Chrome-trace object
+   with a lane (pid) per rank, clock-aligned through the published
+   monotonic-offset handshake; it must carry both ranks' lanes and
+   valid spans;
+2. **straggler report** — every rank stamps its arrival at each
+   collective boundary; the report must NAME the injected-slow rank
+   and carry its lag percentiles (and the per-boundary skew);
+3. **goodput fraction on a live scrape** — each rank's
+   ``/snapshot.json`` (stdlib ``http.server``, one real HTTP GET)
+   must expose ``goodput_frac`` classified from its own spans, and
+   the merged gauges must label it by rank with cross-rank
+   percentiles.
+
+Exit code 0 and one ``graftfleet smoke OK`` line = the fleet
+observability stack is wired. Schema drift fails loudly here, before
+a real incident needs the artifacts.
+
+Run: ``python benchmarks/fleet_smoke.py`` (CPU-safe, jax-free:
+threads as ranks, milliseconds of synthetic work).
+"""
+
+import argparse
+import itertools
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+ROUNDS = 6
+SLOW_RANK = 1
+SLOW_S = 0.03
+FAST_S = 0.002
+
+
+def _span(scope, seq, name, cat, dur, host, rank, **attrs):
+    """Record one retroactive span into a NON-armed per-rank scope
+    (two ranks share this process, so the module-global arm — one
+    rank per process in production — is driven directly here)."""
+    from pytorch_multiprocessing_distributed_tpu.runtime import (
+        scope as graftscope)
+
+    attrs = dict(attrs, host=host, rank=rank)
+    scope.record(graftscope.Event(
+        name, cat, "X", time.perf_counter() - dur, dur, 0,
+        next(seq), attrs))
+
+
+def _rank_workload(store, rank, scope, seq):
+    """One rank's synthetic run: per round, a train window (the slow
+    rank's is longer — IT is the straggler), a data-wait span, and an
+    arrival stamp at the collective boundary."""
+    from pytorch_multiprocessing_distributed_tpu.runtime import fleet
+
+    monitor = fleet.FleetMonitor(store, f"host{rank}", rank, 2,
+                                 run_uid="smoke")
+    delay = SLOW_S if rank == SLOW_RANK else FAST_S
+    for _ in range(ROUNDS):
+        time.sleep(delay)
+        _span(scope, seq, "train.window", "train", delay,
+              f"host{rank}", rank)
+        _span(scope, seq, "train.data", "train", delay * 0.1,
+              f"host{rank}", rank)
+        monitor.note_arrival("dist.gate")
+    return monitor
+
+
+def run() -> dict:
+    """The smoke body; returns the parsed artifacts for the caller
+    (the tier-1 test asserts on them in-process)."""
+    from pytorch_multiprocessing_distributed_tpu.runtime import fleet
+    from pytorch_multiprocessing_distributed_tpu.runtime import (
+        scope as graftscope)
+    from pytorch_multiprocessing_distributed_tpu.runtime.store import (
+        MemStore)
+
+    store = MemStore()
+    seq = itertools.count()
+    scopes = {r: graftscope.Scope(keep=True) for r in (0, 1)}
+    monitors = {}
+
+    # the two "ranks" run concurrently (the real multi-process shape);
+    # the slow one falls behind at every boundary
+    def worker(rank):
+        monitors[rank] = _rank_workload(store, rank, scopes[rank], seq)
+
+    threads = [threading.Thread(target=worker, args=(r,))
+               for r in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30.0)
+    assert not any(t.is_alive() for t in threads), "a rank hung"
+
+    # each rank serves its snapshot + events live; goodput classified
+    # from its OWN spans rides /snapshot.json
+    servers = {}
+    try:
+        for rank in (0, 1):
+            ledger = fleet.GoodputLedger()
+            scope_r = scopes[rank]
+
+            def snapshot_fn(ledger=ledger, scope_r=scope_r,
+                            rank=rank):
+                ledger.ingest(scope_r.events())
+                snap = {"rank": rank,
+                        "rounds_completed": ROUNDS}
+                snap.update(ledger.gauges())
+                return snap
+
+            def events_fn(since=0, scope_r=scope_r):
+                events, _ = scope_r.events_since(since)
+                return [e.to_dict() for e in events]
+
+            servers[rank] = graftscope.start_stats_server(
+                snapshot_fn, port=0, events_fn=events_fn)
+            address = (f"127.0.0.1:"
+                       f"{servers[rank].server_address[1]}")
+            monitors[rank].publish_endpoint(address)
+
+        # one live scrape straight off a rank's HTTP endpoint (not
+        # through the collector): the goodput gauge is THERE
+        port0 = servers[0].server_address[1]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port0}/snapshot.json") as resp:
+            live_snap = json.loads(resp.read())
+
+        collector = fleet.FleetCollector(store, run_uid="smoke")
+        scraped = collector.scrape()
+        gauges = collector.merged_gauges(
+            {r: s["snapshot"] for r, s in scraped.items()})
+        timeline = collector.merged_timeline(
+            {r: s["events"] for r, s in scraped.items()},
+            hosts={r: s["host"] for r, s in scraped.items()})
+        report = collector.straggler_report()
+    finally:
+        for server in servers.values():
+            server.shutdown()
+
+    # ---- assert: merged timeline — one lane per rank, spans valid
+    parsed = json.loads(json.dumps(timeline))  # schema must serialize
+    lanes = {ev["pid"] for ev in parsed["traceEvents"]}
+    assert lanes == {0, 1}, f"expected a lane per rank, got {lanes}"
+    names = {ev["args"]["name"] for ev in parsed["traceEvents"]
+             if ev["ph"] == "M"}
+    assert names == {"rank 0 (host0)", "rank 1 (host1)"}, names
+    spans = [ev for ev in parsed["traceEvents"] if ev["ph"] == "X"]
+    assert len(spans) == 2 * 2 * ROUNDS, len(spans)
+    assert all(ev["ts"] >= 0.0 and ev["dur"] >= 0.0 for ev in spans)
+
+    # ---- assert: the straggler report NAMES the slow rank
+    assert report["collectives"] == ROUNDS, report
+    assert report["straggler_rank"] == SLOW_RANK, report
+    assert report["by_rank"][SLOW_RANK]["lag_p50_s"] > 0.0
+    assert report["straggler_lag_p95_s"] > 0.0
+    assert report["by_name"]["dist.gate"]["slowest_rank"] == SLOW_RANK
+    for q in ("skew_p50_s", "skew_p95_s", "skew_p99_s"):
+        assert report[q] >= 0.0, (q, report)
+
+    # ---- assert: goodput fraction on the live scrape + merged gauges
+    assert 0.0 < live_snap["goodput_frac"] <= 1.0, live_snap
+    assert live_snap["goodput_productive_s"] > 0.0
+    merged_goodput = gauges["goodput_frac"]
+    assert set(merged_goodput["by_rank"]) == {0, 1}
+    assert 0.0 <= merged_goodput["p50"] <= 1.0
+    assert gauges["rank"]["by_rank"] == {0: 0.0, 1: 1.0}
+
+    return {"timeline": parsed, "report": report,
+            "gauges": gauges, "live_snapshot": live_snap}
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.parse_args(argv)
+    out = run()
+    report = out["report"]
+    print(f"# straggler rank {report['straggler_rank']} "
+          f"(lag p95 {report['straggler_lag_p95_s'] * 1e3:.1f} ms over "
+          f"{report['collectives']} collectives), "
+          f"goodput_frac={out['live_snapshot']['goodput_frac']:.3f}")
+    print("graftfleet smoke OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -15,6 +15,9 @@ set -u
 cd "$(dirname "$0")/.." || exit 1
 note() { echo "=== $* ($(date -u +%T))" >&2; }
 
+note "fleet observability smoke (graftfleet wiring sane before capture)"
+python benchmarks/fleet_smoke.py
+
 note "baselines (all configs, slope estimator)"
 python benchmarks/record_baselines.py
 

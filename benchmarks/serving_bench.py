@@ -99,7 +99,10 @@ def run_point(model, params, prompts, new_tokens, slots, offered_rps,
               s_max, warmup=False, arm_plan=None, **engine_kwargs):
     from pytorch_multiprocessing_distributed_tpu.runtime import (
         hbm as hbm_ledger)
-    from pytorch_multiprocessing_distributed_tpu.runtime import faults
+    from pytorch_multiprocessing_distributed_tpu.runtime import (
+        faults, fleet)
+    from pytorch_multiprocessing_distributed_tpu.runtime import (
+        scope as graftscope)
     from pytorch_multiprocessing_distributed_tpu.serving import (
         ServingEngine)
     from pytorch_multiprocessing_distributed_tpu.utils.metrics import (
@@ -111,7 +114,10 @@ def run_point(model, params, prompts, new_tokens, slots, offered_rps,
     # the try: a failed engine construction must still disarm (a
     # stale process-wide ledger would silently absorb later points'
     # registrations).
+    # graftfleet: one fresh full-log scope per point — the engine's
+    # prefill/drain spans feed the point's goodput fraction.
     ledger = hbm_ledger.arm(hbm_ledger.HbmLedger())
+    point_scope = graftscope.arm(graftscope.Scope(keep=True))
     try:
         engine = ServingEngine(model, params, max_slots=slots,
                                s_max=s_max, **engine_kwargs)
@@ -153,7 +159,25 @@ def run_point(model, params, prompts, new_tokens, slots, offered_rps,
         if arm_plan is not None:
             faults.disarm()
         hbm_ledger.disarm()
+        graftscope.disarm()
     wall = time.perf_counter() - t_start
+    # graftfleet: goodput over the point's own timeline (engine
+    # prefill + drain spans vs the point's wall); collective skew only
+    # when a fleet monitor is armed (multi-rank run) — None-safe
+    # off-TPU and single-host, never a fake number
+    goodput = fleet.GoodputLedger.from_events(point_scope.events())
+    goodput_frac = (round(goodput.gauges()["goodput_frac"], 4)
+                    if goodput.wall_s > 0 else None)
+    collective_skew_p95_s = None
+    collective_straggler_rank = None
+    monitor = fleet.active_fleet()
+    if monitor is not None:
+        report = fleet.FleetCollector(
+            monitor.store, run_uid=monitor.run_uid,
+            prefix=monitor.prefix).straggler_report()
+        if report["collectives"]:
+            collective_skew_p95_s = report["skew_p95_s"]
+            collective_straggler_rank = report["straggler_rank"]
     ttfts = [r.first_token_time - r.submit_time for r in finished]
     waits = [r.admit_time - r.submit_time for r in finished]
     total_tokens = sum(len(r.tokens) for r in finished)
@@ -182,6 +206,11 @@ def run_point(model, params, prompts, new_tokens, slots, offered_rps,
         "hbm_per_slot_bytes": engine.pool.per_slot_bytes,
         "decode_flops_per_dispatch": decode_flops,
         "mfu": mfu,
+        # graftfleet: wall-time accounting + cross-rank attribution
+        # for EVERY sweep point (None-safe single-host/off-TPU)
+        "goodput_frac": goodput_frac,
+        "collective_skew_p95_s": collective_skew_p95_s,
+        "collective_straggler_rank": collective_straggler_rank,
         "completed": len(finished),
         "wall_s": wall,
         "tokens_per_sec": total_tokens / wall,

@@ -411,24 +411,36 @@ def main(args):
         # and /snapshot.json over stdlib http.server — plus /healthz
         # (graftheal): 200 only while the run is up, with last-beat
         # ages when a PMDT_HEARTBEAT monitor is armed
-        from pytorch_multiprocessing_distributed_tpu.runtime import heal
+        from pytorch_multiprocessing_distributed_tpu.runtime import (
+            fleet, heal)
 
         health = heal.HealthState()
+        # graftfleet: goodput_* gauges classified from the Trainer's
+        # own spans (train.window/data/metrics_fetch/checkpoint)
+        fleet.arm_goodput()
 
         def live_snapshot():
             snap = dict(trainer.live)
             ledger = hbm.active_ledger()
             if ledger is not None:
                 snap.update(ledger.snapshot())
+            snap.update(fleet.goodput_gauges())
             return snap
 
         stats_server = graftscope.start_stats_server(
             live_snapshot, port=args.stats_port, prefix="pmdt",
             health_fn=lambda: heal.healthz(health,
-                                           heal.active_monitor()))
+                                           heal.active_monitor()),
+            # /events.json (graftfleet): the armed scope, served
+            # live, ?since= cursor for incremental scrapes
+            events_fn=graftscope.scope_events_fn)
         print(f"stats: http://127.0.0.1:"
               f"{stats_server.server_address[1]}/metrics "
               f"(+ /healthz)", flush=True)
+        # announce this rank's scrape address to the fleet store
+        # (no-op unless PMDT_FLEET armed a monitor at rendezvous)
+        fleet.publish_endpoint(
+            f"127.0.0.1:{stats_server.server_address[1]}")
         health.to_ready("training")
 
     try:

@@ -101,6 +101,15 @@ RULES: Dict[str, str] = {
              "external supervisor's hook) silently stops firing; "
              "capture with getsignal and CHAIN it, as the trainer's "
              "_install_preemption_handler does",
+    "GL115": "wall-clock timing around a dispatch-only jitted call "
+             "with no block_until_ready/device sync between the "
+             "start and the closing clock read — jax dispatch is "
+             "async, so the stopwatch measures ENQUEUE latency, not "
+             "execution: the reported number is a lie that gets "
+             "faster the less the host waits (sync the result — "
+             "block_until_ready / device_get / profiler.sync — "
+             "inside the timed region, the bench.py readback "
+             "discipline)",
 }
 
 # wrappers that COMPILE (jit family) — GL105/106/107/108 anchor on these
@@ -1036,6 +1045,129 @@ def _check_unpaired_trace(file: _File, out: List[Finding]):
             "utils.profiler.trace, a try/finally, or call stop_trace)"))
 
 
+# GL115: host clocks that start/stop a stopwatch, and the calls that
+# actually force device completion inside a timed region
+_GL115_CLOCKS = {"time.perf_counter", "time.monotonic", "time.time"}
+_GL115_SYNC_ATTRS = {"block_until_ready", "item"}
+_GL115_SYNC_DOTTED = {"jax.block_until_ready", "jax.device_get",
+                      "jax.effects_barrier", "numpy.asarray",
+                      "numpy.array"}
+
+
+def _is_gl115_sync(node: ast.Call, file: _File) -> bool:
+    if (isinstance(node.func, ast.Attribute)
+            and node.func.attr in _GL115_SYNC_ATTRS):
+        return True
+    d = _dotted(node.func, file)
+    if not d:
+        return False
+    # utils.profiler.sync (the framework's one D2H-forcing readback —
+    # what bench.py's window discipline uses) counts however imported
+    return d in _GL115_SYNC_DOTTED or d.endswith("profiler.sync")
+
+
+def _check_unsynced_timing(file: _File, out: List[Finding]):
+    """GL115 (host half) — per HOST function scope (and module scope),
+    the stopwatch idiom ``t0 = clock(); ... jitted(...) ...;
+    dt = clock() - t0`` with NO device sync between the start and the
+    closing read. jax dispatch is asynchronous: the jitted call
+    returns the moment the work is enqueued, so the measured interval
+    is dispatch overhead, not execution — serving_bench's round-1
+    class of lie. Deliberately precise over complete: only bare-name
+    clock starts (``t0 = time.perf_counter()``), only closes that
+    subtract a tracked start (a fresh clock read, or another tracked
+    clock name, minus it), and only dispatch calls the file can prove
+    are jitted (a direct jit root, or a name assigned from
+    ``jax.jit(...)``). A sync anywhere in [start, close] — including
+    the trainer's ``device_get`` windowed fetch and bench.py's
+    ``profiler.sync`` readback — silences the finding."""
+    module_jit_names = {
+        t.id for node in ast.iter_child_nodes(file.tree)
+        if isinstance(node, ast.Assign)
+        and isinstance(node.value, ast.Call)
+        and _is_jit(_dotted(node.value.func, file))
+        for t in node.targets if isinstance(t, ast.Name)}
+
+    def is_jit_dispatch(node: ast.Call, scope: Optional[_Func],
+                        jit_names: Set[str]) -> bool:
+        f = node.func
+        if isinstance(f, ast.Call):  # jax.jit(f)(x) inline
+            return _is_jit(_dotted(f.func, file))
+        if not isinstance(f, ast.Name):
+            return False
+        if f.id in jit_names or f.id in module_jit_names:
+            return True
+        target = _resolve_local(file, f.id, scope)
+        return target is not None and target.root_statics is not None
+
+    scopes: List[Optional[_Func]] = [None] + [
+        fn for fn in file.funcs if not fn.jit_scoped]
+    for scope in scopes:
+        nodes = list(_iter_own(scope.node) if scope is not None
+                     else _iter_own(file.tree))
+        # local names bound from jax.jit(...) in this scope
+        jit_names = {
+            t.id for node in nodes
+            if isinstance(node, ast.Assign)
+            and isinstance(node.value, ast.Call)
+            and _is_jit(_dotted(node.value.func, file))
+            for t in node.targets if isinstance(t, ast.Name)}
+        # clock-start bindings: name -> lines it was bound at
+        starts: Dict[str, List[int]] = {}
+        sync_lines: List[int] = []
+        dispatch_lines: List[int] = []
+        closes: List[Tuple[ast.AST, str]] = []  # (sub node, start name)
+        for node in nodes:
+            if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call) and _dotted(
+                    node.value.func, file) in _GL115_CLOCKS:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        starts.setdefault(t.id, []).append(node.lineno)
+            if isinstance(node, ast.Call):
+                if _is_gl115_sync(node, file):
+                    sync_lines.append(node.lineno)
+                elif is_jit_dispatch(node, scope, jit_names):
+                    dispatch_lines.append(node.lineno)
+            if (isinstance(node, ast.BinOp)
+                    and isinstance(node.op, ast.Sub)
+                    and isinstance(node.right, ast.Name)):
+                # candidate close; judged after the loop, once every
+                # start binding is known (_iter_own's visit order is
+                # not source order)
+                closes.append((node, node.right.id))
+        for node, start_name in closes:
+            if start_name not in starts:
+                continue
+            left = node.left
+            left_is_clock = (
+                (isinstance(left, ast.Call)
+                 and _dotted(left.func, file) in _GL115_CLOCKS)
+                or (isinstance(left, ast.Name) and left.id in starts
+                    and left.id != start_name))
+            if not left_is_clock:
+                continue
+            close_line = node.lineno
+            bound = [ln for ln in starts.get(start_name, [])
+                     if ln < close_line]
+            if not bound:
+                continue
+            start_line = max(bound)
+            timed_dispatch = any(start_line < ln <= close_line
+                                 for ln in dispatch_lines)
+            synced = any(start_line <= ln <= close_line
+                         for ln in sync_lines)
+            if timed_dispatch and not synced:
+                out.append(Finding(
+                    file.path, close_line, node.col_offset, "GL115",
+                    f"wall-clock close over `{start_name}` times a "
+                    "dispatch-only jitted call with no "
+                    "block_until_ready/device sync inside the timed "
+                    "region — async dispatch makes this latency a "
+                    "lie (sync the result before stopping the "
+                    "clock, as bench.py's readback does)"))
+
+
 def _check_signal_discard(file: _File, out: List[Finding]):
     """GL114 — ``signal.signal(sig, handler)`` installing a FRESH
     handler (a lambda, or a name resolving to a def in this file)
@@ -1216,6 +1348,7 @@ def analyze_files(paths: Sequence[str],
         _check_swallowed_except(f, findings)
         _check_unpaired_trace(f, findings)
         _check_signal_discard(f, findings)
+        _check_unsynced_timing(f, findings)
         for fn in f.funcs:
             if fn.jit_scoped:
                 _check_jit_scoped_body(fn, findings)

@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..runtime import fleet as graftfleet
+from ..runtime import scope as graftscope
 from ..utils.compat import shard_map
 from .mesh import DATA_AXIS
 
@@ -109,6 +111,17 @@ def all_reduce(x, mesh: Mesh, axis_name: str = DATA_AXIS, op: str = "sum"):
             f"leading dim {x.shape[0]} != size of mesh axis "
             f"{axis_name!r} ({mesh.shape[axis_name]})"
         )
+    # graftfleet: stamp this rank's arrival at the boundary with the
+    # STATIC per-member payload bytes — host metadata (.nbytes), never
+    # a device read (it matches the psum bytes the graftcheck budget
+    # commits for this program). The emitted event is an INSTANT, not
+    # a span: the jitted call below is dispatch-only, and timing it
+    # here would be exactly the async-dispatch lie GL115 flags.
+    per_member_bytes = int(x.nbytes // x.shape[0]) if x.shape[0] else 0
+    graftfleet.note_arrival(f"all_reduce@{axis_name}", axis=axis_name,
+                            nbytes=per_member_bytes)
+    graftscope.emit("collective.all_reduce", cat="collective",
+                    axis=axis_name, op=op, nbytes=per_member_bytes)
     return _all_reduce_program(x, mesh, axis_name, op)
 
 

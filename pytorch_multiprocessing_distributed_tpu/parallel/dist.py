@@ -26,6 +26,8 @@ from typing import Optional
 
 import jax
 
+from ..runtime import fleet as graftfleet
+from ..runtime import scope as graftscope
 from ..runtime.faults import (maybe_fault, register_site,
                               run_with_timeout)
 
@@ -66,7 +68,14 @@ def gate_collectives() -> None:
     """Run the liveness gate if one is armed (no-op otherwise). Call
     at any host boundary that is about to enter (or dispatch work
     containing) a collective a dead peer would wedge — the step
-    loops' windowed-fetch boundaries do."""
+    loops' windowed-fetch boundaries do.
+
+    graftfleet: the arrival stamp lands FIRST (one module-global read
+    when no fleet is armed) — this rank's arrival at the boundary is
+    the straggler report's raw datum, and it must record even when
+    the gate then raises a named PeerLostError (the stamp is exactly
+    how the collector sees who was alive and when)."""
+    graftfleet.note_arrival("dist.gate")
     gate = _collective_gate
     if gate is not None:
         gate()
@@ -216,6 +225,12 @@ def _store_rendezvous(timeout: float):
 
     heal.monitor_from_env(store, str(rank),
                           [str(i) for i in range(world)])
+    # graftfleet env hook: PMDT_FLEET=<run_uid> arms the fleet
+    # monitor over the SAME store — rank-tagged events, clock
+    # handshake, endpoint publication, collective arrival stamps
+    # (no-op when unset)
+    graftfleet.monitor_from_env(store, socket.gethostname(), rank,
+                                world)
     return coordinator, world, rank
 
 
@@ -300,6 +315,7 @@ def destroy_process_group() -> None:
     from ..runtime import heal
 
     heal.disarm()
+    graftfleet.disarm()
     if _initialized and jax.process_count() > 1:
         jax.distributed.shutdown()
     if _store is not None:
@@ -334,10 +350,20 @@ def barrier(name: str = "barrier") -> None:
     """Block until every host arrives (control-plane sync). An
     injected fault here surfaces named (fail fast) — a half-synced
     fleet must never proceed silently, and with graftheal armed a
-    DEAD peer fails this barrier named BEFORE anyone blocks in it."""
+    DEAD peer fails this barrier named BEFORE anyone blocks in it.
+
+    graftfleet: this rank's arrival is stamped to the store and the
+    blocking sync itself is a ``collective.barrier`` span — the wait
+    INSIDE the span is precisely this rank's lead over the last
+    arriver, so barrier spans and the straggler report cross-check."""
     gate_collectives()
     maybe_fault(_SITE_RENDEZVOUS)
+    graftfleet.note_arrival(f"barrier:{name}")
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
-        multihost_utils.sync_global_devices(name)
+        # attr key must not be "name" — span() binds that to the
+        # event name (and the attr would clobber it in to_dict)
+        with graftscope.span("collective.barrier", cat="collective",
+                             barrier=name):
+            multihost_utils.sync_global_devices(name)

@@ -24,7 +24,13 @@ control plane — rendezvous, barriers, health keys — is C++:
   :class:`~.faults.PeerLostError` on every survivor), coordinated
   poison-key abort, the bounded-restart :class:`~.heal.Supervisor`,
   and graceful drain (health state machine + request-redelivery
-  journal) for serving.
+  journal) for serving;
+- :mod:`.fleet` — graftfleet: cross-host observability — rank-tagged
+  events + the store-mediated clock handshake, the
+  :class:`~.fleet.FleetCollector` (merged per-rank timeline +
+  rank-labelled gauges), per-rank collective arrival stamps feeding
+  a named-straggler report, and the :class:`~.fleet.GoodputLedger`
+  (productive-vs-lost wall-time accounting on every live snapshot).
 """
 
 from .faults import (DeadlineExceeded, FaultInjected, FaultPlan,

@@ -387,8 +387,10 @@ def retry_with_backoff(fn: Callable, *, attempts: int = 3,
                 raise
             # visible on the timeline BEFORE the on_retry hook runs —
             # a retry that crashes its own metrics hook still shows
+            # delay_s is the backoff about to be slept — the goodput
+            # ledger's fault_retry lost-seconds payload (graftfleet)
             _scope.emit("fault.retry", cat="fault", attempt=attempt,
-                        error=type(e).__name__)
+                        error=type(e).__name__, delay_s=delay)
             if on_retry is not None:
                 on_retry(attempt, e)
             if delay > 0:

@@ -66,9 +66,11 @@ from typing import Callable, Deque, Dict, List, Optional, Sequence
 
 __all__ = [
     "Event", "Scope", "arm", "disarm", "active_scope", "scoped",
+    "set_identity", "get_identity",
     "emit", "span", "emit_span", "flight_dump",
     "to_chrome_trace", "write_chrome_trace", "write_jsonl",
-    "events_from_jsonl", "prometheus_text", "start_stats_server",
+    "events_from_jsonl", "prometheus_text", "scope_events_fn",
+    "start_stats_server",
     "flight_recorder", "add_cli_args", "arm_from_args",
     "export_from_args",
 ]
@@ -114,6 +116,26 @@ class Event:
 
 _SEQ = itertools.count()
 
+# graftfleet: process-wide identity tags ((host, rank, run_uid) — set
+# by runtime.fleet.arm) merged into every RECORDED event's attrs, so a
+# fleet collector can lane-split a merged timeline by rank. One module
+# global; None (the default) adds nothing anywhere — and the merge
+# only runs inside Scope.record, which a disarmed process never
+# reaches, so the disarmed hot-path cost contract is untouched.
+_IDENTITY: Optional[Dict] = None
+
+
+def set_identity(identity: Optional[Dict]) -> None:
+    """Install (or with None clear) the identity tags every recorded
+    event carries from here on. Existing attrs win on collision —
+    an event that explicitly names a rank keeps its own."""
+    global _IDENTITY
+    _IDENTITY = dict(identity) if identity else None
+
+
+def get_identity() -> Optional[Dict]:
+    return dict(_IDENTITY) if _IDENTITY is not None else None
+
 
 class Scope:
     """An armed event sink.
@@ -143,6 +165,10 @@ class Scope:
         self._mu = threading.Lock()
 
     def record(self, event: Event) -> None:
+        identity = _IDENTITY
+        if identity is not None:
+            for key, value in identity.items():
+                event.attrs.setdefault(key, value)
         with self._mu:
             if self.keep:
                 self.log.append(event)
@@ -155,6 +181,22 @@ class Scope:
         ``keep=False``), in record order."""
         with self._mu:
             return list(self.log) if self.keep else list(self.ring)
+
+    def events_since(self, start: int):
+        """Incremental read: ``(events, next_start)`` — the retained
+        events whose STREAM index (count of events ever recorded) is
+        ``>= start``, plus the cursor to pass next time. A periodic
+        consumer (graftfleet's goodput scrape) stays O(new events) per
+        call instead of re-copying the whole log. In ring mode events
+        older than the ring are gone — a too-old ``start`` yields what
+        is left (downstream seq cursors make that a visible
+        undercount, never a double count)."""
+        with self._mu:
+            if self.keep:
+                return self.log[start:], len(self.log)
+            base = self.dropped
+            items = list(self.ring)[max(0, start - base):]
+            return items, base + len(self.ring)
 
     def tail(self) -> List[Event]:
         """The flight-recorder window: the most recent events."""
@@ -461,10 +503,26 @@ def prometheus_text(snapshot: Dict, prefix: str = "pmdt_serving"
     return "\n".join(lines) + "\n"
 
 
+def scope_events_fn(since: int = 0) -> List[Dict]:
+    """The standard ``events_fn`` for :func:`start_stats_server`: the
+    ARMED scope's retained events from stream index ``since`` as
+    ``to_dict`` rows ([] when disarmed). Reading through the module
+    global — not a captured Scope — means a re-armed scope (a
+    supervised restart) is served live, never a dead incarnation's
+    log; the ``since`` cursor keeps periodic scrapes O(new events)."""
+    s = _SCOPE
+    if s is None:
+        return []
+    events, _ = s.events_since(max(0, int(since)))
+    return [e.to_dict() for e in events]
+
+
 def start_stats_server(snapshot_fn: Callable[[], Dict], port: int = 0,
                        host: str = "127.0.0.1",
                        prefix: str = "pmdt_serving",
-                       health_fn: Optional[Callable[[], Dict]] = None):
+                       health_fn: Optional[Callable[[], Dict]] = None,
+                       events_fn: Optional[Callable[[int], List[Dict]]]
+                       = None):
     """Serve live telemetry over stdlib ``http.server`` (daemon
     thread): ``/metrics`` is the Prometheus text exposition of
     ``snapshot_fn()``, ``/snapshot.json`` the raw JSON snapshot.
@@ -478,6 +536,16 @@ def start_stats_server(snapshot_fn: Callable[[], Dict], port: int = 0,
     consumes (a DRAINING engine stops receiving traffic the moment it
     flips, without racing its queue). Without ``health_fn`` the path
     404s like any other.
+
+    ``events_fn`` (graftfleet) adds ``/events.json``: called as
+    ``events_fn(since)`` where ``since`` is the stream cursor from
+    the optional ``?since=N`` query (0 without one); returns the
+    recorded event dicts from that point (``Event.to_dict`` rows —
+    the JSONL schema as one JSON array). :func:`scope_events_fn` is
+    the standard source (the ARMED scope, re-arms followed live); a
+    :class:`~.fleet.FleetCollector` scrapes the full array for the
+    merged per-rank timeline, while a periodic consumer passes the
+    count it already holds to stay O(new events) per scrape.
     """
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -490,6 +558,19 @@ def start_stats_server(snapshot_fn: Callable[[], Dict], port: int = 0,
                     ctype = "text/plain; version=0.0.4"
                 elif self.path.startswith("/snapshot.json"):
                     body = json.dumps(snapshot_fn(), sort_keys=True)
+                    ctype = "application/json"
+                elif (self.path.startswith("/events.json")
+                        and events_fn is not None):
+                    since = 0
+                    if "?" in self.path:
+                        from urllib.parse import parse_qs, urlsplit
+
+                        query = parse_qs(urlsplit(self.path).query)
+                        try:
+                            since = int(query.get("since", ["0"])[0])
+                        except ValueError:
+                            since = 0
+                    body = json.dumps(events_fn(since), default=repr)
                     ctype = "application/json"
                 elif (self.path.startswith("/healthz")
                         and health_fn is not None):

@@ -139,10 +139,18 @@ def lowered_program_analysis(fn, *args, **kwargs):
     shapes by ``utils.compat.memory_analysis_dict``) or None when the
     backend exposes no memory model. Same lowering, same executable —
     the static memory budget in ``analysis/costs.json``, the bench's
-    roofline stamp, and the auditor's HLO all read ONE program."""
+    roofline stamp, and the auditor's HLO all read ONE program.
+
+    The compile is a ``compile.lower`` graftscope span (cat
+    ``compile``) — the goodput ledger's compile category; host-side
+    only, and a no-op when no scope is armed."""
+    from ..runtime import scope as graftscope
     from .compat import cost_analysis_dict, memory_analysis_dict
 
-    compiled = fn.lower(*args, **kwargs).compile()
+    with graftscope.span("compile.lower", cat="compile",
+                         what=getattr(fn, "__name__",
+                                      type(fn).__name__)):
+        compiled = fn.lower(*args, **kwargs).compile()
     return (compiled, cost_analysis_dict(compiled),
             memory_analysis_dict(compiled))
 

@@ -13,7 +13,7 @@ with a p99 of 900 ms is a broken service that averages fine.
 from __future__ import annotations
 
 import math
-from typing import Dict, List, Sequence
+from typing import Dict, List, Optional, Sequence
 
 
 class AverageMeter:
@@ -66,7 +66,7 @@ def exact_percentile(values: Sequence[float], q: float) -> float:
 
 
 class PercentileMeter(AverageMeter):
-    """AverageMeter that also keeps every sample for exact percentiles.
+    """AverageMeter that also keeps samples for exact percentiles.
 
     - drop-in: ``val``/``avg``/``sum``/``count`` behave exactly like
       the base meter (weighted ``update(v, n)`` records ``v`` n times,
@@ -77,20 +77,59 @@ class PercentileMeter(AverageMeter):
       recorded since the last :meth:`advance_window` — the
       steady-state delta ``ServingMetrics.snapshot_delta`` builds on.
 
-    Samples are kept in full (exactness beats estimation at serving
-    scale: one float per request/step, bounded by the run). A system
-    that outgrows that switches to a sketch — and loses the "exact"
-    in the test name with it.
+    Memory: uncapped by default (every sample kept — exactness over
+    the whole run; the mode every test and short bench wants). A
+    LONG-RUNNING server grows without bound on that mode, so
+    ``max_samples`` (constructor, or :meth:`bound` on a live meter —
+    the CLIs arm it wherever ``ServingMetrics`` backs a stats server)
+    caps retention to the most recent ``max_samples``: percentiles
+    stay EXACT over that window (and bit-identical to the uncapped
+    meter until the cap is first exceeded), while ``avg``/``sum``/
+    ``count`` remain run-total. A sliding exact window beats a
+    sketch here: the tail stats stay testably exact and recent —
+    which is what a dashboard wants anyway — at a bounded, chosen
+    cost.
     """
+
+    def __init__(self, max_samples: Optional[int] = None) -> None:
+        if max_samples is not None and int(max_samples) < 2:
+            raise ValueError(
+                f"max_samples must be >= 2 (or None), got {max_samples}")
+        # set before super().__init__() — the base constructor calls
+        # reset(), which reads the cap
+        self.max_samples = None if max_samples is None \
+            else int(max_samples)
+        super().__init__()
 
     def reset(self) -> None:
         super().reset()
         self.values: List[float] = []
+        # window start / discard counts are ABSOLUTE sample indices,
+        # so the windowed view survives cap trimming
         self._window_start = 0
+        self._discarded = 0
+
+    def _trim(self) -> None:
+        cap = self.max_samples
+        if cap is not None and len(self.values) > cap:
+            drop = len(self.values) - cap
+            del self.values[:drop]
+            self._discarded += drop
 
     def update(self, val, n: int = 1) -> None:
         super().update(val, n)
         self.values.extend([val] * n)
+        self._trim()
+
+    def bound(self, max_samples: int) -> None:
+        """Arm (or tighten) the retention cap on a live meter,
+        trimming immediately — the ``--stats_port`` arming hook."""
+        if int(max_samples) < 2:
+            raise ValueError(
+                f"max_samples must be >= 2, got {max_samples}")
+        if self.max_samples is None or int(max_samples) < self.max_samples:
+            self.max_samples = int(max_samples)
+        self._trim()
 
     def percentile(self, q: float) -> float:
         return exact_percentile(self.values, q)
@@ -106,7 +145,8 @@ class PercentileMeter(AverageMeter):
 
     # ---- windowed (steady-state) view ----
     def window_values(self) -> List[float]:
-        return self.values[self._window_start:]
+        start = max(0, self._window_start - self._discarded)
+        return self.values[start:]
 
     def window_stats(self, qs: Sequence[float] = (50, 95, 99)
                      ) -> Dict[str, float]:
@@ -122,7 +162,7 @@ class PercentileMeter(AverageMeter):
 
     def advance_window(self) -> None:
         """Start a fresh window at the current sample count."""
-        self._window_start = len(self.values)
+        self._window_start = self._discarded + len(self.values)
 
     def __repr__(self) -> str:
         return (

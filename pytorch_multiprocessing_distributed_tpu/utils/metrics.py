@@ -170,6 +170,18 @@ class ServingMetrics:
         self._queue_wait_max = 0.0
         self._delta_base: dict = {}
 
+    def bound_samples(self, max_samples: int) -> None:
+        """Cap every percentile meter's sample retention (graftfleet):
+        a LIVE server scraped forever must not grow one float per
+        request without bound. Percentiles stay exact over the most
+        recent ``max_samples``; counters and averages stay run-total.
+        The CLIs arm this whenever ``--stats_port`` puts these meters
+        behind a long-running stats server; tests and short benches
+        keep the uncapped default."""
+        for meter in (self.ttft, self.queue_wait, self.decode_step,
+                      self.request_tokens):
+            meter.bound(max_samples)
+
     def record_first_token(self, ttft_seconds: float) -> None:
         self.ttft.update(ttft_seconds)
         self.tokens_generated += 1

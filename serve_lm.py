@@ -54,7 +54,7 @@ import json
 import sys
 
 from pytorch_multiprocessing_distributed_tpu.runtime import (
-    heal, scope as graftscope)
+    fleet, heal, scope as graftscope)
 from pytorch_multiprocessing_distributed_tpu.utils.compile_cache import (
     enable_compilation_cache)
 
@@ -363,7 +363,12 @@ def main():
             # live telemetry beside the serving loop: /metrics
             # (Prometheus) + /snapshot.json + /healthz (200 only while
             # READY — the replica router's probe); the graftmeter
-            # hbm_* gauges ride the same snapshot
+            # hbm_* gauges and the graftfleet goodput_* gauges ride
+            # the same snapshot. A live server's percentile meters
+            # are CAPPED (graftfleet): exact tails over the most
+            # recent window, bounded memory over an unbounded run.
+            engine.metrics.bound_samples(8192)
+            fleet.arm_goodput()
 
             def live_snapshot():
                 snap = engine.metrics.snapshot()
@@ -372,15 +377,26 @@ def main():
                     snap.update(ledger.snapshot())
                     snap["hbm_per_slot_bytes"] = \
                         engine.pool.per_slot_bytes
+                snap.update(fleet.goodput_gauges())
                 return snap
 
             stats_server = graftscope.start_stats_server(
                 live_snapshot, port=args.stats_port,
                 health_fn=lambda: heal.healthz(
-                    engine.health, heal.active_monitor()))
+                    engine.health, heal.active_monitor()),
+                # /events.json (graftfleet): the fleet collector's
+                # merged-timeline feed — reads the ARMED scope live
+                # (follows re-arms), ?since= cursor for incremental
+                # scrapes
+                events_fn=graftscope.scope_events_fn)
             print(f"stats: http://127.0.0.1:"
                   f"{stats_server.server_address[1]}/metrics "
                   f"(+ /healthz)", flush=True)
+            # graftfleet: announce this replica's scrape address to
+            # the fleet store (no-op unless PMDT_FLEET armed a
+            # monitor at rendezvous)
+            fleet.publish_endpoint(
+                f"127.0.0.1:{stats_server.server_address[1]}")
         try:
             # a crash anywhere in the drive loop leaves the flight
             # ring on disk before propagating (engine-internal fatals
@@ -490,6 +506,9 @@ def main():
     if hbm.active_ledger() is not None:
         snap.update(hbm.active_ledger().snapshot())
         snap["hbm_per_slot_bytes"] = engine.pool.per_slot_bytes
+    # graftfleet: goodput fraction on the final record too ({} when
+    # --stats_port never armed the ledger)
+    snap.update(fleet.goodput_gauges())
     print("metrics: " + json.dumps(snap, sort_keys=True), flush=True)
     if args.metrics_out:
         with open(args.metrics_out, "w") as f:

@@ -1,0 +1,55 @@
+"""GL115 near-miss: the honest timing disciplines — a device sync
+inside the timed region (block_until_ready / device_get /
+profiler.sync, the bench.py readback shape), timing around a plain
+host call, and a dispatch that happens BEFORE the stopwatch starts."""
+import time
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_multiprocessing_distributed_tpu.utils.profiler import sync
+
+
+@jax.jit
+def step(x):
+    return jnp.sum(x) * 2
+
+
+def honest_block(x):
+    t0 = time.perf_counter()
+    y = step(x)
+    jax.block_until_ready(y)
+    return time.perf_counter() - t0
+
+
+def honest_method(x):
+    t0 = time.perf_counter()
+    y = step(x)
+    y.block_until_ready()
+    dt = time.perf_counter() - t0
+    return y, dt
+
+
+def honest_readback(x):
+    t0 = time.perf_counter()
+    y = step(x)
+    sync(y)
+    return time.perf_counter() - t0
+
+
+def not_jitted(x):
+    t0 = time.perf_counter()
+    y = host_work(x)
+    return time.perf_counter() - t0
+
+
+def host_work(x):
+    return [v * 2 for v in x]
+
+
+def dispatch_outside_the_clock(x):
+    y = step(x)
+    jax.block_until_ready(y)
+    t0 = time.perf_counter()
+    z = host_work(x)
+    return z, time.perf_counter() - t0

@@ -1,0 +1,35 @@
+"""GL115 positive: wall-clock timing around a dispatch-only jitted
+call — jax dispatch is async, so the stopwatch stops before the device
+finishes and the reported latency is a lie (it gets FASTER the less
+the host waits)."""
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(x):
+    return jnp.sum(x) * 2
+
+
+def benched(x):
+    t0 = time.perf_counter()
+    y = step(x)
+    dt = time.perf_counter() - t0                  # <- GL115
+    return y, dt
+
+
+def benched_local_wrap(f, x):
+    fast = jax.jit(f)
+    start = time.monotonic()
+    y = fast(x)
+    elapsed = time.monotonic() - start             # <- GL115
+    return y, elapsed
+
+
+def benched_two_reads(x):
+    t0 = time.perf_counter()
+    y = step(x)
+    t1 = time.perf_counter()
+    return y, t1 - t0                              # <- GL115

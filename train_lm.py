@@ -539,21 +539,36 @@ def _run(args):
     health = None
     if args.stats_port:
         health = heal.HealthState()
+        # graftfleet: goodput_* gauges beside the loss/throughput and
+        # hbm_* gauges — classified from the spans the loop already
+        # emits (window/data/fetch/checkpoint/restart)
+        from pytorch_multiprocessing_distributed_tpu.runtime import (
+            fleet)
+
+        fleet.arm_goodput()
 
         def live_snapshot():
             snap = dict(live)
             ledger = hbm.active_ledger()
             if ledger is not None:
                 snap.update(ledger.snapshot())
+            snap.update(fleet.goodput_gauges())
             return snap
 
         stats_server = graftscope.start_stats_server(
             live_snapshot, port=args.stats_port, prefix="pmdt",
             health_fn=lambda: heal.healthz(health,
-                                           heal.active_monitor()))
+                                           heal.active_monitor()),
+            # /events.json (graftfleet): the armed scope, served
+            # live, ?since= cursor for incremental scrapes
+            events_fn=graftscope.scope_events_fn)
         print(f"stats: http://127.0.0.1:"
               f"{stats_server.server_address[1]}/metrics "
               f"(+ /healthz)", flush=True)
+        # announce this rank's scrape address to the fleet store
+        # (no-op unless PMDT_FLEET armed a monitor at rendezvous)
+        fleet.publish_endpoint(
+            f"127.0.0.1:{stats_server.server_address[1]}")
         health.to_ready("training")
 
     os.makedirs(args.save_path, exist_ok=True)
@@ -585,6 +600,7 @@ def _run(args):
             batches = (prefetch_to_device(loader, mesh) if use_prefetch
                        else loader)
             t_ready = time.perf_counter() if armed else 0.0
+            t_window = t_ready  # window wall anchor (armed only)
             for i, batch in enumerate(batches):
                 if armed:
                     # data wait: time from step dispatch to the next
@@ -621,6 +637,17 @@ def _run(args):
                             np.asarray(metrics.get('skipped', 0)))
                         loss = (None if skipped
                                 else float(np.asarray(metrics['loss'])))
+                    if armed:
+                        # the window span: this fetch boundary is the
+                        # one honest per-window timing point under
+                        # async dispatch — and the PRODUCTIVE span the
+                        # goodput ledger classifies (its nested
+                        # train.data waits are subtracted there)
+                        now = time.perf_counter()
+                        graftscope.emit_span(
+                            "train.window", now - t_window,
+                            cat="train", epoch=epoch, batch=i)
+                        t_window = now
                     if skipped:
                         # NaN/inf grad guard refused this step — its
                         # loss is the poisoned batch's (possibly NaN);
