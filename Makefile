@@ -4,7 +4,7 @@
 PYTEST_ENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 
 .PHONY: test test-fast lint check check-update chaos soak scope meter \
-        fleet dryrun bench bench-cpu store clean
+        fleet spec dryrun bench bench-cpu store clean
 
 # graftlint: AST-only jit-hygiene gate (no jax import, milliseconds).
 # Exit 1 on any non-baselined finding; the tier-1 suite and
@@ -74,6 +74,16 @@ meter:
 # tests/test_graftfleet.py).
 fleet:
 	$(PYTEST_ENV) python benchmarks/fleet_smoke.py
+
+# graftspec: speculative-decode smoke — the spec engine's greedy
+# streams must be byte-identical to the non-speculative engine AND
+# generate(), a repetitive stream must clear >1.0 accepted tokens per
+# target-model step in FEWER dispatches, k=0 must run zero spec
+# passes, and acceptance telemetry + goodput_spec_waste_s must ride
+# the bus. Same body runs in tier-1 (test_spec_smoke_end_to_end in
+# tests/test_graftspec.py).
+spec:
+	$(PYTEST_ENV) python benchmarks/spec_smoke.py
 
 # full suite on the virtual 8-device CPU mesh (incl. slow e2e CLI runs)
 test:

@@ -7,6 +7,7 @@
 #                                      non-causal — backs COVERAGE.md)
 #   benchmarks/generate_bench_tpu.txt  (decode tokens/sec)
 #   benchmarks/serving_bench_tpu.json  (load + length-bucket sweeps)
+#   benchmarks/serving_bench_spec_tpu.json (graftspec accepted/step)
 #   benchmarks/mfu_tune_results.json   (resnet50 flag/batch sweep)
 #   benchmarks/convergence_record.json (framework-on-TPU vs torch-CPU)
 # Prints a section header per step; steps are independent — a failure
@@ -44,6 +45,13 @@ python benchmarks/serving_bench.py \
     --json_out benchmarks/serving_bench_paged_tpu.json \
     > benchmarks/serving_bench_paged_tpu.txt 2>&1
 tail -16 benchmarks/serving_bench_paged_tpu.txt >&2
+
+note "serving bench (graftspec: accepted/target-step x k x draft source)"
+python benchmarks/serving_bench.py \
+    --sweep spec --draft_model gpt_tiny \
+    --json_out benchmarks/serving_bench_spec_tpu.json \
+    > benchmarks/serving_bench_spec_tpu.txt 2>&1
+tail -20 benchmarks/serving_bench_spec_tpu.txt >&2
 
 note "MFU tune sweep (resnet50 north star)"
 python benchmarks/mfu_tune.py --config resnet50_imagenet

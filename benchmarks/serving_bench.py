@@ -51,7 +51,24 @@ Three sweeps over the continuous-batching :class:`ServingEngine`:
    full hit skips prefill entirely: state splice + at most one COW
    page fork). Paged streams are asserted token-exact vs dense.
 
-6. **Drain sweep** (``--sweep drain``, graftheal): the elastic-
+6. **Spec sweep** (``--sweep spec``, graftspec): speculative decode —
+   accepted-tokens/target-step, TTFT and decode tok/s at draft length
+   k ∈ {0, 2, 4, 8} x draft source {self-draft n-gram, draft model}
+   on REPETITIVE vs RANDOM prompt families. The repetitive family's
+   target is briefly trained on the motif stream (a few seconds of
+   SGD) so its greedy continuation genuinely continues the pattern —
+   acceptance is then structural, not luck; the random family is the
+   adversarial floor (acceptance ~0, and the adaptive
+   ``pick_draft_k`` ladder collapses k so throughput holds). Points
+   of record: ``spec_accepted_per_target_step`` > 1.0 on the
+   repetitive config (more tokens per weight stream — THE speculative
+   claim), accept_len p50/p95/p99 in the JSON, and k=0 reproducing
+   the non-speculative engine exactly (no spec passes, same program
+   ladder — disarmed costs nothing). Off-TPU the draft model is the
+   target itself (structural full acceptance — the mode's smoke);
+   on TPU pass ``--draft_model`` for a real small-drafts-big setup.
+
+7. **Drain sweep** (``--sweep drain``, graftheal): the elastic-
    lifecycle latencies. Point one: **drain latency** — a loaded
    engine flips to DRAINING mid-serve (the SIGTERM path) and the
    clock runs until every in-flight request finished (admission
@@ -241,6 +258,17 @@ def run_point(model, params, prompts, new_tokens, slots, offered_rps,
         "dispatch_retries": snap["dispatch_retries"],
         "requests_failed": snap["requests_failed"],
         "horizon_collapses": snap["horizon_collapses"],
+        # graftspec telemetry (all zero when spec is disarmed)
+        "spec_tokens_drafted": snap["spec_tokens_drafted"],
+        "spec_tokens_accepted": snap["spec_tokens_accepted"],
+        "spec_verify_passes": snap["spec_verify_passes"],
+        "spec_accept_rate": snap["spec_accept_rate"],
+        "spec_accepted_per_target_step":
+            snap["spec_accepted_per_target_step"],
+        "accept_len_p50": snap["accept_len_p50"],
+        "accept_len_p95": snap["accept_len_p95"],
+        "accept_len_p99": snap["accept_len_p99"],
+        "spec_programs": [list(p) for p in engine.spec_programs],
         "injected": (arm_plan.triggered() - injected_base
                      if arm_plan is not None else 0),
     }
@@ -567,6 +595,125 @@ def run_paged_sweep(model, params, args, rng):
     return results
 
 
+def train_repetitive(model, params, motif, steps=60, lr=0.1,
+                     seq=64, batch=8, seed=0):
+    """Quick plain-SGD fit of ``model`` on the cyclic ``motif``
+    stream (a few seconds on CPU for the tiny geometry): repetition is
+    the easiest structure a LM learns, so the trained target's greedy
+    continuation genuinely loops — the spec sweep's repetitive family
+    then measures STRUCTURAL acceptance (the model really continues
+    the pattern the n-gram drafter indexes), not random-params luck."""
+    rng = np.random.default_rng(seed)
+
+    def make_batch():
+        rows = []
+        for _ in range(batch):
+            off = int(rng.integers(0, len(motif)))
+            rows.append([motif[(off + i) % len(motif)]
+                         for i in range(seq)])
+        return jnp.asarray(rows, jnp.int32)
+
+    def loss_fn(p, toks):
+        logits = model.apply({"params": p}, toks, train=False)
+        lp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), -1)
+        return -jnp.mean(jnp.take_along_axis(
+            lp, toks[:, 1:][..., None], -1))
+
+    step = jax.jit(lambda p, t: jax.tree.map(
+        lambda a, g: (a - lr * g).astype(a.dtype), p,
+        jax.grad(loss_fn)(p, t)))
+    for _ in range(steps):
+        params = step(params, make_batch())
+    return params
+
+
+def run_spec_sweep(model, params, args, rng):
+    """Speculative-decode grid (graftspec): {repetitive, random}
+    prompts x {self-draft, draft-model} x k. See the module docstring
+    (sweep 6). Asserted invariants: k=0 runs ZERO spec passes with
+    the non-spec program ladder (disarmed reproduces the plain
+    engine), and the repetitive family's best k>0 point clears >1.0
+    accepted tokens per target step."""
+    from pytorch_multiprocessing_distributed_tpu import models
+
+    platform = jax.devices()[0].platform
+    ks = [int(x) for x in args.spec_ks.split(",")]
+    new_tokens = max(args.new_tokens, 48)
+    motif = [7, 19, 3, 42, 11, 58, 23, 5]
+    motif = [t % model.vocab_size for t in motif]
+    n_req = min(args.requests, 4 if platform != "tpu" else args.requests)
+    s_max = min(model.max_seq_len, 32 + new_tokens)
+    prompt_len = min(30, s_max - new_tokens - 1)
+
+    # draft model: a REAL registry model on TPU (--draft_model), the
+    # target itself off-TPU (structural acceptance — the mode's smoke)
+    if args.draft_model:
+        draft_model = models.get_model(
+            args.draft_model, dtype=model.dtype,
+            vocab_size=model.vocab_size, attn_impl="xla")
+        from pytorch_multiprocessing_distributed_tpu.serving import (
+            init_params)
+
+        draft_params = init_params(draft_model, 7)
+    else:
+        draft_model, draft_params = model, None  # filled per family
+
+    # the repetitive family's target: briefly trained on the motif
+    rep_params = train_repetitive(model, params, motif)
+    families = {
+        "repetitive": (rep_params,
+                       [[motif[i % len(motif)] for i in range(prompt_len)]
+                        for _ in range(n_req)]),
+        "random": (params,
+                   [rng.integers(0, model.vocab_size,
+                                 (prompt_len,)).tolist()
+                    for _ in range(n_req)]),
+    }
+    results = []
+    best_rep = 0.0
+    for family, (fam_params, prompts) in families.items():
+        for mode in args.spec_modes.split(","):
+            for k in ks:
+                kwargs = dict(decode_buckets=(), decode_horizon=4,
+                              draft_k=k)
+                if k and mode == "model":
+                    kwargs.update(
+                        draft_model=draft_model,
+                        draft_params=(draft_params if draft_params
+                                      is not None else fam_params))
+                elif k == 0 and mode == "model":
+                    continue  # k=0 is mode-less; keep one baseline row
+                r = run_point(model, fam_params, prompts, new_tokens,
+                              min(4, n_req), float("inf"), s_max,
+                              warmup=True, **kwargs)
+                r.update(family=family, mode=(mode if k else "off"),
+                         draft_k=k, new_tokens=new_tokens)
+                results.append(r)
+                if k == 0:
+                    assert r["spec_verify_passes"] == 0, (
+                        "k=0 must run ZERO speculative passes")
+                    assert not r["spec_programs"], (
+                        "k=0 must not compile spec programs")
+                if family == "repetitive" and k:
+                    best_rep = max(
+                        best_rep, r["spec_accepted_per_target_step"])
+                print(f"spec {family:10s} {r['mode']:5s} k={k}  "
+                      f"acc/step={r['spec_accepted_per_target_step']:5.2f}  "
+                      f"rate={r['spec_accept_rate']:4.2f}  "
+                      f"accept_len p50/p95="
+                      f"{r['accept_len_p50']:.1f}/"
+                      f"{r['accept_len_p95']:.1f}  "
+                      f"{r['decode_tokens_per_sec']:8.1f} decode tok/s  "
+                      f"ttft p95={r['ttft_p95_ms']:7.1f} ms", flush=True)
+    assert best_rep > 1.0, (
+        f"repetitive-prompt config must clear >1.0 accepted tokens "
+        f"per target step, got {best_rep:.3f} — the speculative claim "
+        "is the whole point")
+    print(f"# spec: repetitive best accepted/target-step = "
+          f"{best_rep:.2f}", flush=True)
+    return results
+
+
 def run_drain_sweep(model, params, args, rng):
     """Drain latency + post-restart recovery TTFT (graftheal), both
     wall-clocked on a loaded engine; the redelivered streams are
@@ -700,6 +847,17 @@ def main():
     p.add_argument("--horizon_repeats", default=3, type=int,
                    help="horizon sweep: best-of-N runs per point "
                         "(host-noise suppression)")
+    p.add_argument("--spec_ks", default="0,2,4,8", type=str,
+                   help="spec sweep: draft lengths k (0 = the "
+                        "non-speculative baseline the k>0 points "
+                        "must not regress when disarmed)")
+    p.add_argument("--spec_modes", default="self,model", type=str,
+                   help="spec sweep: draft sources (self = n-gram "
+                        "self-drafting, model = draft model)")
+    p.add_argument("--draft_model", default="", type=str,
+                   help="spec sweep: registry name of the draft "
+                        "model ('' = off-TPU smoke uses the target "
+                        "as its own draft)")
     p.add_argument("--json_out", default="", type=str,
                    help="record every sweep point as JSON")
     p.add_argument("--dtype", default="bfloat16",
@@ -740,7 +898,7 @@ def main():
               "requests": args.requests, "new_tokens": args.new_tokens,
               "s_max": s_max, "load_sweep": [], "length_sweep": [],
               "horizon_sweep": [], "chaos_sweep": [], "drain_sweep": [],
-              "paged_sweep": []}
+              "paged_sweep": [], "spec_sweep": []}
     sweeps = args.sweep.split(",")
 
     if "load" in sweeps:
@@ -776,6 +934,10 @@ def main():
     if "paged" in sweeps:
         record["paged_sweep"] = run_paged_sweep(model, params, args,
                                                 rng)
+
+    if "spec" in sweeps:
+        record["spec_sweep"] = run_spec_sweep(model, params, args,
+                                              rng)
 
     if "chaos" in sweeps:
         record["chaos_sweep"] = run_chaos_sweep(model, params, args,

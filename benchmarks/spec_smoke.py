@@ -1,0 +1,130 @@
+"""graftspec smoke: speculative decode end-to-end on the CPU mesh.
+
+The contract, asserted in one short run (same body runs in tier-1 —
+``tests/test_graftspec.py::test_spec_smoke_end_to_end``):
+
+1. **Token-exactness**: the speculative engine's greedy streams
+   (self-draft, dense AND paged) are byte-identical to the
+   non-speculative engine and per-request ``generate()``.
+2. **The speculative claim**: on a repetitive stream (target briefly
+   trained on the motif so continuation is structural), self-drafting
+   clears > 1.0 accepted tokens per target-model step AND finishes in
+   fewer decode dispatches than the non-speculative engine — more
+   tokens per weight stream, which is the whole point.
+3. **Disarmed is free**: k=0 runs zero speculative passes and
+   compiles zero spec programs.
+4. **Telemetry**: acceptance counters/percentiles ride the metrics
+   snapshot, ``spec.verify``/``spec.draft`` land on the graftscope
+   bus, and the GoodputLedger books rejected-draft verify work as
+   ``goodput_spec_waste_s``, not productive time.
+
+Run: ``make spec`` (or ``python benchmarks/spec_smoke.py``).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_smoke():
+    import jax.numpy as jnp
+    import numpy as np
+
+    from benchmarks.serving_bench import train_repetitive
+    from pytorch_multiprocessing_distributed_tpu import models
+    from pytorch_multiprocessing_distributed_tpu.inference import (
+        generate)
+    from pytorch_multiprocessing_distributed_tpu.runtime import (
+        fleet, scope as graftscope)
+    from pytorch_multiprocessing_distributed_tpu.serving import (
+        ServingEngine, init_params)
+
+    model = models.GPT(vocab_size=61, max_seq_len=256, hidden_size=32,
+                       num_layers=2, num_heads=2, mlp_dim=64,
+                       attn_impl="xla")
+    params = init_params(model, 1)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 61, (n,)).tolist() for n in (3, 12)]
+
+    def ref_tail(p, n):
+        out = generate(model, params, jnp.asarray(p)[None, :],
+                       max_new_tokens=n)
+        return np.asarray(out[0, -n:]).tolist()
+
+    # ---- 1: token-exactness, H>1, ragged batch (the FULL pinned
+    # matrix — paged, chunked, TP, EOS, fault quarantine — lives in
+    # tests/test_graftspec.py; the smoke pins the dense core)
+    dense_ref = ServingEngine(model, params, max_slots=2, s_max=32,
+                              min_bucket=8, decode_horizon=4)
+    ref = dense_ref.serve([(p, 6) for p in prompts])
+    spec = ServingEngine(model, params, max_slots=2, s_max=32,
+                         min_bucket=8, decode_horizon=4, draft_k=4)
+    got = spec.serve([(p, 6) for p in prompts])
+    for a, b, p in zip(got, ref, prompts):
+        assert a.tokens == b.tokens == ref_tail(p, 6), (
+            f"speculative stream diverged (prompt len {len(p)}): "
+            f"{a.tokens} vs {b.tokens}")
+    print("spec smoke: token-exact vs non-spec engine AND generate() "
+          "OK")
+
+    # ---- 2: the speculative claim on a repetitive stream
+    motif = [7, 19, 3, 42, 11, 58, 23, 5]
+    rep_params = train_repetitive(model, params, motif, steps=40,
+                                  lr=0.3)
+    prompt = (motif * 6)[:30]
+    scope = graftscope.arm(graftscope.Scope(keep=True))
+    try:
+        spec = ServingEngine(model, rep_params, max_slots=1, s_max=128,
+                             decode_buckets=(), decode_horizon=4,
+                             draft_k=4)
+        (r_spec,) = spec.serve([(prompt, 64)])
+    finally:
+        graftscope.disarm()
+    base = ServingEngine(model, rep_params, max_slots=1, s_max=128,
+                         decode_buckets=(), decode_horizon=4)
+    (r_base,) = base.serve([(prompt, 64)])
+    assert r_spec.tokens == r_base.tokens
+    snap = spec.metrics.snapshot()
+    per_step = snap["spec_accepted_per_target_step"]
+    assert per_step > 1.0, (
+        f"repetitive config must clear >1.0 accepted tokens per "
+        f"target step, got {per_step:.3f}")
+    assert (snap["decode_dispatches"]
+            < base.metrics.snapshot()["decode_dispatches"]), (
+        "speculation must finish the stream in fewer dispatches")
+    assert snap["accept_len_p50"] > 0 and snap["spec_tokens_accepted"]
+    print(f"spec smoke: accepted/target-step={per_step:.2f} "
+          f"(accept p50/p95={snap['accept_len_p50']:.0f}/"
+          f"{snap['accept_len_p95']:.0f}), dispatches "
+          f"{snap['decode_dispatches']} vs "
+          f"{base.metrics.snapshot()['decode_dispatches']} non-spec OK")
+
+    # ---- 4: bus + goodput accounting
+    names = {e.name for e in scope.events()}
+    assert "spec.verify" in names, "spec.verify missing from the bus"
+    assert "spec.draft" in names, "spec.draft missing from the bus"
+    ledger = fleet.GoodputLedger.from_events(scope.events())
+    gauges = ledger.gauges()
+    assert "goodput_spec_waste_s" in gauges
+    assert gauges["goodput_spec_waste_s"] >= 0.0
+    verify = [e for e in scope.events() if e.name == "spec.verify"]
+    assert all(e.attrs["accepted"] <= e.attrs["drafted"]
+               for e in verify)
+    print(f"spec smoke: bus + goodput OK (spec_waste="
+          f"{gauges['goodput_spec_waste_s']:.4f}s over "
+          f"{len(verify)} verify spans)")
+
+    # ---- 3: disarmed spec is the plain engine (the draft_k=0
+    # reference above IS the disarmed engine — no spec telemetry, no
+    # spec programs)
+    snap_off = dense_ref.metrics.snapshot()
+    assert snap_off["spec_verify_passes"] == 0
+    assert snap_off["spec_tokens_drafted"] == 0
+    assert dense_ref.spec_programs == ()
+    print("spec smoke: k=0 disarmed — zero spec passes/programs OK")
+
+
+if __name__ == "__main__":
+    run_smoke()
+    print("spec smoke OK")

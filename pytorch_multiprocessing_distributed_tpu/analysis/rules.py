@@ -110,6 +110,13 @@ RULES: Dict[str, str] = {
              "block_until_ready / device_get / profiler.sync — "
              "inside the timed region, the bench.py readback "
              "discipline)",
+    "GL116": "Python control flow coercing a traced array to bool "
+             "inside jit-traced code (`if accepted:`, `while mask:`, "
+             "`bool(tracer)` on a jnp/jax-produced value — the "
+             "accept-mask bug class: TracerBoolConversionError at "
+             "trace time, which only explodes when the branch is "
+             "finally traced; keep acceptance/freeze logic as array "
+             "masking — jnp.where/lax.select/lax.cond)",
 }
 
 # wrappers that COMPILE (jit family) — GL105/106/107/108 anchor on these
@@ -810,6 +817,90 @@ def _check_traced_branches(fn: _Func, out: List[Finding]):
                     "the arg in static_argnames)"))
 
 
+# GL116: jax/jnp calls whose RESULT is host metadata, not a traced
+# array — branching on these is ordinary Python (keep the rule
+# high-precision; anything else under the jax/jnp namespaces is
+# assumed array-valued)
+_GL116_STATIC_TAILS = {
+    "ShapeDtypeStruct", "dtype", "device_count", "local_device_count",
+    "default_backend", "devices", "process_index", "process_count",
+    "tree_structure", "eval_shape", "named_scope",
+}
+
+
+def _gl116_array_call(node: ast.AST, file: _File) -> bool:
+    """Is ``node`` a call into the jax/jnp namespaces that returns a
+    traced array (by the static-tail allowlist)?"""
+    if not isinstance(node, ast.Call):
+        return False
+    d = _dotted(node.func, file)
+    if not d:
+        return False
+    parts = d.split(".")
+    if parts[0] != "jax":  # jnp resolves to jax.numpy via origins
+        return False
+    return parts[-1] not in _GL116_STATIC_TAILS
+
+
+def _check_traced_bool_coercion(fn: _Func, out: List[Finding]):
+    """GL116 — Python `if`/`while`/`bool()` on a LOCAL value produced
+    by a jnp/jax call inside jit-traced code. Complements GL106 (which
+    covers branches on traced PARAMS of direct jit roots): the
+    accept-mask bug class builds the mask locally (`accepted =
+    jnp.logical_and(...)`) and branches on it — invisible to GL106,
+    and it only explodes at trace time. High-precision by
+    construction: only bare names assigned from jax/jnp array calls
+    (or direct jnp calls in the test) are flagged."""
+    file = fn.file
+    traced_locals: Set[str] = set()
+    for node in _iter_own(fn.node):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            if (isinstance(t, ast.Name)
+                    and _gl116_array_call(node.value, file)):
+                traced_locals.add(t.id)
+
+    def name_hits(test) -> List[str]:
+        if isinstance(test, ast.Name):
+            return [test.id] if test.id in traced_locals else []
+        if (isinstance(test, ast.UnaryOp)
+                and isinstance(test.op, ast.Not)):
+            return name_hits(test.operand)
+        if isinstance(test, ast.BoolOp):
+            hits: List[str] = []
+            for v in test.values:
+                hits.extend(name_hits(v))
+            return hits
+        return []
+
+    def add(node, what):
+        out.append(Finding(
+            fn.file.path, node.lineno, node.col_offset, "GL116",
+            f"{what} in jit-traced `{fn.qual}` coerces a traced "
+            "array to a Python bool — TracerBoolConversionError at "
+            "trace time (the accept-mask bug class); keep it as "
+            "array masking (jnp.where/lax.select) or lax.cond"))
+
+    for node in _iter_own(fn.node):
+        if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+            kind = ("while" if isinstance(node, ast.While) else "if")
+            hits = name_hits(node.test)
+            if hits:
+                add(node, f"`{kind} {'/'.join(sorted(set(hits)))}:` "
+                          "branch on a jnp-produced value")
+                continue
+            if _gl116_array_call(node.test, file):
+                add(node, f"`{kind}` on a jnp/jax call result")
+        elif (isinstance(node, ast.Call)
+              and isinstance(node.func, ast.Name)
+              and node.func.id == "bool" and len(node.args) == 1
+              and not node.keywords
+              and isinstance(node.args[0], ast.Name)
+              and node.args[0].id in traced_locals):
+            add(node, f"bool({node.args[0].id}) on a jnp-produced "
+                      "value")
+
+
 def _check_static_defaults(fn: _Func, out: List[Finding]):
     """GL107: a static jit arg whose default is a mutable literal."""
     if fn.root_statics is None or not fn.root_statics:
@@ -1353,6 +1444,7 @@ def analyze_files(paths: Sequence[str],
             if fn.jit_scoped:
                 _check_jit_scoped_body(fn, findings)
                 _check_traced_branches(fn, findings)
+                _check_traced_bool_coercion(fn, findings)
                 _check_static_defaults(fn, findings)
                 _check_missing_donate(fn, findings)
                 _check_ctrl_body_scalars(fn, findings)

@@ -27,6 +27,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.pallas.decode_attention import (decode_attention,
                                            paged_decode_attention,
+                                           paged_verify_decode_attention,
+                                           verify_decode_attention,
                                            xla_decode_attention)
 
 # flax-default fallback for models predating the ln_eps field; every
@@ -307,13 +309,96 @@ def _block_decode_slots(p, x_t, k_cache, v_cache, positions, h, dtype,
     return (x_t + _ffn(p, x_t, dtype, eps, top_k), k_cache, v_cache)
 
 
+# ------------------------------------------------------------- graftspec
+
+# Knuth multiplicative constant for the unigram draft-table hash. ONE
+# formula shared (test-pinned) by the host-side table builder
+# (``serving.spec.NgramDrafter``, numpy — uint32 wraparound) and the
+# in-scan device lookup below, the same host/device-hash discipline
+# the PR 10 prefix cache uses for its prompt keys.
+DRAFT_HASH_PRIME = 2654435761
+
+
+def draft_bucket(tokens, n_buckets: int):
+    """Draft-table bucket of each token id (jnp; uint32 wraparound)."""
+    t = tokens.astype(jnp.uint32) * jnp.uint32(DRAFT_HASH_PRIME)
+    return (t % jnp.uint32(n_buckets)).astype(jnp.int32)
+
+
+def _block_verify_slots(p, x_t, k_cache, v_cache, positions, h, dtype,
+                        eps, cs=_no_cs, top_k=1, window=None,
+                        attn_impl="xla", block_k=256, interpret=None,
+                        page_table=None, page_size=None):
+    """k-query VERIFY variant of :func:`_block_decode_slots`
+    (graftspec): ``x_t`` is ``[N, K1, D]`` — each slot's pending token
+    plus its ``K1 - 1`` draft proposals. Row ``i``'s K/V is written at
+    column ``positions + i`` (all K1 columns, BEFORE the attention, so
+    later rows see earlier rows' keys — the same write-then-attend
+    order as the single-query step), then row ``i`` attends
+    ``[0, positions + i]`` through the k-query flash kernel or its XLA
+    reference (:func:`...ops.pallas.decode_attention.
+    verify_decode_attention`).
+
+    Rejected/overflow draft columns follow the stale-column
+    invariant: a column beyond the slot's accepted frontier is masked
+    by every later read until the frontier's own (correct) write
+    overwrites it. Dense writes past the cache bound are DROPPED
+    (``mode="drop"`` — such a column could never be emitted anyway:
+    ``position + remaining <= s_max - 1``); paged writes whose column
+    falls beyond the slot's table land on the scratch page 0, so a
+    draft write can never touch a page owned by another tenant or a
+    shared read-only prefix page."""
+    n, k1, _ = x_t.shape
+    hn = _ln(x_t, p["ln1"], eps).astype(dtype)
+    q, k, v = jnp.split(_dense(hn, p["attn"]["wqkv"], dtype), 3, axis=-1)
+    q = cs(_split_heads(q, h), None, None, "model", None)
+    k = cs(_split_heads(k, h), None, None, "model", None)
+    v = cs(_split_heads(v, h), None, None, "model", None)
+    cols = positions[:, None] + jnp.arange(k1)[None, :]     # [N, K1]
+    if page_table is not None:
+        ps = int(page_size)
+        blk = cols // ps
+        n_tab = page_table.shape[1]
+        page_ids = jnp.take_along_axis(
+            page_table, jnp.clip(blk, 0, n_tab - 1), axis=1)
+        page_ids = jnp.where(blk < n_tab, page_ids, 0)
+        offs = cols % ps
+        k_cache = k_cache.at[page_ids, :, offs].set(k)
+        v_cache = v_cache.at[page_ids, :, offs].set(v)
+        n_win = (-(-int(window) // ps) if window is not None
+                 else page_table.shape[1])
+        ids = jax.lax.slice_in_dim(page_table, 0,
+                                   min(n_win, page_table.shape[1]),
+                                   axis=1)
+        att = paged_verify_decode_attention(
+            q, k_cache, v_cache, ids, positions, window=window,
+            impl=attn_impl, interpret=interpret)
+    else:
+        rows = jnp.arange(n)[:, None]
+        k_cache = k_cache.at[rows, cols].set(k, mode="drop")
+        v_cache = v_cache.at[rows, cols].set(v, mode="drop")
+        if window is not None and window < k_cache.shape[1]:
+            k_win = jax.lax.slice_in_dim(k_cache, 0, window, axis=1)
+            v_win = jax.lax.slice_in_dim(v_cache, 0, window, axis=1)
+        else:
+            k_win, v_win = k_cache, v_cache
+        att = verify_decode_attention(q, k_win, v_win, positions,
+                                      impl=attn_impl, block_k=block_k,
+                                      interpret=interpret)
+    att = att.reshape(n, k1, -1).astype(dtype)
+    x_t = x_t + _dense(att, p["attn"]["wo"], dtype)
+    return (x_t + _ffn(p, x_t, dtype, eps, top_k), k_cache, v_cache)
+
+
 def _decode_horizon(model, params, k_caches, v_caches, positions,
                     last_tokens, active, remaining, eos_ids, keys, *,
                     cs=_no_cs, cs_cache=None, window=None,
                     attn_impl="xla", block_k=256, temperature=0.0,
                     top_k=0, top_p=0.0, offsets=None, kv_valid=None,
                     uniform_positions=False, page_table=None,
-                    page_size=None):
+                    page_size=None, draft_k=0, draft_table=None,
+                    draft_model=None, draft_params=None,
+                    draft_k_caches=None, draft_v_caches=None):
     """THE fused multi-step decode loop: ``H = keys.shape[0]`` cached
     decode steps as one ``lax.scan`` — one dispatch, zero host
     round-trips inside. Both decode callers run on this core:
@@ -358,11 +443,40 @@ def _decode_horizon(model, params, k_caches, v_caches, positions,
         each slot's logical columns onto pages (read-only inside the
         scan — allocation is host-side, pre-jit). See
         :func:`_block_decode_slots`.
+      draft_k (graftspec): > 0 arms SPECULATIVE decode — each scan
+        step proposes ``draft_k`` tokens per slot, verifies them with
+        ONE batched (draft_k + 1)-query target pass
+        (:func:`_block_verify_slots`), and accepts greedily ON DEVICE:
+        the emitted prefix per pass is ``g_0 .. g_a`` where ``a`` is
+        the leading-match count of drafts against the target's own
+        greedy outputs, composed with the same eos/budget freeze
+        gating as the non-speculative step (a pass emits between 1 and
+        draft_k + 1 tokens per active row; the finishing token is
+        emitted, then the row freezes). Greedy only (``temperature``
+        must be 0); every emitted token is a target-model greedy
+        continuation of the accepted history, which is what makes the
+        accepted streams token-identical to the non-speculative
+        engine (pinned across the serving matrix).
+      draft_table: self-drafting mode — ``[N, buckets, draft_k]``
+        int32 per-slot unigram n-gram tables (entry ``-1`` = no
+        proposal, never accepted); looked up by
+        :func:`draft_bucket` on each pass's pending token.
+      draft_model / draft_params / draft_k_caches / draft_v_caches:
+        draft-model mode — a small registry GPT proposes the k tokens
+        autoregressively inside the scan against its own dense
+        ``[L_d, N, S, H_d, Dh_d]`` caches (carried through the scan
+        and returned at the END of ``carry``; the draft runs
+        ``draft_k + 1`` steps so its cache stays gap-free under full
+        acceptance).
 
     Returns ``(tokens, carry)``: ``tokens`` ``[H, N]`` int32 (``-1``
-    where the row was frozen BEFORE the step), ``carry`` the updated
-    ``(k_caches, v_caches, positions, last_tokens, active,
-    remaining)``.
+    where the row was frozen BEFORE the step) — with ``draft_k`` > 0
+    the block is ``[H * (draft_k + 1), N]`` in step-major order (pass
+    j's k+1 emission rows, then pass j+1's), ``-1`` marking
+    rejected/frozen rows, so a drain loop replays finish rules row by
+    row exactly as in the non-speculative shape. ``carry`` is the
+    updated ``(k_caches, v_caches, positions, last_tokens, active,
+    remaining)`` (+ the draft caches in draft-model mode).
     """
     dtype = model.dtype
     eps = getattr(model, "ln_eps", _LN_EPS)
@@ -372,6 +486,31 @@ def _decode_horizon(model, params, k_caches, v_caches, positions,
     if cs_cache is None:
         def cs_cache(c):
             return c
+
+    if draft_k:
+        if temperature > 0.0:
+            raise ValueError(
+                "speculative decode (draft_k > 0) is greedy-only: a "
+                "sampled stream cannot be verified by argmax matching "
+                "(temperature > 0)")
+        if (draft_table is None) == (draft_model is None):
+            raise ValueError(
+                "draft_k > 0 needs exactly one draft source: "
+                "draft_table (self-drafting) or draft_model (+ params "
+                "and caches)")
+        if kv_valid is not None or uniform_positions:
+            raise ValueError(
+                "speculative decode composes with neither kv_valid "
+                "nor uniform_positions (serving slots only)")
+        return _decode_horizon_spec(
+            model, params, k_caches, v_caches, positions, last_tokens,
+            active, remaining, eos_ids, keys, cs=cs, cs_cache=cs_cache,
+            window=window, attn_impl=attn_impl, block_k=block_k,
+            page_table=page_table, page_size=page_size,
+            draft_k=int(draft_k), draft_table=draft_table,
+            draft_model=draft_model, draft_params=draft_params,
+            draft_k_caches=draft_k_caches,
+            draft_v_caches=draft_v_caches)
 
     def step(carry, key):
         (k_caches, v_caches, positions, last_tokens, active,
@@ -411,6 +550,144 @@ def _decode_horizon(model, params, k_caches, v_caches, positions,
     carry, tokens = jax.lax.scan(
         step, (k_caches, v_caches, positions, last_tokens, active,
                remaining), keys)
+    return tokens, carry
+
+
+def _decode_horizon_spec(model, params, k_caches, v_caches, positions,
+                         last_tokens, active, remaining, eos_ids, keys,
+                         *, cs, cs_cache, window, attn_impl, block_k,
+                         page_table, page_size, draft_k, draft_table,
+                         draft_model, draft_params, draft_k_caches,
+                         draft_v_caches):
+    """The speculative body of :func:`_decode_horizon` (graftspec):
+    ``H`` draft-then-verify passes as one ``lax.scan``. Per pass and
+    slot: propose ``k = draft_k`` tokens (n-gram table lookup, or the
+    draft model run ``k + 1`` cached steps), run ONE batched
+    ``k + 1``-query target pass (the pending token + the k drafts —
+    the same weight/KV stream one decode step owes, at ``k + 1`` MXU
+    query rows), take the target's greedy outputs ``g_0 .. g_k``, and
+    emit the verified prefix: ``g_i`` emits iff every draft before it
+    matched (``d_j == g_{j-1}`` for ``j <= i``), the row is active,
+    ``i < remaining``, and no earlier ``g_j`` was the stop token —
+    i.e. exactly the tokens ``i`` sequential non-speculative steps
+    would have emitted, in order, with the same freeze gating. The
+    per-row acceptance is pure on-device masking: no shape depends on
+    it, so one compiled program serves every acceptance pattern."""
+    dtype = model.dtype
+    eps = getattr(model, "ln_eps", _LN_EPS)
+    moe_k = getattr(model, "moe_top_k", 1)
+    h = model.num_heads
+    n_layers = model.num_layers
+    kk = draft_k
+    vocab = model.vocab_size
+    n = positions.shape[0]
+
+    def draft_with_model(dk, dv, positions, last_tokens):
+        """k+1 cached draft-model steps (the last one only feeds the
+        draft cache's column ``p + k``, so full acceptance leaves no
+        gap for the NEXT pass to read stale data through); proposals
+        are the first k greedy outputs."""
+        d_dtype = draft_model.dtype
+        d_eps = getattr(draft_model, "ln_eps", _LN_EPS)
+        d_moe = getattr(draft_model, "moe_top_k", 1)
+        d_h = draft_model.num_heads
+        d_pe = draft_params["pos_embed"]
+        t = last_tokens
+        p_d = positions
+        toks = []
+        for _ in range(kk + 1):
+            ids = jnp.clip(p_d, 0, d_pe.shape[0] - 1)
+            x_d = (draft_params["embed"][t][:, None, :].astype(d_dtype)
+                   + d_pe[ids][:, None, :].astype(d_dtype))
+            new_dk, new_dv = [], []
+            for i in range(draft_model.num_layers):
+                x_d, kc, vc = _block_decode_slots(
+                    draft_params[f"block_{i}"], x_d, dk[i], dv[i],
+                    p_d, d_h, d_dtype, d_eps, _no_cs, d_moe,
+                    attn_impl="xla")
+                new_dk.append(kc)
+                new_dv.append(vc)
+            dk, dv = jnp.stack(new_dk), jnp.stack(new_dv)
+            t = jnp.argmax(
+                _logits(draft_params, x_d, d_eps)[:, 0],
+                axis=-1).astype(jnp.int32)
+            toks.append(t)
+            p_d = p_d + 1
+        return jnp.stack(toks[:kk], axis=1), dk, dv  # [N, k]
+
+    def step(carry, key):
+        del key  # greedy-only (validated by the caller)
+        if draft_model is not None:
+            (k_caches, v_caches, positions, last_tokens, active,
+             remaining, dk, dv) = carry
+            drafts, dk, dv = draft_with_model(dk, dv, positions,
+                                              last_tokens)
+            draft_ok = jnp.ones(drafts.shape, bool)
+        else:
+            (k_caches, v_caches, positions, last_tokens, active,
+             remaining) = carry
+            bucket = draft_bucket(last_tokens, draft_table.shape[1])
+            drafts = draft_table[jnp.arange(n), bucket]      # [N, k]
+            draft_ok = drafts >= 0  # -1 = no proposal, never accepted
+        drafts = jnp.where(draft_ok, jnp.clip(drafts, 0, vocab - 1), 0)
+
+        # ---- verify: ONE (k+1)-query target pass
+        qtok = jnp.concatenate([last_tokens[:, None], drafts], axis=1)
+        cols = positions[:, None] + jnp.arange(kk + 1)[None, :]
+        pe = params["pos_embed"]
+        ids = jnp.clip(cols, 0, pe.shape[0] - 1)
+        x_t = (params["embed"][qtok].astype(dtype)
+               + pe[ids].astype(dtype))
+        new_k, new_v = [], []
+        for i in range(n_layers):
+            x_t, kc, vc = _block_verify_slots(
+                params[f"block_{i}"], x_t, k_caches[i], v_caches[i],
+                positions, h, dtype, eps, cs, moe_k, window=window,
+                attn_impl=attn_impl, block_k=block_k,
+                page_table=page_table, page_size=page_size)
+            new_k.append(kc)
+            new_v.append(vc)
+        logits = _logits(params, x_t, eps, cs)        # [N, k+1, V]
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+        # ---- greedy acceptance, composed with the freeze gates
+        match = jnp.logical_and(drafts == greedy[:, :kk], draft_ok)
+        a = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1),
+                    axis=1)                            # [N] leading matches
+        idx = jnp.arange(kk + 1)[None, :]
+        is_eos = greedy == eos_ids[:, None]
+        eos_before = (jnp.cumsum(is_eos.astype(jnp.int32), axis=1)
+                      - is_eos.astype(jnp.int32))
+        can = jnp.logical_and(
+            jnp.logical_and(idx <= a[:, None], idx < remaining[:, None]),
+            jnp.logical_and(eos_before == 0, active[:, None]))
+        e = jnp.sum(can.astype(jnp.int32), axis=1)     # [N] emitted
+        emitted = jnp.where(can, greedy, -1)           # [N, k+1]
+        last_tokens = jnp.where(
+            e > 0,
+            jnp.take_along_axis(greedy, jnp.maximum(e - 1, 0)[:, None],
+                                axis=1)[:, 0],
+            last_tokens)
+        remaining = remaining - e
+        hit_eos = jnp.any(jnp.logical_and(can, is_eos), axis=1)
+        finished = jnp.logical_and(
+            active, jnp.logical_or(hit_eos, remaining <= 0))
+        positions = positions + e
+        active = jnp.logical_and(active, jnp.logical_not(finished))
+        out = (cs_cache(jnp.stack(new_k)), cs_cache(jnp.stack(new_v)),
+               positions, last_tokens, active, remaining)
+        if draft_model is not None:
+            out = out + (dk, dv)
+        return out, emitted
+
+    carry0 = (k_caches, v_caches, positions, last_tokens, active,
+              remaining)
+    if draft_model is not None:
+        carry0 = carry0 + (draft_k_caches, draft_v_caches)
+    carry, toks = jax.lax.scan(step, carry0, keys)
+    # [H, N, k+1] -> [H * (k+1), N], step-major: the drain loop reads
+    # the block exactly like H*(k+1) single steps with -1 holes
+    tokens = jnp.moveaxis(toks, 2, 1).reshape(-1, n)
     return tokens, carry
 
 

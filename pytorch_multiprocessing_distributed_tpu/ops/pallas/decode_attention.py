@@ -41,7 +41,10 @@ from jax.experimental.pallas import tpu as pltpu
 from .flash_attention import NEG_INF
 
 __all__ = ["decode_attention", "paged_decode_attention",
-           "xla_decode_attention", "xla_paged_decode_attention"]
+           "verify_decode_attention", "paged_verify_decode_attention",
+           "xla_decode_attention", "xla_paged_decode_attention",
+           "xla_verify_decode_attention",
+           "xla_paged_verify_decode_attention"]
 
 
 def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, acc, m_scr,
@@ -368,3 +371,305 @@ def decode_attention(
         mask = (jnp.arange(k.shape[1])[None, :]
                 <= positions[:, None])
     return xla_decode_attention(q, k, v, mask)
+
+
+# ------------------------------------------------------------- graftspec
+#
+# k-query VERIFY attention: the speculative-decode verify pass runs
+# k+1 query tokens per slot (the pending token + k drafts) against the
+# same cached columns one decode step reads, in ONE batched pass —
+# more MXU rows over the SAME K/V stream, which is the whole
+# bandwidth-bound argument for speculation (the committed costs.json
+# budgets pin verify bytes ~ decode bytes at (k+1)x the query FLOPs).
+# Query row i sits at column positions[b] + i and attends [0, pos+i]
+# — after the caller's cache writes, that window includes the
+# in-flight keys of the preceding draft queries, exactly the causal
+# set a future single-query step would see. The XLA reference is the
+# same einsum/masked-softmax math as xla_decode_attention with the
+# row-staggered mask; the Pallas kernels are the flash recurrence
+# with a [K1, d] query block instead of [1, d].
+
+
+def _verify_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, acc, m_scr,
+                   l_scr, *, scale, block_k, k1):
+    """One (slot*head, k-block) grid cell; the softmax state is [K1]
+    rows of the same online recurrence as :func:`_decode_kernel`."""
+    kb = pl.program_id(1)
+    n_k = pl.num_programs(1)
+
+    @pl.when(kb == 0)
+    def _():
+        acc[:] = jnp.zeros_like(acc)
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    pos = pos_ref[0]
+
+    # the block matters to SOME query row iff its first column is
+    # within the last row's reach (pos + k1 - 1); per-row masking
+    # below keeps earlier rows exact
+    @pl.when(kb * block_k <= pos + k1 - 1)
+    def _():
+        q = q_ref[0]          # [K1, d]
+        kblk = k_ref[0]       # [bk, d]
+        vblk = v_ref[0]
+        s = jnp.dot(q, kblk.T,
+                    preferred_element_type=jnp.float32) * scale  # [K1, bk]
+        col = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (k1, block_k), 1)
+        row = jax.lax.broadcasted_iota(jnp.int32, (k1, block_k), 0)
+        s = jnp.where(col <= pos + row, s, NEG_INF)
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        m_scr[:] = m_new
+        l_scr[:] = l_scr[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc[:] = acc[:] * corr + jnp.dot(
+            p.astype(vblk.dtype), vblk,
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kb == n_k - 1)
+    def _():
+        o_ref[0] = acc[:] / jnp.maximum(l_scr[:], 1e-30)
+
+
+def _pallas_verify(q, k, v, positions, scale, block_k, interpret):
+    """q [B, K1, H, Dh]; k/v [B, S, H, Dh]; positions [B] -> f32
+    [B, K1, H, Dh]."""
+    b, k1, h, d = q.shape
+    s = k.shape[1]
+    block_k = max(8, min(block_k, ((s + 7) // 8) * 8))
+    pad = (-s) % block_k
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    n_k = k.shape[1] // block_k
+
+    def merge(x):  # [B, S, H, Dh] -> [B*H, S, Dh]
+        return jnp.moveaxis(x, 2, 1).reshape(b * h, x.shape[1], d)
+
+    q3 = merge(q)                      # [B*H, K1, Dh]
+    k3, v3 = merge(k), merge(v)
+    pos_bh = jnp.repeat(positions.astype(jnp.int32), h)
+
+    out = pl.pallas_call(
+        functools.partial(_verify_kernel, scale=scale, block_k=block_k,
+                          k1=k1),
+        grid=(b * h, n_k),
+        in_specs=[
+            pl.BlockSpec((1,), lambda i, kb: (i,),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, k1, d), lambda i, kb: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda i, kb: (i, kb, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda i, kb: (i, kb, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, k1, d), lambda i, kb: (i, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b * h, k1, d), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((k1, d), jnp.float32),   # output accumulator
+            pltpu.VMEM((k1, 1), jnp.float32),   # running max
+            pltpu.VMEM((k1, 1), jnp.float32),   # running denominator
+        ],
+        interpret=interpret,
+    )(pos_bh, q3, k3, v3)
+    return jnp.moveaxis(out.reshape(b, h, k1, d), 1, 2)  # [B, K1, H, Dh]
+
+
+def _paged_verify_kernel(pos_ref, tab_ref, q_ref, k_ref, v_ref, o_ref,
+                         acc, m_scr, l_scr, *, scale, page_size, heads,
+                         k1):
+    """Paged k-query verify: :func:`_paged_decode_kernel`'s
+    scalar-prefetched page indirection with the [K1, d] query block
+    and the row-staggered column mask."""
+    i = pl.program_id(0)
+    kb = pl.program_id(1)
+    n_k = pl.num_programs(1)
+
+    @pl.when(kb == 0)
+    def _():
+        acc[:] = jnp.zeros_like(acc)
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    pos = pos_ref[i // heads]
+
+    @pl.when(kb * page_size <= pos + k1 - 1)
+    def _():
+        q = q_ref[0]             # [K1, d]
+        kblk = k_ref[0, 0]       # [ps, d]
+        vblk = v_ref[0, 0]
+        s = jnp.dot(q, kblk.T,
+                    preferred_element_type=jnp.float32) * scale
+        col = kb * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (k1, page_size), 1)
+        row = jax.lax.broadcasted_iota(jnp.int32, (k1, page_size), 0)
+        s = jnp.where(col <= pos + row, s, NEG_INF)
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        m_scr[:] = m_new
+        l_scr[:] = l_scr[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc[:] = acc[:] * corr + jnp.dot(
+            p.astype(vblk.dtype), vblk,
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kb == n_k - 1)
+    def _():
+        o_ref[0] = acc[:] / jnp.maximum(l_scr[:], 1e-30)
+
+
+def _pallas_paged_verify(q, k_pages, v_pages, page_table, positions,
+                         scale, interpret):
+    """q [B, K1, H, Dh]; pages [P, H, ps, Dh]; page_table [B, n_win]
+    -> f32 [B, K1, H, Dh]."""
+    b, k1, h, d = q.shape
+    ps = k_pages.shape[2]
+    n_win = page_table.shape[1]
+    q3 = jnp.moveaxis(q, 2, 1).reshape(b * h, k1, d)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # positions, page table
+        grid=(b * h, n_win),
+        in_specs=[
+            pl.BlockSpec((1, k1, d),
+                         lambda i, kb, pos, tab: (i, 0, 0)),
+            pl.BlockSpec((1, 1, ps, d),
+                         lambda i, kb, pos, tab:
+                         (tab[i // h, kb], i % h, 0, 0)),
+            pl.BlockSpec((1, 1, ps, d),
+                         lambda i, kb, pos, tab:
+                         (tab[i // h, kb], i % h, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, k1, d),
+                               lambda i, kb, pos, tab: (i, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((k1, d), jnp.float32),   # output accumulator
+            pltpu.VMEM((k1, 1), jnp.float32),   # running max
+            pltpu.VMEM((k1, 1), jnp.float32),   # running denominator
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_verify_kernel, scale=scale,
+                          page_size=ps, heads=h, k1=k1),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b * h, k1, d), jnp.float32),
+        interpret=interpret,
+    )(positions.astype(jnp.int32), page_table.astype(jnp.int32),
+      q3, k_pages, v_pages)
+    return jnp.moveaxis(out.reshape(b, h, k1, d), 1, 2)
+
+
+def xla_verify_decode_attention(q, k, v, positions):
+    """Reference k-query verify math: xla_decode_attention's exact
+    einsum/masked-softmax shape with the row-staggered mask — query
+    row ``i`` attends columns ``[0, positions[b] + i]`` inclusive.
+    K1=1 degenerates to the single-query reference bit-for-bit."""
+    scale = q.shape[-1] ** -0.5
+    k1 = q.shape[1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    mask = (jnp.arange(k.shape[1])[None, None, :]
+            <= positions[:, None, None]
+            + jnp.arange(k1)[None, :, None])          # [B, K1, S]
+    probs = jax.nn.softmax(
+        jnp.where(mask[:, None], logits, -jnp.inf), axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+
+
+def xla_paged_verify_decode_attention(q, k_pages, v_pages, page_table,
+                                      positions,
+                                      window: Optional[int] = None):
+    """Paged reference verify: the same take-gather as
+    :func:`xla_paged_decode_attention`, then the dense reference."""
+    b = q.shape[0]
+    h, d = q.shape[2], q.shape[3]
+    ps = k_pages.shape[2]
+    n_win = page_table.shape[1]
+
+    def gather(pages):
+        g = jnp.take(pages, page_table, axis=0)
+        g = jnp.moveaxis(g, 3, 2).reshape(b, n_win * ps, h, d)
+        if window is not None and window < n_win * ps:
+            g = jax.lax.slice_in_dim(g, 0, window, axis=1)
+        return g
+
+    return xla_verify_decode_attention(q, gather(k_pages),
+                                       gather(v_pages), positions)
+
+
+def verify_decode_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    positions: jax.Array,
+    *,
+    impl: str = "auto",
+    block_k: int = 256,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Speculative-verify attention: ``K1 = k_draft + 1`` query tokens
+    per slot over one KV window.
+
+    Args:
+      q: ``[B, K1, H, Dh]`` — row ``i`` is the query at column
+        ``positions[b] + i`` (the pending token, then the k drafts).
+      k, v: ``[B, S, H, Dh]`` KV window (the caller has already
+        written the K1 in-flight columns, so row ``i`` sees its
+        predecessors' keys — the causal verify set).
+      positions: ``[B]`` int — row ``i`` attends ``[0, positions[b]
+        + i]`` inclusive.
+      impl / block_k / interpret: as :func:`decode_attention`.
+
+    Returns ``[B, K1, H, Dh]`` f32 (caller casts)."""
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if impl == "pallas":
+        if interpret is None:
+            from . import default_interpret
+
+            interpret = default_interpret()
+        scale = q.shape[-1] ** -0.5
+        return _pallas_verify(q, k, v, positions, scale, int(block_k),
+                              bool(interpret))
+    if impl != "xla":
+        raise ValueError(
+            f"impl must be 'pallas', 'xla' or 'auto', got {impl!r}")
+    return xla_verify_decode_attention(q, k, v, positions)
+
+
+def paged_verify_decode_attention(
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    page_table: jax.Array,
+    positions: jax.Array,
+    *,
+    window: Optional[int] = None,
+    impl: str = "auto",
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Paged twin of :func:`verify_decode_attention` (graftspec x
+    graftpage): the k-query verify reads KV through the same windowed
+    page-table slice the single-query paged step uses."""
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if impl == "pallas":
+        if interpret is None:
+            from . import default_interpret
+
+            interpret = default_interpret()
+        scale = q.shape[-1] ** -0.5
+        return _pallas_paged_verify(q, k_pages, v_pages, page_table,
+                                    positions, scale, bool(interpret))
+    if impl != "xla":
+        raise ValueError(
+            f"impl must be 'pallas', 'xla' or 'auto', got {impl!r}")
+    return xla_paged_verify_decode_attention(q, k_pages, v_pages,
+                                             page_table, positions,
+                                             window)

@@ -612,10 +612,18 @@ _SPAN_CATEGORIES = {
     "checkpoint.write": "checkpoint_write",
     "engine.drain": "drain",
 }
-# instant events whose attrs carry a lost-seconds payload
+# instant events whose attrs carry a lost-seconds payload.
+# spec.verify (graftspec) is a span, but its waste_s attr is an
+# instant-style cost: the fraction of the drained block's wall spent
+# on REJECTED draft verify rows — work the chip did that yielded no
+# token. Booked as spec_waste and SUBTRACTED from the productive
+# serving sum (decode.drain covers the whole block's wall), so a
+# low-acceptance speculative engine shows its waste as lost goodput
+# instead of laundering it as serving time.
 _INSTANT_COSTS = {
     "heal.restart": ("restart_backoff", "backoff_s"),
     "fault.retry": ("fault_retry", "delay_s"),
+    "spec.verify": ("spec_waste", "waste_s"),
 }
 
 
@@ -752,10 +760,13 @@ class GoodputLedger:
     @property
     def productive_s(self) -> float:
         """Train windows minus their own nested waits, plus the
-        serving work spans — never negative."""
+        serving work spans minus rejected-draft verify waste
+        (graftspec) — never negative."""
         train = max(0.0, self.seconds.get("train_window", 0.0)
                     - self.seconds.get("window_nested", 0.0))
-        return train + self.seconds.get("serving", 0.0)
+        serving = max(0.0, self.seconds.get("serving", 0.0)
+                      - self.seconds.get("spec_waste", 0.0))
+        return train + serving
 
     def gauges(self) -> Dict[str, float]:
         """The flat dict the stats endpoints merge in (every key
@@ -775,7 +786,8 @@ class GoodputLedger:
         }
         for bucket in ("compile", "checkpoint", "checkpoint_write",
                        "data_wait", "metrics_sync", "eval",
-                       "fault_retry", "restart_backoff", "drain"):
+                       "fault_retry", "restart_backoff", "drain",
+                       "spec_waste"):
             out[f"goodput_{bucket}_s"] = seconds.get(bucket, 0.0)
         return out
 

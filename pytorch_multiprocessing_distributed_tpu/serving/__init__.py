@@ -20,11 +20,14 @@ from .kv_pages import PagePool, PagePoolExhausted, PrefixCache
 from .kv_slots import SlotPool
 from .params import init_params, load_params
 from .scheduler import (DONE, FAILED, FIFOScheduler, PrefillPlan,
-                        QueueFull, Request, bucket_length, pick_horizon)
+                        QueueFull, Request, bucket_length, pick_draft_k,
+                        pick_horizon)
+from .spec import NgramDrafter, ngram_bucket
 
 __all__ = [
     "ServingEngine", "SlotPool", "PagePool", "PagePoolExhausted",
-    "PrefixCache", "FIFOScheduler", "PrefillPlan",
+    "PrefixCache", "FIFOScheduler", "PrefillPlan", "NgramDrafter",
     "QueueFull", "Request", "bucket_length", "init_params",
-    "load_params", "pick_horizon", "DONE", "FAILED",
+    "load_params", "ngram_bucket", "pick_draft_k", "pick_horizon",
+    "DONE", "FAILED",
 ]

@@ -130,7 +130,9 @@ from ..utils.metrics import ServingMetrics
 from .kv_pages import PagePool, PagePoolExhausted, PrefixCache
 from .kv_slots import SlotPool
 from .scheduler import (DONE, FAILED, FIFOScheduler, PrefillPlan,
-                        QueueFull, Request, bucket_length, pick_horizon)
+                        QueueFull, Request, bucket_length, pick_draft_k,
+                        pick_horizon)
+from .spec import NgramDrafter
 
 __all__ = ["ServingEngine", "Request"]
 
@@ -163,17 +165,24 @@ _SITE_INSERT = register_site(
 
 class _TokenBlock:
     """One dispatched decode horizon awaiting readback: the device
-    ``[H, slots]`` token block plus the host snapshot needed to
+    ``[rows, slots]`` token block plus the host snapshot needed to
     attribute it at drain time (which request held each slot when the
-    horizon launched, how many steps it ran, at which window)."""
+    horizon launched, how many steps it ran, at which window).
+    ``rows == h`` for plain decode; a speculative horizon (``k > 0``,
+    graftspec) drains ``h * (k + 1)`` rows — pass ``j``'s ``k + 1``
+    verified-emission rows in order, ``-1`` holes where the device
+    rejected or froze — through the SAME row-by-row attribution
+    loop."""
 
-    __slots__ = ("tokens", "h", "window", "slots")
+    __slots__ = ("tokens", "h", "window", "slots", "k", "rows")
 
-    def __init__(self, tokens, h, window, slots):
+    def __init__(self, tokens, h, window, slots, k=0):
         self.tokens = tokens
         self.h = h
         self.window = window
         self.slots = slots  # slot -> Request at dispatch time
+        self.k = k
+        self.rows = h * (k + 1)
 
 
 class _PendingPrefill:
@@ -334,6 +343,37 @@ class ServingEngine:
         engines only: sampled streams are not replayable, so
         ``journal`` with ``temperature > 0`` is rejected.
 
+      draft_k: > 0 arms **speculative decode** (graftspec): every
+        decode pass proposes up to ``draft_k`` tokens per slot and
+        verifies them with ONE batched (k+1)-query target pass
+        through the same caches/page tables — the verify pass streams
+        ~the same weight/KV bytes as one decode step (the committed
+        costs.json budgets pin it) and emits 1..k+1 tokens per active
+        slot, so the bandwidth-bound decode turns slack into tokens.
+        Greedy engines only (``temperature > 0`` is rejected loudly —
+        argmax matching cannot verify a sampled stream); accepted
+        streams are token-identical to the non-speculative engine and
+        ``generate()`` (test-pinned across the matrix). The realized
+        k per dispatch is :func:`~.scheduler.pick_draft_k`'s choice
+        on the ``{0, draft_k}`` ladder — collapsed under fault
+        cooldown or sustained low acceptance (with periodic re-probe)
+        — so the compile set is ``buckets x {1, H} x {k off, on}``;
+        k=0 dispatches run the UNCHANGED non-speculative programs
+        (disarmed spec is one host-side branch: zero extra compiles,
+        transfers or syncs at steady state).
+      draft_model / draft_params: optional small registry GPT (+ its
+        params) proposing the k tokens autoregressively inside the
+        scan instead of self-drafting; must share the target's vocab
+        and cover ``s_max`` positions. Its dense ``[L_d, slots,
+        s_max, H_d, Dh_d]`` caches ride the pool (prefilled
+        whole-prompt at every admission — also under chunked/prefix-
+        hit admission: the draft model is the cheap side). Default
+        (None with ``draft_k > 0``): self-drafting via per-slot
+        n-gram tables over each request's own prompt + emitted
+        tokens (:class:`~.spec.NgramDrafter`, host-mirrored, lazy
+        dirty upload like the page table).
+      draft_buckets: n-gram table buckets per slot (self-draft only).
+
     **Elastic lifecycle (graftheal).** The engine carries a
     :class:`~..runtime.heal.HealthState` machine (``STARTING`` during
     construction, ``READY`` when serving, ``DRAINING`` after
@@ -364,7 +404,11 @@ class ServingEngine:
                  kv_layout: str = "dense",
                  page_size: Optional[int] = None,
                  num_pages: Optional[int] = None,
-                 prefix_cache: int = 0):
+                 prefix_cache: int = 0,
+                 draft_k: int = 0,
+                 draft_model=None,
+                 draft_params=None,
+                 draft_buckets: int = 64):
         # health first: an engine that dies mid-construction reports
         # STARTING on /healthz, never a stale READY
         self.health = heal.HealthState()
@@ -440,6 +484,31 @@ class ServingEngine:
                 "prefix_cache requires deterministic (greedy) decode — "
                 "a cached first token cannot be replayed into a "
                 "sampled stream (temperature > 0)")
+        if draft_k < 0:
+            raise ValueError(f"draft_k must be >= 0, got {draft_k}")
+        if draft_k and temperature > 0.0:
+            # loud, at submission of the config: spec verification is
+            # argmax matching — a sampled stream has no argmax to match
+            raise ValueError(
+                "speculative decode (draft_k > 0) is greedy-only: "
+                "temperature > 0 cannot be verified by argmax "
+                "matching — disarm spec or serve greedy")
+        if (draft_model is not None or draft_params is not None):
+            if not draft_k:
+                raise ValueError(
+                    "draft_model/draft_params need draft_k > 0")
+            if draft_model is None or draft_params is None:
+                raise ValueError(
+                    "draft-model speculation needs BOTH draft_model "
+                    "and draft_params")
+            if draft_model.vocab_size != model.vocab_size:
+                raise ValueError(
+                    f"draft model vocab {draft_model.vocab_size} != "
+                    f"target vocab {model.vocab_size} — drafts could "
+                    "never verify")
+        if draft_buckets < 1:
+            raise ValueError(
+                f"draft_buckets must be >= 1, got {draft_buckets}")
         self.model = model
         self.params = params
         self.mesh = mesh
@@ -456,6 +525,38 @@ class ServingEngine:
             self.pool = SlotPool(model, max_slots, s_max, mesh)
         self._prefix_cache = (PrefixCache(self.pool, prefix_cache)
                               if prefix_cache else None)
+        # graftspec state (all host-side; spec disarmed == draft_k 0)
+        self._draft_k = int(draft_k)
+        self._draft_model = draft_model
+        self._draft_params = draft_params
+        self._drafter = None
+        self._draft_k_caches = None
+        self._draft_v_caches = None
+        if self._draft_k:
+            if draft_model is not None:
+                if draft_model.max_seq_len < self.pool.s_max:
+                    raise ValueError(
+                        f"draft model max_seq_len "
+                        f"{draft_model.max_seq_len} < s_max="
+                        f"{self.pool.s_max} — the draft cache could "
+                        "not cover the slots")
+                d_h = draft_model.num_heads
+                dshape = (draft_model.num_layers, int(max_slots),
+                          self.pool.s_max, d_h,
+                          draft_model.hidden_size // d_h)
+                self._draft_k_caches = self.pool._replicated(
+                    jnp.zeros(dshape, draft_model.dtype))
+                self._draft_v_caches = self.pool._replicated(
+                    jnp.zeros(dshape, draft_model.dtype))
+            else:
+                self._drafter = NgramDrafter(
+                    int(max_slots), self._draft_k, int(draft_buckets),
+                    place=self.pool._replicated)
+        # decayed mean of accepted/k per verify pass — pick_draft_k's
+        # collapse signal; None until the first spec pass drains
+        self._accept_ema: Optional[float] = None
+        self._spec_dispatches = 0
+        self._last_spec = None  # (drafted, accepted, passes) at drain
         self._held_uid = None  # FIFO head currently held for pages
         self.scheduler = FIFOScheduler(self.pool.s_max, max_queue)
         self.metrics = ServingMetrics()
@@ -519,10 +620,18 @@ class ServingEngine:
             state_insert_out = (rep, rep, rep, rep, rep)
             copy_out = (cache_sh, cache_sh)
             gather_out = (pref_sh, pref_sh)
+            # graftspec: same carry as decode (+ replicated draft
+            # caches in draft-model mode — the draft is small, TP
+            # shards only the target)
+            spec_out = (decode_out + (rep, rep)
+                        if draft_model is not None else decode_out)
+            draft_prefill_out = (rep, rep)
+            draft_insert_out = (rep, rep)
         else:
             decode_out = insert_out = prefill_out = None
             chunk_out = tok0_out = evict_out = None
             state_insert_out = copy_out = gather_out = None
+            spec_out = draft_prefill_out = draft_insert_out = None
         self._decode = jax.jit(
             self._make_decode_horizon(), out_shardings=decode_out,
             static_argnames=("window", "horizon"),
@@ -565,6 +674,32 @@ class ServingEngine:
         self._evict_jit = jax.jit(
             self._evict_fn, out_shardings=evict_out,
             donate_argnums=(0, 1) if donate_cache else ())
+        # graftspec: the draft+verify horizon is its OWN jitted
+        # function — the k=0 dispatch path keeps calling the untouched
+        # self._decode, so disarmed spec cannot move the committed
+        # non-spec fingerprints, donation lists or compile ladder
+        self._decode_spec = None
+        self._draft_prefill_jit = None
+        self._draft_insert_jit = None
+        if self._draft_k:
+            if self._draft_model is not None:
+                spec_donate = ((2, 3, 5, 6, 7, 8, 9, 10) if self._paged
+                               else (2, 3, 4, 5, 6, 7, 8, 9))
+            else:
+                spec_donate = ((1, 2, 4, 5, 6, 7) if self._paged
+                               else (1, 2, 3, 4, 5, 6))
+            self._decode_spec = jax.jit(
+                self._make_decode_spec(), out_shardings=spec_out,
+                static_argnames=("window", "horizon", "draft_k"),
+                donate_argnums=spec_donate if donate_cache else ())
+            if self._draft_model is not None:
+                self._draft_prefill_jit = jax.jit(
+                    self._make_draft_prefill(),
+                    out_shardings=draft_prefill_out)
+                self._draft_insert_jit = jax.jit(
+                    self._draft_insert_fn,
+                    out_shardings=draft_insert_out,
+                    donate_argnums=(0, 1) if donate_cache else ())
         # graftmeter: resident params on the ledger (disarmed: ONE
         # global read — the tree walk too stays behind the check;
         # bytes from host metadata, no device touch). The pool
@@ -655,6 +790,160 @@ class ServingEngine:
                                 horizon=horizon, page_table=page_table)
 
         return paged_horizon_step
+
+    def _make_decode_spec(self):
+        """The speculative twin of :func:`_make_decode_horizon`
+        (graftspec): ``horizon`` draft-then-verify passes as ONE
+        ``lax.scan`` on the SHARED
+        :func:`...inference.generate._decode_horizon` core (its
+        ``draft_k`` branch), statics ``(window, horizon, draft_k)`` —
+        the ``buckets x {1, H} x {k}`` half of the compile ladder.
+        Greedy-only (enforced at construction), so no sample keys
+        ride the signature."""
+        model = self.model
+        cs = _make_cs(self.mesh)
+        attn_impl = self._attn_impl
+        block_k = self._decode_block_k
+        paged = self._paged
+        page_size = self.pool.page_size if paged else None
+        draft_model = self._draft_model
+
+        def cs_cache(c):
+            if paged:
+                return cs(c, None, None, "model", None, None)
+            return cs(c, None, None, None, "model", None)
+
+        def run(params, k_caches, v_caches, positions, last_tokens,
+                active, remaining, eos_ids, *, window, horizon,
+                draft_k, page_table=None, draft_table=None,
+                draft_params=None, dk=None, dv=None):
+            keys = jnp.zeros((horizon, 2), jnp.uint32)  # greedy
+            tokens, carry = _decode_horizon(
+                model, params, k_caches, v_caches, positions,
+                last_tokens, active, remaining, eos_ids, keys, cs=cs,
+                cs_cache=cs_cache, window=window, attn_impl=attn_impl,
+                block_k=block_k, page_table=page_table,
+                page_size=page_size, draft_k=draft_k,
+                draft_table=draft_table,
+                draft_model=(draft_model if draft_params is not None
+                             else None),
+                draft_params=draft_params, draft_k_caches=dk,
+                draft_v_caches=dv)
+            return (tokens,) + carry
+
+        if draft_model is not None:
+            if paged:
+                def spec_step(params, draft_params, k_pages, v_pages,
+                              page_table, dk, dv, positions,
+                              last_tokens, active, remaining, eos_ids,
+                              *, window, horizon, draft_k):
+                    return run(params, k_pages, v_pages, positions,
+                               last_tokens, active, remaining,
+                               eos_ids, window=window, horizon=horizon,
+                               draft_k=draft_k, page_table=page_table,
+                               draft_params=draft_params, dk=dk, dv=dv)
+            else:
+                def spec_step(params, draft_params, k_caches, v_caches,
+                              dk, dv, positions, last_tokens, active,
+                              remaining, eos_ids, *, window, horizon,
+                              draft_k):
+                    return run(params, k_caches, v_caches, positions,
+                               last_tokens, active, remaining,
+                               eos_ids, window=window, horizon=horizon,
+                               draft_k=draft_k,
+                               draft_params=draft_params, dk=dk, dv=dv)
+            return spec_step
+        if paged:
+            def spec_step(params, k_pages, v_pages, page_table,
+                          positions, last_tokens, active, remaining,
+                          eos_ids, draft_table, *, window, horizon,
+                          draft_k):
+                return run(params, k_pages, v_pages, positions,
+                           last_tokens, active, remaining, eos_ids,
+                           window=window, horizon=horizon,
+                           draft_k=draft_k, page_table=page_table,
+                           draft_table=draft_table)
+        else:
+            def spec_step(params, k_caches, v_caches, positions,
+                          last_tokens, active, remaining, eos_ids,
+                          draft_table, *, window, horizon, draft_k):
+                return run(params, k_caches, v_caches, positions,
+                           last_tokens, active, remaining, eos_ids,
+                           window=window, horizon=horizon,
+                           draft_k=draft_k, draft_table=draft_table)
+        return spec_step
+
+    def _make_draft_prefill(self):
+        """Whole-prompt prefill of the DRAFT model (graftspec) — the
+        shared ``_prefill`` pass, caches only (the target's prefill
+        already sampled tok0). Compiles once per prompt bucket, like
+        the target's prefill."""
+        draft_model = self._draft_model
+
+        def prefill(dparams, prompt):
+            _x, k_pref, v_pref = _prefill(draft_model, dparams, prompt,
+                                          prompt.shape[1])
+            return k_pref, v_pref
+
+        return prefill
+
+    @staticmethod
+    def _draft_insert_fn(dk, dv, k_pref, v_pref, slot):
+        """Splice a draft-model prefill into slot ``slot`` of the
+        draft caches (graftspec). Stale columns beyond the prompt stay
+        masked by the position gate until the draft's own decode
+        writes overwrite them — the same invariant as the target
+        splice."""
+        s_max = dk.shape[2]
+        if k_pref.shape[2] > s_max:
+            k_pref = jax.lax.slice_in_dim(k_pref, 0, s_max, axis=2)
+            v_pref = jax.lax.slice_in_dim(v_pref, 0, s_max, axis=2)
+        dk = jax.lax.dynamic_update_slice(dk, k_pref, (0, slot, 0, 0, 0))
+        dv = jax.lax.dynamic_update_slice(dv, v_pref, (0, slot, 0, 0, 0))
+        return dk, dv
+
+    def _spec_admit(self, request: Request, slot: int,
+                    length: int) -> None:
+        """Per-admission graftspec hook, called after the target
+        splice on EVERY admission path (whole, chunked, prefix hits):
+        self-drafting rebuilds the slot's n-gram index from the
+        request's history; draft-model mode prefills the draft on the
+        (bucket-padded) prompt and splices its caches. Failures raise
+        into the caller's quarantine path — the request fails named,
+        the engine keeps serving."""
+        if self._drafter is not None:
+            self._drafter.note_history(
+                slot, list(request.prompt) + list(request.tokens))
+            return
+        if self._draft_model is None:
+            return
+        pool = self.pool
+        bucket = bucket_length(length, self.min_bucket, pool.s_max)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :length] = request.prompt[:length]
+
+        def prefill_once():
+            with expected_transfer("draft-model prompt upload at "
+                                   "admission (graftspec)"):
+                k_pref, v_pref = self._draft_prefill_jit(
+                    self._draft_params, jnp.asarray(padded))
+                return k_pref, v_pref
+
+        with graftscope.span("spec.draft_prefill", cat="serving",
+                             req=request.uid, bucket=bucket):
+            k_pref, v_pref = self._attempted(prefill_once)
+        record_jit_key(self._draft_prefill_jit,
+                       ("draft_prefill", bucket))
+
+        def splice_once():
+            with expected_transfer("draft-cache splice at admission "
+                                   "(graftspec, scalar H2D)"):
+                return self._donated(lambda: self._draft_insert_jit(
+                    self._draft_k_caches, self._draft_v_caches,
+                    k_pref, v_pref, jnp.int32(slot)))
+
+        self._draft_k_caches, self._draft_v_caches = self._attempted(
+            splice_once)
 
     def _make_prefill(self):
         """Whole-prompt prefill-on-join: the SHARED ``_prefill`` pass on
@@ -1099,6 +1388,29 @@ class ServingEngine:
                      if tag == "decode")
 
     @property
+    def spec_programs(self) -> Tuple[Tuple[int, int, int], ...]:
+        """``(window, horizon, draft_k)`` SPECULATIVE programs that
+        actually compiled (graftspec), in first-use order — the
+        ``x {k on}`` half of the ladder; the k=0 half is
+        ``decode_programs``, untouched by arming spec."""
+        if self._decode_spec is None:
+            return ()
+        return tuple((w, h, k) for tag, w, h, k in
+                     jit_cache_keys(self._decode_spec)
+                     if tag == "decode_spec")
+
+    @property
+    def draft_k(self) -> int:
+        """The configured max draft length (0 = spec disarmed)."""
+        return self._draft_k
+
+    @property
+    def spec_accept_ema(self) -> Optional[float]:
+        """Decayed mean accepted/k per verify pass (None before the
+        first speculative drain) — pick_draft_k's collapse signal."""
+        return self._accept_ema
+
+    @property
     def decode_horizon(self) -> int:
         """The configured max fused-decode horizon (H_max)."""
         return self._horizon_max
@@ -1458,6 +1770,11 @@ class ServingEngine:
             pool.bind_slot(slot, prep.page_ids)
             prep.shared_ids, prep.fresh_ids = [], []
             pool.note_insert(slot, length)
+            if self._draft_k:
+                try:
+                    self._spec_admit(request, slot, length)
+                except Exception as e:
+                    self._poisoned(request, e, slot=slot)
 
     def _seed_partial_pending(self, request: Request, prep: _PagedPrep,
                               chunk: int) -> _PendingPrefill:
@@ -1702,6 +2019,9 @@ class ServingEngine:
                  pool.last_tokens, pool.active, pool.budgets,
                  pool.eos_ids) = self._attempted(insert_once)
         pool.note_insert(slot, length)
+        if self._draft_k:
+            # raises into the caller's quarantine path on failure
+            self._spec_admit(request, slot, length)
 
     def _register_prefix(self, request: Request, page_ids) -> None:
         """Offer a freshly spliced prompt's prefix to the cache (miss
@@ -1796,32 +2116,53 @@ class ServingEngine:
 
     # ---- horizon scheduling / dispatch / drain ------------------------
     def _inflight_steps(self) -> int:
-        """Decode steps dispatched but not yet drained — the host
-        mirror's conservative position overshoot (every in-flight step
-        MAY have advanced every slot; rows frozen mid-horizon advanced
-        less, which only widens the window pick, never under-sizes
-        it)."""
-        return sum(block.h for block in self._blocks)
+        """Max tokens any slot may have advanced in dispatched-but-
+        undrained blocks — the host mirror's conservative position
+        overshoot (every in-flight row MAY have advanced every slot;
+        rows frozen or rejected mid-horizon advanced less, which only
+        widens the window pick, never under-sizes it). A speculative
+        block counts ``h * (k + 1)`` rows."""
+        return sum(block.rows for block in self._blocks)
 
     def _min_remaining_eff(self) -> int:
         """Shortest remaining decode budget over running requests,
-        discounted by in-flight steps already dispatched against each
+        discounted by in-flight rows already dispatched against each
         slot (host knows only DRAINED tokens)."""
         rem = []
         for slot, request in self._running.items():
-            assumed = sum(block.h for block in self._blocks
+            assumed = sum(block.rows for block in self._blocks
                           if block.slots.get(slot) is request)
             rem.append(request.max_new_tokens - len(request.tokens)
                        - assumed)
         return min(rem) if rem else 0
 
-    def _pick_schedule(self) -> Tuple[int, int]:
-        """``(window, horizon)`` for the next dispatch, off the
-        conservative host mirror: the smallest bucket covering the
-        highest possible next write, and the scheduler's adaptive
-        horizon snapped to the ``{1, H_max}`` ladder."""
+    def _pick_k(self) -> int:
+        """Realized draft length for the next dispatch, on the
+        ``{0, draft_k}`` ladder: collapsed during the post-fault
+        cooldown and under sustained low acceptance, with a periodic
+        probe dispatch so a stream that turned repetitive again can
+        re-arm (acceptance data only exists when drafts actually
+        run). The decision counter advances on EVERY pick — collapsed
+        dispatches included — or the probe could never come due while
+        collapsed and speculation would disarm permanently."""
+        if not self._draft_k:
+            return 0
+        probe = (self._spec_dispatches % 16 == 0)
+        self._spec_dispatches += 1
+        return pick_draft_k(self._draft_k, self._accept_ema,
+                            self._cooldown > 0, probe=probe)
+
+    def _pick_schedule(self) -> Tuple[int, int, int]:
+        """``(window, horizon, draft_k)`` for the next dispatch, off
+        the conservative host mirror: the smallest bucket covering the
+        highest possible next write (a speculative pass writes AND
+        reads up to ``k + 1`` columns past each position, so the
+        window must cover ``h * (k + 1)`` columns of advance), and the
+        scheduler's adaptive horizon snapped to the ``{1, H_max}``
+        ladder."""
+        k = self._pick_k()
         max_eff = self.pool.max_active_pos + self._inflight_steps()
-        need = max_eff + 1
+        need = max_eff + 1 + k
         window = self._buckets[-1]
         for b in self._buckets:
             if b >= need:
@@ -1830,7 +2171,8 @@ class ServingEngine:
         admission_pending = (self.scheduler.queue_depth > 0
                              or self._pending is not None)
         h = pick_horizon(self._horizon_max, window, max_eff,
-                         self._min_remaining_eff(), admission_pending)
+                         self._min_remaining_eff(), admission_pending,
+                         per_step=k + 1)
         if self._cooldown > 0:
             # post-fault degradation: smaller blast radius per dispatch
             # (one token's work lost on a repeat, not a horizon's) and
@@ -1841,7 +2183,7 @@ class ServingEngine:
                 self.metrics.record_horizon_collapse()
                 graftscope.emit("fault.horizon_collapse", cat="fault",
                                 cooldown_left=self._cooldown)
-        return window, h
+        return window, h, k
 
     def _dispatch(self, overlapped: bool = False) -> None:
         """Launch one fused decode horizon (no host sync — the token
@@ -1852,7 +2194,7 @@ class ServingEngine:
         ``GraftFaultError`` — the dispatch domain covers every
         resident slot, so there is no single request to quarantine."""
         pool = self.pool
-        window, h = self._pick_schedule()
+        window, h, k = self._pick_schedule()
         key = self._next_key()
 
         if self._paged:
@@ -1865,30 +2207,69 @@ class ServingEngine:
         else:
             caches = (pool.k_caches, pool.v_caches)
 
-        def launch():
-            maybe_fault(_SITE_DISPATCH)
-            return self._donated(lambda: self._decode(
-                self.params, *caches, pool.positions,
-                pool.last_tokens, pool.active, pool.budgets,
-                pool.eos_ids, key, window=window, horizon=h))
+        if k:
+            if self._drafter is not None:
+                # lazy draft-table upload (the PagePool dirty-upload
+                # discipline): a converged repetitive stream stops
+                # changing its index, so steady state re-uses the
+                # device copy — the host-side refresh is the visible
+                # spec.draft span on the timeline
+                with graftscope.span("spec.draft", cat="serving",
+                                     draft_k=k):
+                    table = self._drafter.device_table()
 
-        (tokens, k_out, v_out, pool.positions, pool.last_tokens,
-         pool.active, pool.budgets) = self._attempted_engine(
-            launch, "decode dispatch")
+                def launch():
+                    maybe_fault(_SITE_DISPATCH)
+                    return self._donated(lambda: self._decode_spec(
+                        self.params, *caches, pool.positions,
+                        pool.last_tokens, pool.active, pool.budgets,
+                        pool.eos_ids, table, window=window, horizon=h,
+                        draft_k=k))
+            else:
+                def launch():
+                    maybe_fault(_SITE_DISPATCH)
+                    return self._donated(lambda: self._decode_spec(
+                        self.params, self._draft_params, *caches,
+                        self._draft_k_caches, self._draft_v_caches,
+                        pool.positions, pool.last_tokens, pool.active,
+                        pool.budgets, pool.eos_ids, window=window,
+                        horizon=h, draft_k=k))
+
+            out = self._attempted_engine(launch, "decode dispatch")
+            if self._draft_model is not None:
+                (tokens, k_out, v_out, pool.positions,
+                 pool.last_tokens, pool.active, pool.budgets,
+                 self._draft_k_caches, self._draft_v_caches) = out
+            else:
+                (tokens, k_out, v_out, pool.positions,
+                 pool.last_tokens, pool.active, pool.budgets) = out
+            record_jit_key(self._decode_spec,
+                           ("decode_spec", window, h, k))
+        else:
+            def launch():
+                maybe_fault(_SITE_DISPATCH)
+                return self._donated(lambda: self._decode(
+                    self.params, *caches, pool.positions,
+                    pool.last_tokens, pool.active, pool.budgets,
+                    pool.eos_ids, key, window=window, horizon=h))
+
+            (tokens, k_out, v_out, pool.positions, pool.last_tokens,
+             pool.active, pool.budgets) = self._attempted_engine(
+                launch, "decode dispatch")
+            if record_jit_key(self._decode, ("decode", window, h)):
+                # this dispatch just paid a compile anyway — the one
+                # moment measuring the program's temp HBM is off the
+                # steady-state path (no-op unless a ledger is armed)
+                self._note_decode_program(window, h)
         if self._paged:
             pool.k_pages, pool.v_pages = k_out, v_out
         else:
             pool.k_caches, pool.v_caches = k_out, v_out
-        if record_jit_key(self._decode, ("decode", window, h)):
-            # this dispatch just paid a compile anyway — the one
-            # moment measuring the program's temp HBM is off the
-            # steady-state path (no-op unless a ledger is armed)
-            self._note_decode_program(window, h)
         self._blocks.append(
-            _TokenBlock(tokens, h, window, dict(self._running)))
+            _TokenBlock(tokens, h, window, dict(self._running), k=k))
         self.metrics.record_dispatch(h, overlapped)
         graftscope.emit("decode.dispatch", cat="serving", window=window,
-                        horizon=h, overlapped=overlapped,
+                        horizon=h, draft_k=k, overlapped=overlapped,
                         occupancy=pool.occupancy)
 
     def _overlap_ok(self) -> bool:
@@ -1952,7 +2333,7 @@ class ServingEngine:
             tokens = self._attempted_engine(
                 attempt, "horizon token-block readback")
             realized: Dict[int, int] = {}
-            for h in range(block.h):
+            for h in range(block.rows):
                 for slot, request in block.slots.items():
                     if self._running.get(slot) is not request:
                         continue  # finished in an earlier step/block
@@ -1960,7 +2341,8 @@ class ServingEngine:
                         # tokens are in a later block)
                     token = int(tokens[h, slot])
                     if token < 0:
-                        continue  # device froze the row pre-block
+                        continue  # device froze the row pre-block (or
+                        # rejected the draft position, under spec)
                     request.tokens.append(token)
                     realized[slot] = realized.get(slot, 0) + 1
                     reason = self._finished(request, token)
@@ -1974,8 +2356,42 @@ class ServingEngine:
                     events.append((request, token, reason is not None))
             pool.note_advance_slots(realized)
             emitted = sum(realized.values())
+            if block.k:
+                self._note_spec_drain(block, tokens, realized)
             drain_span.note(tokens=emitted)
         return block.window, emitted
+
+    def _note_spec_drain(self, block: _TokenBlock, tokens,
+                         realized: Dict[int, int]) -> None:
+        """Acceptance accounting for one drained speculative block
+        (graftspec): per (pass, slot), the emitted-row count ``e``
+        means ``e - 1`` accepted drafts (an active pass always emits
+        its verified pending token first). Feeds the ``accept_len``
+        percentiles + drafted/accepted counters, the pick_draft_k
+        collapse EMA, and the drafters' n-gram refresh for every slot
+        that advanced."""
+        k1 = block.k + 1
+        mat = (np.asarray(tokens) >= 0).reshape(block.h, k1, -1)
+        e = mat.sum(axis=1)                      # [passes, slots]
+        act = e >= 1                             # active verify passes
+        passes = int(act.sum())
+        accept_lens = (e[act] - 1).tolist()
+        accepted = int(sum(accept_lens))
+        drafted = block.k * passes
+        if passes:
+            self.metrics.record_spec(drafted, accept_lens)
+            rate = accepted / drafted if drafted else 0.0
+            ema = self._accept_ema
+            self._accept_ema = (rate if ema is None
+                                else 0.75 * ema + 0.25 * rate)
+        self._last_spec = (drafted, accepted, passes, block.k)
+        if self._drafter is not None:
+            for slot in realized:
+                request = block.slots.get(slot)
+                if request is not None:
+                    self._drafter.note_history(
+                        slot,
+                        list(request.prompt) + list(request.tokens))
 
     def step(self) -> List[Tuple[Request, int, bool]]:
         """One engine iteration: admit (a whole prompt per free slot,
@@ -2017,11 +2433,24 @@ class ServingEngine:
             if self._overlap_ok():
                 self._dispatch(overlapped=True)
             occupancy = pool.occupancy  # before releases, like PR 2
+            self._last_spec = None
             window, emitted = self._drain_one(events)
             dt = time.perf_counter() - t0
             self.metrics.record_decode_step(
                 dt, emitted, occupancy, self.scheduler.queue_depth,
                 window)
+            if self._last_spec is not None:
+                # spec.verify rides the bus at the drain boundary the
+                # host already synced; waste_s apportions the step's
+                # wall to the REJECTED verify rows — the GoodputLedger
+                # books it as goodput_spec_waste_s, not productive
+                drafted, accepted, passes, k = self._last_spec
+                rows = passes * (k + 1)
+                waste = (dt * (drafted - accepted) / rows
+                         if rows else 0.0)
+                graftscope.emit_span(
+                    "spec.verify", dt, cat="serving", drafted=drafted,
+                    accepted=accepted, passes=passes, waste_s=waste)
         self._step_idx += 1
         if self.journal is not None and events:
             # one fsync'd WAL batch per step, at the drain boundary
@@ -2200,7 +2629,18 @@ def audit_programs():
     committed graftmeter budget records the argument-bytes drop of
     pages-vs-dense (the pool's num_pages is sized BELOW dense worst
     case here, as production would), and any drift in the table-driven
-    gather/scatter structure fails the gate."""
+    gather/scatter structure fails the gate.
+
+    The SPEC ladder (graftspec) fingerprints the draft+verify
+    programs on the same reduced structural family: self-draft dense
+    at {8, 32} x {1, 4} x k=4, the paged twin and the draft-model
+    twin at (32, 4, 4). The committed costs.json budgets are the
+    bandwidth argument made enforceable: the verify pass must show
+    ~(k+1)x the non-spec program's FLOPs at ~1x its bytes accessed
+    (more MXU rows over the same weight/KV stream) — drift in either
+    direction fails tier-1 (``tests/test_graftspec.py`` pins the
+    ratio from the committed records). Spec OFF leaves the original
+    programs' fingerprints untouched (separate jitted function)."""
     def specs():
         # ONE audit geometry across the LM-family hooks
         from ..analysis.programs import audit_tiny_gpt
@@ -2255,6 +2695,79 @@ def audit_programs():
                                 f"_h{horizon}",
                         "min_devices": 1, "build": build,
                     })
+
+        # ---- graftspec: the draft+verify ladder ----
+        spec = ServingEngine(model, params, max_slots=4, s_max=32,
+                             min_bucket=8, decode_horizon=4,
+                             decode_buckets=(8, 32), draft_k=4)
+        spec_paged = ServingEngine(model, params, max_slots=4,
+                                   s_max=32, min_bucket=8,
+                                   decode_horizon=4, kv_layout="paged",
+                                   page_size=8, num_pages=13,
+                                   decode_buckets=(32,), draft_k=4)
+        draft_model = audit_tiny_gpt(num_layers=1)
+        draft_params = jax.eval_shape(
+            lambda: draft_model.init(jax.random.PRNGKey(0),
+                                     jnp.zeros((1, 1), jnp.int32),
+                                     train=False))["params"]
+        spec_dm = ServingEngine(model, params, max_slots=4, s_max=32,
+                                min_bucket=8, decode_horizon=4,
+                                decode_buckets=(32,), draft_k=4,
+                                draft_model=draft_model,
+                                draft_params=draft_params)
+
+        def spec_args(eng, table=True):
+            base = decode_args(eng)[:-1]  # greedy spec takes no key
+            if table:
+                return base + (jax.ShapeDtypeStruct(
+                    eng._drafter._table.shape, jnp.int32),)
+            return base
+
+        # (8, 4) is the windowed-slice structural variant; (32, *) is
+        # the full-cache one — the {1, H} rungs ride the latter (a
+        # w8_h1 entry would duplicate both families)
+        for window, horizon in ((8, 4), (32, 1), (32, 4)):
+            def build(a=spec_args(spec), w=window, h=horizon):
+                return {
+                    "fn": spec._decode_spec, "args": a,
+                    "kwargs": {"window": w, "horizon": h,
+                               "draft_k": 4},
+                    # the verify pass moves zero collective bytes too
+                    # — speculation spends BANDWIDTH slack, it never
+                    # buys communication
+                    "expect_collectives": {},
+                }
+            out.append({
+                "name": f"serving_decode_spec_w{window}_h{horizon}_k4",
+                "min_devices": 1, "build": build,
+            })
+
+        def build_spec_paged():
+            return {
+                "fn": spec_paged._decode_spec,
+                "args": spec_args(spec_paged),
+                "kwargs": {"window": 32, "horizon": 4, "draft_k": 4},
+                "expect_collectives": {},
+            }
+
+        out.append({"name": "serving_decode_spec_paged_w32_h4_k4",
+                    "min_devices": 1, "build": build_spec_paged})
+
+        def build_spec_dm():
+            pool = spec_dm.pool
+            args = (params, draft_params, sds(pool.k_caches),
+                    sds(pool.v_caches), sds(spec_dm._draft_k_caches),
+                    sds(spec_dm._draft_v_caches), sds(pool.positions),
+                    sds(pool.last_tokens), sds(pool.active),
+                    sds(pool.budgets), sds(pool.eos_ids))
+            return {
+                "fn": spec_dm._decode_spec, "args": args,
+                "kwargs": {"window": 32, "horizon": 4, "draft_k": 4},
+                "expect_collectives": {},
+            }
+
+        out.append({"name": "serving_decode_spec_draft_w32_h4_k4",
+                    "min_devices": 1, "build": build_spec_dm})
         return out
 
     return specs()

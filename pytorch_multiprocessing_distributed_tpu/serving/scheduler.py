@@ -42,18 +42,25 @@ def bucket_length(length: int, min_bucket: int, s_max: int) -> int:
 
 
 def pick_horizon(h_max: int, window: int, max_pos: int,
-                 min_remaining: int, admission_pending: bool) -> int:
+                 min_remaining: int, admission_pending: bool,
+                 per_step: int = 1) -> int:
     """Adaptive fused-decode horizon, snapped to the ``{1, h_max}``
     ladder (two compiled scan lengths per window bucket, never a
     program per horizon value).
 
-    The candidate is ``min(h_max, window - max_pos, min_remaining)``:
+    The candidate is ``min(h_max, (window - max_pos) // per_step,
+    min_remaining)``:
 
     - ``window - max_pos`` — steps until the highest-positioned slot's
       write would cross the picked attention-window bucket (crossing
       mid-scan would need a wider window for the WHOLE horizon; running
       single steps up to the boundary keeps small-bucket traffic
-      paying small-bucket attention);
+      paying small-bucket attention). ``per_step`` is the worst-case
+      position advance per scan pass — 1 for plain decode,
+      ``draft_k + 1`` under speculation (graftspec), where every pass
+      may write (and READ, at its last verify query) that many
+      columns, so the whole horizon must fit ``h * per_step`` columns
+      inside the window;
     - ``min_remaining`` — the shortest remaining decode budget among
       running slots: a horizon that mostly outlives every request just
       burns frozen-row compute;
@@ -69,8 +76,42 @@ def pick_horizon(h_max: int, window: int, max_pos: int,
     """
     if h_max <= 1 or admission_pending:
         return 1
-    h = min(h_max, window - max_pos, min_remaining)
+    h = min(h_max, (window - max_pos) // max(1, per_step),
+            min_remaining)
     return h_max if h >= h_max else 1
+
+
+def pick_draft_k(k_max: int, accept_ema: Optional[float],
+                 cooldown_active: bool, probe: bool = False,
+                 min_accept: float = 0.125) -> int:
+    """Adaptive draft length for speculative decode (graftspec),
+    snapped to the ``{0, k_max}`` ladder — the same
+    two-compiled-programs discipline as :func:`pick_horizon` (the
+    decode compile set stays ``buckets x {1, H} x {k off, on}``).
+
+    Collapses to 0 (the plain non-speculative program — one global
+    read, zero spec overhead) when:
+
+    - ``cooldown_active``: a recovered fault opened the post-fault
+      window; degraded mode wants the smallest blast radius per
+      dispatch, and a verify pass multiplies the work a repeat would
+      lose;
+    - ``accept_ema`` (the engine's decayed mean of accepted-drafts/k
+      per verify pass) has fallen below ``min_accept``: drafts that
+      never match burn (k+1)x query FLOPs for 1x tokens. ``probe``
+      overrides the collapse for one dispatch so a stream that turned
+      repetitive again can re-arm — the engine probes periodically
+      while collapsed (acceptance data only exists when drafts run).
+
+    ``accept_ema=None`` (no verify pass measured yet) arms
+    optimistically: the first measurement decides.
+    """
+    if k_max <= 0 or cooldown_active:
+        return 0
+    if (accept_ema is not None and accept_ema < min_accept
+            and not probe):
+        return 0
+    return k_max
 
 
 class PrefillPlan:

@@ -1,0 +1,141 @@
+"""graftspec host state: self-drafting n-gram tables for the engine.
+
+The speculative decode path (:func:`...inference.generate.
+_decode_horizon` with ``draft_k > 0``) needs k proposals per slot per
+scan pass. Self-drafting gets them from the request's OWN
+prompt + emitted tokens: a per-slot unigram index mapping each token
+(hashed — the same host/device-shared formula discipline the PR 10
+prefix cache uses for prompt keys) to the k tokens that followed its
+most recent occurrence. Repetitive text — templated prompts, code,
+looping continuations — makes those proposals match the target's own
+greedy outputs, and every match is one more token per weight stream.
+
+The table is **host-mirrored with lazy dirty upload**, exactly the
+``PagePool.device_table()`` discipline: refreshed at drain/admission
+boundaries with a BOUNDED backward scan over the recent history
+(host numpy, no device work; most-recent occurrences win, and the
+scan stops once every bucket is owned or the recency window is
+exhausted — never O(full history) per drained block), uploaded ONLY
+when a slot's index actually changed — a converged repetitive stream
+stops changing its index, so steady-state dispatches re-use the
+device copy (zero transfers; the upload carries its own
+``expected_transfer`` annotation).
+
+Correctness never depends on the table's contents: a stale, missing
+(``-1``) or colliding entry only lowers acceptance — every emitted
+token is the TARGET model's greedy output, verified on device.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..inference.generate import DRAFT_HASH_PRIME
+
+__all__ = ["NgramDrafter", "ngram_bucket"]
+
+
+def ngram_bucket(tokens, n_buckets: int) -> np.ndarray:
+    """Host (numpy) twin of :func:`...inference.generate.draft_bucket`
+    — uint32 wraparound multiply, test-pinned equal to the device
+    formula."""
+    arr = np.asarray(tokens, dtype=np.uint32)
+    with np.errstate(over="ignore"):
+        h = arr * np.uint32(DRAFT_HASH_PRIME)
+    return (h % np.uint32(n_buckets)).astype(np.int32)
+
+
+class NgramDrafter:
+    """Per-slot unigram draft tables, ``[max_slots, buckets, k]``
+    int32 (``-1`` = no proposal — the scan never accepts it).
+
+    ``note_history(slot, history)`` refreshes one slot's index from
+    its request's token history (prompt + emitted), at boundaries
+    where the host already synchronized — admission and horizon
+    drain. The most recent occurrence of a token wins its bucket, so
+    the rebuild walks BACKWARD and stops as soon as every bucket is
+    owned — and unconditionally after ``scan_window`` positions (a
+    recency window: self-drafting draws its value from recent
+    structure, and an unbounded walk would put O(full history) Python
+    work on the drain hot path per block). A stream that settles into
+    a loop converges to a fixed index and the device upload stops."""
+
+    def __init__(self, max_slots: int, draft_k: int,
+                 n_buckets: int = 64, place=None,
+                 scan_window: Optional[int] = None):
+        if max_slots < 1:
+            raise ValueError(f"max_slots must be >= 1, got {max_slots}")
+        if draft_k < 1:
+            raise ValueError(f"draft_k must be >= 1, got {draft_k}")
+        if n_buckets < 1:
+            raise ValueError(
+                f"n_buckets must be >= 1, got {n_buckets}")
+        self.max_slots = int(max_slots)
+        self.k = int(draft_k)
+        self.n_buckets = int(n_buckets)
+        # default recency window: enough positions that every bucket
+        # COULD be claimed several times over, small enough that a
+        # near-s_max history costs O(window), not O(history)
+        self.scan_window = (int(scan_window) if scan_window is not None
+                            else 4 * self.n_buckets)
+        if self.scan_window < 1:
+            raise ValueError(
+                f"scan_window must be >= 1, got {self.scan_window}")
+        self._place = place if place is not None else (lambda a: a)
+        self._table = np.full(
+            (self.max_slots, self.n_buckets, self.k), -1, np.int32)
+        self._dev = None
+        self._dirty = True
+        self.uploads = 0  # telemetry: how often the mirror moved
+
+    def build_row(self, history: Sequence[int]) -> np.ndarray:
+        """One slot's ``[buckets, k]`` index from a token history:
+        backward walk over (at most) the ``scan_window`` most recent
+        context positions, early-exited once every bucket is owned."""
+        row = np.full((self.n_buckets, self.k), -1, np.int32)
+        hist = np.asarray(list(history), np.int32)
+        if hist.size < 2:
+            return row
+        lo = max(0, hist.size - 1 - self.scan_window)
+        buckets = ngram_bucket(hist[lo:-1], self.n_buckets)
+        filled = np.zeros((self.n_buckets,), bool)
+        left = self.n_buckets
+        for j in range(hist.size - 2, lo - 1, -1):
+            b = buckets[j - lo]
+            if filled[b]:
+                continue  # a LATER occurrence already owns the bucket
+            filled[b] = True
+            nxt = hist[j + 1:j + 1 + self.k]
+            row[b, :nxt.size] = nxt
+            left -= 1
+            if not left:
+                break  # every bucket owned — older context can't win
+        return row
+
+    def note_history(self, slot: int, history: Sequence[int]) -> None:
+        """Refresh ``slot``'s index; marks the device copy dirty only
+        when the index actually changed (a converged loop stops
+        uploading)."""
+        row = self.build_row(history)
+        if not np.array_equal(row, self._table[slot]):
+            self._table[slot] = row
+            self._dirty = True
+
+    def device_table(self):
+        """The ``[max_slots, buckets, k]`` device operand, re-uploaded
+        lazily — the ``PagePool.device_table()`` dirty-upload
+        discipline, annotation included."""
+        if self._dirty or self._dev is None:
+            from ..analysis.sentinels import expected_transfer
+
+            with expected_transfer("draft-table upload after a slot's "
+                                   "n-gram index changed (graftspec "
+                                   "host-mirrored self-drafting)"):
+                self._dev = self._place(jnp.asarray(self._table))
+            self._dirty = False
+            self.uploads += 1
+        return self._dev

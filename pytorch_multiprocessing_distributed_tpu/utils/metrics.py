@@ -165,6 +165,14 @@ class ServingMetrics:
         self.prefix_partial_hits = 0
         self.prefix_misses = 0
         self.page_holds = 0
+        # graftspec counters: draft tokens proposed vs accepted by the
+        # batched verify pass, and the per-pass accepted-length
+        # percentiles (accept_len p50/p95/p99 — the distribution the
+        # draft source's quality shows up in; tokens/target-step =
+        # 1 + accept_len mean)
+        self.tokens_drafted = 0
+        self.tokens_accepted = 0
+        self.accept_len = PercentileMeter()
         self._elapsed = 0.0
         self._occupancy_max = 0
         self._queue_wait_max = 0.0
@@ -179,7 +187,7 @@ class ServingMetrics:
         behind a long-running stats server; tests and short benches
         keep the uncapped default."""
         for meter in (self.ttft, self.queue_wait, self.decode_step,
-                      self.request_tokens):
+                      self.request_tokens, self.accept_len):
             meter.bound(max_samples)
 
     def record_first_token(self, ttft_seconds: float) -> None:
@@ -270,6 +278,17 @@ class ServingMetrics:
         else:
             self.prefix_misses += 1
 
+    # ---- speculative-decode counters (graftspec) ----
+    def record_spec(self, drafted: int, accept_lens) -> None:
+        """One drained speculative block: ``drafted`` draft tokens
+        proposed across its active verify passes, ``accept_lens`` the
+        per-(pass, slot) accepted-draft counts (each in
+        ``[0, draft_k]``; emitted tokens per pass = accepted + 1)."""
+        self.tokens_drafted += int(drafted)
+        for a in accept_lens:
+            self.tokens_accepted += int(a)
+            self.accept_len.update(float(a))
+
     def record_page_hold(self) -> None:
         """One admission deferred because the page pool could not
         cover the FIFO head's demand — the head stays QUEUED (held,
@@ -318,6 +337,19 @@ class ServingMetrics:
             "prefix_partial_hits": self.prefix_partial_hits,
             "prefix_misses": self.prefix_misses,
             "page_holds": self.page_holds,
+            # graftspec: verify passes = accept_len samples; tokens
+            # per target-model step is THE speculative headline (1.0
+            # = non-speculative; every point above it is a token the
+            # bandwidth-bound weight stream yielded for free)
+            "spec_tokens_drafted": self.tokens_drafted,
+            "spec_tokens_accepted": self.tokens_accepted,
+            "spec_verify_passes": self.accept_len.count,
+            "spec_accept_rate": (
+                0.0 if self.tokens_drafted == 0
+                else self.tokens_accepted / self.tokens_drafted),
+            "spec_accepted_per_target_step": (
+                0.0 if self.accept_len.count == 0
+                else 1.0 + self.accept_len.avg),
         }
         # graftscope percentile telemetry: the tail IS the SLO
         for name, meter in (("ttft", self.ttft),
@@ -328,6 +360,8 @@ class ServingMetrics:
         for q, v in self.request_tokens.percentiles((50, 95)).items():
             snap[f"tokens_per_request_{q}"] = v
         snap["tokens_per_request_avg"] = self.request_tokens.avg
+        for q, v in self.accept_len.percentiles((50, 95, 99)).items():
+            snap[f"accept_len_{q}"] = v
         return snap
 
     # counters whose deltas snapshot_delta reports
@@ -336,6 +370,7 @@ class ServingMetrics:
         "requests_failed", "requests_shed", "requests_redelivered",
         "dispatches", "host_syncs",
         "dispatch_retries", "horizon_collapses", "watchdog_trips",
+        "tokens_drafted", "tokens_accepted",
     )
 
     def snapshot_delta(self) -> dict:

@@ -12,8 +12,11 @@ prompts can prefill in fixed chunks interleaved with decode
 (``--prefill_chunk`` — no resident request stalls longer than one
 chunk), steady-state decode can fuse H steps into one dispatched scan
 with one (overlapped) readback per horizon (``--decode_horizon`` —
-host syncs/token = 1/H), and per-request tokens stream to stdout as
-they are emitted.
+host syncs/token = 1/H), speculative decode can verify up to K
+drafted tokens per target pass (``--draft_k`` [+ ``--draft_model``],
+graftspec — greedy only, byte-identical streams, 1..K+1 tokens per
+weight stream), and per-request tokens stream to stdout as they are
+emitted.
 
 Request sources (first match wins):
   --requests FILE   JSON Lines, one request per line:
@@ -87,9 +90,16 @@ parser.add_argument('--decode_buckets', default='auto', type=str,
                          "(powers of two up to s_max), 'off' (always "
                          "the full s_max window — the pre-bucketing "
                          "behavior), or explicit sizes '64,128,512'. "
-                         "One decode compile per bucket touched; step "
-                         "cost tracks the longest ACTIVE sequence's "
-                         "bucket instead of s_max")
+                         "Step cost tracks the longest ACTIVE "
+                         "sequence's bucket instead of s_max. "
+                         "COMPILE-LADDER COST MODEL: the decode "
+                         "program set is buckets x {1, H} x {k off, "
+                         "on} — one compile per (window bucket "
+                         "touched) x (single-step and --decode_"
+                         "horizon rung) x (plain and, with --draft_k, "
+                         "speculative) — so an n-bucket ladder "
+                         "compiles at most 4n decode programs, never "
+                         "one per batch composition or prompt length")
 parser.add_argument('--prefill_chunk', default=0, type=int,
                     help='admit prompts in fixed chunks of N tokens, '
                          'one chunk per engine step interleaved with '
@@ -103,7 +113,14 @@ parser.add_argument('--decode_horizon', default=1, type=int,
                          'steady state) — host syncs/token drops to '
                          '1/H; the horizon collapses to 1 while '
                          'admission work is pending, so join latency '
-                         'stays bounded (1 = per-step decode)')
+                         'stays bounded (1 = per-step decode). '
+                         'Compile cost: the {1, H} rung of the '
+                         'buckets x {1, H} x {k off, on} decode '
+                         'ladder (see --decode_buckets) — raising H '
+                         'adds at most one program per bucket (x2 '
+                         'with --draft_k armed), never a program per '
+                         'horizon value (intermediate horizons snap '
+                         'to 1)')
 parser.add_argument('--decode_attn', default='auto',
                     choices=['auto', 'xla', 'pallas'],
                     help='decode-step attention: fused flash-decode '
@@ -131,6 +148,23 @@ parser.add_argument('--prefix_cache', default=0, type=int,
                          'shared-prefix cache — identical prompts '
                          'prefill ONCE and re-join copy-on-write '
                          '(TTFT(hit) ~ one decode step); 0 = off')
+parser.add_argument('--draft_k', default=0, type=int,
+                    help='graftspec: arm speculative decode with up '
+                         'to K draft tokens verified per target pass '
+                         '(greedy serving only — rejected loudly with '
+                         '--temperature > 0). Self-drafting n-gram '
+                         'tables by default; token streams stay '
+                         'byte-identical to the non-speculative '
+                         'engine (0 = off)')
+parser.add_argument('--draft_model', default='', type=str,
+                    help='graftspec: registry name of a small DRAFT '
+                         'model proposing the k tokens instead of '
+                         'self-drafting (must share the vocab; pair '
+                         'with --draft_ckpt for trained drafts)')
+parser.add_argument('--draft_ckpt', default='', type=str,
+                    help='msgpack checkpoint for --draft_model '
+                         '(default: random init — correct but '
+                         'low-acceptance; fine for smoke runs)')
 parser.add_argument('--max_new_tokens', default=32, type=int,
                     help='default per-request budget (jsonl requests '
                          'override per line)')
@@ -287,6 +321,25 @@ def main():
     else:
         decode_buckets = [int(b) for b in args.decode_buckets.split(',')]
 
+    # graftspec: loud rejection BEFORE any compile — a sampled stream
+    # cannot be verified by argmax matching
+    if args.draft_k and args.temperature > 0:
+        raise SystemExit(
+            "--draft_k (speculative decode) is greedy-only: drop "
+            "--temperature or disarm speculation")
+    if args.draft_model and not args.draft_k:
+        raise SystemExit("--draft_model needs --draft_k > 0")
+    draft_model = draft_params = None
+    if args.draft_k and args.draft_model:
+        draft_model = models.get_model(
+            args.draft_model, dtype=dtype,
+            vocab_size=model.vocab_size, attn_impl="xla")
+        if args.draft_ckpt:
+            draft_params = load_params(draft_model, args.draft_ckpt,
+                                       "msgpack", None)
+        else:
+            draft_params = init_params(draft_model, args.seed + 1)
+
     def build_engine(journal):
         return ServingEngine(
             model, params,
@@ -310,6 +363,9 @@ def main():
                        if args.kv_layout == 'paged' else None),
             prefix_cache=(args.prefix_cache
                           if args.kv_layout == 'paged' else 0),
+            draft_k=args.draft_k,
+            draft_model=draft_model,
+            draft_params=draft_params,
             journal=journal)
 
     def emit(events):

@@ -1,0 +1,39 @@
+"""GL116 near-miss: the legitimate shapes — array masking instead of
+branching, lax.cond on the traced predicate, Python branches on HOST
+values (config, shapes, None checks), and the same `if mask:` pattern
+in a plain host function (not jit-traced)."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def masked_accept(drafts, greedy):
+    accepted = jnp.all(drafts == greedy)
+    return jnp.where(accepted, greedy, drafts)
+
+
+@jax.jit
+def cond_accept(drafts, greedy):
+    accepted = jnp.all(drafts == greedy)
+    return jax.lax.cond(accepted, lambda: greedy, lambda: drafts)
+
+
+@jax.jit
+def host_value_branches(x, flag=None):
+    n = x.shape[0]
+    if flag is None:
+        return x
+    if n > 4:
+        return x * 2
+    shape = jax.eval_shape(lambda a: a, x)
+    if shape.dtype == jnp.float32:
+        return x + 1
+    return x
+
+
+def host_loop(xs):
+    # not jit-traced: a numpy-style bool here is ordinary Python
+    mask = jnp.any(jnp.asarray(xs) > 0)
+    if bool(mask):
+        return list(xs)
+    return []

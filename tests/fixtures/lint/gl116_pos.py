@@ -1,0 +1,46 @@
+"""GL116 positive: Python control flow coercing a traced array to
+bool inside jit-traced code — the accept-mask bug class. Builds the
+mask with jnp, then branches on it: nothing wrong at import time,
+TracerBoolConversionError the moment the function traces."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def accept_branch(drafts, greedy):
+    accepted = jnp.all(drafts == greedy)
+    if accepted:                                   # <- GL116
+        return greedy
+    return drafts
+
+
+@jax.jit
+def accept_loop(x):
+    mask = jnp.any(x > 0)
+    while mask:                                    # <- GL116
+        x = x - 1
+        mask = jnp.any(x > 0)
+    return x
+
+
+@jax.jit
+def accept_bool(x, y):
+    same = jnp.array_equal(x, y)
+    return 1 if bool(same) else 0                  # <- GL116
+
+
+@jax.jit
+def direct_call_test(x):
+    y = x * 2  # a derived local, so GL106 (root-param rule) is silent
+    if jnp.any(y < 0):                             # <- GL116
+        return -y
+    return y
+
+
+@jax.jit
+def boolop_test(x, y):
+    hit = jnp.all(x == y)
+    ok = jnp.any(y > 0)
+    if hit and ok:                                 # <- GL116
+        return x
+    return y

@@ -272,6 +272,14 @@ def test_serving_ladder_fingerprints_cover_decode_programs():
     # {8, 32} bucket set (one gather/scatter shape recipe per window)
     expected |= {f"serving_decode_paged_w{w}_h{h}"
                  for w in (8, 32) for h in (1, horizon)}
+    # graftspec: the draft+verify ladder — windowed-slice (w8) and
+    # full-cache (w32) structural variants, the {1, H} rungs on the
+    # latter, plus the paged and draft-model twins
+    expected |= {"serving_decode_spec_w8_h4_k4",
+                 "serving_decode_spec_w32_h1_k4",
+                 "serving_decode_spec_w32_h4_k4",
+                 "serving_decode_spec_paged_w32_h4_k4",
+                 "serving_decode_spec_draft_w32_h4_k4"}
     assert names == expected
     committed = graftcheck.load_fingerprints(
         graftcheck.default_fingerprints_path())
