@@ -4,7 +4,7 @@
 PYTEST_ENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 
 .PHONY: test test-fast lint check check-update chaos soak scope meter \
-        fleet spec dryrun bench bench-cpu store clean
+        fleet spec zero dryrun bench bench-cpu store clean
 
 # graftlint: AST-only jit-hygiene gate (no jax import, milliseconds).
 # Exit 1 on any non-baselined finding; the tier-1 suite and
@@ -84,6 +84,18 @@ fleet:
 # tests/test_graftspec.py).
 spec:
 	$(PYTEST_ENV) python benchmarks/spec_smoke.py
+
+# graftzero: sharded-weight-update smoke — on a 2-shard CPU mesh the
+# traced zero DP step must move grads as exactly ONE reduce-scatter +
+# ONE all-gather with ZERO grad-sized psums (budget flip), the armed
+# HBM ledger must show hbm_opt_state_bytes == the plan's per-chip
+# shard bytes (~1/N, byte-exact vs plan_capacity(zero_shards=N)), a
+# 3-step sharded trajectory must be BIT-identical to the replicated
+# one, and a gather-on-save checkpoint must round-trip into a
+# replicated run. Same body runs in tier-1
+# (test_zero_smoke_end_to_end in tests/test_graftzero.py).
+zero:
+	$(PYTEST_ENV) python benchmarks/zero_smoke.py
 
 # full suite on the virtual 8-device CPU mesh (incl. slow e2e CLI runs)
 test:

@@ -378,7 +378,8 @@ def compile_step(step, *args):
 
 
 def build_workload(config: str, dtype_name: str, batch_size: int,
-                   devices, remat: bool = False, vocab_chunks: int = 0):
+                   devices, remat: bool = False, vocab_chunks: int = 0,
+                   zero: bool = False, zero_overlap: bool = True):
     """Construct the EXACT program a config benches: the jitted train
     step, its initialized state, the resident device batch, and the
     item count per step. The ONE place this lives — ``run_bench`` times
@@ -437,7 +438,8 @@ def build_workload(config: str, dtype_name: str, batch_size: int,
             model, jax.random.PRNGKey(0), tokens[:2], opt
         )
         step = make_lm_train_step(model, opt, mesh, remat=remat,
-                                  vocab_chunks=vocab_chunks)
+                                  vocab_chunks=vocab_chunks, zero=zero,
+                                  zero_overlap=zero_overlap)
         batch_args = shard_batch((tokens,), mesh)
         items_per_step = batch * s  # tokens
     else:
@@ -451,18 +453,27 @@ def build_workload(config: str, dtype_name: str, batch_size: int,
         state = create_train_state(
             model, jax.random.PRNGKey(0), jnp.zeros((2, s, s, 3)), opt
         )
-        step = make_train_step(model, opt, mesh, remat=remat)
+        step = make_train_step(model, opt, mesh, remat=remat, zero=zero,
+                               zero_overlap=zero_overlap)
         x = jnp.asarray(rng.normal(size=(batch, s, s, 3)), jnp.float32)
         y = jnp.asarray(rng.integers(0, cfg["num_classes"], (batch,)))
         batch_args = shard_batch((x, y), mesh)
         items_per_step = batch  # images
 
+    if zero:
+        # graftzero: moments sharded from step one (the replicated
+        # tree never materializes); the step binds on this structure
+        from pytorch_multiprocessing_distributed_tpu.parallel.zero import (
+            zeroify_state)
+
+        state = zeroify_state(state, mesh)
     return step, state, batch_args, items_per_step, batch
 
 
 def run_bench(config: str, dtype_name: str, batch_size: int,
               min_window: float, warmup: int, devices, note,
-              remat: bool = False, vocab_chunks: int = 0) -> dict:
+              remat: bool = False, vocab_chunks: int = 0,
+              zero: bool = False) -> dict:
     import numpy as np
 
     n_dev = len(devices)
@@ -472,8 +483,14 @@ def run_bench(config: str, dtype_name: str, batch_size: int,
         min_window, warmup = min(min_window, 0.2), min(warmup, 1)
     step, state, batch_args, items_per_step, batch = build_workload(
         config, dtype_name, batch_size, devices, remat=remat,
-        vocab_chunks=vocab_chunks,
+        vocab_chunks=vocab_chunks, zero=zero,
     )
+    zero_plan = state.opt_state.plan if zero else None
+    if zero:
+        # the lazy zero wrapper has no .lower — hand the AOT path the
+        # bound jit program for this state structure (the exact
+        # program the loop runs)
+        step = step.jit_program(state)
     # graftfleet goodput accounting for the bench run itself: compile
     # seconds vs measured-window seconds vs everything else (warmup,
     # queue drains, window growth) over the run's wall clock
@@ -578,6 +595,92 @@ def run_bench(config: str, dtype_name: str, batch_size: int,
     eff = roofline(flops, bytes_accessed, step_s, peak, peak_bw)
     mfu = eff["mfu"]
 
+    # ---- graftzero comparison sweep (--zero): the replicated twin,
+    # the serialized (overlap-off) twin and a comm-only probe, each a
+    # short drained window — honest syncs, never a dispatch stopwatch.
+    # overlap_frac = (t_serialized - t_zero) / t_comm: the fraction of
+    # the standalone grad-comm wall the bucketed dependency chain
+    # hides under compute. hbm_opt_state_bytes is the measured
+    # per-chip ledger delta (sharded vs replicated moments).
+    zero_extra = {}
+    if zero:
+        import jax.numpy as _jnp
+
+        from pytorch_multiprocessing_distributed_tpu.parallel import (
+            zero as zero_mod)
+        from pytorch_multiprocessing_distributed_tpu.runtime import hbm
+        from pytorch_multiprocessing_distributed_tpu.runtime import (
+            scope as graftscope)
+        from pytorch_multiprocessing_distributed_tpu.train.step import (
+            register_state_hbm)
+
+        def timed_steps(fn, st, bargs, n):
+            st, m = fn(st, *bargs)
+            sync(m)  # drain: the clock cannot absorb queued work
+            t0 = time.perf_counter()
+            for _ in range(n):
+                st, m = fn(st, *bargs)
+            sync(m)
+            return (time.perf_counter() - t0) / n
+
+        n_cmp = max(2, n1 // 2) if is_tpu else 2
+        rep_step, rep_state, rep_args, _, _ = build_workload(
+            config, dtype_name, batch, devices, remat=remat,
+            vocab_chunks=vocab_chunks, zero=False)
+        with hbm.scoped_ledger() as rep_ledger:
+            register_state_hbm(rep_state)
+            rep_opt_bytes = rep_ledger.snapshot().get(
+                "hbm_opt_state_bytes", 0)
+        rep_s = timed_steps(rep_step, rep_state, rep_args, n_cmp)
+
+        ser_step, ser_state, ser_args, _, _ = build_workload(
+            config, dtype_name, batch, devices, remat=remat,
+            vocab_chunks=vocab_chunks, zero=True, zero_overlap=False)
+        with hbm.scoped_ledger() as z_ledger:
+            register_state_hbm(ser_state)
+            zero_opt_bytes = z_ledger.snapshot().get(
+                "hbm_opt_state_bytes", 0)
+        ser_s = timed_steps(ser_step, ser_state, ser_args, n_cmp)
+
+        mesh = rep_args[0].sharding.mesh
+        comm_fn = zero_mod.comm_probe(zero_plan, mesh)
+        dummies = [_jnp.zeros((b.padded,), _jnp.dtype(b.dtype))
+                   for b in zero_plan.buckets]
+
+        def comm_once(_st, *a):
+            out = comm_fn(list(a))
+            return _st, out
+
+        comm_s = timed_steps(comm_once, None, tuple(dummies), n_cmp)
+        comm_bytes = zero_mod.static_comm_bytes(zero_plan)
+        total_comm_bytes = (comm_bytes["reduce_scatter"]
+                            + comm_bytes["all_gather"])
+        # the measured grad-comm span on the bus (static bytes rider —
+        # the fleet.static_collective_bytes discipline), feeding the
+        # goodput ledger below like every other bench span
+        graftscope.emit_span("train.grad_comm", comm_s, cat="train",
+                             nbytes=total_comm_bytes,
+                             buckets=len(zero_plan.buckets))
+        overlap_frac = None
+        if comm_s > 0:
+            overlap_frac = max(0.0, min(1.0, (ser_s - step_s) / comm_s))
+        zero_extra = {
+            "zero": True,
+            "zero_shards": zero_plan.num_shards,
+            "zero_buckets": len(zero_plan.buckets),
+            "replicated_step_ms": round(1000 * rep_s, 3),
+            "serialized_step_ms": round(1000 * ser_s, 3),
+            "grad_comm_ms": round(1000 * comm_s, 3),
+            "grad_comm_bytes": total_comm_bytes,
+            "grad_comm_frac_of_step": (round(comm_s / step_s, 4)
+                                       if step_s > 0 else None),
+            "overlap_frac": (round(overlap_frac, 4)
+                             if overlap_frac is not None else None),
+            "hbm_opt_state_bytes": zero_opt_bytes,
+            "hbm_opt_state_bytes_replicated": rep_opt_bytes,
+        }
+        del rep_step, rep_state, ser_step, ser_state
+
     # graftfleet: goodput over this bench run (classified through the
     # same ledger the CLIs serve) + collective skew when a fleet
     # monitor is armed — None-safe on a single host, never a fake 0
@@ -633,9 +736,10 @@ def run_bench(config: str, dtype_name: str, batch_size: int,
             # must not bar a config from ever recording a baseline.
             "canonical": (batch_size == 0 and dtype_name == "bfloat16"
                           and is_tpu and not remat
-                          and vocab_chunks == 0),
+                          and vocab_chunks == 0 and not zero),
             "remat": remat,
             "vocab_chunks": vocab_chunks,
+            **zero_extra,
             "flops_per_step_per_chip": flops,
             "peak_flops_per_chip": peak,
             # ---- graftmeter efficiency attribution: every record
@@ -730,6 +834,14 @@ def build_parser():
                    help="LM configs: stream the head+CE over N vocab "
                         "slices (logits never materialize); 0 = dense. "
                         "Non-canonical probe knob like --remat")
+    p.add_argument("--zero", action="store_true",
+                   help="graftzero sweep: bench the sharded-update "
+                        "step AND its replicated/serialized twins + a "
+                        "comm-only probe — records replicated vs "
+                        "sharded step time, grad-comm bytes/wall, "
+                        "overlap_frac and the per-chip "
+                        "hbm_opt_state_bytes delta (~1/N). "
+                        "Non-canonical probe knob like --remat")
     return p
 
 
@@ -763,7 +875,8 @@ def main():
         result = run_bench(args.config, args.dtype, args.batch_size,
                            args.min_window, args.warmup, devices, note,
                            remat=args.remat,
-                           vocab_chunks=args.vocab_chunks)
+                           vocab_chunks=args.vocab_chunks,
+                           zero=args.zero)
     except BaseException as e:  # noqa: BLE001 — the JSON line must appear
         _log(traceback.format_exc())
         result = {

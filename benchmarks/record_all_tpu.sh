@@ -59,4 +59,12 @@ python benchmarks/mfu_tune.py --config resnet50_imagenet
 note "convergence (framework on TPU vs torch CPU)"
 python benchmarks/convergence.py --epochs 8 --train_size 2048
 
+note "graftzero sweep (sharded vs replicated step, grad-comm overlap, hbm_opt_state delta)"
+python bench.py --zero --config resnet50_imagenet \
+    > benchmarks/bench_zero_tpu.json 2> benchmarks/bench_zero_tpu.log
+tail -1 benchmarks/bench_zero_tpu.json >&2
+python bench.py --zero --config gpt_lm \
+    >> benchmarks/bench_zero_tpu.json 2>> benchmarks/bench_zero_tpu.log
+tail -1 benchmarks/bench_zero_tpu.json >&2
+
 note "done — review artifacts, then commit"

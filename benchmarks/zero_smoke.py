@@ -1,0 +1,154 @@
+"""graftzero smoke: the sharded weight update proves its claims on a
+2-shard CPU mesh in seconds.
+
+Asserts, end to end (same body runs in tier-1 as
+``tests/test_graftzero.py::test_zero_smoke_end_to_end``):
+
+1. **budget flip** — the traced zero DP step moves gradients as exactly
+   ONE reduce-scatter + ONE all-gather on the data axis and has ZERO
+   grad-sized psums (the replicated twin has its per-leaf psums), with
+   the NaN-guard's summed non-finite scalar psum still present;
+2. **ledger delta** — ``hbm_opt_state_bytes`` with sharded moments is
+   exactly the plan's per-chip shard bytes (~1/N of the replicated
+   gauge), measured off the armed graftmeter ledger, and
+   ``plan_capacity(zero_shards=N)`` quotes the SAME number byte-exactly;
+3. **trajectory** — 3 sharded steps land bit-identical to 3 replicated
+   steps (params AND gathered moments);
+4. **round-trip** — gather-on-save: the sharded state's checkpoint
+   restores into a replicated run and re-shards back, values intact.
+
+Run via ``make zero`` (sets the 8-virtual-device CPU env; the smoke
+uses 2 of them for the 2-shard mesh).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def run() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pytorch_multiprocessing_distributed_tpu.analysis import ir
+    from pytorch_multiprocessing_distributed_tpu.analysis.meter import (
+        plan_capacity)
+    from pytorch_multiprocessing_distributed_tpu.analysis.programs import (
+        audit_tiny_gpt)
+    from pytorch_multiprocessing_distributed_tpu.parallel import (
+        make_mesh, zero as zero_mod)
+    from pytorch_multiprocessing_distributed_tpu.runtime import hbm
+    from pytorch_multiprocessing_distributed_tpu.train.checkpoint import (
+        load_checkpoint, save_checkpoint)
+    from pytorch_multiprocessing_distributed_tpu.train.lm import (
+        create_lm_train_state, make_lm_train_step)
+    from pytorch_multiprocessing_distributed_tpu.train.optim import sgd
+    from pytorch_multiprocessing_distributed_tpu.train.step import (
+        register_state_hbm, shard_batch)
+
+    n = 2
+    mesh = make_mesh(n, devices=jax.devices()[:n])
+    # half the audit geometry: the smoke proves the contract, not the
+    # model — compile time is the whole cost of this gate
+    model = audit_tiny_gpt(dtype=jnp.float32, num_layers=1,
+                           hidden_size=16, mlp_dim=32, num_heads=2)
+    opt = sgd(learning_rate=0.1)
+    rng = np.random.default_rng(0)
+    toks = jnp.asarray(rng.integers(0, model.vocab_size, (8, 16)))
+    base = create_lm_train_state(model, jax.random.PRNGKey(0),
+                                 toks[:2], opt)
+
+    # ---- 1. budget flip -------------------------------------------
+    s_zero = zero_mod.zeroify_state(jax.tree.map(jnp.array, base), mesh)
+    step_zero = make_lm_train_step(model, opt, mesh, zero=True)
+    step_rep = make_lm_train_step(model, opt, mesh)
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), s_zero)
+    atoks = jax.ShapeDtypeStruct(toks.shape, toks.dtype)
+    closed = ir.trace(step_zero.jit_program(abstract), abstract, atoks)
+    budget = ir.collective_budget(closed)
+    pb = hbm.tree_nbytes(base.params)
+    assert budget.get("reduce_scatter@data", {}).get("count") == 1, budget
+    assert budget.get("all_gather@data", {}).get("count") == 1, budget
+    assert sum(1 for s in ir.psum_sizes(closed) if s == pb) == 0
+    assert max(ir.psum_sizes(closed)) <= 4  # loss/count/guard scalars
+    rep_closed = ir.trace(step_rep, jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), base), atoks)
+    rep_budget = ir.collective_budget(rep_closed)
+    assert "reduce_scatter@data" not in rep_budget
+    assert rep_budget["psum@data"]["count"] > budget.get(
+        "psum@data", {}).get("count", 0)
+    print(f"[zero_smoke] budget flip OK: zero={budget} "
+          f"(replicated psums: {rep_budget['psum@data']['count']})")
+
+    # ---- 2. ledger delta + planner agreement ----------------------
+    plan = s_zero.opt_state.plan
+    with hbm.scoped_ledger() as ledger:
+        register_state_hbm(s_zero)
+        sharded_bytes = ledger.snapshot()["hbm_opt_state_bytes"]
+    with hbm.scoped_ledger() as ledger:
+        register_state_hbm(base)
+        replicated_bytes = ledger.snapshot()["hbm_opt_state_bytes"]
+    # the ledger charges the whole opt_state: the sharded moment
+    # buckets (the plan's exact per-chip bytes) plus the replicated
+    # scalars (step count + init flag)
+    scalar_bytes = (hbm.tree_nbytes(base.opt_state)
+                    - hbm.tree_nbytes(base.opt_state.momentum))
+    assert sharded_bytes == plan.shard_bytes + scalar_bytes, (
+        sharded_bytes, plan.shard_bytes, scalar_bytes)
+    assert sharded_bytes < replicated_bytes / (n - 0.5), (
+        "sharded gauge is not ~1/N of replicated")
+    cap = plan_capacity(model, 64, 1 << 30, params=base.params,
+                        optimizer_moments=1, zero_shards=n)
+    assert cap["opt_state_bytes"] == plan.shard_bytes, (
+        cap["opt_state_bytes"], plan.shard_bytes)
+    print(f"[zero_smoke] ledger delta OK: {replicated_bytes} -> "
+          f"{sharded_bytes} bytes/chip (x{n} shards), planner agrees")
+
+    # ---- 3. bit-identical trajectory ------------------------------
+    s_rep = jax.tree.map(jnp.array, base)
+    (tb,) = shard_batch((toks,), mesh)
+    for _ in range(3):
+        s_rep, m_rep = step_rep(s_rep, tb)
+        s_zero, m_zero = step_zero(s_zero, tb)
+    assert float(m_rep["loss"]) == float(m_zero["loss"])
+    pr = jax.tree.leaves(jax.device_get(s_rep.params))
+    pz = jax.tree.leaves(jax.device_get(s_zero.params))
+    assert all(np.array_equal(a, b) for a, b in zip(pr, pz)), (
+        "sharded trajectory diverged from replicated")
+    inner = zero_mod.gather_opt_state(s_zero.opt_state, s_zero.params)
+    mr = jax.tree.leaves(jax.device_get(s_rep.opt_state.momentum))
+    mz = jax.tree.leaves(inner.momentum)
+    assert all(np.array_equal(np.asarray(a), np.asarray(b))
+               for a, b in zip(mr, mz))
+    print("[zero_smoke] 3-step trajectory bit-identical "
+          "(params + gathered moments)")
+
+    # ---- 4. gather-on-save round-trip ------------------------------
+    with tempfile.TemporaryDirectory() as tmp:
+        save_checkpoint(tmp, s_zero, epoch=3)
+        restored = load_checkpoint(
+            os.path.join(tmp, "model_3.pth"),
+            jax.tree.map(jnp.array, base))
+    rz = jax.tree.leaves(jax.device_get(restored.opt_state.momentum))
+    assert all(np.array_equal(np.asarray(a), np.asarray(b))
+               for a, b in zip(mz, rz))
+    rezero = zero_mod.zeroify_state(restored, mesh)
+    for a, b in zip(jax.tree.leaves(rezero.opt_state.inner.momentum),
+                    jax.tree.leaves(s_zero.opt_state.inner.momentum)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    print("[zero_smoke] checkpoint round-trip OK "
+          "(sharded -> replicated artifact -> re-sharded)")
+
+    print("zero smoke OK")
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.exit(run())

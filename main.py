@@ -52,6 +52,16 @@ parser.add_argument('--dtype', default='float32', choices=['float32', 'bfloat16'
                     help='compute dtype for conv/matmul (params stay f32)')
 parser.add_argument('--model_parallel', default=1, type=int,
                     help='model-axis size of the mesh (1 = pure DP, reference mode)')
+parser.add_argument('--zero', action='store_true',
+                    help='graftzero: sharded weight update on the '
+                         'explicit shard_map-DP step — grads reduce-'
+                         'scatter into per-rank bucket shards, the '
+                         'optimizer updates only the local shard '
+                         '(moments sharded from step one, ~1/world '
+                         'optimizer HBM per chip), params all-gather '
+                         'back. Bit-identical trajectory; checkpoints '
+                         'stay mode-portable (gather-on-save). Pure DP '
+                         'only — see --zero1/--fsdp for the GSPMD path')
 parser.add_argument('--zero1', action='store_true',
                     help='ZeRO-1: shard optimizer moments over the data '
                          'axis (each replica stores 1/world of them; '
@@ -212,6 +222,25 @@ def main(args):
             "the GSPMD partitioner cannot shard through the opaque "
             "Pallas call (it would replicate the moment buffers, "
             "defeating the sharding). Use --optimizer sgd there."
+        )
+    if args.zero and (args.zero1 or args.fsdp or args.model_parallel > 1):
+        raise ValueError(
+            "--zero is the explicit shard_map-DP sharded update; "
+            "--zero1/--fsdp/--model_parallel run the GSPMD path, which "
+            "shards state via placement instead — pick one family."
+        )
+    if args.zero and args.optimizer == "sgd_fused":
+        raise ValueError(
+            "--zero shards the update through the transform's "
+            "update()/shard_update() path; the fused Pallas whole-"
+            "update kernel cannot run on shards. Use --optimizer sgd "
+            "or lamb with --zero."
+        )
+    if args.zero and args.ckpt_backend == "orbax":
+        raise ValueError(
+            "--zero checkpoints via msgpack gather-on-save (the "
+            "artifact round-trips between --zero and plain runs); "
+            "--ckpt_backend orbax would persist the sharded layout."
         )
     if args.warmup_epochs and args.lr_schedule != "cosine":
         raise ValueError(
@@ -391,6 +420,7 @@ def main(args):
         epochs=args.epochs,
         print_freq=args.print_freq,
         start_epoch=start_epoch,
+        zero=args.zero,
         zero1=args.zero1,
         fsdp=args.fsdp,
         remat=args.remat,

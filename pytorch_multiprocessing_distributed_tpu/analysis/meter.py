@@ -222,6 +222,7 @@ def compare_costs(records: Dict[str, dict],
 
 def plan_capacity(model, s_max: int, hbm_budget: int, *,
                   params=None, optimizer_moments: int = 0,
+                  zero_shards: int = 1,
                   reserved_bytes: int = 0,
                   page_size: Optional[int] = None,
                   length_dist: Optional[Sequence[int]] = None) -> dict:
@@ -237,6 +238,15 @@ def plan_capacity(model, s_max: int, hbm_budget: int, *,
       optimizer_moments: moment buffers per parameter the resident
         optimizer keeps (serving: 0; SGD+momentum: 1; Adam/LAMB: 2) —
         each costs another ``params_bytes``.
+      zero_shards: graftzero DP degree (``--zero`` on the trainer
+        CLIs): optimizer moments are sharded into flat buckets over
+        this many ranks, so each chip pays ``shard_bytes`` (the exact
+        padded-bucket math of ``parallel.zero.plan_buckets`` — ONE
+        copy of the layout, byte-exact vs the real
+        :class:`~..parallel.zero.ZeroOptState` allocation) per moment
+        instead of ``params_bytes``. The freed ``(N-1)/N`` of the
+        optimizer state is exactly what this planner re-spends on
+        slots/batch. 1 = replicated (the default).
       reserved_bytes: extra fixed reservation (decode-program temps,
         runtime overhead) charged before slots are counted.
       page_size: PAGED mode (graftpage): plan a
@@ -277,7 +287,15 @@ def plan_capacity(model, s_max: int, hbm_budget: int, *,
     from ..runtime.hbm import tree_nbytes
 
     params_bytes = tree_nbytes(params)
-    opt_bytes = int(optimizer_moments) * params_bytes
+    if int(zero_shards) > 1:
+        # the SAME bucket layout the trainer allocates: per-chip
+        # moment cost = the padded flat shard, never an estimate
+        from ..parallel.zero import plan_buckets
+
+        per_moment = plan_buckets(params, int(zero_shards)).shard_bytes
+    else:
+        per_moment = params_bytes
+    opt_bytes = int(optimizer_moments) * per_moment
     per_slot = (SlotPool.per_slot_kv_bytes(model, s_max)
                 + SlotPool.per_slot_state_bytes())
     fixed = params_bytes + opt_bytes + int(reserved_bytes)
@@ -295,6 +313,7 @@ def plan_capacity(model, s_max: int, hbm_budget: int, *,
         "headroom_bytes": int(free - max_slots * per_slot),
         "max_generate_batch": int(max(0, free // per_row)),
         "s_max": int(s_max),
+        "zero_shards": int(zero_shards),
         "fits": fixed <= hbm_budget,
     }
     if page_size is None:
@@ -426,6 +445,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser.add_argument("--page_size", default=None, type=int,
                         help="--plan in PAGED mode: pages-per-chip at "
                              "this page size (graftpage)")
+    parser.add_argument("--optimizer_moments", default=0, type=int,
+                        help="--plan: resident moment buffers per "
+                             "parameter (SGD+momentum 1, LAMB 2)")
+    parser.add_argument("--zero_shards", default=1, type=int,
+                        help="--plan: graftzero DP degree — moments "
+                             "sharded over N ranks cost shard_bytes "
+                             "per chip instead of params_bytes")
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -439,6 +465,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         model = get_model(args.plan)
         plan = plan_capacity(model, min(args.s_max, model.max_seq_len),
                              int(args.hbm_gb * (1 << 30)),
+                             optimizer_moments=args.optimizer_moments,
+                             zero_shards=args.zero_shards,
                              page_size=args.page_size)
         if args.as_json:
             print(json.dumps(plan, indent=2, sort_keys=True))
@@ -447,6 +475,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                   f"budget={plan['hbm_budget'] / (1 << 30):.1f} GiB")
             print(f"  params            "
                   f"{plan['params_bytes'] / (1 << 20):10.1f} MiB")
+            if args.optimizer_moments:
+                print(f"  optimizer state   "
+                      f"{plan['opt_state_bytes'] / (1 << 20):10.1f} MiB"
+                      + (f" (zero_shards={plan['zero_shards']})"
+                         if args.zero_shards > 1 else ""))
             print(f"  per KV slot       "
                   f"{plan['per_slot_bytes'] / (1 << 20):10.1f} MiB")
             print(f"  max resident slots {plan['max_slots']:9d}")

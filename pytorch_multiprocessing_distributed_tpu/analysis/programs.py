@@ -20,6 +20,12 @@ Each hook returns specs of the shape::
         #      `make check-update` — the hand-written contract):
         "expect_collectives": {..},# exact jaxpr-level budget
         "expect_grad_psums": int,  # psum eqns sized == params_bytes
+        "expect_collective_subset": {..},  # exact count+bytes for
+                                   # SELECTED budget keys (graftzero's
+                                   # reduce-scatter/all-gather pin)
+        "max_psum_bytes": int,     # per-call psum byte cap (pins a
+                                   # zero-psum program against a grad-
+                                   # sized all-reduce creeping back)
         "params_bytes": int,
         "min_donated": int,        # lowered aliases required
         "require_hlo": (ops,),     # compiled ops that must exist
@@ -200,6 +206,27 @@ def audit_program(spec: ProgramSpec
                 f"{got} psum(s) sized exactly like the parameter tree "
                 f"({pb} bytes), expected {n_grad} — the gradient "
                 "all-reduce contract moved")
+
+    subset = built.get("expect_collective_subset")
+    if subset is not None:
+        # exact count+bytes pin for SELECTED budget keys (the graftzero
+        # reduce-scatter/all-gather contract) without freezing the whole
+        # budget dict inline — the rest stays committed/refreshable
+        for key, want in subset.items():
+            got = budget.get(key)
+            if got != want:
+                add("GC101",
+                    f"collective {key}: traced {got} != declared "
+                    f"{want} — the sharded-update exchange moved")
+
+    psum_cap = built.get("max_psum_bytes")
+    if psum_cap is not None:
+        worst = max(ir.psum_sizes(closed), default=0)
+        if worst > int(psum_cap):
+            add("GC101",
+                f"a psum moves {worst} bytes, over this program's "
+                f"{psum_cap}-byte cap — a gradient-sized all-reduce "
+                "crept back into a reduce-scatter program")
 
     cap = built.get("max_allgather_bytes")
     if cap is not None:

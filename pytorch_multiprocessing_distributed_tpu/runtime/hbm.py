@@ -36,7 +36,7 @@ from typing import Dict, Optional
 __all__ = [
     "HbmLedger", "arm", "disarm", "active_ledger", "scoped_ledger",
     "register", "update", "release", "set_gauge", "nbytes_of",
-    "tree_nbytes",
+    "tree_nbytes", "shard_nbytes", "tree_shard_nbytes",
 ]
 
 
@@ -65,6 +65,32 @@ def tree_nbytes(tree) -> int:
     import jax
 
     return sum(nbytes_of(leaf) for leaf in jax.tree.leaves(tree))
+
+
+def shard_nbytes(x) -> int:
+    """PER-CHIP device bytes of one array: a sharded leaf charges the
+    slice one device holds (``sharding.shard_shape`` — pure host
+    metadata, no device read), a replicated/unplaced leaf its full
+    size. The graftzero/FSDP ledger truth: ``hbm_*`` gauges describe
+    ONE chip's HBM, so a ``P(data)``-sharded moment bucket must count
+    ``1/data`` of itself."""
+    sharding = getattr(x, "sharding", None)
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if sharding is not None and shape is not None and dtype is not None:
+        try:
+            shard_shape = sharding.shard_shape(tuple(shape))
+        except Exception:  # noqa: BLE001  # graftlint: disable=GL111 exotic shardings fall back to global bytes
+            return nbytes_of(x)
+        return int(math.prod(shard_shape)) * int(dtype.itemsize)
+    return nbytes_of(x)
+
+
+def tree_shard_nbytes(tree) -> int:
+    """Per-chip total of a pytree (:func:`shard_nbytes` per leaf)."""
+    import jax
+
+    return sum(shard_nbytes(leaf) for leaf in jax.tree.leaves(tree))
 
 
 class HbmLedger:

@@ -135,12 +135,31 @@ def save_checkpoint(save_path: str, state: TrainState, epoch: int) -> Optional[s
     (``model_N.pth.sha256``), AFTER the checkpoint itself is durable —
     a crash between the two leaves a valid checkpoint with no digest
     (verified loads treat a missing sidecar as legacy, not corrupt),
-    never a digest pointing at torn bytes."""
+    never a digest pointing at torn bytes.
+
+    graftzero: a state carrying a sharded
+    :class:`~..parallel.zero.ZeroOptState` saves GATHER-ON-SAVE — the
+    moments are unflattened back to the replicated format, so the
+    artifact is mode-portable: ``--resume auto`` round-trips between
+    ``--zero`` and plain runs (the CLIs load into the replicated
+    template and re-shard with ``zero.zeroify_state`` when ``--zero``
+    is set). The digest sidecar and ``load_with_fallback`` are
+    untouched."""
     # Collective leaf replication first — ALL hosts participate even
-    # though only the primary writes (see _gather_for_host).
+    # though only the primary writes (see _gather_for_host). It also
+    # makes the zero moment buckets host-addressable for the gather
+    # below.
     state = _gather_for_host(state)
     if not dist.is_primary():
         return None
+    from ..parallel.zero import ZeroOptState, gather_opt_state
+
+    if isinstance(state.opt_state, ZeroOptState):
+        # graftzero gather-on-save: host-local unflatten (no
+        # collective — safe after the primary gate), so the artifact
+        # is always the replicated, mode-portable format
+        state = state.replace(
+            opt_state=gather_opt_state(state.opt_state, state.params))
     path = checkpoint_path(save_path, epoch)
     with graftscope.span("checkpoint.write", cat="train", epoch=epoch,
                          path=os.path.basename(path)) as ckpt_span:

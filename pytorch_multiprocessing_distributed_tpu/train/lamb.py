@@ -45,11 +45,16 @@ def lamb(
             count=jnp.zeros((), jnp.int32),
         )
 
-    def update(grads, state: LambState, params, lr_step=None):
+    def _lr(lr_step):
         if callable(learning_rate):
-            lr = learning_rate(lr_step)
-        else:
-            lr = jnp.asarray(learning_rate, jnp.float32)
+            return learning_rate(lr_step)
+        return jnp.asarray(learning_rate, jnp.float32)
+
+    def shard_update(grads, state: LambState, params, lr_step=None):
+        """The ELEMENTWISE phase: moment updates + bias-corrected
+        direction ``u`` (pre-trust-ratio). Runs identically on full
+        leaves and on graftzero's flat 1-D shards — the trust ratio is
+        the only per-leaf reduction, split into ``shard_finish``."""
         count = state.count + 1
         c1 = 1.0 - b1 ** count.astype(jnp.float32)
         c2 = 1.0 - b2 ** count.astype(jnp.float32)
@@ -58,11 +63,34 @@ def lamb(
         nu = jax.tree.map(
             lambda v, g: b2 * v + (1 - b2) * jnp.square(g), state.nu, grads
         )
+        u = jax.tree.map(
+            lambda p, m, v: (m / c1) / (jnp.sqrt(v / c2) + eps)
+            + weight_decay * p,
+            params, mu, nu,
+        )
+        return u, LambState(mu=mu, nu=nu, count=count)
 
-        def one(p, m, v):
-            mhat = m / c1
-            vhat = v / c2
-            u = mhat / (jnp.sqrt(vhat) + eps) + weight_decay * p
+    def shard_finish(updates, params, lr_step=None):
+        """The PER-LEAF phase: trust ratio + LR, on full leaves (under
+        graftzero this runs after the direction's all-gather, so the
+        norms see exactly what the replicated update sees).
+
+        The direction is materialized at this boundary
+        (``optimization_barrier`` — graftzero's all-gather already
+        does this implicitly): without it XLA fuses ``u`` separately
+        into each of its three consumers (norm, scale, apply) with
+        per-site FMA contraction, and the replicated trajectory
+        drifts 1 ulp from the sharded one once the moments are
+        nonzero. The barrier pins one evaluation in both programs —
+        bit-identical by construction, at the cost of one
+        param-sized buffer XLA would likely materialize anyway."""
+        leaves = jax.lax.optimization_barrier(
+            tuple(jax.tree.leaves(updates)))
+        updates = jax.tree.unflatten(jax.tree.structure(updates),
+                                     list(leaves))
+        lr = _lr(lr_step)
+
+        def one(u, p):
             p_norm = jnp.linalg.norm(p)
             u_norm = jnp.linalg.norm(u)
             # trust ratio, guarded exactly as in the paper/optax: 1 when
@@ -72,7 +100,13 @@ def lamb(
             )
             return -lr * r * u
 
-        updates = jax.tree.map(one, params, mu, nu)
-        return updates, LambState(mu=mu, nu=nu, count=count)
+        return jax.tree.map(one, updates, params)
 
-    return Transform(init, update)
+    def update(grads, state: LambState, params, lr_step=None):
+        # the replicated update IS the two phases composed — one copy
+        # of the math, so sharded == replicated by construction
+        u, new_state = shard_update(grads, state, params, lr_step=lr_step)
+        return shard_finish(u, params, lr_step=lr_step), new_state
+
+    return Transform(init, update, shard_update=shard_update,
+                     shard_finish=shard_finish)

@@ -162,6 +162,8 @@ def make_lm_train_step(
     moe_aux_weight: float = 0.01,
     moe_z_weight: float = 1e-3,
     vocab_chunks: int = 0,
+    zero: bool = False,
+    zero_overlap: bool = True,
 ):
     """Build the jitted LM train step.
 
@@ -186,12 +188,25 @@ def make_lm_train_step(
     load-balancing aux loss and the ST-MoE router z-loss the layer sows
     into its ``losses`` collection (``moe_aux_weight`` /
     ``moe_z_weight``; metrics gain ``moe_aux``).
+
+    ``zero=True`` (graftzero): the per-leaf grad psums become one
+    bucketed reduce-scatter, the update runs on local shards (moments
+    sharded — the state must carry a
+    :class:`..parallel.zero.ZeroOptState`; build it with
+    ``zero.zeroify_state``), params all-gather back. DP only
+    (``seq_axis`` must be None — the cross-shard label shift lives on
+    the SP path).
     """
     if grad_accum < 1:
         raise ValueError(
             f"grad_accum must be >= 1, got {grad_accum} (1 = no "
             "accumulation; 0/negative would silently disable it)"
         )
+    if zero and seq_axis is not None:
+        raise ValueError(
+            "zero=True shards the update over the data axis only; "
+            "combine it with DP (seq_axis=None), not sequence "
+            "parallelism")
     axes = (axis_name,) if seq_axis is None else (axis_name, seq_axis)
     is_moe = getattr(model, "n_experts", 0) > 0
     # zigzag SP: the model was built with sp_mode="zigzag", so tokens
@@ -201,7 +216,12 @@ def make_lm_train_step(
     zigzag = (seq_axis is not None
               and getattr(model, "sp_mode", "ring") == "zigzag")
 
-    def body(state: TrainState, tokens):
+    def make_body(zero_plan=None):
+        def body(state: TrainState, tokens):
+            return _body(state, tokens, zero_plan)
+        return body
+
+    def _body(state: TrainState, tokens, zero_plan):
         targets, valid = _next_token_targets(tokens, seq_axis, zigzag)
         w = valid.astype(jnp.float32)
         # Constants wrt params, computed before differentiation: global
@@ -283,17 +303,35 @@ def make_lm_train_step(
             )
             aux = aux_sum / grad_accum
         loss = jax.lax.psum(loss_sum, axes) / count
-        grads = jax.tree.map(lambda g: jax.lax.psum(g, axes), grads)
-
-        # NaN/inf skip-and-count guard off the globally-summed grads
-        # (replicated — every shard agrees): see step.guard_nonfinite
         from .step import finite_grads, guard_nonfinite
 
-        finite = finite_grads(grads)
-        updates, new_opt = optimizer.update(
-            grads, state.opt_state, state.params, lr_step=state.epoch
-        )
-        new_params = apply_updates(state.params, updates)
+        if zero_plan is not None:
+            # graftzero: the per-leaf grad psums become ONE bucketed
+            # reduce-scatter (sum semantics — the local objective is
+            # already globally pre-normalized), the update runs on
+            # local shards, params all-gather back; the guard counts
+            # non-finites on the scattered shards with one summed
+            # scalar psum
+            from ..parallel import zero as zero_mod
+
+            g_shards = zero_mod.reduce_scatter_grads(
+                grads, zero_plan, axis_name, mean=False,
+                overlap=zero_overlap)
+            finite = zero_mod.finite_shards(g_shards, axis_name)
+            new_params, new_opt = zero_mod.apply_sharded_update(
+                optimizer, state.opt_state, g_shards, state.params,
+                axis_name, lr_step=state.epoch, overlap=zero_overlap)
+        else:
+            grads = jax.tree.map(lambda g: jax.lax.psum(g, axes), grads)
+
+            # NaN/inf skip-and-count guard off the globally-summed
+            # grads (replicated — every shard agrees): see
+            # step.guard_nonfinite
+            finite = finite_grads(grads)
+            updates, new_opt = optimizer.update(
+                grads, state.opt_state, state.params, lr_step=state.epoch
+            )
+            new_params = apply_updates(state.params, updates)
         new_state = state.replace(params=new_params, opt_state=new_opt)
         metrics = {"loss": loss, "count": count}
         if is_moe:
@@ -302,12 +340,20 @@ def make_lm_train_step(
                                              metrics)
         return new_state, metrics
 
+    if zero:
+        from .step import _lazy_zero_step
+
+        return _lazy_zero_step(
+            make_body, mesh, axis_name, n_batch_args=1,
+            entry=lambda sharded: _checked_token_entry(
+                sharded, mesh, axis_name, None, False, grad_accum))
+
     if seq_axis is None:
         in_specs = (P(), P(axis_name))
     else:
         in_specs = (P(), P(axis_name, seq_axis))
     sharded = shard_map(
-        body,
+        make_body(),
         mesh=mesh,
         in_specs=in_specs,
         out_specs=(P(), P()),
@@ -599,10 +645,48 @@ def audit_programs():
             "min_donated": len(jax.tree.leaves(state.params)),
         }
 
+    def build_dp_zero():
+        """graftzero twin of ``lm_step_dp``: the ~30 per-leaf grad
+        psums collapse into ONE bucketed reduce-scatter + ONE
+        all-gather on the data axis (byte volumes pinned inline and
+        committed); the only psums left are the loss/count scalars and
+        the NaN-guard's summed non-finite int32 — ``max_psum_bytes=4``
+        pins them separately (any grad-sized psum creeping back fails
+        live, no refresh can launder it)."""
+        import numpy as np
+
+        from ..parallel import zero as zero_mod
+
+        model = _audit_gpt()
+        mesh, state, tokens, opt = _audit_lm_pieces(model, mesh_data=8)
+        state = zero_mod.zeroify_state(state, mesh)
+        step = make_lm_train_step(model, opt, mesh, zero=True)
+        jit_fn = step.jit_program(state)
+        comm = zero_mod.static_comm_bytes(state.opt_state.plan)
+        params_bytes = sum(
+            int(np.prod(leaf.shape)) * leaf.dtype.itemsize
+            for leaf in jax.tree.leaves(state.params))
+        return {
+            "fn": jit_fn, "args": (state, tokens), "mesh": mesh,
+            "lower_fn": jit_fn,
+            "params_bytes": params_bytes,
+            "expect_grad_psums": 0,
+            "expect_collective_subset": {
+                "reduce_scatter@data": {"count": 1,
+                                      "bytes": comm["reduce_scatter"]},
+                "all_gather@data": {"count": 1,
+                                    "bytes": comm["all_gather"]},
+            },
+            "max_psum_bytes": 4,
+            "min_donated": len(jax.tree.leaves(state.params)),
+        }
+
     return [
         {"name": "lm_step_dp", "min_devices": 8, "build": build_dp},
         {"name": "lm_step_tp", "min_devices": 4, "build": build_tp},
         {"name": "lm_step_fsdp", "min_devices": 4,
          "build": lambda: build_tp(fsdp=True)},
         {"name": "lm_step_moe", "min_devices": 8, "build": build_moe},
+        {"name": "lm_step_dp_zero", "min_devices": 8,
+         "build": build_dp_zero},
     ]

@@ -50,11 +50,26 @@ class Transform(NamedTuple):
     When set, the train step uses it instead of ``update`` +
     ``apply_updates`` — the seam for single-pass Pallas updates
     (:func:`..ops.pallas.sgd_pallas`).
+
+    ``shard_update``/``shard_finish`` (optional, graftzero): the
+    ZeRO-1 split. ``shard_update`` has ``update``'s signature but
+    returns the pre-finish update DIRECTION (everything elementwise —
+    it runs on flat 1-D shards of the parameter space);
+    ``shard_finish(updates, params, lr_step) -> updates`` applies the
+    post-gather phase (the LR scale; LAMB adds its per-leaf trust
+    ratio) on full leaves. BOTH shipped transforms define the pair —
+    keeping the final leafwise ops in the same fusion context as the
+    replicated update is what makes sharded == replicated bitwise. A
+    custom transform may leave both unset; graftzero then runs its
+    unmodified ``update`` directly on the shards, which is only
+    correct if that update is purely elementwise.
     """
 
     init: Callable[[Any], OptState]
     update: Callable[..., Any]
     apply: Any = None
+    shard_update: Any = None
+    shard_finish: Any = None
 
 
 def multistep_lr(
@@ -131,11 +146,13 @@ def sgd(
             initialized=jnp.zeros((), jnp.bool_),
         )
 
-    def update(grads, state: OptState, params, lr_step=None):
-        if callable(learning_rate):
-            lr = learning_rate(lr_step)
-        else:
-            lr = jnp.asarray(learning_rate, jnp.float32)
+    def shard_update(grads, state: OptState, params, lr_step=None):
+        """The ELEMENTWISE phase: weight decay + momentum + nesterov
+        combine, returning the update DIRECTION ``d`` (no LR). Runs
+        identically on full leaves and on graftzero's flat 1-D shards;
+        the LR scale stays in ``shard_finish`` so the zero path's
+        post-gather leafwise ops mirror the replicated update's exactly
+        (same final fusion context -> bit-identical trajectories)."""
 
         def one(g, p, buf):
             g = g + weight_decay * p
@@ -143,19 +160,34 @@ def sgd(
             # momentum*0 + g — identical value, kept for clarity).
             new_buf = jnp.where(state.initialized, momentum * buf + g, g)
             d = g + momentum * new_buf if nesterov else new_buf
-            return -lr * d, new_buf
+            return d, new_buf
 
         flat = jax.tree.map(one, grads, params, state.momentum)
-        updates = jax.tree.map(lambda t: t[0], flat, is_leaf=lambda t: isinstance(t, tuple))
-        bufs = jax.tree.map(lambda t: t[1], flat, is_leaf=lambda t: isinstance(t, tuple))
+        is_pair = lambda t: isinstance(t, tuple)  # noqa: E731
+        directions = jax.tree.map(lambda t: t[0], flat, is_leaf=is_pair)
+        bufs = jax.tree.map(lambda t: t[1], flat, is_leaf=is_pair)
         new_state = OptState(
             momentum=bufs,
             count=state.count + 1,
             initialized=jnp.ones((), jnp.bool_),
         )
-        return updates, new_state
+        return directions, new_state
 
-    return Transform(init, update)
+    def shard_finish(updates, params, lr_step=None):
+        if callable(learning_rate):
+            lr = learning_rate(lr_step)
+        else:
+            lr = jnp.asarray(learning_rate, jnp.float32)
+        return jax.tree.map(lambda d: -lr * d, updates)
+
+    def update(grads, state: OptState, params, lr_step=None):
+        # the replicated update IS the two phases composed — one copy
+        # of the math, so graftzero's sharded run == replicated run
+        d, new_state = shard_update(grads, state, params, lr_step=lr_step)
+        return shard_finish(d, params, lr_step=lr_step), new_state
+
+    return Transform(init, update, shard_update=shard_update,
+                     shard_finish=shard_finish)
 
 
 def apply_updates(params, updates):

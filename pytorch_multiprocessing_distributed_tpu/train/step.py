@@ -42,7 +42,8 @@ def _train_body(model, optimizer: Transform, loss_fn: Callable,
                 axis_name: Optional[str], remat: bool = False,
                 grad_accum: int = 1, dp_size: int = 1,
                 clip_grad_norm: Optional[float] = None,
-                ema_decay: Optional[float] = None):
+                ema_decay: Optional[float] = None,
+                zero_plan=None, zero_overlap: bool = True):
     """The one train-step body both parallelism paths share.
 
     ``axis_name`` set: per-shard view under ``shard_map`` — grads/metrics
@@ -157,38 +158,61 @@ def _train_body(model, optimizer: Transform, loss_fn: Callable,
             pred = jnp.argmax(logits, axis=-1)
             correct = jnp.sum((pred == labels).astype(jnp.int32))
 
-        if axis_name is not None:
-            # The DDP all-reduce moment (reference main.py:109): average
-            # gradients across the data axis. BN stats were already
-            # pmean-ed inside the forward (axis bound by shard_map).
-            grads = jax.lax.pmean(grads, axis_name)
+        if zero_plan is not None:
+            # graftzero (parallel/zero.py): the grad psum + replicated
+            # update becomes reduce-scatter -> sharded update ->
+            # all-gather; the guard predicate moves to the scattered
+            # shards (same values, partitioned) with ONE summed scalar
+            # psum, still BEFORE clipping
+            from ..parallel import zero as zero_mod
 
-        # NaN/inf guard predicate off the AVERAGED grads (replicated,
-        # so every shard agrees) and BEFORE clipping — a non-finite
-        # norm would poison the clip scale itself
-        finite = finite_grads(grads)
-
-        if clip_grad_norm is not None:
-            # Global-norm clipping of the ALREADY-averaged gradients
-            # (torch.nn.utils.clip_grad_norm_ semantics: one norm over
-            # every leaf; scale only when the norm exceeds the bound).
-            gnorm = jnp.sqrt(sum(
-                jnp.sum(jnp.square(g.astype(jnp.float32)))
-                for g in jax.tree.leaves(grads)
-            ))
-            scale = jnp.minimum(1.0, clip_grad_norm / (gnorm + 1e-6))
-            grads = jax.tree.map(lambda g: g * scale, grads)
-
-        if getattr(optimizer, "apply", None) is not None:
-            # fused whole-update path (e.g. the Pallas single-pass SGD)
-            new_params, new_opt = optimizer.apply(
-                grads, state.opt_state, state.params, lr_step=state.epoch
-            )
+            g_shards = zero_mod.reduce_scatter_grads(
+                grads, zero_plan, axis_name, mean=True,
+                overlap=zero_overlap)
+            finite = zero_mod.finite_shards(g_shards, axis_name)
+            if clip_grad_norm is not None:
+                g_shards = zero_mod.clip_shards_by_global_norm(
+                    g_shards, axis_name, clip_grad_norm)
+            new_params, new_opt = zero_mod.apply_sharded_update(
+                optimizer, state.opt_state, g_shards, state.params,
+                axis_name, lr_step=state.epoch, overlap=zero_overlap)
         else:
-            updates, new_opt = optimizer.update(
-                grads, state.opt_state, state.params, lr_step=state.epoch
-            )
-            new_params = apply_updates(state.params, updates)
+            if axis_name is not None:
+                # The DDP all-reduce moment (reference main.py:109):
+                # average gradients across the data axis. BN stats were
+                # already pmean-ed inside the forward (axis bound by
+                # shard_map).
+                grads = jax.lax.pmean(grads, axis_name)
+
+            # NaN/inf guard predicate off the AVERAGED grads
+            # (replicated, so every shard agrees) and BEFORE clipping —
+            # a non-finite norm would poison the clip scale itself
+            finite = finite_grads(grads)
+
+            if clip_grad_norm is not None:
+                # Global-norm clipping of the ALREADY-averaged
+                # gradients (torch.nn.utils.clip_grad_norm_ semantics:
+                # one norm over every leaf; scale only when the norm
+                # exceeds the bound).
+                gnorm = jnp.sqrt(sum(
+                    jnp.sum(jnp.square(g.astype(jnp.float32)))
+                    for g in jax.tree.leaves(grads)
+                ))
+                scale = jnp.minimum(1.0, clip_grad_norm / (gnorm + 1e-6))
+                grads = jax.tree.map(lambda g: g * scale, grads)
+
+            if getattr(optimizer, "apply", None) is not None:
+                # fused whole-update path (the Pallas single-pass SGD)
+                new_params, new_opt = optimizer.apply(
+                    grads, state.opt_state, state.params,
+                    lr_step=state.epoch
+                )
+            else:
+                updates, new_opt = optimizer.update(
+                    grads, state.opt_state, state.params,
+                    lr_step=state.epoch
+                )
+                new_params = apply_updates(state.params, updates)
 
         count = jnp.asarray(labels.shape[0], jnp.int32)
         if axis_name is not None:
@@ -226,23 +250,116 @@ def make_train_step(
     grad_accum: int = 1,
     clip_grad_norm=None,
     ema_decay=None,
+    zero: bool = False,
+    zero_overlap: bool = True,
 ):
     """Build the jitted DP train step.
 
     Returns ``step(state, images, labels) -> (state, metrics)`` where
     ``metrics = {loss, prec1, correct, count}`` are already globally
     reduced (scalars, replicated).
+
+    ``zero=True`` (graftzero, ``parallel/zero.py``): gradients are
+    reduce-scattered along the data axis into per-rank flat shards, the
+    optimizer update runs on the local shard only (moments sharded —
+    the state must carry a :class:`..parallel.zero.ZeroOptState`, build
+    it with ``zero.zeroify_state``), and updated params are
+    all-gathered back. Same trajectory bit-for-bit (test-pinned;
+    exception: ``clip_grad_norm``, whose global norm is necessarily a
+    psum of per-shard partial sums — a different summation order than
+    the replicated leafwise norm, so clipped runs agree to float
+    reassociation tolerance rather than bitwise). Optimizer HBM drops
+    ~1/N per chip. ``zero_overlap=False`` serializes the bucketed
+    collectives behind the full backward (the bench's overlap
+    baseline).
     """
-    sharded = shard_map(
-        _train_body(model, optimizer, loss_fn, axis_name, remat=remat,
-                    grad_accum=grad_accum, clip_grad_norm=clip_grad_norm,
-                    ema_decay=ema_decay),
-        mesh=mesh,
-        in_specs=(P(), P(axis_name), P(axis_name)),
-        out_specs=(P(), P()),
-        check_vma=False,
-    )
-    return jax.jit(sharded, donate_argnums=(0,))
+    if not zero:
+        sharded = shard_map(
+            _train_body(model, optimizer, loss_fn, axis_name,
+                        remat=remat, grad_accum=grad_accum,
+                        clip_grad_norm=clip_grad_norm,
+                        ema_decay=ema_decay),
+            mesh=mesh,
+            in_specs=(P(), P(axis_name), P(axis_name)),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+        return jax.jit(sharded, donate_argnums=(0,))
+    return _lazy_zero_step(
+        lambda plan: _train_body(
+            model, optimizer, loss_fn, axis_name, remat=remat,
+            grad_accum=grad_accum, clip_grad_norm=clip_grad_norm,
+            ema_decay=ema_decay, zero_plan=plan,
+            zero_overlap=zero_overlap),
+        mesh, axis_name, n_batch_args=2)
+
+
+def _lazy_zero_step(make_body, mesh: Mesh, axis_name: str,
+                    n_batch_args: int, entry=None):
+    """Lazily-bound graftzero jit: shard_map in/out specs depend on the
+    state's bucket layout (``ZeroOptState.plan``), so the program binds
+    on first call keyed on the state's pytree structure — the shard_map
+    twin of :func:`lazy_gspmd_jit`, shared by the image and LM DP
+    steps. ``entry(step_fn) -> step_fn`` optionally wraps the jitted
+    callee (the LM path's trace-time shape validation).
+
+    The returned step also emits the ``train.grad_comm`` instant on the
+    graftscope bus and a fleet arrival stamp per dispatch — the STATIC
+    per-step collective bytes from the plan (the
+    ``fleet.static_collective_bytes`` discipline: never a device read,
+    never a dispatch-only stopwatch), feeding the straggler report's
+    byte join. Disarmed cost: two module-global reads.
+    """
+    from ..parallel import zero as zero_mod
+    from ..runtime import fleet as graftfleet
+    from ..runtime import scope as graftscope
+
+    compiled = {}
+
+    def _bind(state):
+        if not isinstance(state.opt_state, zero_mod.ZeroOptState):
+            raise ValueError(
+                "zero=True needs a zero-sharded state — build it with "
+                "parallel.zero.zeroify_state(state, mesh) after init/"
+                "resume")
+        key = jax.tree.structure(state)
+        if key not in compiled:
+            spec = zero_mod.train_state_specs(state, axis_name)
+            sharded = shard_map(
+                make_body(state.opt_state.plan),
+                mesh=mesh,
+                in_specs=(spec,) + (P(axis_name),) * n_batch_args,
+                out_specs=(spec, P()),
+                check_vma=False,
+            )
+            if entry is not None:
+                sharded = entry(sharded)
+            compiled[key] = jax.jit(sharded, donate_argnums=(0,))
+        return compiled[key]
+
+    def step(state, *args):
+        fn = _bind(state)
+        if (graftscope.active_scope() is not None
+                or graftfleet.active_fleet() is not None):
+            plan = state.opt_state.plan
+            comm = zero_mod.static_comm_bytes(plan)
+            nbytes = comm["reduce_scatter"] + comm["all_gather"]
+            graftscope.emit(
+                "train.grad_comm", cat="train", nbytes=nbytes,
+                buckets=len(plan.buckets), axis=axis_name,
+                bucket_bytes=[
+                    b.padded * jnp.dtype(b.dtype).itemsize
+                    for b in plan.buckets])
+            graftfleet.note_arrival("train.grad_comm", axis=axis_name,
+                                    nbytes=nbytes)
+        return fn(state, *args)
+
+    # graftcheck's lowering handle (the lazy_gspmd_jit contract): the
+    # underlying jax.jit program for a given state structure, so the
+    # donation/HLO audits interrogate the EXACT program the trainer
+    # runs (abstract states work — structure + plan are all it reads)
+    step.jit_program = _bind
+    return step
 
 
 def make_eval_step(
@@ -511,24 +628,30 @@ def register_state_hbm(state, prefix: str = "train") -> None:
     """Put a :class:`TrainState`'s resident footprint on the armed
     graftmeter HBM ledger (no-op when disarmed — one global read):
     parameters, optimizer moments, batch stats and the EMA shadow,
-    each its own gauge. Bytes are GLOBAL (host metadata via
-    ``.nbytes``); under ZeRO/FSDP the per-chip share is the gauge
-    divided by the data-axis size — exactly the ~1/N the sharded-
-    update roadmap item claims, now readable off ``/metrics``."""
+    each its own gauge. Bytes are PER-CHIP, from host sharding
+    metadata only (``hbm.tree_shard_nbytes`` — a replicated leaf
+    charges its full size, a ``P(data)``-sharded leaf its
+    ``1/data``-slice), so under graftzero/ZeRO-1/FSDP the
+    ``hbm_opt_state_bytes`` gauge on ``/metrics`` IS the measured
+    ~1/N saving the sharded-update schedule claims — a live delta,
+    not a divided-by-hand estimate."""
     if hbm.active_ledger() is None:
         return
-    hbm.register(f"{prefix}.params", hbm.tree_nbytes(state.params),
+    hbm.register(f"{prefix}.params",
+                 hbm.tree_shard_nbytes(state.params),
                  category="params")
     hbm.register(f"{prefix}.opt_state",
-                 hbm.tree_nbytes(state.opt_state),
+                 hbm.tree_shard_nbytes(state.opt_state),
                  category="opt_state")
     stats = getattr(state, "batch_stats", None)
     if stats:
-        hbm.register(f"{prefix}.batch_stats", hbm.tree_nbytes(stats),
+        hbm.register(f"{prefix}.batch_stats",
+                     hbm.tree_shard_nbytes(stats),
                      category="params")
     ema = getattr(state, "ema_params", None)
     if ema:
-        hbm.register(f"{prefix}.ema_params", hbm.tree_nbytes(ema),
+        hbm.register(f"{prefix}.ema_params",
+                     hbm.tree_shard_nbytes(ema),
                      category="params")
 
 
@@ -689,8 +812,72 @@ def audit_programs():
             "min_donated": len(jax.tree.leaves(state.params)),
         }
 
+    def build_dp_zero():
+        """The graftzero twin: SAME model/mesh/batch as build_dp, but
+        the committed communication contract is FLIPPED — zero psums
+        sized like the parameter tree; the gradient exchange is
+        exactly one reduce-scatter (the full padded flat buckets) plus
+        one all-gather (the per-rank shard) on the data axis, byte
+        volumes pinned inline AND committed. The NaN-guard's summed
+        non-finite scalar psum stays (pinned separately:
+        ``max_psum_bytes`` bounds every remaining psum at the BN
+        statistic size — a grad-sized one reappearing fails here, not
+        just in the refreshable budget)."""
+        import numpy as np
+
+        from ..models import get_model
+        from ..parallel import zero as zero_mod
+        from ..parallel.mesh import audit_mesh
+        from .optim import sgd
+        from .state import create_train_state
+
+        mesh = audit_mesh(data=8)
+        model = get_model("res", stem="cifar", num_classes=10,
+                          bn_axis=DATA_AXIS)
+        opt = sgd(learning_rate=0.1)
+        state = jax.eval_shape(
+            lambda: create_train_state(
+                model, jax.random.PRNGKey(0),
+                jnp.zeros((2, 32, 32, 3)), opt))
+        state = zero_mod.zeroify_state(state, mesh)
+        step = make_train_step(model, opt, mesh, zero=True)
+        jit_fn = step.jit_program(state)
+        images = jax.ShapeDtypeStruct((16, 32, 32, 3), jnp.float32)
+        labels = jax.ShapeDtypeStruct((16,), jnp.int32)
+        params_bytes = sum(
+            int(np.prod(leaf.shape)) * leaf.dtype.itemsize
+            for leaf in jax.tree.leaves(state.params))
+        comm = zero_mod.static_comm_bytes(state.opt_state.plan)
+        # largest surviving psum: sync-BN pmeans its batch mean AND
+        # var in ONE tupled eqn, so the cap is 2x the widest [C]
+        # statistic leaf — everything else (loss/correct/count
+        # scalars, the guard's int32) sits far under it, and a
+        # grad-sized psum creeping back is ~3 orders over
+        max_bn = 2 * max(
+            (int(np.prod(leaf.shape)) * leaf.dtype.itemsize
+             for leaf in jax.tree.leaves(state.batch_stats)),
+            default=4)
+        return {
+            "fn": jit_fn,
+            "args": (state, images, labels),
+            "mesh": mesh,
+            "lower_fn": jit_fn,
+            "params_bytes": params_bytes,
+            "expect_grad_psums": 0,
+            "expect_collective_subset": {
+                "reduce_scatter@data": {"count": 1,
+                                      "bytes": comm["reduce_scatter"]},
+                "all_gather@data": {"count": 1,
+                                    "bytes": comm["all_gather"]},
+            },
+            "max_psum_bytes": max_bn,
+            "min_donated": len(jax.tree.leaves(state.params)),
+        }
+
     return [{"name": "train_step_dp_resnet18", "min_devices": 8,
-             "build": build_dp}]
+             "build": build_dp},
+            {"name": "train_step_dp_resnet18_zero", "min_devices": 8,
+             "build": build_dp_zero}]
 
 
 def shard_batch(batch, mesh: Mesh, axis_name: str = DATA_AXIS):

@@ -72,6 +72,7 @@ class Trainer:
         epochs: int,
         print_freq: int = 10,
         start_epoch: int = 1,
+        zero: bool = False,
         zero1: bool = False,
         fsdp: bool = False,
         remat: bool = False,
@@ -126,6 +127,25 @@ class Trainer:
         from ..ops.losses import cross_entropy_loss
 
         loss_fn = loss_fn or cross_entropy_loss
+        # graftzero: the shard_map-DP sharded weight update. Distinct
+        # from --zero1 (the GSPMD zero1 placement): this mode rewrites
+        # the explicit DP step's communication schedule, so it
+        # composes with pure DP only.
+        self._zero = zero
+        if zero:
+            if dict(mesh.shape).get(MODEL_AXIS, 1) > 1 or zero1 or fsdp:
+                raise ValueError(
+                    "zero=True is the explicit shard_map-DP sharded "
+                    "update; under --model_parallel/--zero1/--fsdp the "
+                    "GSPMD path already owns the state placement — use "
+                    "zero1/fsdp there instead")
+            if ckpt_backend == "orbax":
+                raise ValueError(
+                    "zero=True checkpoints via the msgpack "
+                    "gather-on-save path (mode-portable artifacts); "
+                    "--ckpt_backend orbax would persist the sharded "
+                    "layout and break --resume round-trips — use "
+                    "msgpack with --zero")
         if dict(mesh.shape).get(MODEL_AXIS, 1) > 1 or zero1 or fsdp:
             # the GSPMD step: real tensor parallelism (params sharded
             # over the model axis), ZeRO-1 (optimizer moments sharded
@@ -146,9 +166,16 @@ class Trainer:
             self.train_step = make_train_step(
                 model, optimizer, mesh, remat=remat, grad_accum=grad_accum,
                 loss_fn=loss_fn, clip_grad_norm=clip_grad_norm,
-                ema_decay=ema_decay,
+                ema_decay=ema_decay, zero=zero,
             )
             self.eval_step = make_eval_step(model, mesh, loss_fn=loss_fn)
+            if zero:
+                # moments sharded from step one: the replicated tree
+                # (fresh init or a restored checkpoint) is flattened
+                # into P(data) buckets and never materializes again
+                from ..parallel.zero import zeroify_state
+
+                self.state = zeroify_state(self.state, mesh)
         self.train_logger = Logger(os.path.join(save_path, "train.log"))
         self.test_logger = Logger(os.path.join(save_path, "test.log"))
         # graftmeter: resident-state footprint on the armed ledger
@@ -441,6 +468,11 @@ class Trainer:
         eval_state = self.state
         if self.ema_decay and getattr(self.state, "ema_params", None):
             eval_state = self.state.replace(params=self.state.ema_params)
+        if self._zero:
+            # the eval step reads params/stats only; its replicated
+            # state spec would silently all-gather the sharded moment
+            # buckets per batch — hand it a state without them
+            eval_state = eval_state.replace(opt_state={})
         n_batches = len(self.test_loader)
         pending = []
         window_start = time.time()

@@ -111,6 +111,15 @@ parser.add_argument('--vocab_chunks', default=0, type=int,
                          'same objective). dp/sp paths; 0 = dense')
 parser.add_argument('--grad_accum', default=1, type=int,
                     help='microbatches per update (dp/sp paths)')
+parser.add_argument('--zero', action='store_true',
+                    help='graftzero sharded weight update (dp path '
+                         'only): grads reduce-scatter into per-rank '
+                         'bucket shards, the optimizer updates the '
+                         'local shard (moments sharded — ~1/world '
+                         'optimizer HBM per chip), params all-gather '
+                         'back. Bit-identical trajectory; msgpack '
+                         'checkpoints stay mode-portable '
+                         '(gather-on-save)')
 parser.add_argument('--zero1', action='store_true',
                     help='ZeRO-1 optimizer sharding (tp path only)')
 parser.add_argument('--fsdp', action='store_true',
@@ -280,6 +289,17 @@ def _run(args):
         raise SystemExit(
             "--zero1/--fsdp shard state through the GSPMD path; use "
             f"--parallel tp (got --parallel {args.parallel})")
+    if args.zero and args.parallel != 'dp':
+        raise SystemExit(
+            "--zero rewrites the explicit DP step's grad exchange "
+            "(reduce-scatter -> sharded update -> all-gather); use "
+            f"--parallel dp (got --parallel {args.parallel}; the tp "
+            "path's --zero1/--fsdp shard via GSPMD placement instead)")
+    if args.zero and args.ckpt_backend == 'orbax':
+        raise SystemExit(
+            "--zero checkpoints via msgpack gather-on-save (artifacts "
+            "round-trip between --zero and plain runs); --ckpt_backend "
+            "orbax would persist the sharded layout")
     if args.pp_schedule != 'gpipe' and args.parallel != 'pp':
         raise SystemExit(
             f"--pp_schedule {args.pp_schedule} only applies to "
@@ -493,12 +513,20 @@ def _run(args):
         mesh = (make_mesh(dp, deg, axis_names=axes)
                 if args.parallel == 'sp' else make_mesh(dp))
         state = maybe_resume(init_state())
+        if args.zero:
+            # moments sharded from step one — the replicated tree
+            # (fresh init or the restored checkpoint) flattens into
+            # P(data) buckets; save_checkpoint gathers back on save
+            from pytorch_multiprocessing_distributed_tpu.parallel.zero import (
+                zeroify_state)
+
+            state = zeroify_state(state, mesh)
         step = make_lm_train_step(
             model, opt, mesh,
             seq_axis='seq' if args.parallel == 'sp' else None,
             remat=args.remat, grad_accum=args.grad_accum,
             moe_aux_weight=args.moe_aux_weight,
-            vocab_chunks=args.vocab_chunks)
+            vocab_chunks=args.vocab_chunks, zero=args.zero)
 
     eval_step = None
     if val_loader is not None:
@@ -686,11 +714,16 @@ def _run(args):
                 with graftscope.span("train.validate", cat="train",
                                      epoch=epoch):
                     tot, cnt = 0.0, 0.0
+                    # graftzero: the eval step reads params only; its
+                    # replicated state spec would all-gather the
+                    # sharded moment buckets per batch — strip them
+                    eval_state = (state.replace(opt_state={})
+                                  if args.zero else state)
                     for batch in val_loader:
                         tok = jnp.asarray(batch)
                         if args.parallel not in ('tp', 'pp'):
                             (tok,) = shard_batch((tok,), mesh)
-                        m = eval_step(state, tok)
+                        m = eval_step(eval_state, tok)
                         c = float(np.asarray(m['count']))
                         tot = tot + float(np.asarray(m['loss'])) * c
                         cnt = cnt + c
