@@ -4,7 +4,7 @@
 PYTEST_ENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 
 .PHONY: test test-fast lint check check-update chaos soak scope meter \
-        fleet spec zero dryrun bench bench-cpu store clean
+        fleet spec zero route dryrun bench bench-cpu store clean
 
 # graftlint: AST-only jit-hygiene gate (no jax import, milliseconds).
 # Exit 1 on any non-baselined finding; the tier-1 suite and
@@ -96,6 +96,17 @@ spec:
 # (test_zero_smoke_end_to_end in tests/test_graftzero.py).
 zero:
 	$(PYTEST_ENV) python benchmarks/zero_smoke.py
+
+# graftroute: disaggregated-fleet smoke — 2 paged replicas behind the
+# router over an in-process MemStore must serve byte-identically to
+# the single-engine baseline, survive one injected replica death by
+# journal redelivery to the peer (fleet token count dedup-verified),
+# route an identical prompt to the replica holding its cached pages
+# (engine-level FULL hit, warm TTFT < cold), and publish the replica
+# directory to the store. Same body runs in tier-1
+# (test_route_smoke_end_to_end in tests/test_graftroute.py).
+route:
+	$(PYTEST_ENV) python benchmarks/route_smoke.py
 
 # full suite on the virtual 8-device CPU mesh (incl. slow e2e CLI runs)
 test:

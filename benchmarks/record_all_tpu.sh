@@ -8,6 +8,8 @@
 #   benchmarks/generate_bench_tpu.txt  (decode tokens/sec)
 #   benchmarks/serving_bench_tpu.json  (load + length-bucket sweeps)
 #   benchmarks/serving_bench_spec_tpu.json (graftspec accepted/step)
+#   benchmarks/serving_bench_fleet_tpu.json (graftroute fleet/disagg/
+#                                      redelivery sweep)
 #   benchmarks/mfu_tune_results.json   (resnet50 flag/batch sweep)
 #   benchmarks/convergence_record.json (framework-on-TPU vs torch-CPU)
 # Prints a section header per step; steps are independent — a failure
@@ -18,6 +20,9 @@ note() { echo "=== $* ($(date -u +%T))" >&2; }
 
 note "fleet observability smoke (graftfleet wiring sane before capture)"
 python benchmarks/fleet_smoke.py
+
+note "fleet serving smoke (graftroute wiring sane before capture)"
+python benchmarks/route_smoke.py
 
 note "baselines (all configs, slope estimator)"
 python benchmarks/record_baselines.py
@@ -45,6 +50,13 @@ python benchmarks/serving_bench.py \
     --json_out benchmarks/serving_bench_paged_tpu.json \
     > benchmarks/serving_bench_paged_tpu.txt 2>&1
 tail -16 benchmarks/serving_bench_paged_tpu.txt >&2
+
+note "serving bench (graftroute: 2-replica fleet + disagg + redelivery)"
+python benchmarks/serving_bench.py \
+    --sweep fleet \
+    --json_out benchmarks/serving_bench_fleet_tpu.json \
+    > benchmarks/serving_bench_fleet_tpu.txt 2>&1
+tail -8 benchmarks/serving_bench_fleet_tpu.txt >&2
 
 note "serving bench (graftspec: accepted/target-step x k x draft source)"
 python benchmarks/serving_bench.py \

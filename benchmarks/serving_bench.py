@@ -82,6 +82,20 @@ Three sweeps over the continuous-batching :class:`ServingEngine`:
    a drain holds a slot hostage, and how long a restarted replica
    takes to resume visible progress.
 
+8. **Fleet sweep** (``--sweep fleet``, graftroute): the
+   disaggregated-fleet evidence. Point one: a 2-replica router's
+   streams are BYTE-IDENTICAL to the single-engine baseline —
+   aggregate tok/s vs one engine, per-replica ``goodput_frac`` with
+   the straggler named, work steals counted. Point two:
+   prefill/decode disaggregation (one prefill replica handing KV
+   blocks to a decode replica over the host round-trip) is
+   token-exact vs monolithic, transfer bytes per request recorded.
+   Point three: one injected replica death mid-run — the dead
+   replica's journal redelivers to the peer, every stream still
+   byte-exact, fleet ``tokens_generated`` dedup-verified, and the
+   **redelivery recovery TTFT** (death detection to the first
+   redelivered token) wall-clocked.
+
 ``offered=inf`` is the closed-loop limit: every request submitted
 up front, measuring peak engine throughput. CPU-runnable (shapes clamp
 down off-TPU, same convention as ``generate_bench.py``), TPU-ready.
@@ -814,6 +828,172 @@ def run_drain_sweep(model, params, args, rng):
     return results
 
 
+def run_fleet_sweep(model, params, args, rng):
+    """graftroute (sweep 8): the fleet evidence — (1) a 2-replica
+    router's streams are BYTE-IDENTICAL to the single-engine baseline
+    at higher aggregate tok/s, with per-replica goodput_frac and the
+    straggler named; (2) prefill/decode disaggregation is token-exact
+    vs monolithic, transfer bytes recorded; (3) one injected replica
+    death mid-run -> journal redelivery to the peer, every stream
+    still exact, recovery TTFT wall-clocked."""
+    import tempfile
+
+    from pytorch_multiprocessing_distributed_tpu.runtime import (
+        faults, fleet as graftfleet, heal)
+    from pytorch_multiprocessing_distributed_tpu.serving import (
+        Router, ServingEngine, ServingReplica)
+
+    new_tokens = max(4, min(args.new_tokens, 16))
+    prompt_hi = max(2, min(args.prompt_max,
+                           model.max_seq_len - new_tokens) - 1)
+    s_max = min(model.max_seq_len, prompt_hi + new_tokens)
+    slots = int(args.slots.split(",")[0])
+    n_req = max(2 * slots + 2, min(args.requests, 12))
+    prompts = [rng.integers(0, model.vocab_size, (int(rng.integers(
+        max(1, prompt_hi // 2), prompt_hi + 1)),)).tolist()
+        for _ in range(n_req)]
+
+    def mk(journal=None, dispatch_retries=3):
+        return ServingEngine(model, params, max_slots=slots,
+                             s_max=s_max, decode_buckets=(),
+                             retry_backoff_s=0.0, journal=journal,
+                             dispatch_retries=dispatch_retries)
+
+    # ---- baseline: ONE engine, same request set
+    base = mk()
+    base.serve([(prompts[0], 2)])  # compiles off the clock
+    t0 = time.perf_counter()
+    ref = base.serve([(p, new_tokens) for p in prompts])
+    base_s = time.perf_counter() - t0
+    ref_tokens = {i: list(r.tokens) for i, r in enumerate(ref)}
+    total_tokens = sum(len(t) for t in ref_tokens.values())
+    results = []
+
+    # ---- point 1: 2-replica fleet, byte-identical + aggregate tok/s
+    router = Router([ServingReplica("r0", mk()),
+                     ServingReplica("r1", mk())])
+    for replica in router.replicas:  # compiles off the clock, like
+        replica.engine.serve([(prompts[0], 2)])  # the baseline's
+    t0 = time.perf_counter()
+    out = router.serve([(p, new_tokens) for p in prompts])
+    fleet_s = time.perf_counter() - t0
+    for i, r in enumerate(out):
+        assert r.state == "done" and list(r.tokens) == ref_tokens[i], (
+            f"fleet stream {i} diverged from the single-engine "
+            "baseline")
+    merged = router.merged_metrics()
+    report = graftfleet.fleet_serving_report(merged["per_replica"])
+    point = {
+        "mode": "fleet", "replicas": 2, "slots": slots,
+        "requests": n_req,
+        "baseline_tokens_per_sec": total_tokens / base_s,
+        "tokens_per_sec": total_tokens / fleet_s,
+        "speedup": base_s / fleet_s,
+        "steals": router.steals,
+        "goodput_frac_per_replica":
+            report.get("goodput_frac_per_replica", {}),
+        "straggler": report.get("straggler"),
+        "byte_identical": True,
+    }
+    print(f"fleet    2 replicas  {point['tokens_per_sec']:9.1f} tok/s "
+          f"(1 engine: {point['baseline_tokens_per_sec']:9.1f})  "
+          f"speedup={point['speedup']:5.2f}x  steals={router.steals}",
+          flush=True)
+    results.append(point)
+
+    # ---- point 2: prefill/decode split vs monolithic (token-exact)
+    router = Router([ServingReplica("pf", mk(), role="prefill"),
+                     ServingReplica("dc", mk(), role="decode")])
+    router.serve([(prompts[0], 2)])  # both halves' compiles off-clock
+    t0 = time.perf_counter()
+    out = router.serve([(p, new_tokens) for p in prompts])
+    disagg_s = time.perf_counter() - t0
+    for i, r in enumerate(out):
+        assert r.state == "done" and list(r.tokens) == ref_tokens[i], (
+            f"disaggregated stream {i} diverged from monolithic")
+    pf = router._by_rid["pf"]
+    point = {
+        "mode": "disagg", "slots": slots, "requests": n_req,
+        "tokens_per_sec": total_tokens / disagg_s,
+        "transfers": router.transfers_routed,
+        "transfer_bytes": router.transfer_bytes,
+        "transfer_bytes_per_request":
+            router.transfer_bytes // max(1, router.transfers_routed),
+        "prefill_transfers": pf.transfers_out,
+        "token_exact": True,
+    }
+    print(f"disagg   prefill->decode  "
+          f"{point['tokens_per_sec']:9.1f} tok/s  "
+          f"transfers={router.transfers_routed} (token-exact)",
+          flush=True)
+    results.append(point)
+
+    # ---- point 3: injected replica death -> redelivery recovery TTFT
+    tmpdir = tempfile.mkdtemp(prefix="pmdt_fleet_bench_")
+
+    def mkrep(i):
+        journal = heal.RequestJournal(
+            os.path.join(tmpdir, f"wal{i}.jsonl"))
+        return ServingReplica(f"r{i}", mk(journal, dispatch_retries=1),
+                              journal=journal)
+
+    router = Router([mkrep(0), mkrep(1)])
+    reqs = [router.submit(p, new_tokens, uid=f"u{i}")
+            for i, p in enumerate(prompts)]
+    for _ in range(3):
+        router.step()  # tokens into both WALs before the kill
+    plan = faults.FaultPlan(seed=7, rules=[faults.FaultRule(
+        "serving.decode_dispatch", "fatal", times=1)])
+    faults.arm(plan)
+    t_death = None
+    t_recover = None
+    try:
+        while router.in_flight:
+            before = router.requests_redelivered
+            t_pre = time.perf_counter()
+            events = router.step()
+            if router.requests_redelivered > before and t_death is None:
+                # the dying dispatch, the reap AND the journal replay
+                # all happen inside this one step — clock recovery
+                # from the step's START, or the interval measures the
+                # microseconds between two post-step reads
+                t_death = t_pre
+            if t_death is not None and t_recover is None:
+                redelivered = set(router.redelivered_uids)
+                for request, _tok, _done in events:
+                    if request.uid in redelivered:
+                        t_recover = time.perf_counter()
+                        break
+    finally:
+        faults.disarm()
+    recs = router.records()
+    for i in range(n_req):
+        r = recs[f"u{i}"]
+        assert r.state == "done" and list(r.tokens) == ref_tokens[i], (
+            f"post-death stream u{i} diverged")
+    merged = router.merged_metrics()
+    assert merged["tokens_generated"] == total_tokens, (
+        "redelivery dedup broke the fleet token count")
+    point = {
+        "mode": "redelivery", "slots": slots, "requests": n_req,
+        "redelivered": router.requests_redelivered,
+        "replayed_tokens": router.redelivery_replayed_tokens,
+        "recovery_ttft_s": (t_recover - t_death
+                            if t_recover and t_death else None),
+        "replicas_dead": merged["fleet_replicas_dead"],
+        "token_exact": True,
+    }
+    print(f"redeliver dead=1  redelivered={point['redelivered']}  "
+          f"recovery_ttft="
+          f"{point['recovery_ttft_s'] if point['recovery_ttft_s'] is None else round(point['recovery_ttft_s'], 4)} s",
+          flush=True)
+    results.append(point)
+    import shutil
+
+    shutil.rmtree(tmpdir, ignore_errors=True)
+    return results
+
+
 def main():
     _common.apply_platform_env()
     p = argparse.ArgumentParser()
@@ -829,7 +1009,8 @@ def main():
                         "submitted up front)")
     p.add_argument("--sweep", default="load,length,horizon", type=str,
                    help="which sweeps to run: load, length, horizon, "
-                        "chaos, drain, or any comma list")
+                        "chaos, drain, paged, spec, fleet, or any "
+                        "comma list")
     p.add_argument("--chaos_every", default=5, type=int,
                    help="chaos sweep: inject one transient fault every "
                         "K-th dispatch ATTEMPT, K >= 2 (realized "
@@ -898,7 +1079,7 @@ def main():
               "requests": args.requests, "new_tokens": args.new_tokens,
               "s_max": s_max, "load_sweep": [], "length_sweep": [],
               "horizon_sweep": [], "chaos_sweep": [], "drain_sweep": [],
-              "paged_sweep": [], "spec_sweep": []}
+              "paged_sweep": [], "spec_sweep": [], "fleet_sweep": []}
     sweeps = args.sweep.split(",")
 
     if "load" in sweeps:
@@ -945,6 +1126,10 @@ def main():
 
     if "drain" in sweeps:
         record["drain_sweep"] = run_drain_sweep(model, params, args,
+                                                rng)
+
+    if "fleet" in sweeps:
+        record["fleet_sweep"] = run_fleet_sweep(model, params, args,
                                                 rng)
 
     if args.json_out:

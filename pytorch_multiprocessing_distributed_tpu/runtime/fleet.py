@@ -83,6 +83,7 @@ __all__ = [
     "note_arrival", "publish_endpoint", "monitor_from_env",
     "arm_goodput", "disarm_goodput", "active_goodput",
     "goodput_gauges", "static_collective_bytes",
+    "publish_replica", "replica_directory", "fleet_serving_report",
 ]
 
 
@@ -828,3 +829,114 @@ def goodput_gauges() -> Dict[str, float]:
         return {}
     ledger.ingest_scope()
     return ledger.gauges()
+
+
+# ------------------------------------------- graftroute: replica fleet
+
+def publish_replica(store, rid: str, *, role: str = "both",
+                    state: str = "starting",
+                    address: Optional[str] = None,
+                    run_uid: str = "run", prefix: str = "fleet") -> bool:
+    """Publish one serving replica's identity to the control-plane
+    store — ``fleet/<run_uid>/replica/<rid>`` -> ``{role, state,
+    address}`` — the discovery seam a REMOTE graftroute router
+    bootstraps from (the in-process router publishes here too, so one
+    deployment's directory looks the same either way). Best-effort by
+    the graftfleet contract: a store outage drops the record and
+    returns False — the run never dies for observability."""
+    payload = {"rid": str(rid), "role": str(role),
+               "state": str(state)}
+    if address is not None:
+        payload["address"] = str(address)
+    try:
+        store.set(_k(prefix, run_uid, "replica", rid),
+                  json.dumps(payload, sort_keys=True).encode())
+    except (OSError, ValueError) as e:
+        print(f"graftroute: replica publish {rid!r} failed "
+              f"({type(e).__name__}: {e}); directory readers see a "
+              "stale entry — routing correctness never depends on it",
+              file=sys.stderr)
+        return False
+    # keep a roster so readers can discover rids without a scan API
+    # on the store: append-only slots claimed through the store's
+    # atomic ``add`` — concurrent publishers (the remote rendezvous
+    # case) can never lose each other to a read-modify-write race
+    base = _k(prefix, run_uid, "replicas")
+    try:
+        known = _roster_rids(store, base)
+        if str(rid) not in known:
+            idx = int(store.add(base + "/n", 1)) - 1
+            store.set(f"{base}/{idx}", str(rid).encode())
+    except (OSError, ValueError):
+        return False
+    return True
+
+
+def _roster_rids(store, base: str) -> List[str]:
+    """The claimed roster slots, in claim order, deduped (a re-publish
+    race can claim two slots for one rid — harmless)."""
+    n = int(store.add(base + "/n", 0))
+    rids: List[str] = []
+    for i in range(n):
+        raw = store.get(f"{base}/{i}")
+        if not raw:
+            continue  # slot claimed, write not landed yet
+        rid = raw.decode()
+        if rid not in rids:
+            rids.append(rid)
+    return rids
+
+
+def replica_directory(store, *, run_uid: str = "run",
+                      prefix: str = "fleet") -> Dict[str, Dict]:
+    """Read back the store-published replica directory:
+    ``{rid: {role, state, address?}}`` — what a remote router (or an
+    operator's one-liner) consumes to find the fleet."""
+    out: Dict[str, Dict] = {}
+    try:
+        roster = _roster_rids(store, _k(prefix, run_uid, "replicas"))
+    except (OSError, ValueError):
+        return out
+    for rid in roster:
+        try:
+            rec = store.get(_k(prefix, run_uid, "replica", rid))
+        except (OSError, ValueError):
+            continue
+        if not rec:
+            continue
+        try:
+            out[str(rid)] = json.loads(rec.decode())
+        except ValueError:
+            continue
+    return out
+
+
+def fleet_serving_report(per_replica: Dict[str, Dict]) -> Dict:
+    """Aggregate graftroute per-replica snapshots (the
+    ``Router.merged_metrics()['per_replica']`` dicts, or remote
+    ``/snapshot.json`` scrapes) into the fleet-level goodput/straggler
+    view: per-replica ``goodput_frac`` with min/mean, and the
+    straggler NAMED — the replica with the lowest goodput fraction
+    (the fleet analogue of the rank-level straggler report; goodput
+    is historical wall-time accounting, so cleanly-drained replicas
+    report honestly too)."""
+    fracs = {rid: float(s.get("goodput_frac", 0.0))
+             for rid, s in per_replica.items()}
+    out: Dict[str, object] = {
+        "replicas": len(per_replica),
+        "replicas_alive": sum(
+            1 for s in per_replica.values()
+            if s.get("state") not in ("dead",)),
+    }
+    if not fracs:
+        return out
+    vals = sorted(fracs.values())
+    straggler = min(fracs, key=lambda rid: fracs[rid])
+    out.update({
+        "goodput_frac_per_replica": fracs,
+        "goodput_frac_min": vals[0],
+        "goodput_frac_mean": sum(vals) / len(vals),
+        "straggler": straggler,
+        "straggler_goodput_frac": fracs[straggler],
+    })
+    return out

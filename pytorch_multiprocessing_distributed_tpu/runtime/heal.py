@@ -515,19 +515,32 @@ class HealthState:
         return self.state == DEAD
 
     def snapshot(self) -> Dict:
-        return {"state": self.state, "reason": self.reason,
+        # state_name is the canonical UPPERCASE machine-state name
+        # (STARTING/READY/DRAINING/DEAD): the graftroute router keys
+        # its routing decision on it — DRAINING means finish in-flight
+        # but send no new work, DEAD means redeliver the journal —
+        # while the lowercase ``state`` stays for existing consumers
+        # (the 200-only-when-ready HTTP semantics are unchanged)
+        return {"state": self.state, "state_name": self.state.upper(),
+                "reason": self.reason,
                 "since_s": round(time.perf_counter() - self.since, 3)}
 
 
 def healthz(health: Optional[HealthState],
             monitor: Optional[HeartbeatMonitor] = None) -> Dict:
-    """The /healthz payload: health-machine state (+ drain reason and
-    dwell time) and, when a monitor is armed, every peer's last-beat
-    age — exactly what a replica router needs to route around a
-    draining or silent host. ``state`` drives the HTTP code (200 only
-    for ``ready``; see ``scope.start_stats_server``)."""
+    """The /healthz payload: health-machine state (both the lowercase
+    ``state`` and the canonical ``state_name`` — STARTING/READY/
+    DRAINING/DEAD — plus drain reason and dwell time) and, when a
+    monitor is armed, every peer's last-beat age — exactly what a
+    replica router needs to route around a draining or silent host.
+    A router distinguishes DRAINING (stop sending, let it finish)
+    from DEAD (redeliver its journal) from the BODY; ``state`` still
+    drives the HTTP code (200 only for ``ready``; see
+    ``scope.start_stats_server``) so existing 200/503 probes keep
+    working unchanged."""
     out = (health.snapshot() if health is not None
-           else {"state": READY, "reason": "static", "since_s": 0.0})
+           else {"state": READY, "state_name": READY.upper(),
+                 "reason": "static", "since_s": 0.0})
     if monitor is not None:
         out.update(monitor.snapshot())
     return out
@@ -832,6 +845,24 @@ class RequestJournal:
                                 "state": request.state,
                                 "reason": request.finish_reason})
             self._append(ops)
+
+    def record_handoff(self, request, to: str = "") -> None:
+        """Journal a QUEUED request leaving this engine for a peer
+        (graftroute work stealing / fleet rebalance): terminal HERE —
+        state ``"handoff"`` — so a later crash of THIS engine never
+        redelivers a request a peer now owns (the peer's own journal
+        records the admit; exactly one replica owns the uid at any
+        time)."""
+        with self._mu:
+            entry = self._entries.get(request.uid)
+            if entry is None or entry.done:
+                return
+            entry.done = True
+            entry.state = "handoff"
+            entry.reason = f"to:{to}" if to else "stolen"
+            self._append([{"op": "done", "uid": request.uid,
+                           "state": entry.state,
+                           "reason": entry.reason}])
 
     def record_failed(self, request) -> None:
         """Journal a quarantined request as terminal — a FAILED

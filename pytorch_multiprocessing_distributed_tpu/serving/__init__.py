@@ -12,13 +12,20 @@ token-exact), prompts admitted whole or in fixed-size chunks
 interleaved with decode (``scheduler.PrefillPlan``), fed by a FIFO
 scheduler with admission control and the adaptive horizon policy
 (``scheduler``), loading trained checkpoints param-only (``params``).
-CLI: repo-root ``serve_lm.py``.
+graftroute (``router``/``replica``) composes N engines into ONE
+fleet: cache- and load-aware placement, AIMD admission windows +
+work stealing, prefill/decode disaggregation over a host
+``PageTransfer`` seam, and journal redelivery across replica death.
+CLI: repo-root ``serve_lm.py`` (``--replicas N`` for the fleet).
 """
 
 from .engine import ServingEngine
 from .kv_pages import PagePool, PagePoolExhausted, PrefixCache
 from .kv_slots import SlotPool
 from .params import init_params, load_params
+from .replica import PageTransfer, ServingReplica
+from .router import (FleetDead, FleetSaturated, PrefixCacheDirectory,
+                     Router)
 from .scheduler import (DONE, FAILED, FIFOScheduler, PrefillPlan,
                         QueueFull, Request, bucket_length, pick_draft_k,
                         pick_horizon)
@@ -30,4 +37,7 @@ __all__ = [
     "QueueFull", "Request", "bucket_length", "init_params",
     "load_params", "ngram_bucket", "pick_draft_k", "pick_horizon",
     "DONE", "FAILED",
+    # graftroute: fleet serving
+    "Router", "ServingReplica", "PageTransfer",
+    "PrefixCacheDirectory", "FleetSaturated", "FleetDead",
 ]

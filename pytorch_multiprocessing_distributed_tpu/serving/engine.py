@@ -129,9 +129,9 @@ from ..utils.compile_cache import (jit_cache_keys, jit_cache_size,
 from ..utils.metrics import ServingMetrics
 from .kv_pages import PagePool, PagePoolExhausted, PrefixCache
 from .kv_slots import SlotPool
-from .scheduler import (DONE, FAILED, FIFOScheduler, PrefillPlan,
-                        QueueFull, Request, bucket_length, pick_draft_k,
-                        pick_horizon)
+from .scheduler import (DONE, FAILED, RUNNING, FIFOScheduler,
+                        PrefillPlan, QueueFull, Request, bucket_length,
+                        pick_draft_k, pick_horizon)
 from .spec import NgramDrafter
 
 __all__ = ["ServingEngine", "Request"]
@@ -2589,6 +2589,220 @@ class ServingEngine:
             graftscope.emit("request.redelivered", cat="request",
                             req=entry.uid,
                             replayed_tokens=len(entry.tokens))
+            out.append(request)
+        return out
+
+    # ---- graftroute: fleet seams --------------------------------------
+    def prefill_detached(self, request: Request,
+                         chunk: Optional[int] = None
+                         ) -> Tuple[int, jax.Array, jax.Array]:
+        """Run ONE request's prefill WITHOUT touching this engine's
+        pool — the prefill half of graftroute's prefill/decode split.
+
+        Returns ``(tok0, k_pref, v_pref)``: the sampled first token
+        (host int) and the standalone ``[L, 1, W, H, Dh]`` prefill
+        cache block, computed by the SAME jitted programs ordinary
+        admission runs (whole-prompt ``_prefill_jit``, or the fixed
+        ``[1, chunk]`` incremental program when ``chunk`` is given — a
+        dedicated prefill replica has no resident decode to interleave
+        with, so its chunks run back-to-back inside the call). Because
+        program, bucket padding and params are identical to a
+        monolithic admission, a handed-off continuation is token-exact
+        by construction; the receiving engine splices the block at ITS
+        OWN chosen write_ids (:meth:`admit_prefilled`) — the
+        receiver-chosen scatter of the portable-redistribution
+        discipline (arXiv:2112.01075). The block stays on THIS
+        engine's devices; the :class:`~.replica.PageTransfer` seam
+        owns the host round-trip.
+
+        Faults ride the normal admission domains (``serving.prefill``
+        / ``prefill_chunk`` / ``prefill_tok0`` sites, bounded retry);
+        exhaustion raises to the caller, who fails the request named —
+        there is no pool state to scrub."""
+        pool = self.pool
+        length = len(request.prompt)
+        if length < 1:
+            raise ValueError("empty prompt")
+        if length + request.max_new_tokens > pool.s_max:
+            raise ValueError(
+                f"prompt {length} + max_new_tokens "
+                f"{request.max_new_tokens} exceeds the slot capacity "
+                f"s_max={pool.s_max}")
+        if chunk is None:
+            bucket = bucket_length(length, self.min_bucket, pool.s_max)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :length] = request.prompt
+            key = self._next_key()
+
+            def prefill_once():
+                maybe_fault(_SITE_PREFILL)
+                with expected_transfer("prompt upload + first-token "
+                                       "readback (detached prefill)"):
+                    tok0, k_pref, v_pref = self._prefill_jit(
+                        self.params, jnp.asarray(padded),
+                        jnp.int32(length), key)
+                    record_jit_key(self._prefill_jit,
+                                   ("prefill", bucket))
+                    return int(tok0), k_pref, v_pref
+
+            with graftscope.span("serving.prefill", cat="serving",
+                                 req=request.uid, bucket=bucket,
+                                 prompt_len=length, detached=True):
+                return self._attempted(prefill_once)
+        plan = PrefillPlan(request, int(chunk), self.min_bucket,
+                           pool.s_max)
+        model = self.model
+        shape = (model.num_layers, 1, plan.width, model.num_heads,
+                 model.hidden_size // model.num_heads)
+        k_pref = self._pref_sharded(jnp.zeros(shape, model.dtype))
+        v_pref = self._pref_sharded(jnp.zeros(shape, model.dtype))
+        x = None
+        start = 0
+        while not plan.done:
+            start, valid, _is_last = plan.next_chunk()
+            padded = np.zeros((1, plan.chunk), np.int32)
+            padded[0, :valid] = request.prompt[start:start + valid]
+
+            def chunk_once(k=k_pref, v=v_pref, p=padded, s=start):
+                # the injected site fires BEFORE the jitted call, like
+                # _drive_pending: a retried injection never replays
+                # against donated buffers
+                maybe_fault(_SITE_CHUNK)
+                with expected_transfer("chunk upload (detached "
+                                       "prefill)"):
+                    return self._chunk_jit(self.params, k, v,
+                                           jnp.asarray(p),
+                                           jnp.int32(s))
+
+            with graftscope.span("serving.prefill_chunk",
+                                 cat="serving", req=request.uid,
+                                 start=start, chunk=plan.chunk,
+                                 detached=True):
+                x, k_pref, v_pref = self._attempted(chunk_once)
+            record_jit_key(self._chunk_jit,
+                           ("prefill_chunk", plan.chunk, plan.width))
+        key = self._next_key()
+
+        def tok0_once():
+            maybe_fault(_SITE_TOK0)
+            with expected_transfer("first-token readback (detached "
+                                   "prefill)"):
+                return int(self._tok0_jit(
+                    self.params, x, jnp.int32(length - 1 - start),
+                    key))
+
+        with graftscope.span("serving.prefill_tok0", cat="serving",
+                             req=request.uid, detached=True):
+            tok0 = self._attempted(tok0_once)
+        return tok0, k_pref, v_pref
+
+    def admit_prefilled(self, request: Request, tok0: int, k_pref,
+                        v_pref) -> List[Tuple[Request, int, bool]]:
+        """Splice a transferred prefill block into THIS engine — the
+        decode half of graftroute's split. ``k_pref``/``v_pref`` may
+        be device arrays or host numpy (the host-round-trip transfer
+        seam); this engine chooses the destination itself — a free
+        slot, and in paged mode freshly allocated pages whose ids
+        become the splice's write_ids — and runs the SAME jitted
+        insert program ordinary admission runs, so the continuation
+        is token-exact with a monolithic admission (test-pinned).
+
+        Raises ``QueueFull`` when admission is closed (not READY), no
+        slot is free, or the page pool cannot cover the request (after
+        shedding prefix-cache entries LRU-first, exactly like local
+        admission) — the router's signal to HOLD the transfer and
+        retry after this engine steps. Token events (the first token;
+        possibly finished-at-first-token) are returned AND journaled
+        like any admission."""
+        if not self.health.ready:
+            self.metrics.record_shed()
+            graftscope.emit("request.shed", cat="request",
+                            req=request.uid,
+                            reason=self.health.state)
+            raise QueueFull(
+                f"admission closed: engine {self.health.state.upper()}"
+                f" ({self.health.reason}); transfer to another replica")
+        pool = self.pool
+        length = len(request.prompt)
+        if length < 1:
+            raise ValueError("empty prompt")
+        if length + request.max_new_tokens > pool.s_max:
+            raise ValueError(
+                f"prompt {length} + max_new_tokens "
+                f"{request.max_new_tokens} exceeds the slot capacity "
+                f"s_max={pool.s_max}")
+        if pool.free_slots < 1:
+            raise QueueFull(
+                "no free slot for the transferred prefill; step this "
+                "engine and retry (graftroute holds the transfer)")
+        prep = None
+        if self._paged:
+            n_total = PagePool.pages_for(
+                length + request.max_new_tokens, pool.page_size)
+            if n_total > pool.num_pages - 1:
+                raise ValueError(
+                    f"transfer needs {n_total} page(s); the pool holds "
+                    f"{pool.num_pages - 1} allocatable")
+            while (pool.free_pages < n_total
+                   and self._prefix_cache is not None
+                   and self._prefix_cache.evict_lru()):
+                pass  # shed cache before holding a transfer
+            if pool.free_pages < n_total:
+                self.metrics.record_page_hold()
+                graftscope.emit("request.held", cat="request",
+                                req=request.uid, pages_needed=n_total,
+                                pages_free=pool.free_pages)
+                raise QueueFull(
+                    f"page pressure: transfer needs {n_total} page(s),"
+                    f" {pool.free_pages} free — retry after running "
+                    "work completes")
+            prep = _PagedPrep("miss", None, 0, [],
+                              pool.alloc_pages(n_total), None, n_total)
+        if request.submit_time is None:
+            request.submit_time = time.perf_counter()
+        if self.journal is not None:
+            self.journal.record_admit(request)
+        request.state = RUNNING
+        request.admit_time = time.perf_counter()
+        self.metrics.record_admission(
+            request.admit_time - request.submit_time)
+        graftscope.emit("request.admit", cat="request",
+                        req=request.uid, transfer=True,
+                        queue_wait_s=(request.admit_time
+                                      - request.submit_time))
+        events: List[Tuple[Request, int, bool]] = []
+        slot = self._first_token(request, int(tok0), events)
+        if slot is None:  # finished at its (transferred) first token
+            self._abort_prep(prep)
+        else:
+            k_dev = self._pref_sharded(jnp.asarray(k_pref))
+            v_dev = self._pref_sharded(jnp.asarray(v_pref))
+            try:
+                self._insert(request, slot, k_dev, v_dev, length,
+                             jnp.int32(int(tok0)), prep=prep)
+            except Exception as e:
+                self._abort_prep(prep)
+                self._poisoned(request, e, slot=slot)
+        if self.journal is not None and events:
+            self.journal.note_events(events)
+        return events
+
+    def withdraw_queued(self, max_n: int = 1) -> List[Request]:
+        """graftroute work stealing: hand up to ``max_n`` QUEUED
+        requests (taken from the queue TAIL — the FIFO head keeps its
+        admission order on this replica; the request that would wait
+        LONGEST moves) back to the router for re-placement on a
+        drained peer. The ROUTER journals the handoff
+        (``RequestJournal.record_handoff``) only once the peer
+        ACCEPTS — a refused theft requeues here with its WAL entry
+        still live, so the redelivery guarantee never has a gap."""
+        out: List[Request] = []
+        for _ in range(max_n):
+            request = self.scheduler.withdraw_tail()
+            if request is None:
+                break
+            graftscope.emit("request.stolen", cat="request",
+                            req=request.uid)
             out.append(request)
         return out
 

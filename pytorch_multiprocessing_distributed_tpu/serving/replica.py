@@ -1,0 +1,345 @@
+"""graftroute: replica handles + the prefill→decode transfer seam.
+
+A :class:`~.engine.ServingEngine` is one chip's worth of serving; the
+fleet story (ROADMAP item 2) needs N of them behind one router. This
+module is the half the router holds in its hand: a
+:class:`ServingReplica` wraps one engine with an identity (``rid``), a
+**role** (``"both"`` — the classic monolithic replica; ``"prefill"`` —
+runs only the prefill programs and hands finished KV blocks off;
+``"decode"`` — receives transferred blocks and decodes them), an
+**admission window** (the continuous-batching backpressure signal the
+router places against), and the **stats/health surface** the router
+consumes.
+
+The stats seam is deliberately dict-shaped: :meth:`ServingReplica
+.snapshot` and :meth:`ServingReplica.health` return exactly the
+payloads a live replica publishes on ``/snapshot.json`` and
+``/healthz`` (``runtime.scope.start_stats_server`` +
+``runtime.heal.healthz``) — so the in-process handle the router uses
+today and a remote handle that scrapes a store-published endpoint
+(``runtime.fleet.publish_replica`` / ``replica_directory``) are the
+same interface. The router never reaches into an engine except through
+these dicts plus the four verbs (``enqueue`` / ``step`` /
+``admit_prefilled`` / ``withdraw_queued``), which is what keeps the
+remote deployment a transport change, not a redesign.
+
+**The PageTransfer seam.** A prefill replica runs a request through
+the SAME jitted prefill programs ordinary admission uses
+(:meth:`~.engine.ServingEngine.prefill_detached` — whole-prompt or
+chunked) and exports the standalone ``[L, 1, W, H, Dh]`` cache block
+to HOST memory; the decode replica splices it at its OWN freshly
+chosen write_ids through the existing paged-splice machinery
+(:meth:`~.engine.ServingEngine.admit_prefilled`). Host round-trip
+first — the portable, receiver-chosen-scatter discipline of
+arXiv:2112.01075 — with device-to-device transfer as a later
+optimization behind the same class. Because both halves run the exact
+programs a monolithic admission runs, the handed-off continuation is
+token-exact by construction (test-pinned in
+``tests/test_graftroute.py``).
+
+**Admission windows.** Continuous batching means a replica's real
+capacity is dynamic (free slots, free pages, queue law); stuffing a
+saturated replica just converts router traffic into per-replica
+``QueueFull`` churn. Each handle keeps a window in
+``[min_window, window_max]``: it HALVES whenever the replica signals
+pressure (a ``QueueFull`` at placement, or growth of the engine's
+``page_holds`` / ``requests_shed`` counters between steps) and creeps
+back up one per pressure-free step — AIMD, the same shape TCP uses
+and for the same reason (the signal is binary and delayed). The
+router admits to a replica only while its live ``in_flight`` is below
+the window, holding or shedding at the FLEET level otherwise.
+
+All host-side: no jitted program changes, graftcheck fingerprints and
+cost budgets do not move.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Deque, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..runtime import scope as graftscope
+from ..runtime.faults import (DeadlineExceeded, FaultInjected,
+                              GraftFaultError)
+from .scheduler import FAILED, QueueFull, Request
+
+__all__ = ["PageTransfer", "ServingReplica", "ROLES"]
+
+ROLES = ("both", "prefill", "decode")
+
+
+class PageTransfer:
+    """One finished prefill leaving its prefill replica: the request
+    (identity + lifecycle record — its ``submit_time``, and so its
+    TTFT clock, travels with it) plus the first token and the
+    standalone prefill cache block as HOST numpy arrays. The receiver
+    (:meth:`~.engine.ServingEngine.admit_prefilled`) picks its own
+    write_ids and splices through the existing insert program — the
+    block never dictates where it lands (arXiv:2112.01075's
+    receiver-chosen redistribution, the property that makes the seam
+    portable across hosts)."""
+
+    __slots__ = ("request", "tok0", "k_block", "v_block", "src_rid")
+
+    def __init__(self, request: Request, tok0: int, k_block, v_block,
+                 src_rid: Optional[str] = None):
+        self.request = request
+        self.tok0 = int(tok0)
+        self.k_block = k_block
+        self.v_block = v_block
+        self.src_rid = src_rid
+
+    @property
+    def nbytes(self) -> int:
+        """Transferred payload bytes (the number a device-to-device
+        path would move instead)."""
+        return int(self.k_block.nbytes) + int(self.v_block.nbytes)
+
+
+class ServingReplica:
+    """One engine behind the router.
+
+    Args:
+      rid: replica id (stable string — journal names, directory keys,
+        straggler reports all use it).
+      engine: the wrapped :class:`~.engine.ServingEngine`.
+      role: ``"both"`` | ``"prefill"`` | ``"decode"``. A prefill
+        replica never decodes: requests queue host-side here and leave
+        as :class:`PageTransfer`\\ s; its engine's pool is only a
+        program cache. A decode replica admits transfers (and, when
+        the router must, ordinary requests — its engine is a full
+        engine).
+      journal: the replica's redelivery WAL (defaults to
+        ``engine.journal``) — what the router replays to peers when
+        this replica dies.
+      min_window / window_max: admission-window bounds; defaults
+        derive from the engine (``max_slots`` + queue allowance).
+      address: optional ``host:port`` of this replica's live stats
+        server (published to the fleet store for remote routers).
+    """
+
+    def __init__(self, rid: str, engine, role: str = "both",
+                 journal=None, min_window: int = 1,
+                 window_max: Optional[int] = None,
+                 address: Optional[str] = None):
+        if role not in ROLES:
+            raise ValueError(
+                f"role must be one of {ROLES}, got {role!r}")
+        self.rid = str(rid)
+        self.engine = engine
+        self.role = role
+        self.journal = journal if journal is not None else engine.journal
+        self.address = address
+        slots = engine.pool.max_slots
+        queue_allow = engine.scheduler.max_queue
+        if window_max is None:
+            window_max = slots + (queue_allow if queue_allow is not None
+                                  else max(2, slots))
+        if min_window < 1:
+            raise ValueError(
+                f"min_window must be >= 1, got {min_window}")
+        self.min_window = int(min_window)
+        self.window_max = max(int(window_max), self.min_window)
+        self.window = self.window_max
+        # pressure baseline: counter values at the last poll — growth
+        # between polls IS the backpressure signal (page_holds: the
+        # paged pool deferred an admission; requests_shed: the bounded
+        # queue or a closed door rejected one)
+        self._holds_base = engine.metrics.page_holds
+        self._shed_base = engine.metrics.requests_shed
+        self._prefill_queue: Deque[Request] = deque()
+        self._born = time.perf_counter()
+        self._prefill_s = 0.0  # prefill replicas' productive seconds
+        self.transfers_out = 0
+        self.reaped = False  # router bookkeeping: dead + redelivered
+
+    # ---- identity / health (the /healthz shape) -----------------------
+    @property
+    def decode_capable(self) -> bool:
+        return self.role in ("both", "decode")
+
+    @property
+    def dead(self) -> bool:
+        return self.engine.health.dead
+
+    def health(self) -> Dict:
+        """The replica's ``/healthz`` payload (``runtime.heal``'s
+        snapshot: ``state`` + canonical ``state_name`` + reason +
+        dwell), plus identity — the dict a remote router reads off the
+        wire and this in-process handle serves directly."""
+        out = dict(self.engine.health.snapshot())
+        out["rid"] = self.rid
+        out["role"] = self.role
+        return out
+
+    # ---- stats (the /snapshot.json shape) -----------------------------
+    @property
+    def in_flight(self) -> int:
+        """Work owned by this replica: the engine's own in-flight
+        (queued + resident + undrained blocks) plus any prompts
+        waiting in the prefill queue."""
+        return self.engine.in_flight + len(self._prefill_queue)
+
+    def snapshot(self) -> Dict:
+        """The placement-relevant live stats: what a remote router
+        scrapes from ``/snapshot.json`` and the in-process router
+        reads here — queue law, free slots/pages, pressure counters,
+        admission window, and this replica's goodput fraction
+        (productive decode/prefill seconds over wall seconds since
+        birth — the per-replica goodput the fleet report aggregates).
+        """
+        engine = self.engine
+        m = engine.metrics
+        wall = time.perf_counter() - self._born
+        productive = m.decode_elapsed_s + self._prefill_s
+        snap = {
+            "rid": self.rid,
+            "role": self.role,
+            "state": engine.health.state,
+            "state_name": engine.health.state.upper(),
+            "queue_depth": engine.scheduler.queue_depth,
+            "prefill_queue_depth": len(self._prefill_queue),
+            "in_flight": self.in_flight,
+            "free_slots": engine.pool.free_slots,
+            "free_pages": getattr(engine.pool, "free_pages", -1),
+            "page_holds": m.page_holds,
+            "requests_shed": m.requests_shed,
+            "requests_completed": m.requests_completed,
+            "requests_redelivered": m.requests_redelivered,
+            "tokens_generated": m.tokens_generated,
+            "transfers_out": self.transfers_out,
+            "admit_window": self.window,
+            "goodput_frac": (productive / wall if wall > 0 else 0.0),
+        }
+        return snap
+
+    # ---- admission window (AIMD backpressure) -------------------------
+    def admittable(self) -> bool:
+        """Would the router place NEW work here right now? READY and
+        inside the admission window. (DRAINING replicas keep stepping
+        — they finish in-flight work — but never admit.)"""
+        return self.engine.health.ready and self.in_flight < self.window
+
+    def load(self) -> Tuple[int, int]:
+        """Least-loaded placement key: live in-flight first, then
+        page scarcity (more free pages wins — the dense pool's -1
+        sentinel makes dense replicas tie and fall through to
+        in-flight alone)."""
+        return (self.in_flight,
+                -int(getattr(self.engine.pool, "free_pages", -1)))
+
+    def note_pressure(self) -> None:
+        """One explicit pressure signal (a ``QueueFull`` the router
+        just absorbed at placement): halve the admission window."""
+        new = max(self.min_window, self.window // 2)
+        if new != self.window:
+            graftscope.emit("route.window", cat="serving",
+                            rid=self.rid, window=new, was=self.window)
+        self.window = new
+
+    def poll_pressure(self) -> None:
+        """Per-step window adaptation off the engine's own counters:
+        growth of ``page_holds`` / ``requests_shed`` since the last
+        poll halves the window; a pressure-free step grows it by one
+        (additive-increase / multiplicative-decrease — the delayed
+        binary signal shape)."""
+        m = self.engine.metrics
+        pressured = (m.page_holds > self._holds_base
+                     or m.requests_shed > self._shed_base)
+        self._holds_base = m.page_holds
+        self._shed_base = m.requests_shed
+        if pressured:
+            self.note_pressure()
+        elif self.window < self.window_max:
+            self.window += 1
+
+    # ---- placement verbs ----------------------------------------------
+    def enqueue(self, request: Request) -> Request:
+        """Place one ordinary request (decode-capable roles only)."""
+        if not self.decode_capable:
+            raise ValueError(
+                f"replica {self.rid} is prefill-only; the router "
+                "routes ordinary admissions to decode-capable "
+                "replicas")
+        return self.engine.enqueue(request)
+
+    def submit_prefill(self, request: Request) -> Request:
+        """Queue one request for detached prefill (prefill role)."""
+        if self.role != "prefill":
+            raise ValueError(
+                f"replica {self.rid} has role {self.role!r}; "
+                "submit_prefill is the prefill-role intake")
+        if not self.engine.health.ready:
+            raise QueueFull(
+                f"prefill replica {self.rid} is "
+                f"{self.engine.health.state}; place elsewhere")
+        if request.submit_time is None:
+            request.submit_time = time.perf_counter()
+        self._prefill_queue.append(request)
+        return request
+
+    def withdraw_prefill(self) -> List[Request]:
+        """Drain the prefill intake (replica death / drain: the router
+        re-places these — no tokens exist yet, so a plain re-route is
+        already exact)."""
+        out = list(self._prefill_queue)
+        self._prefill_queue.clear()
+        return out
+
+    # ---- drive --------------------------------------------------------
+    def step(self) -> List[Tuple[Request, int, bool]]:
+        """One engine step (decode-capable roles; a prefill replica's
+        work happens in :meth:`prefill_step`)."""
+        if not self.decode_capable:
+            return []
+        return self.engine.step()
+
+    def prefill_step(self) -> Optional[PageTransfer]:
+        """Run ONE queued prompt through detached prefill and export
+        the block to host (prefill role; one prompt per router step —
+        the fleet-level analogue of one chunk per engine step). A
+        per-request failure (exhausted transient retries, a poisoned
+        prompt) fails THAT request named and returns None — the
+        replica keeps prefilling; a named fatal propagates (the
+        router reaps the replica and re-places its queue)."""
+        if not self._prefill_queue:
+            return None
+        request = self._prefill_queue.popleft()
+        t0 = time.perf_counter()
+        try:
+            tok0, k_pref, v_pref = self.engine.prefill_detached(
+                request, chunk=self.engine._prefill_chunk)
+            # the host round-trip: device blocks -> numpy (the seam a
+            # device-to-device path would replace)
+            k_block = np.asarray(k_pref)
+            v_block = np.asarray(v_pref)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except BaseException as e:
+            if (isinstance(e, GraftFaultError)
+                    and not isinstance(e, (FaultInjected,
+                                           DeadlineExceeded))):
+                # engine-fatal: this replica is done — the router
+                # reaps it and re-places the rest of the queue
+                self.engine.health.to_dead(type(e).__name__)
+                raise
+            request.state = FAILED
+            request.finish_reason = "error"
+            request.error = e
+            request.finish_time = time.perf_counter()
+            self.engine.metrics.record_failure()
+            graftscope.emit("request.failed", cat="request",
+                            req=request.uid, error=type(e).__name__,
+                            where="detached_prefill")
+            return None
+        self._prefill_s += time.perf_counter() - t0
+        self.transfers_out += 1
+        transfer = PageTransfer(request, tok0, k_block, v_block,
+                                src_rid=self.rid)
+        graftscope.emit("route.transfer", cat="serving",
+                        req=request.uid, src=self.rid,
+                        nbytes=transfer.nbytes)
+        return transfer

@@ -336,6 +336,21 @@ class FIFOScheduler:
         request.state = RUNNING
         return request
 
+    def withdraw_tail(self) -> Optional[Request]:
+        """Remove and return the queue TAIL, still QUEUED (graftroute
+        work stealing: the FIFO head keeps its admission order on this
+        engine; the most recently queued request — the one that would
+        wait longest here — moves to the drained peer). ``None`` when
+        the queue is empty. The request's lifecycle record (uid,
+        ``submit_time``, hence its TTFT clock) travels with it."""
+        return self._queue.pop() if self._queue else None
+
+    def requeue_tail(self, request: Request) -> None:
+        """Put a withdrawn request back at the TAIL (a theft the
+        thief refused after all — never a silent drop). Skips the
+        bound: the request was already counted against it."""
+        self._queue.append(request)
+
     def complete(self, request: Request, reason: str) -> None:
         request.state = DONE
         request.finish_reason = reason

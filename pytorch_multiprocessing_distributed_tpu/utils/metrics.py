@@ -228,6 +228,12 @@ class ServingMetrics:
         self.decode_tokens += tokens
         self._elapsed += seconds
 
+    @property
+    def decode_elapsed_s(self) -> float:
+        """Accumulated decode wall seconds (the productive-time
+        numerator graftroute's per-replica goodput fraction uses)."""
+        return self._elapsed
+
     def record_completion(self, tokens: int = 0) -> None:
         """``tokens`` = the finished request's generated-token count
         (tokens/request is a percentile the capacity planner reads)."""

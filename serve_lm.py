@@ -191,6 +191,30 @@ parser.add_argument('--metrics_out', default='', type=str,
                     help='write the final metrics snapshot as JSON')
 parser.add_argument('--quiet', action='store_true',
                     help='suppress per-token streaming lines')
+# --- graftroute: fleet serving ---
+parser.add_argument('--replicas', default=1, type=int,
+                    help='graftroute: serve through an in-process '
+                         'fleet of N engine replicas behind one load- '
+                         'and cache-aware Router — per-replica '
+                         'admission windows, cross-replica work '
+                         'stealing, journal redelivery on replica '
+                         'death (1 = the single-engine path)')
+parser.add_argument('--role', default='both', type=str,
+                    help="graftroute replica roles: 'both' (every "
+                         "replica prefills AND decodes), 'split' "
+                         "(replica 0 runs ONLY prefill and hands "
+                         "finished KV page-blocks to the decode "
+                         "replicas — prefill/decode disaggregation; "
+                         "needs --replicas >= 2), or an explicit "
+                         "comma list 'prefill,decode,decode' of "
+                         "length --replicas (at least one "
+                         "decode-capable role required)")
+parser.add_argument('--router_port', default=0, type=int,
+                    help='graftroute: serve the ROUTER-level stats/'
+                         'health endpoint — merged fleet metrics '
+                         '(redelivery-deduped) on /metrics + '
+                         '/snapshot.json, aggregated per-replica '
+                         'states on /healthz (0 = off)')
 # --- graftheal: elastic runtime ---
 parser.add_argument('--drain_deadline_s', default=0.0, type=float,
                     help='graceful-drain bound: on SIGTERM (or source '
@@ -526,6 +550,153 @@ def main():
             if stats_server is not None:
                 stats_server.shutdown()
         return engine
+
+    # ---- graftroute: in-process fleet behind one router -------------
+    fleet_mode = args.replicas > 1 or args.role != 'both'
+    if fleet_mode:
+        from pytorch_multiprocessing_distributed_tpu.serving import (
+            FleetSaturated, Router, ServingReplica)
+
+        if args.replicas < 1:
+            raise SystemExit("--replicas must be >= 1")
+        if args.role == 'both':
+            roles = ['both'] * args.replicas
+        elif args.role == 'split':
+            if args.replicas < 2:
+                raise SystemExit(
+                    "--role split needs --replicas >= 2 (one prefill "
+                    "replica handing KV blocks to >= 1 decode replica)")
+            roles = ['prefill'] + ['decode'] * (args.replicas - 1)
+        else:
+            roles = [r.strip() for r in args.role.split(',')]
+            if len(roles) != args.replicas:
+                raise SystemExit(
+                    f"--role lists {len(roles)} role(s) for "
+                    f"--replicas {args.replicas}")
+        if not any(r in ('both', 'decode') for r in roles):
+            raise SystemExit(
+                "at least one replica must be decode-capable (role "
+                "'both' or 'decode') — a prefill-only fleet can never "
+                "emit a token")
+
+        def serve_fleet_once(attempt):
+            """One fleet incarnation: build N replicas behind one
+            router (replaying each replica's journal token-exact),
+            pump the source through fleet placement, drain
+            gracefully. A replica death mid-run is absorbed INSIDE
+            the router (journal redelivery to peers); only a
+            whole-fleet fatal (FleetDead) reaches the supervisor."""
+            replicas = []
+            for i, role in enumerate(roles):
+                rid = f"r{i}"
+                journal = None
+                if args.journal and role != 'prefill':
+                    journal = heal.RequestJournal(
+                        f"{args.journal}.{rid}")
+                replicas.append(ServingReplica(
+                    rid, build_engine(journal), role=role,
+                    journal=journal))
+            router = Router(replicas)
+            if attempt:
+                print(f"graftheal: restart {attempt}: fleet rebuilt "
+                      f"({len(replicas)} replica(s))", flush=True)
+            prev_handler = heal.install_drain_handler(router)
+            stats_server = None
+            if args.router_port:
+                for r in replicas:
+                    r.engine.metrics.bound_samples(8192)
+                fleet.arm_goodput()
+
+                def fleet_snapshot():
+                    snap = router.merged_metrics()
+                    snap.update(fleet.fleet_serving_report(
+                        snap.get("per_replica", {})))
+                    snap.update(fleet.goodput_gauges())
+                    return snap
+
+                stats_server = graftscope.start_stats_server(
+                    fleet_snapshot, port=args.router_port,
+                    prefix="pmdt_fleet",
+                    health_fn=router.healthz,
+                    events_fn=graftscope.scope_events_fn)
+                print(f"router stats: http://127.0.0.1:"
+                      f"{stats_server.server_address[1]}/metrics "
+                      f"(+ /healthz)", flush=True)
+            try:
+                with graftscope.flight_recorder(
+                        "serve_lm fleet drive loop"):
+                    replay_events = []
+                    router.recover(events_out=replay_events)
+                    emit(replay_events)
+                    while not router.draining:
+                        if pending_src[0] is None:
+                            try:
+                                prompt, max_new = next(source)
+                            except StopIteration:
+                                break
+                            pending_src[0] = (f"src-{src_idx[0]}",
+                                              prompt, max_new)
+                            src_idx[0] += 1
+                        uid, prompt, max_new = pending_src[0]
+                        if router.known(uid):
+                            pending_src[0] = None
+                            continue
+                        handled = False
+                        while True:
+                            try:
+                                served.append(router.submit(
+                                    prompt, max_new, uid=uid))
+                                handled = True
+                                break
+                            except FleetSaturated:
+                                emit(router.step())
+                            except QueueFull:
+                                break  # fleet draining: closed
+                            except ValueError as e:
+                                rejected[0] += 1
+                                print(f"rejected: {e}",
+                                      file=sys.stderr)
+                                handled = True
+                                break
+                        if handled:
+                            pending_src[0] = None
+                        if router.draining:
+                            break
+                        if args.stdin:
+                            emit(router.step())
+                    while router.in_flight and not router.draining:
+                        emit(router.step())
+                    emit(router.drain(args.drain_deadline_s or None))
+            finally:
+                heal.restore_drain_handler(prev_handler)
+                if stats_server is not None:
+                    stats_server.shutdown()
+            return router
+
+        if args.max_restarts:
+            router = heal.Supervisor(
+                serve_fleet_once, max_restarts=args.max_restarts,
+                backoff_s=args.restart_backoff).run()
+        else:
+            router = serve_fleet_once(0)
+        for msg in skipped:
+            print(f"rejected: {msg}", file=sys.stderr)
+        for request in router.records().values():
+            graftscope.emit("request.timeline", cat="request",
+                            **request.timeline())
+        snap = router.merged_metrics()
+        snap["rejected"] = rejected[0] + len(skipped)
+        snap.update(fleet.fleet_serving_report(
+            snap.get("per_replica", {})))
+        snap["fleet_state"] = router.healthz()["state_name"]
+        snap.update(fleet.goodput_gauges())
+        print("metrics: " + json.dumps(snap, sort_keys=True),
+              flush=True)
+        if args.metrics_out:
+            with open(args.metrics_out, "w") as f:
+                json.dump(snap, f, indent=2, sort_keys=True)
+        graftscope.export_from_args(args)
+        return
 
     if args.max_restarts:
         engine = heal.Supervisor(

@@ -1,0 +1,734 @@
+"""graftroute: fleet router over engine replicas — placement,
+backpressure, prefill/decode disaggregation, failure redelivery.
+
+The headline pins (ISSUE 14 acceptance):
+- with 2+ replicas behind the router, every stream is BYTE-IDENTICAL
+  to the single-engine baseline — including requests redelivered
+  across a replica death and prompts served through the fleet prefix
+  directory;
+- prefill→decode page handoff produces token-exact continuations vs a
+  monolithic replica (whole-prompt AND chunked prefill);
+- fleet-level metrics dedup: a redelivered-and-completed request never
+  double-counts ``tokens_generated`` in the merged snapshot;
+- /healthz carries the canonical state NAME (DRAINING vs DEAD is a
+  routing decision, not a status-code guess).
+
+All host-side: the router composes existing jitted programs, so
+graftcheck's fingerprints and cost budgets cannot move (no new audit
+programs — ``make check`` pins that globally).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from pytorch_multiprocessing_distributed_tpu import models
+from pytorch_multiprocessing_distributed_tpu.runtime import (
+    faults, fleet as graftfleet, heal)
+from pytorch_multiprocessing_distributed_tpu.runtime.store import (
+    MemStore)
+from pytorch_multiprocessing_distributed_tpu.serving import (
+    FleetDead, FleetSaturated, PageTransfer, PrefixCacheDirectory,
+    QueueFull, Request, Router, ServingEngine, ServingReplica,
+    init_params)
+
+
+def _tiny(**kw):
+    return models.GPT(vocab_size=61, max_seq_len=64, hidden_size=32,
+                      num_layers=2, num_heads=2, mlp_dim=64,
+                      attn_impl="xla", **kw)
+
+
+@pytest.fixture(scope="module")
+def served():
+    model = _tiny()
+    params = init_params(model, 1)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, model.vocab_size, (n,)).tolist()
+               for n in (3, 7, 12, 5, 9, 6)]
+    return model, params, prompts
+
+
+def _engine(model, params, **kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("s_max", 32)
+    kw.setdefault("min_bucket", 8)
+    kw.setdefault("retry_backoff_s", 0.0)
+    return ServingEngine(model, params, **kw)
+
+
+@pytest.fixture(scope="module")
+def baseline(served):
+    """Single-engine reference streams (uid -> tokens), max_new=6."""
+    model, params, prompts = served
+    engine = _engine(model, params)
+    done = engine.serve([(p, 6) for p in prompts])
+    return {i: list(r.tokens) for i, r in enumerate(done)}
+
+
+# ---------------------------------------------------------- placement
+
+def test_fleet_streams_byte_identical(served, baseline):
+    """THE acceptance pin: 2 replicas behind the router, every stream
+    byte-identical to the single-engine baseline; the merged token
+    count equals the baseline total (no drops, no dupes)."""
+    model, params, prompts = served
+    router = Router([
+        ServingReplica("r0", _engine(model, params)),
+        ServingReplica("r1", _engine(model, params))])
+    out = router.serve([(p, 6) for p in prompts])
+    assert len(out) == len(prompts)
+    for i, request in enumerate(out):
+        assert request.state == "done"
+        assert list(request.tokens) == baseline[i], f"stream {i}"
+    merged = router.merged_metrics()
+    assert merged["tokens_generated"] == sum(
+        len(t) for t in baseline.values())
+    # both replicas actually served (least-loaded spread the work)
+    per = merged["per_replica"]
+    assert all(s["requests_completed"] > 0 for s in per.values())
+
+
+def test_least_loaded_placement_and_windows(served):
+    """Placement prefers the emptier replica; a replica at its
+    admission window stops receiving (the router holds instead) and
+    its window HALVES on a pressure signal, creeping back up on
+    pressure-free steps (AIMD)."""
+    model, params, prompts = served
+    r0 = ServingReplica("r0", _engine(model, params), window_max=3)
+    r1 = ServingReplica("r1", _engine(model, params), window_max=3)
+    router = Router([r0, r1])
+    a = router.submit(prompts[0], 2)
+    b = router.submit(prompts[1], 2)
+    assert {router._assigned[a.uid], router._assigned[b.uid]} == \
+        {"r0", "r1"}
+    # saturate both windows -> the router HOLDS (no replica admit)
+    for _ in range(r0.window + r1.window):
+        router.submit(prompts[2], 2)
+    assert len(router._pending) > 0
+    # AIMD: explicit pressure halves, a clean poll grows by one
+    w = r0.window
+    r0.note_pressure()
+    assert r0.window == max(1, w // 2)
+    r0._holds_base = r0.engine.metrics.page_holds
+    r0._shed_base = r0.engine.metrics.requests_shed
+    shrunk = r0.window
+    r0.poll_pressure()
+    assert r0.window == shrunk + 1
+    # drain everything; holds place as windows free up
+    for _ in router.run():
+        pass
+    assert len(router._pending) == 0
+
+
+def test_fleet_saturated_sheds_named(served):
+    """Past ``max_pending`` the router sheds with FleetSaturated — a
+    QueueFull subclass, so engine-style retry handling applies one
+    level up."""
+    model, params, prompts = served
+    router = Router([ServingReplica(
+        "r0", _engine(model, params, max_queue=1))], max_pending=1)
+    n_ok = 0
+    with pytest.raises(FleetSaturated):
+        for _ in range(64):
+            router.submit(prompts[0], 4)
+            n_ok += 1
+    assert n_ok >= 2  # window + hold absorbed some before the shed
+    assert router.requests_shed_fleet == 1
+    assert isinstance(FleetSaturated("x"), QueueFull)
+    for _ in router.run():
+        pass
+
+
+@pytest.mark.slow
+def test_work_stealing_rebalances(served):
+    """A replica that drains its queue steals the backlogged peer's
+    queue TAIL; the journal records the handoff terminal on the
+    victim (crash-after-steal never redelivers a stolen uid)."""
+    model, params, prompts = served
+    tmp = pytest.importorskip("tempfile").mkdtemp()
+    wal = os.path.join(tmp, "victim.jsonl")
+    journal = heal.RequestJournal(wal)
+    victim = ServingReplica(
+        "victim", _engine(model, params, journal=journal),
+        journal=journal)
+    thief = ServingReplica("thief", _engine(model, params))
+    router = Router([victim, thief])
+    # pile the backlog onto the victim directly (bypassing placement,
+    # the way a burst routed before the peer came up would land)
+    reqs = [victim.engine.submit(p, 4, uid=f"v{i}")
+            for i, p in enumerate(prompts)]
+    while router.in_flight:
+        router.step()
+    assert router.steals >= 1
+    stolen = [uid for uid, rid in router._assigned.items()
+              if rid == "thief"]
+    assert stolen  # the thief really served stolen work
+    for request in reqs:
+        assert request.state == "done"
+    # victim's WAL: stolen uids are terminal as "handoff"
+    entries = {e.uid: e for e in journal.entries}
+    for uid in stolen:
+        assert entries[uid].done and entries[uid].state == "handoff"
+
+
+def test_page_transfer_seam_shape():
+    """The transfer seam carries host arrays + the request's lifecycle
+    record (its TTFT clock travels with it) and meters its payload."""
+    request = Request([1, 2, 3], 4, uid="t")
+    k = np.zeros((2, 1, 8, 2, 16), np.float32)
+    v = np.ones_like(k)
+    transfer = PageTransfer(request, 5, k, v, src_rid="pf")
+    assert transfer.nbytes == k.nbytes + v.nbytes
+    assert transfer.tok0 == 5
+    assert transfer.request is request
+    assert transfer.src_rid == "pf"
+
+
+# ------------------------------------------------- prefix directory
+
+def test_prefix_directory_keys_match_prefix_cache():
+    """The directory's key discipline is PrefixCache's: page-aligned
+    prefixes, hash-routed, token-verified, longest-first; drop_replica
+    forgets a dead holder."""
+    d = PrefixCacheDirectory(page_size=4)
+    d.register(list(range(10)), "r0")   # 2 full pages
+    d.register(list(range(100, 104)), "r1")
+    assert d.lookup(list(range(10))) == "r0"         # full key
+    assert d.lookup(list(range(8)) + [99]) == "r0"   # 2-page prefix
+    assert d.lookup(list(range(4)) + [99]) == "r0"   # 1-page prefix
+    assert d.lookup([99, 98, 97]) is None
+    assert d.lookup(list(range(100, 104)) + [1]) == "r1"
+    d.drop_replica("r0")
+    assert d.lookup(list(range(10))) is None
+    assert d.lookup(list(range(100, 104))) == "r1"
+    # too short to cover a page: never registered
+    d.register([1, 2], "r2")
+    assert d.lookup([1, 2]) is None
+
+
+def test_prefix_hit_routed_to_holding_replica(served):
+    """A prompt served once on a paged+prefix-cache replica pulls the
+    identical prompt BACK to that replica (directory hit), where it
+    admits as an engine-level FULL hit — and the warm TTFT beats the
+    same engine's cold-miss TTFT."""
+    model, params, _prompts = served
+    rng = np.random.default_rng(7)
+
+    def mk():
+        return _engine(model, params, kv_layout="paged", page_size=8,
+                       prefix_cache=4)
+
+    router = Router([ServingReplica("p0", mk()),
+                     ServingReplica("p1", mk())])
+    warm = rng.integers(0, model.vocab_size, (16,)).tolist()
+    first = router.serve([(warm, 4)])[0]
+    holder = router._assigned[first.uid]
+    # identical prompt: routed to the holder, FULL engine hit; first
+    # hit pays the state-splice compile, judge TTFT on the second
+    router.serve([(warm, 4)])
+    hit = router.serve([(warm, 4)])[0]
+    assert router._assigned[hit.uid] == holder
+    assert router.prefix_routed >= 2
+    holder_engine = router._by_rid[holder].engine
+    assert holder_engine.metrics.prefix_hits == 2
+    assert list(hit.tokens) == list(first.tokens)
+    # warm vs cold on the SAME engine (same compiled programs)
+    cold_prompt = rng.integers(0, model.vocab_size, (16,)).tolist()
+    cold = router.serve([(cold_prompt, 4)])[0]
+    warm_ttft = hit.first_token_time - hit.submit_time
+    cold_ttft = cold.first_token_time - cold.submit_time
+    assert warm_ttft < cold_ttft, (
+        f"prefix-routed TTFT {warm_ttft:.4f}s not under the cold "
+        f"miss {cold_ttft:.4f}s")
+
+
+# ------------------------------------- prefill/decode disaggregation
+
+def test_disaggregated_matches_monolithic(served, baseline):
+    """Prefill replica -> host PageTransfer -> decode replica splice
+    at decode-chosen write_ids: continuations token-exact vs the
+    monolithic baseline (dense pools, whole-prompt prefill)."""
+    model, params, prompts = served
+    router = Router([
+        ServingReplica("pf", _engine(model, params), role="prefill"),
+        ServingReplica("dc", _engine(model, params), role="decode")])
+    out = router.serve([(p, 6) for p in prompts])
+    for i, request in enumerate(out):
+        assert request.state == "done"
+        assert list(request.tokens) == baseline[i], f"stream {i}"
+    assert router.transfers_routed == len(prompts)
+    assert router.transfer_bytes > 0
+    # the prefill replica never decoded; the decode replica never
+    # prefilled a prompt of its own
+    pf = router._by_rid["pf"].engine
+    dc = router._by_rid["dc"].engine
+    assert pf.metrics.decode_tokens == 0
+    assert dc.prefill_compiles == 0
+
+
+@pytest.mark.slow
+def test_disaggregated_paged_chunked_matches(served, baseline):
+    """The same pin through the chunked-prefill path into a PAGED
+    decode replica: chunk programs on the prefill side, page-block
+    splice at decode-chosen write_ids on the other."""
+    model, params, prompts = served
+    router = Router([
+        ServingReplica("pf", _engine(model, params, prefill_chunk=4),
+                       role="prefill"),
+        ServingReplica("dc", _engine(model, params, kv_layout="paged",
+                                     page_size=8), role="decode")])
+    out = router.serve([(p, 6) for p in prompts])
+    for i, request in enumerate(out):
+        assert request.state == "done"
+        assert list(request.tokens) == baseline[i], f"stream {i}"
+    pf = router._by_rid["pf"].engine
+    assert pf.chunk_prefill_compiles >= 1  # really took the chunk path
+
+
+def test_admit_prefilled_backpressure(served):
+    """A decode replica with no free slot refuses the transfer with
+    QueueFull (the router holds it); page pressure on a paged pool
+    refuses the same way and counts a page hold."""
+    model, params, prompts = served
+    engine = _engine(model, params, max_slots=1)
+    donor = _engine(model, params)
+    req_a = Request(prompts[1], 4, uid="a")
+    req_b = Request(prompts[3], 4, uid="b")
+    tok0, k, v = donor.prefill_detached(req_a)
+    engine.admit_prefilled(req_a, tok0, np.asarray(k), np.asarray(v))
+    tok0b, kb, vb = donor.prefill_detached(req_b)
+    with pytest.raises(QueueFull, match="free slot"):
+        engine.admit_prefilled(req_b, tok0b, np.asarray(kb),
+                               np.asarray(vb))
+    # paged pool too small for the transfer -> page-pressure hold
+    paged = _engine(model, params, kv_layout="paged", page_size=8,
+                    num_pages=4, max_slots=2)
+    big = Request([1] * 20, 8, uid="big")
+    with pytest.raises(ValueError, match="page"):
+        paged.admit_prefilled(big, 0, np.asarray(k), np.asarray(v))
+
+
+# --------------------------------------------- failure + redelivery
+
+def test_replica_death_redelivers_token_exact(served, baseline):
+    """Kill one replica mid-stream (injected engine-fatal at the
+    existing dispatch site): the dead replica's journal redelivers to
+    the peer under ORIGINAL uids, every stream completes byte-exact,
+    and the merged metrics dedup the replayed prefix."""
+    model, params, prompts = served
+    tmp = pytest.importorskip("tempfile").mkdtemp()
+
+    def mkrep(i):
+        journal = heal.RequestJournal(
+            os.path.join(tmp, f"wal{i}.jsonl"))
+        engine = _engine(model, params, journal=journal,
+                         dispatch_retries=1)
+        return ServingReplica(f"r{i}", engine, journal=journal)
+
+    router = Router([mkrep(0), mkrep(1)])
+    for i, p in enumerate(prompts):
+        router.submit(p, 6, uid=f"u{i}")
+    for _ in range(3):
+        router.step()  # partial progress into both WALs
+    plan = faults.FaultPlan(seed=1, rules=[faults.FaultRule(
+        "serving.decode_dispatch", "fatal", times=1)])
+    faults.arm(plan)
+    try:
+        while router.in_flight:
+            router.step()
+    finally:
+        faults.disarm()
+    assert sum(r.reaped for r in router.replicas) == 1
+    assert router.requests_redelivered >= 1
+    recs = router.records()
+    for i in range(len(prompts)):
+        request = recs[f"u{i}"]
+        assert request.state == "done"
+        assert list(request.tokens) == baseline[i], f"stream u{i}"
+    merged = router.merged_metrics()
+    unique = sum(len(t) for t in baseline.values())
+    assert merged["tokens_generated"] == unique, (
+        "fleet tokens_generated must dedup the redelivered prefix")
+    assert merged["redelivery_replayed_tokens"] > 0
+    # healthz: survivor READY, dead replica DEAD — by NAME
+    hz = router.healthz()
+    assert hz["state_name"] == "READY"
+    dead_rid = next(r.rid for r in router.replicas if r.reaped)
+    assert hz["replicas"][dead_rid]["state_name"] == "DEAD"
+
+
+def test_whole_fleet_death_is_named(served):
+    """Every decode replica dead -> FleetDead (a GraftFaultError: the
+    supervisor's restart budget consumes it)."""
+    model, params, prompts = served
+    router = Router([ServingReplica(
+        "solo", _engine(model, params, dispatch_retries=1))])
+    router.submit(prompts[0], 6)
+    plan = faults.FaultPlan(seed=1, rules=[faults.FaultRule(
+        "serving.decode_dispatch", "fatal", times=1)])
+    faults.arm(plan)
+    try:
+        with pytest.raises(FleetDead):
+            for _ in range(64):
+                router.step()
+    finally:
+        faults.disarm()
+
+
+def test_draining_replica_refuses_but_finishes(served, baseline):
+    """DRAINING: the replica takes no NEW work (router routes around
+    it) but its in-flight requests complete; the fleet healthz stays
+    READY while a peer still admits."""
+    model, params, prompts = served
+    r0 = ServingReplica("r0", _engine(model, params))
+    r1 = ServingReplica("r1", _engine(model, params))
+    router = Router([r0, r1])
+    first = router.submit(prompts[0], 6)
+    first_rid = router._assigned[first.uid]
+    draining = router._by_rid[first_rid]
+    other = r1 if draining is r0 else r0
+    draining.engine.begin_drain("test")
+    assert router.healthz()["state_name"] == "READY"
+    assert router.healthz()["replicas"][first_rid]["state_name"] == \
+        "DRAINING"
+    # new work all lands on the OTHER replica
+    later = [router.submit(p, 6) for p in prompts[1:4]]
+    for request in later:
+        assert router._assigned[request.uid] == other.rid
+    while router.in_flight:
+        router.step()
+    assert first.state == "done"
+    assert list(first.tokens) == baseline[0]
+    for i, request in enumerate(later, start=1):
+        assert list(request.tokens) == baseline[i]
+
+
+@pytest.mark.slow
+def test_fleet_drain_and_supervised_recover(served, baseline):
+    """Router.drain lands every replica DEAD with compacted journals;
+    a FRESH fleet over the same WAL paths redelivers the unfinished
+    requests token-exact (Router.recover — the supervised-restart
+    shape)."""
+    model, params, prompts = served
+    tmp = pytest.importorskip("tempfile").mkdtemp()
+
+    def mkfleet():
+        reps = []
+        for i in range(2):
+            journal = heal.RequestJournal(
+                os.path.join(tmp, f"wal{i}.jsonl"))
+            reps.append(ServingReplica(
+                f"r{i}", _engine(model, params, journal=journal),
+                journal=journal))
+        return Router(reps)
+
+    router = mkfleet()
+    for i, p in enumerate(prompts):
+        router.submit(p, 6, uid=f"u{i}")
+    for _ in range(3):
+        router.step()
+    prefix = {uid: list(r.tokens)
+              for uid, r in router.records().items()}
+    del router  # abandoned mid-run: the crash shape (WALs not closed)
+
+    fresh = mkfleet()
+    recovered = fresh.recover()
+    assert recovered  # something was mid-flight
+    while fresh.in_flight:
+        fresh.step()
+    recs = fresh.records()
+    for i in range(len(prompts)):
+        request = recs[f"u{i}"]
+        assert request.state == "done"
+        assert list(request.tokens) == baseline[i]
+        assert list(request.tokens)[:len(prefix[f"u{i}"])] == \
+            prefix[f"u{i}"]
+    events = fresh.drain(None)
+    assert fresh.healthz()["state_name"] == "DEAD"
+    # cleanly drained: both WALs compact to empty
+    for i in range(2):
+        path = os.path.join(tmp, f"wal{i}.jsonl")
+        assert os.path.getsize(path) == 0
+
+
+def test_unbounded_drain_terminates_with_held_work(served):
+    """Regression: ``drain(None)`` must TERMINATE when the router
+    still holds unplaced work — DRAINING replicas never admit, so the
+    held request can never place and the old ``while in_flight`` loop
+    spun forever. The held request is failed named instead."""
+    model, params, prompts = served
+    r0 = ServingReplica("r0", _engine(model, params), window_max=1)
+    router = Router([r0])
+    placed = router.submit(prompts[0], 4)
+    held = router.submit(prompts[1], 4)  # window full -> router-held
+    assert len(router._pending) == 1
+    events = router.drain(None)
+    assert placed.state == "done" and len(placed.tokens) > 0
+    assert held.state == "failed"
+    assert held.finish_reason == "drain"
+    assert router.healthz()["state_name"] == "DEAD"
+    assert events  # the placed request's tokens streamed out
+
+
+def test_reap_skips_router_held_uids(served, baseline):
+    """Regression: a journal-less replica death must NOT redeliver
+    uids the router still holds (pending after a failed re-route, or
+    riding a PageTransfer) — those deliver through the held path;
+    redelivering too would run one uid twice and double-count."""
+    model, params, prompts = served
+    pf = ServingReplica("pf", _engine(model, params), role="prefill")
+    dc = ServingReplica("dc", _engine(model, params), role="decode")
+    router = Router([pf, dc])
+    reqs = [router.submit(p, 6, uid=f"u{i}")
+            for i, p in enumerate(prompts[:3])]
+    # decode side refuses everything: the first prefill's transfer
+    # stays queued at the router
+    dc.window = 0
+    router.step()
+    assert len(router._transfers) == 1
+    # the prefill replica dies journal-less: its intake re-routes but
+    # cannot place (decode window closed) -> router-held
+    pf.engine.health.to_dead("test")
+    dc.window = 0  # poll_pressure crept it back up over the step
+    router.step()
+    assert len(router._pending) == 2
+    # NOTHING was redelivered — every uid is alive on a held path
+    assert router.requests_redelivered == 0
+    dc.window = dc.window_max
+    while router.in_flight:
+        router.step()
+    merged = router.merged_metrics()
+    assert merged["requests_completed"] == 3
+    assert merged["tokens_generated"] == sum(
+        len(baseline[i]) for i in range(3))
+    for i, request in enumerate(reqs):
+        record = router.records()[request.uid]
+        assert record.state == "done"
+        assert list(record.tokens) == baseline[i], f"stream {i}"
+
+
+def test_split_mode_backpressure_bounds_intake(served):
+    """Regression: disaggregated placement honors backpressure — the
+    prefill intake is bounded by the replica's admission window and a
+    full transfer backlog holds new work at the router (so
+    ``max_pending``/``FleetSaturated`` engage in split mode too)."""
+    model, params, prompts = served
+    pf = ServingReplica("pf", _engine(model, params), role="prefill",
+                        window_max=2)
+    dc = ServingReplica("dc", _engine(model, params), role="decode")
+    router = Router([pf, dc], max_pending=1)
+    router.submit(prompts[0], 4)
+    router.submit(prompts[1], 4)
+    assert len(pf._prefill_queue) == 2
+    # intake window full -> the third holds at the router, and past
+    # max_pending the fleet sheds NAMED instead of stuffing prefill
+    router.submit(prompts[2], 4)
+    assert len(router._pending) == 1
+    with pytest.raises(FleetSaturated):
+        router.submit(prompts[3], 4)
+    # a saturated transfer backlog alone also gates intake
+    assert not router._transfer_backlog_full()
+    dc.window = 0  # no decode admission capacity -> backlog "full"
+    assert router._transfer_backlog_full()
+    dc.window = dc.window_max
+    for _ in router.run():
+        pass
+    assert all(r.state == "done" for r in router.records().values())
+
+
+def test_invalid_request_fails_named_not_fleet_crash(served):
+    """Regression: engine-level validation failures (vocab range)
+    surface as a submission ValueError when a replica admits
+    directly, and fail the request NAMED when it was router-held —
+    never crash Router.step or silently drop the request."""
+    model, params, prompts = served
+    r0 = ServingReplica("r0", _engine(model, params), window_max=1)
+    router = Router([r0])
+    bad_prompt = [model.vocab_size + 5, 1, 2]
+    # open window: the error belongs to the submitter
+    with pytest.raises(ValueError):
+        router.submit(bad_prompt, 4, uid="direct")
+    assert "direct" not in router.records()
+    # full window: the request holds, then fails named at placement
+    good = router.submit(prompts[0], 4, uid="good")
+    held = router.submit(bad_prompt, 4, uid="held")
+    assert len(router._pending) == 1
+    while router.in_flight:
+        router.step()
+    assert good.state == "done" and len(good.tokens) > 0
+    assert held.state == "failed"
+    assert isinstance(held.error, ValueError)
+    assert not r0.dead  # a bad REQUEST never kills the replica
+
+
+def test_splice_fatal_reaps_and_redelivers_once(served, baseline):
+    """Regression: a replica-fatal inside ``admit_prefilled`` (a
+    poisoned splice) must not escape ``Router.step`` — the replica is
+    reaped, the transfer requeues, and a peer serves the request
+    EXACTLY once (the reap's held-uid rule skips the requeued
+    transfer's uid)."""
+    model, params, prompts = served
+    pf = ServingReplica("pf", _engine(model, params), role="prefill")
+    d1 = ServingReplica("d1", _engine(model, params), role="decode")
+    d2 = ServingReplica("d2", _engine(model, params), role="decode")
+    router = Router([pf, d1, d2])
+
+    def boom(*a, **kw):
+        raise RuntimeError("poisoned splice")
+
+    d1.engine.admit_prefilled = boom
+    request = router.submit(prompts[0], 6, uid="u0")
+    while router.in_flight:
+        router.step()
+    assert d1.reaped and d1.dead
+    assert not d2.dead
+    assert router.requests_redelivered == 0  # held path, not reap
+    record = router.records()[request.uid]
+    assert record.state == "done"
+    assert list(record.tokens) == baseline[0]
+    merged = router.merged_metrics()
+    assert merged["requests_completed"] == 1
+    assert merged["tokens_generated"] == len(baseline[0])
+
+
+def test_recover_dedups_uid_across_wals(served, baseline, tmp_path):
+    """Regression: a crash inside the steal's handoff window leaves
+    one uid live in BOTH WALs (thief's admit fsync'd, victim's
+    handoff record not yet) — ``Router.recover`` must redeliver it
+    ONCE."""
+    model, params, prompts = served
+    paths = [str(tmp_path / f"wal{i}.jsonl") for i in range(2)]
+    request = Request(prompts[0], 6, None, "u0")
+    for path in paths:  # the uid admitted-unfinished in both WALs
+        journal = heal.RequestJournal(path)
+        journal.record_admit(request)
+        del journal  # crash shape: neither WAL closed/compacted
+
+    reps = []
+    for i, path in enumerate(paths):
+        journal = heal.RequestJournal(path)
+        reps.append(ServingReplica(
+            f"r{i}", _engine(model, params, journal=journal),
+            journal=journal))
+    router = Router(reps)
+    recovered = router.recover()
+    assert len(recovered) == 1  # not one per WAL
+    while router.in_flight:
+        router.step()
+    record = router.records()["u0"]
+    assert record.state == "done"
+    assert list(record.tokens) == baseline[0]
+    assert router.merged_metrics()["requests_completed"] == 1
+
+
+def test_publish_replica_concurrent_writers_lossless():
+    """Regression: the store roster is claimed through atomic
+    ``add`` slots — concurrent publishers (the remote rendezvous
+    seam) never lose each other to a read-modify-write race."""
+    import threading
+
+    store = MemStore()
+    rids = [f"r{i}" for i in range(8)]
+    barrier = threading.Barrier(len(rids))
+
+    def publish(rid):
+        barrier.wait()
+        assert graftfleet.publish_replica(store, rid, run_uid="race")
+
+    threads = [threading.Thread(target=publish, args=(r,))
+               for r in rids]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    directory = graftfleet.replica_directory(store, run_uid="race")
+    assert set(directory) == set(rids)
+    # idempotent re-publish: no duplicate roster slots accumulate
+    graftfleet.publish_replica(store, "r0", run_uid="race",
+                               state="ready")
+    directory = graftfleet.replica_directory(store, run_uid="race")
+    assert set(directory) == set(rids)
+    assert directory["r0"]["state"] == "ready"
+
+
+# ------------------------------------------------ surfaces + smoke
+
+def test_healthz_body_carries_state_name():
+    """Satellite pin: the /healthz BODY names the state (the router
+    distinguishes DRAINING from DEAD without guessing off 503)."""
+    health = heal.HealthState()
+    health.to_ready()
+    assert heal.healthz(health)["state_name"] == "READY"
+    health.to_draining("sigterm")
+    payload = heal.healthz(health)
+    assert payload["state"] == "draining"
+    assert payload["state_name"] == "DRAINING"
+    health.to_dead("gone")
+    assert heal.healthz(health)["state_name"] == "DEAD"
+    assert heal.healthz(None)["state_name"] == "READY"
+
+
+def test_replica_directory_over_store(served):
+    """The store-published replica directory: publish_replica /
+    replica_directory round-trip, and the router keeps states fresh
+    through death and drain."""
+    model, params, prompts = served
+    store = MemStore()
+    r0 = ServingReplica("r0", _engine(model, params),
+                        address="127.0.0.1:9100")
+    router = Router([r0], store=store, run_uid="t")
+    directory = graftfleet.replica_directory(store, run_uid="t")
+    assert directory["r0"]["role"] == "both"
+    assert directory["r0"]["address"] == "127.0.0.1:9100"
+    router.serve([(prompts[0], 4)])
+    router.begin_drain("test")
+    directory = graftfleet.replica_directory(store, run_uid="t")
+    assert directory["r0"]["state"] == "draining"
+
+
+def test_fleet_serving_report_names_straggler():
+    """Per-replica goodput aggregation names the slowest replica."""
+    report = graftfleet.fleet_serving_report({
+        "r0": {"state": "ready", "goodput_frac": 0.9},
+        "r1": {"state": "ready", "goodput_frac": 0.4},
+    })
+    assert report["straggler"] == "r1"
+    assert report["goodput_frac_min"] == pytest.approx(0.4)
+    assert report["replicas_alive"] == 2
+
+
+def test_merged_metrics_scrape_safe(served):
+    """The merged snapshot survives the Prometheus projection (nested
+    per_replica dicts skipped, numerics exposed) — the --router_port
+    contract."""
+    from pytorch_multiprocessing_distributed_tpu.runtime.scope import (
+        prometheus_text)
+
+    model, params, prompts = served
+    router = Router([ServingReplica("r0", _engine(model, params))])
+    router.serve([(prompts[0], 4)])
+    text = prometheus_text(router.merged_metrics(), "pmdt_fleet")
+    assert "pmdt_fleet_tokens_generated" in text
+    assert "per_replica" not in text
+    payload = json.dumps(router.merged_metrics())
+    assert "goodput_frac" in payload
+
+
+def test_route_smoke_end_to_end():
+    """`make route` mirrored in tier-1: the full smoke body (2 paged
+    replicas over MemStore, injected death -> redelivery, warm prefix
+    routed + TTFT ratio, directory published)."""
+    import benchmarks.route_smoke as smoke
+
+    out = smoke.run_smoke(verbose=False)
+    assert out["redelivered"] >= 1
+    assert out["merged_tokens"] > 0
+    assert out["prefix_routed"] >= 2
+    # warm full-hit TTFT under the same engine's cold miss; generous
+    # bound — the noisy-box discipline (the smoke records the exact
+    # ratio, the pin only guards the direction)
+    assert out["ttft_ratio_warm_over_cold"] is not None
+    assert out["ttft_ratio_warm_over_cold"] < 1.0

@@ -61,12 +61,18 @@ def _spec(model, params, **kw):
 
 # --------------------------------------------------------- equivalence
 
+@pytest.mark.slow
 def test_spec_paged_chunked_horizon_eos(served):
     """THE slim matrix pin: speculative decode over the paged engine
     with chunked admission, H=4 horizons, a bucket ladder crossed
     mid-stream, and a mid-horizon EOS — byte-identical to generate(),
     all pages returned, and re-serving makes zero fresh spec
-    programs."""
+    programs.
+
+    Slow-marked (PR 14 tier-1 rebalance for the graftroute suite):
+    the heaviest spec-matrix variant — the dense spec pins and the
+    paged non-spec pins stay fast-marked; the full cross stays in
+    `make test`."""
     model, params, prompts = served
     engine = _spec(model, params, max_slots=3, kv_layout="paged",
                    page_size=8, prefill_chunk=5, decode_horizon=4,

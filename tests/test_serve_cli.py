@@ -74,3 +74,38 @@ def test_cli_serves_trained_checkpoint(tmp_path):
                  "--synthetic", "3", "--max_slots", "2",
                  "--max_new_tokens", "4")
     assert out.count("done(length)") == 3
+
+
+@pytest.mark.slow
+def test_cli_fleet_replicas_split(tmp_path):
+    """graftroute CLI: --replicas 2 --role split serves the source
+    through a prefill replica handing KV blocks to a decode replica;
+    merged metrics carry the fleet counters and per-replica goodput."""
+    metrics_path = tmp_path / "metrics.json"
+    _serve(tmp_path, "--random_init", "--synthetic", "5",
+           "--max_slots", "2", "--max_new_tokens", "6",
+           "--replicas", "2", "--role", "split",
+           "--metrics_out", str(metrics_path), "--quiet")
+    snap = json.loads(metrics_path.read_text())
+    assert snap["requests_completed"] == 5
+    assert snap["fleet_replicas"] == 2
+    assert snap["fleet_transfers_routed"] == 5
+    assert snap["fleet_state"] == "DEAD"  # cleanly drained
+    per = snap["per_replica"]
+    assert per["r0"]["role"] == "prefill"
+    assert per["r1"]["role"] == "decode"
+    assert per["r0"]["transfers_out"] == 5
+    assert snap["straggler"] in ("r0", "r1")
+
+
+@pytest.mark.slow
+def test_cli_fleet_roles_validated(tmp_path):
+    """A prefill-only fleet is rejected loudly before any compile."""
+    env = dict(os.environ, PMDT_FORCE_CPU_DEVICES="8")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "serve_lm.py"),
+         "--model", "gpt_tiny", "--random_init",
+         "--replicas", "2", "--role", "prefill,prefill"],
+        env=env, capture_output=True, text=True, timeout=120, cwd=REPO)
+    assert proc.returncode != 0
+    assert "decode-capable" in proc.stderr

@@ -84,10 +84,16 @@ def test_paged_matches_dense_and_generate(served):
     assert paged.pool.pages_in_use == 0
 
 
+@pytest.mark.slow
 def test_paged_chunked_horizon_eos(served):
     """Chunked admission + fused H=4 horizons + an EOS that fires
     mid-horizon: token-exact with generate(), device freeze respected
-    (no page writes past the frozen position corrupt anything)."""
+    (no page writes past the frozen position corrupt anything).
+
+    Slow-marked (PR 14 tier-1 rebalance for the graftroute suite):
+    the heaviest paged-matrix variant — its components (paged decode,
+    chunked admission, horizon+EOS freeze) each keep their own
+    fast-marked pins; the full cross stays in `make test`."""
     model, params, prompts = served
     ref = _ref_tail(model, params, prompts[1], 8)
     eos = int(ref[2])
