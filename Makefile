@@ -4,7 +4,7 @@
 PYTEST_ENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 
 .PHONY: test test-fast lint check check-update chaos soak scope meter \
-        fleet spec zero route dryrun bench bench-cpu store clean
+        fleet spec zero route wire dryrun bench bench-cpu store clean
 
 # graftlint: AST-only jit-hygiene gate (no jax import, milliseconds).
 # Exit 1 on any non-baselined finding; the tier-1 suite and
@@ -107,6 +107,17 @@ zero:
 # (test_route_smoke_end_to_end in tests/test_graftroute.py).
 route:
 	$(PYTEST_ENV) python benchmarks/route_smoke.py
+
+# graftwire: socket-transport smoke — a router in THIS process drives
+# 2 replica-server SUBPROCESSES over localhost: prefill->decode
+# PageTransfer as raw framed numpy (bytes metered, clean drain, both
+# children exit 0), then a SIGKILL -9 of the busiest replica process
+# mid-run -> its WAL redelivers to the peer under original uids,
+# every stream byte-identical to the in-process fleet, fleet token
+# count dedup-verified. Same body runs in tier-1 (slow-marked
+# test_wire_smoke_end_to_end in tests/test_graftwire.py).
+wire:
+	$(PYTEST_ENV) python benchmarks/wire_smoke.py
 
 # full suite on the virtual 8-device CPU mesh (incl. slow e2e CLI runs)
 test:

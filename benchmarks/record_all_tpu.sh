@@ -58,6 +58,13 @@ python benchmarks/serving_bench.py \
     > benchmarks/serving_bench_fleet_tpu.txt 2>&1
 tail -8 benchmarks/serving_bench_fleet_tpu.txt >&2
 
+note "serving bench (graftwire: socket fleet vs in-process + kill recovery)"
+python benchmarks/serving_bench.py \
+    --sweep wire \
+    --json_out benchmarks/serving_bench_wire_tpu.json \
+    > benchmarks/serving_bench_wire_tpu.txt 2>&1
+tail -8 benchmarks/serving_bench_wire_tpu.txt >&2
+
 note "serving bench (graftspec: accepted/target-step x k x draft source)"
 python benchmarks/serving_bench.py \
     --sweep spec --draft_model gpt_tiny \

@@ -96,6 +96,21 @@ Three sweeps over the continuous-batching :class:`ServingEngine`:
    **redelivery recovery TTFT** (death detection to the first
    redelivered token) wall-clocked.
 
+9. **Wire sweep** (``--sweep wire``, graftwire): the socket-transport
+   cost, measured against the in-process fleet it must be
+   semantically identical to. Point one: the SAME 2-replica fleet
+   served in-process and then over localhost sockets (thread-hosted
+   ``ReplicaServer``\\ s — real TCP, zero subprocess noise): tok/s
+   side by side with the **per-RPC overhead p50/p95** from the
+   client's own call clock, streams asserted BYTE-IDENTICAL. Point
+   two: prefill→decode disaggregation over the wire — the KV block
+   rides as raw framed numpy, **transfer bytes/request** recorded at
+   both layers (PageTransfer payload and the framed wire meter).
+   Point three: a socket-level replica kill mid-run (the SIGKILL
+   shape the smoke does to a real process) — WAL redelivery to the
+   peer, **kill→recovery TTFT** wall-clocked, streams exact, fleet
+   metrics dedup-verified.
+
 ``offered=inf`` is the closed-loop limit: every request submitted
 up front, measuring peak engine throughput. CPU-runnable (shapes clamp
 down off-TPU, same convention as ``generate_bench.py``), TPU-ready.
@@ -994,6 +1009,203 @@ def run_fleet_sweep(model, params, args, rng):
     return results
 
 
+def run_wire_sweep(model, params, args, rng):
+    """graftwire (sweep 9): the socket transport vs the in-process
+    seam it mirrors — (1) same fleet, two transports: tok/s side by
+    side, streams byte-identical, per-RPC overhead p50/p95; (2)
+    disaggregation over the wire: PageTransfer bytes/request at the
+    payload and framing layers; (3) socket-level kill -> WAL
+    redelivery with the recovery TTFT on the clock."""
+    import tempfile
+
+    from pytorch_multiprocessing_distributed_tpu.runtime import (
+        heal, wire)
+    from pytorch_multiprocessing_distributed_tpu.serving import (
+        RemoteReplica, ReplicaServer, Router, ServingEngine,
+        ServingReplica)
+
+    new_tokens = max(4, min(args.new_tokens, 16))
+    prompt_hi = max(2, min(args.prompt_max,
+                           model.max_seq_len - new_tokens) - 1)
+    s_max = min(model.max_seq_len, prompt_hi + new_tokens)
+    slots = int(args.slots.split(",")[0])
+    n_req = max(2 * slots + 2, min(args.requests, 12))
+    prompts = [rng.integers(0, model.vocab_size, (int(rng.integers(
+        max(1, prompt_hi // 2), prompt_hi + 1)),)).tolist()
+        for _ in range(n_req)]
+
+    def mk(journal=None, dispatch_retries=3):
+        return ServingEngine(model, params, max_slots=slots,
+                             s_max=s_max, decode_buckets=(),
+                             retry_backoff_s=0.0, journal=journal,
+                             dispatch_retries=dispatch_retries)
+
+    def socket_fleet(journals=None, roles=("both", "both")):
+        servers = []
+        for i, role in enumerate(roles):
+            journal = journals[i] if journals else None
+            servers.append(ReplicaServer(
+                mk(journal, dispatch_retries=1 if journals else 3),
+                rid=f"r{i}", role=role).start())
+        replicas = [RemoteReplica(s.address, backoff_s=0.0)
+                    for s in servers]
+        return Router(replicas), servers, replicas
+
+    def rpc_stats(replicas):
+        samples = [s for r in replicas for s in r._client.rpc_s]
+        if not samples:
+            return {"rpcs": 0}
+        return {"rpcs": len(samples),
+                "rpc_p50_ms": _percentile(samples, 50) * 1e3,
+                "rpc_p95_ms": _percentile(samples, 95) * 1e3}
+
+    results = []
+
+    # ---- point 1: one fleet, two transports (byte-identical)
+    router = Router([ServingReplica("r0", mk()),
+                     ServingReplica("r1", mk())])
+    # full warm pass off the clock (every prefill bucket + decode
+    # program compiled) so the timed runs compare TRANSPORT, not
+    # compile order — the socket fleet gets the identical warmup
+    router.serve([(p, new_tokens) for p in prompts])
+    t0 = time.perf_counter()
+    ref = router.serve([(p, new_tokens) for p in prompts])
+    inproc_s = time.perf_counter() - t0
+    ref_tokens = {i: list(r.tokens) for i, r in enumerate(ref)}
+    total_tokens = sum(len(t) for t in ref_tokens.values())
+
+    router, servers, replicas = socket_fleet()
+    try:
+        router.serve([(p, new_tokens) for p in prompts])  # same warmup
+        for replica in replicas:
+            replica._client.rpc_s.clear()
+        t0 = time.perf_counter()
+        out = router.serve([(p, new_tokens) for p in prompts])
+        socket_s = time.perf_counter() - t0
+        for i, r in enumerate(out):
+            assert r.state == "done" and \
+                list(r.tokens) == ref_tokens[i], (
+                    f"socket-fleet stream {i} diverged from the "
+                    "in-process fleet")
+        point = {
+            "mode": "wire_fleet", "replicas": 2, "slots": slots,
+            "requests": n_req,
+            "inproc_tokens_per_sec": total_tokens / inproc_s,
+            "tokens_per_sec": total_tokens / socket_s,
+            "wire_overhead_frac": socket_s / inproc_s - 1.0,
+            "byte_identical": True,
+        }
+        point.update(rpc_stats(replicas))
+        print(f"wire     2 replicas  {point['tokens_per_sec']:9.1f} "
+              f"tok/s (in-process: "
+              f"{point['inproc_tokens_per_sec']:9.1f})  "
+              f"overhead={point['wire_overhead_frac'] * 100:5.1f}%  "
+              f"rpc p50={point.get('rpc_p50_ms', 0):6.2f} ms "
+              f"p95={point.get('rpc_p95_ms', 0):6.2f} ms "
+              f"({point['rpcs']} rpcs)", flush=True)
+        results.append(point)
+    finally:
+        for server in servers:
+            server.stop()
+
+    # ---- point 2: disaggregation over the wire (PageTransfer bytes)
+    meter0 = wire.wire_meter()["wire_bytes_sent"]
+    router, servers, replicas = socket_fleet(
+        roles=("prefill", "decode"))
+    try:
+        router.serve([(prompts[0], 2)])
+        t0 = time.perf_counter()
+        out = router.serve([(p, new_tokens) for p in prompts])
+        disagg_s = time.perf_counter() - t0
+        for i, r in enumerate(out):
+            assert r.state == "done" and \
+                list(r.tokens) == ref_tokens[i], (
+                    f"wire-disagg stream {i} diverged from the "
+                    "in-process fleet")
+        wire_sent = wire.wire_meter()["wire_bytes_sent"] - meter0
+        point = {
+            "mode": "wire_disagg", "slots": slots, "requests": n_req,
+            "tokens_per_sec": total_tokens / disagg_s,
+            "transfers": router.transfers_routed,
+            "transfer_bytes": router.transfer_bytes,
+            "transfer_bytes_per_request":
+                router.transfer_bytes // max(1,
+                                             router.transfers_routed),
+            "wire_bytes_sent": wire_sent,
+            "token_exact": True,
+        }
+        assert wire_sent >= router.transfer_bytes
+        print(f"wire     prefill->decode  "
+              f"{point['tokens_per_sec']:9.1f} tok/s  "
+              f"{point['transfer_bytes_per_request']} KV B/req over "
+              f"{router.transfers_routed} transfers (token-exact)",
+              flush=True)
+        results.append(point)
+    finally:
+        for server in servers:
+            server.stop()
+
+    # ---- point 3: kill -> WAL redelivery, recovery TTFT
+    tmpdir = tempfile.mkdtemp(prefix="pmdt_wire_bench_")
+    journals = [heal.RequestJournal(
+        os.path.join(tmpdir, f"wal{i}.jsonl")) for i in range(2)]
+    router, servers, replicas = socket_fleet(journals=journals)
+    t_death = None
+    t_recover = None
+    try:
+        for i, p in enumerate(prompts):
+            router.submit(p, new_tokens, uid=f"u{i}")
+        for _ in range(3):
+            router.step()  # tokens into both WALs before the kill
+        victim = max(replicas, key=lambda r: r.in_flight)
+        servers[replicas.index(victim)].kill()
+        while router.in_flight:
+            before = router.requests_redelivered
+            t_pre = time.perf_counter()
+            events = router.step()
+            if (router.requests_redelivered > before
+                    and t_death is None):
+                # reap + WAL read + replay happen inside this one
+                # step: clock recovery from the step's start
+                t_death = t_pre
+            if t_death is not None and t_recover is None:
+                redelivered = set(router.redelivered_uids)
+                for request, _tok, _done in events:
+                    if request.uid in redelivered:
+                        t_recover = time.perf_counter()
+                        break
+        recs = router.records()
+        for i in range(n_req):
+            r = recs[f"u{i}"]
+            assert r.state == "done" and \
+                list(r.tokens) == ref_tokens[i], (
+                    f"post-kill stream u{i} diverged")
+        merged = router.merged_metrics()
+        assert merged["tokens_generated"] == total_tokens, (
+            "redelivery dedup broke the fleet token count")
+        point = {
+            "mode": "wire_kill", "slots": slots, "requests": n_req,
+            "redelivered": router.requests_redelivered,
+            "replayed_tokens": router.redelivery_replayed_tokens,
+            "recovery_ttft_s": (t_recover - t_death
+                                if t_recover and t_death else None),
+            "token_exact": True,
+        }
+        rec_s = point["recovery_ttft_s"]
+        print(f"wire     kill dead=1  "
+              f"redelivered={point['redelivered']}  recovery_ttft="
+              f"{rec_s if rec_s is None else round(rec_s, 4)} s",
+              flush=True)
+        results.append(point)
+    finally:
+        for server in servers:
+            server.stop()
+        import shutil
+
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    return results
+
+
 def main():
     _common.apply_platform_env()
     p = argparse.ArgumentParser()
@@ -1009,8 +1221,8 @@ def main():
                         "submitted up front)")
     p.add_argument("--sweep", default="load,length,horizon", type=str,
                    help="which sweeps to run: load, length, horizon, "
-                        "chaos, drain, paged, spec, fleet, or any "
-                        "comma list")
+                        "chaos, drain, paged, spec, fleet, wire, or "
+                        "any comma list")
     p.add_argument("--chaos_every", default=5, type=int,
                    help="chaos sweep: inject one transient fault every "
                         "K-th dispatch ATTEMPT, K >= 2 (realized "
@@ -1079,7 +1291,8 @@ def main():
               "requests": args.requests, "new_tokens": args.new_tokens,
               "s_max": s_max, "load_sweep": [], "length_sweep": [],
               "horizon_sweep": [], "chaos_sweep": [], "drain_sweep": [],
-              "paged_sweep": [], "spec_sweep": [], "fleet_sweep": []}
+              "paged_sweep": [], "spec_sweep": [], "fleet_sweep": [],
+              "wire_sweep": []}
     sweeps = args.sweep.split(",")
 
     if "load" in sweeps:
@@ -1131,6 +1344,10 @@ def main():
     if "fleet" in sweeps:
         record["fleet_sweep"] = run_fleet_sweep(model, params, args,
                                                 rng)
+
+    if "wire" in sweeps:
+        record["wire_sweep"] = run_wire_sweep(model, params, args,
+                                              rng)
 
     if args.json_out:
         with open(args.json_out, "w") as f:

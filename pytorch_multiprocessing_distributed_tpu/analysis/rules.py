@@ -117,6 +117,17 @@ RULES: Dict[str, str] = {
              "trace time, which only explodes when the branch is "
              "finally traced; keep acceptance/freeze logic as array "
              "masking — jnp.where/lax.select/lax.cond)",
+    "GL117": "blocking socket op with no timeout/deadline in scope "
+             "(.recv/.recv_into/.recvfrom/.accept/.makefile, a "
+             "sock.connect, or socket.create_connection without a "
+             "timeout, in a scope — function, class, or module top "
+             "level — with no settimeout/setdefaulttimeout/"
+             "create_connection(timeout=)/run_with_timeout/"
+             "*ensure_timeout establishing a bound): the "
+             "distributed-hang class — a silent peer parks the "
+             "process forever, with no named error and no timeline "
+             "(graftwire's sockets are all deadline-bounded; keep it "
+             "that way)",
 }
 
 # wrappers that COMPILE (jit family) — GL105/106/107/108 anchor on these
@@ -1308,6 +1319,119 @@ def _check_signal_discard(file: _File, out: List[Finding]):
             "trainer._install_preemption_handler)"))
 
 
+_BLOCKING_SOCKET_ATTRS = {"recv", "recv_into", "recvfrom", "accept",
+                          "makefile"}
+_TIMEOUT_SETTERS = {"settimeout", "setdefaulttimeout"}
+
+
+def _check_blocking_socket(file: _File, out: List[Finding]):
+    """GL117 — blocking socket operations with no timeout/deadline
+    IN SCOPE: the distributed-hang class graftwire must never
+    reintroduce. A ``.recv``/``.recv_into``/``.recvfrom``/
+    ``.accept``/``.makefile`` call (any receiver — pipes and socket
+    wrappers block the same way), a ``*sock*.connect(...)``, or a
+    ``socket.create_connection`` WITHOUT a timeout argument is flagged
+    unless deadline evidence exists in the call's scope chain:
+
+    - the enclosing function (any enclosing def) contains a
+      ``settimeout``/``setdefaulttimeout`` call, a
+      ``create_connection(..., timeout)`` or a ``run_with_timeout``/
+      ``*ensure_timeout`` call (the repo's canonical guard helper);
+    - or the enclosing CLASS does, anywhere in its body — the
+      configure-in-``__init__``, read-in-a-method shape;
+    - or the module's top level does.
+
+    Evidence in an UNRELATED sibling function does not count: a
+    timeout someone set on a different socket in a different scope is
+    exactly the false comfort that leaves the accept loop unbounded.
+    """
+    evidence_fns: Set[int] = set()
+    evidence_cls: Set[int] = set()
+    module_evidence = [False]
+    # (call node, enclosing-fn id chain, enclosing-class id, label)
+    blocking: List[Tuple[ast.Call, Tuple[int, ...], Optional[int],
+                         str]] = []
+
+    def _has_timeout_arg(call: ast.Call) -> bool:
+        # timeout=None is an EXPLICIT request for an unbounded
+        # blocking connect — the exact hang this rule targets — so
+        # only a non-None timeout counts as a deadline
+        for kw in call.keywords:
+            if kw.arg == "timeout":
+                return not (isinstance(kw.value, ast.Constant)
+                            and kw.value.value is None)
+        if len(call.args) >= 2:  # create_connection(addr, timeout)
+            arg = call.args[1]
+            return not (isinstance(arg, ast.Constant)
+                        and arg.value is None)
+        return False
+
+    def _recv_name(expr: ast.AST) -> str:
+        if isinstance(expr, ast.Name):
+            return expr.id
+        if isinstance(expr, ast.Attribute):
+            return expr.attr
+        return ""
+
+    def _classify(call: ast.Call, fns: Tuple[int, ...],
+                  cls: Optional[int]) -> None:
+        func = call.func
+        attr = func.attr if isinstance(func, ast.Attribute) else None
+        d = _dotted(func, file) or ""
+        last = d.split(".")[-1] if d else (
+            func.id if isinstance(func, ast.Name) else (attr or ""))
+        evidence = (attr in _TIMEOUT_SETTERS
+                    or last in _TIMEOUT_SETTERS
+                    or last == "run_with_timeout"
+                    or last.endswith("ensure_timeout"))
+        if last == "create_connection":
+            if _has_timeout_arg(call):
+                evidence = True
+            else:
+                blocking.append((call, fns, cls,
+                                 "socket.create_connection without a "
+                                 "timeout argument"))
+        if evidence:
+            evidence_fns.update(fns)
+            if cls is not None:
+                evidence_cls.add(cls)
+            if not fns and cls is None:
+                module_evidence[0] = True
+            return
+        if attr in _BLOCKING_SOCKET_ATTRS:
+            blocking.append((call, fns, cls, f".{attr}()"))
+        elif (attr == "connect"
+              and "sock" in _recv_name(func.value).lower()):
+            blocking.append((call, fns, cls, ".connect() on a socket"))
+
+    def _visit(node: ast.AST, fns: Tuple[int, ...],
+               cls: Optional[int]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fns = fns + (id(node),)
+        elif isinstance(node, ast.ClassDef):
+            cls = id(node)
+        if isinstance(node, ast.Call):
+            _classify(node, fns, cls)
+        for child in ast.iter_child_nodes(node):
+            _visit(child, fns, cls)
+
+    _visit(file.tree, (), None)
+    for call, fns, cls, label in blocking:
+        if any(f in evidence_fns for f in fns):
+            continue
+        if cls is not None and cls in evidence_cls:
+            continue
+        if module_evidence[0]:
+            continue
+        out.append(Finding(
+            file.path, call.lineno, call.col_offset, "GL117",
+            f"blocking socket op ({label}) with no timeout/deadline "
+            "in scope — a silent peer hangs this call forever with "
+            "no named error; settimeout/create_connection(timeout=)/"
+            "run_with_timeout bound it (the graftwire discipline: "
+            "every socket op has a deadline)"))
+
+
 def _check_jit_in_loop(file: _File, out: List[Finding]):
     """GL105: jax.jit(...) lexically inside a for/while body."""
     loops: List[ast.AST] = [n for n in ast.walk(file.tree)
@@ -1439,6 +1563,7 @@ def analyze_files(paths: Sequence[str],
         _check_swallowed_except(f, findings)
         _check_unpaired_trace(f, findings)
         _check_signal_discard(f, findings)
+        _check_blocking_socket(f, findings)
         _check_unsynced_timing(f, findings)
         for fn in f.funcs:
             if fn.jit_scoped:

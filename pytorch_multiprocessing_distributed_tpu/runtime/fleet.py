@@ -836,16 +836,25 @@ def goodput_gauges() -> Dict[str, float]:
 def publish_replica(store, rid: str, *, role: str = "both",
                     state: str = "starting",
                     address: Optional[str] = None,
-                    run_uid: str = "run", prefix: str = "fleet") -> bool:
+                    run_uid: str = "run", prefix: str = "fleet",
+                    now: Optional[float] = None) -> bool:
     """Publish one serving replica's identity to the control-plane
     store — ``fleet/<run_uid>/replica/<rid>`` -> ``{role, state,
-    address}`` — the discovery seam a REMOTE graftroute router
-    bootstraps from (the in-process router publishes here too, so one
-    deployment's directory looks the same either way). Best-effort by
-    the graftfleet contract: a store outage drops the record and
-    returns False — the run never dies for observability."""
+    address, published_at}`` — the discovery seam a REMOTE graftroute
+    router bootstraps from (the in-process router publishes here too,
+    so one deployment's directory looks the same either way).
+    ``published_at`` is a WALL-clock stamp (``time.time()`` —
+    cross-process comparable, unlike ``perf_counter``; ``now``
+    injectable for tests): each re-publish refreshes it, so a replica
+    that keeps publishing on state changes looks fresh and a crashed
+    publisher's entry AGES — :func:`replica_directory`'s ``ttl_s``
+    filter is what keeps a dead address from being served forever.
+    Best-effort by the graftfleet contract: a store outage drops the
+    record and returns False — the run never dies for observability."""
     payload = {"rid": str(rid), "role": str(role),
-               "state": str(state)}
+               "state": str(state),
+               "published_at": float(time.time() if now is None
+                                     else now)}
     if address is not None:
         payload["address"] = str(address)
     try:
@@ -888,15 +897,27 @@ def _roster_rids(store, base: str) -> List[str]:
 
 
 def replica_directory(store, *, run_uid: str = "run",
-                      prefix: str = "fleet") -> Dict[str, Dict]:
+                      prefix: str = "fleet",
+                      ttl_s: Optional[float] = None,
+                      now: Optional[float] = None) -> Dict[str, Dict]:
     """Read back the store-published replica directory:
-    ``{rid: {role, state, address?}}`` — what a remote router (or an
-    operator's one-liner) consumes to find the fleet."""
+    ``{rid: {role, state, address?, published_at?}}`` — what a remote
+    router (or an operator's one-liner) consumes to find the fleet.
+
+    ``ttl_s`` is the staleness filter: entries whose ``published_at``
+    stamp is older than ``ttl_s`` seconds are SKIPPED — a crashed
+    publisher stops refreshing its stamp, so its dead address ages out
+    of the roster instead of being served forever (the bug class this
+    closes: a remote router dialing a long-gone replica on every
+    bootstrap). Entries WITHOUT a stamp (pre-TTL publishers) are kept
+    — the filter never silently drops a roster a legacy writer
+    published. ``now`` is injectable for tests."""
     out: Dict[str, Dict] = {}
     try:
         roster = _roster_rids(store, _k(prefix, run_uid, "replicas"))
     except (OSError, ValueError):
         return out
+    t_now = time.time() if now is None else now
     for rid in roster:
         try:
             rec = store.get(_k(prefix, run_uid, "replica", rid))
@@ -905,9 +926,21 @@ def replica_directory(store, *, run_uid: str = "run",
         if not rec:
             continue
         try:
-            out[str(rid)] = json.loads(rec.decode())
+            payload = json.loads(rec.decode())
         except ValueError:
             continue
+        if ttl_s is not None:
+            stamp = payload.get("published_at")
+            try:
+                aged = (stamp is not None
+                        and t_now - float(stamp) > ttl_s)
+            except (TypeError, ValueError):
+                aged = False  # garbage stamp = un-stamped: kept, the
+                # same never-raise treatment every other malformed
+                # field in this best-effort read gets
+            if aged:
+                continue  # crashed publisher: the entry aged out
+        out[str(rid)] = payload
     return out
 
 

@@ -654,6 +654,65 @@ class JournalEntry:
         self.emitted = 0
 
 
+def _apply_journal_record(entries: Dict, order: List,
+                          obj: Dict) -> None:
+    """Fold one WAL record into the (entries, order) state — the ONE
+    copy of the replay semantics, shared by the live journal and the
+    read-only loader below."""
+    op = obj.get("op")
+    uid = obj.get("uid")
+    if op == "admit":
+        if uid not in entries:
+            entry = JournalEntry(uid, obj["prompt"],
+                                 obj["max_new_tokens"],
+                                 obj.get("eos_id"))
+            entries[uid] = entry
+            order.append(uid)
+    elif op == "tok":
+        entry = entries.get(uid)
+        if entry is not None:
+            entry.tokens.extend(int(t) for t in obj["tokens"])
+    elif op == "done":
+        entry = entries.get(uid)
+        if entry is not None:
+            entry.done = True
+            entry.state = obj.get("state")
+            entry.reason = obj.get("reason")
+
+
+def load_journal_entries(path: str) -> List[JournalEntry]:
+    """Read a WAL's entries WITHOUT opening it for append — the
+    graftwire reap path: a SIGKILLed replica-server process cannot
+    answer journal RPCs, but its WAL is durable on disk (one fsync'd
+    batch per step), so the router — which knows the path — loads the
+    entries read-only and redelivers the unfinished ones to peers.
+    Torn trailing lines (the crash window of an append) are tolerated
+    and skipped exactly like the live journal's replay; a missing or
+    unreadable file is an empty journal (the caller falls back to its
+    own records). The victim's file is never mutated: a post-mortem
+    read must not race or rewrite the evidence."""
+    entries: Dict[object, JournalEntry] = {}
+    order: List[object] = []
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            lines = fh.read().split("\n")
+    except OSError:
+        return []
+    for lineno, line in enumerate(lines, 1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            print(f"graftheal: journal {path!r} line {lineno} is "
+                  f"torn (crashed mid-append); skipping it and "
+                  f"reading the rest", file=sys.stderr)
+            continue
+        _apply_journal_record(entries, order, obj)
+    return [entries[u] for u in order]
+
+
 class RequestJournal:
     """JSONL write-ahead log of admitted requests and their emitted
     tokens — the redelivery guarantee behind supervised restart.
@@ -731,25 +790,7 @@ class RequestJournal:
             self._apply(obj)
 
     def _apply(self, obj: Dict) -> None:
-        op = obj.get("op")
-        uid = obj.get("uid")
-        if op == "admit":
-            if uid not in self._entries:
-                entry = JournalEntry(uid, obj["prompt"],
-                                     obj["max_new_tokens"],
-                                     obj.get("eos_id"))
-                self._entries[uid] = entry
-                self._order.append(uid)
-        elif op == "tok":
-            entry = self._entries.get(uid)
-            if entry is not None:
-                entry.tokens.extend(int(t) for t in obj["tokens"])
-        elif op == "done":
-            entry = self._entries.get(uid)
-            if entry is not None:
-                entry.done = True
-                entry.state = obj.get("state")
-                entry.reason = obj.get("reason")
+        _apply_journal_record(self._entries, self._order, obj)
 
     def known(self, uid) -> bool:
         """True when ``uid`` is journaled (finished or not) — the

@@ -1,0 +1,641 @@
+"""graftwire: the fleet's wire layer — length-prefixed binary framing
+over TCP sockets, with the graftfault/graftscope discipline built in.
+
+graftroute (PR 14) deliberately shaped the replica seam as dicts plus
+numpy blocks: ``snapshot()``/``health()`` ARE the ``/snapshot.json`` +
+``/healthz`` payloads, and a :class:`~..serving.replica.PageTransfer`
+is a request record plus two host arrays. That makes the remote
+deployment a FRAMING problem, not a semantics problem — this module is
+the framing:
+
+- **Frame layout** (one request or one response)::
+
+      [4B magic "GWR1"][u32 header length][header JSON utf-8]
+      [payload segment 0][payload segment 1]...
+
+  The header is a small JSON object (verb, kwargs, status) whose
+  ``"_arrays"`` field describes the raw payload segments that follow —
+  ``{"shape": [...], "dtype": "...", "nbytes": N}`` per segment. KV
+  page-blocks cross the wire as RAW bytes at their numpy layout: no
+  base64 (a 33% bandwidth tax on the dominant payload), no pickle
+  (arbitrary code execution on connect — a wire format, like a WAL,
+  must be data).
+
+- **Deadlines.** Every socket this module touches has a timeout
+  (:func:`_ensure_timeout` arms a default on sockets the caller left
+  unbounded — the same guarantee GL117 lints for statically), and
+  :meth:`WireClient.call` bounds the whole exchange with
+  :func:`~.faults.run_with_timeout` — a wedged peer surfaces as a
+  named ``FaultTimeout``, never a distributed hang.
+
+- **Retries.** :meth:`WireClient.call` reconnects and retries through
+  :func:`~.faults.retry_with_backoff` for IDEMPOTENT verbs only
+  (reads: hello/snapshot/health/metrics/journal reads; idempotent-by-
+  contract writes: begin_drain, the journal handoff record). A
+  transport failure on a NON-idempotent verb (submit/step/
+  admit_prefilled/withdraw) is commit-ambiguous — the request may have
+  landed and the response been lost — so it raises :class:`WireDead`
+  (named fatal) instead of retrying: the router reaps the replica and
+  the WAL redelivery path restores exactly-once delivery, which is the
+  one recovery that never double-runs work (the same reasoning that
+  keeps the store's ``add`` from retrying real socket failures).
+
+- **Fault sites.** ``wire.connect`` / ``wire.send`` / ``wire.recv``
+  fire at the syscall boundaries (send faults can CORRUPT the frame —
+  the receiver detects it via the magic/JSON sanity checks and drops
+  the connection, exercising the reconnect path). Each site has a
+  matrix scenario in ``tests/test_graftfault.py``.
+
+- **Observability.** Each logical call runs under a ``wire.rpc``
+  graftscope span carrying verb + static byte counts (header-declared
+  sizes — never a device read), and the module-level
+  ``wire_bytes_sent`` / ``wire_bytes_recv`` / ``wire_rpcs`` meter
+  (:func:`wire_meter`) gives benches and CLIs the transport totals.
+
+Stdlib + numpy only: importable from the serving layer and the CLI
+without jax, like every other runtime module.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import scope as graftscope
+from .faults import (FaultTimeout, GraftFaultError, maybe_fault,
+                     register_site, retry_with_backoff,
+                     run_with_timeout)
+
+__all__ = [
+    "WireError", "WireDead", "pack_frame", "send_frame", "recv_frame",
+    "WireClient", "WireServer", "wire_meter", "reset_wire_meter",
+    "DEFAULT_IO_TIMEOUT_S",
+]
+
+MAGIC = b"GWR1"
+_HEAD = struct.Struct(">I")
+# a header is a few hundred bytes of JSON; anything bigger is a
+# desynced or corrupted stream, not a legitimate frame
+_HEADER_MAX = 16 * 1024 * 1024
+DEFAULT_IO_TIMEOUT_S = 30.0
+
+_SITE_CONNECT = register_site(
+    "wire.connect",
+    "graftwire TCP connect to a replica server (client side; "
+    "reconnects retry through the bounded-backoff path)")
+_SITE_SEND = register_site(
+    "wire.send",
+    "graftwire frame send (either side; corrupt faults flip a frame "
+    "byte — the receiver's magic/JSON sanity checks catch it and "
+    "drop the connection)")
+_SITE_RECV = register_site(
+    "wire.recv",
+    "graftwire frame receive, fired once a frame has actually begun "
+    "arriving (idle polls never consume fault-plan hits)")
+
+
+class WireError(GraftFaultError):
+    """The byte stream is not a valid graftwire frame (bad magic,
+    oversized or unparseable header, truncated payload): the
+    connection is desynced or corrupted and is dropped — framing
+    errors are never silently resynced."""
+
+
+class WireDead(GraftFaultError):
+    """The transport to a replica is gone (connect/send/recv failed
+    beyond recovery, or a commit-ambiguous failure on a non-idempotent
+    verb). Named-fatal on purpose: it is the SAME class the router's
+    reap traps already catch for an in-process engine fatal, so a dead
+    socket and a dead engine take the identical redelivery path."""
+
+
+# ----------------------------------------------------------------- meter
+
+_METER_MU = threading.Lock()
+_METER = {"wire_bytes_sent": 0, "wire_bytes_recv": 0, "wire_rpcs": 0}
+
+
+def _note_bytes(sent: int = 0, recv: int = 0, rpcs: int = 0) -> None:
+    with _METER_MU:
+        _METER["wire_bytes_sent"] += sent
+        _METER["wire_bytes_recv"] += recv
+        _METER["wire_rpcs"] += rpcs
+
+
+def wire_meter() -> Dict[str, int]:
+    """Process-wide transport totals (client AND server sides): bytes
+    framed out, bytes framed in, logical RPCs completed."""
+    with _METER_MU:
+        return dict(_METER)
+
+
+def reset_wire_meter() -> None:
+    with _METER_MU:
+        for k in _METER:
+            _METER[k] = 0
+
+
+# --------------------------------------------------------------- framing
+
+def _ensure_timeout(sock: socket.socket) -> None:
+    """Arm the default IO timeout on a socket the caller left
+    unbounded — the runtime guarantee behind GL117's static rule: no
+    graftwire socket op can block forever."""
+    if sock.gettimeout() is None:
+        sock.settimeout(DEFAULT_IO_TIMEOUT_S)
+
+
+def _dtype_name(dt: np.dtype) -> str:
+    return dt.name  # "float32", "int32", "bfloat16" (ml_dtypes), ...
+
+
+def _dtype_from_name(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        # extension dtypes (bfloat16 etc.) register under ml_dtypes;
+        # lazy so the module stays importable without it
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def pack_frame(header: Dict, arrays: Sequence[np.ndarray] = ()) -> bytes:
+    """Serialize one frame: JSON header (its ``"_arrays"`` field is
+    overwritten with the payload segment descriptors) + raw array
+    bytes. Arrays are sent at their C-contiguous numpy layout."""
+    bufs: List[bytes] = []
+    descs: List[Dict] = []
+    for arr in arrays:
+        arr = np.ascontiguousarray(arr)
+        data = arr.tobytes()
+        descs.append({"shape": list(arr.shape),
+                      "dtype": _dtype_name(arr.dtype),
+                      "nbytes": len(data)})
+        bufs.append(data)
+    head = dict(header)
+    if descs:
+        head["_arrays"] = descs
+    payload = json.dumps(head, sort_keys=True).encode("utf-8")
+    if len(payload) > _HEADER_MAX:
+        raise WireError(
+            f"frame header is {len(payload)} bytes (> "
+            f"{_HEADER_MAX}); bulk data belongs in payload segments, "
+            "not the JSON header")
+    return b"".join([MAGIC, _HEAD.pack(len(payload)), payload] + bufs)
+
+
+def send_frame(sock: socket.socket, header: Dict,
+               arrays: Sequence[np.ndarray] = ()) -> int:
+    """Frame and send; returns bytes written. The ``wire.send`` fault
+    site fires on the assembled frame (corrupt faults flip one byte —
+    the receiver's sanity checks catch it)."""
+    frame = pack_frame(header, arrays)
+    frame = maybe_fault(_SITE_SEND, frame)
+    _ensure_timeout(sock)
+    sock.sendall(frame)
+    _note_bytes(sent=len(frame))
+    return len(frame)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    _ensure_timeout(sock)
+    chunks: List[bytes] = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(n - got)
+        if not chunk:
+            raise ConnectionError(
+                f"peer closed mid-frame ({got}/{n} bytes)")
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+def recv_frame(sock: socket.socket, *, idle_ok: bool = False
+               ) -> Optional[Tuple[Dict, List[np.ndarray]]]:
+    """Receive one frame: ``(header, arrays)``.
+
+    ``idle_ok=True`` (server accept loops): a timeout BEFORE any byte
+    arrives returns None (an idle poll, not an error) and a clean EOF
+    before any byte raises ``ConnectionResetError`` (peer hung up
+    between frames — the loop's break signal). A timeout or EOF
+    MID-frame is always an error: the stream is desynced and the
+    connection must drop. The ``wire.recv`` fault site fires only once
+    a frame has begun arriving, so idle polls never consume
+    fault-plan hits."""
+    _ensure_timeout(sock)
+    try:
+        first = sock.recv(1)
+    except socket.timeout:
+        if idle_ok:
+            return None
+        raise
+    if not first:
+        raise ConnectionResetError("peer closed the connection")
+    head = first + _recv_exact(sock, len(MAGIC) + _HEAD.size - 1)
+    maybe_fault(_SITE_RECV)
+    magic, hlen_raw = head[:4], head[4:]
+    if magic != MAGIC:
+        raise WireError(
+            f"bad frame magic {magic!r} (desynced or corrupted "
+            "stream); dropping the connection")
+    (hlen,) = _HEAD.unpack(hlen_raw)
+    if hlen > _HEADER_MAX:
+        raise WireError(
+            f"frame header claims {hlen} bytes (> {_HEADER_MAX}); "
+            "desynced or corrupted stream")
+    try:
+        header = json.loads(_recv_exact(sock, hlen).decode("utf-8"))
+    except (ValueError, UnicodeDecodeError) as e:
+        raise WireError(
+            f"frame header is not valid JSON ({e}); desynced or "
+            "corrupted stream") from e
+    if not isinstance(header, dict):
+        raise WireError(
+            f"frame header must be a JSON object, got "
+            f"{type(header).__name__}")
+    arrays: List[np.ndarray] = []
+    total = len(head) + hlen
+    for desc in header.pop("_arrays", ()):
+        nbytes = int(desc["nbytes"])
+        dtype = _dtype_from_name(desc["dtype"])
+        shape = [int(d) for d in desc["shape"]]
+        want = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+        if nbytes != want:
+            # a descriptor whose byte count contradicts its own
+            # shape x dtype is corruption — named, typed, and the
+            # connection drops; never a raw reshape ValueError that
+            # bypasses the framing-error handling
+            raise WireError(
+                f"payload descriptor claims {nbytes} bytes for "
+                f"shape {shape} {dtype.name} ({want} bytes); "
+                "desynced or corrupted stream")
+        data = _recv_exact(sock, nbytes)
+        total += nbytes
+        arrays.append(np.frombuffer(data, dtype=dtype).reshape(shape))
+    _note_bytes(recv=total)
+    return header, arrays
+
+
+# ---------------------------------------------------------------- client
+
+class WireClient:
+    """One connection to a :class:`WireServer`, speaking
+    request/response frames.
+
+    Args:
+      address: ``host:port``.
+      io_timeout_s: per-socket-op timeout (connect/send/recv).
+      call_deadline_s: default whole-call bound enforced through
+        :func:`~.faults.run_with_timeout` (None = socket timeouts
+        only). Per-call override via ``call(..., deadline_s=)``.
+      retries / backoff_s: reconnect-aware bounded retry for
+        IDEMPOTENT verbs (transport failures only; typed application
+        errors never retry).
+      idempotent: the verb set eligible for transport retries.
+
+    Connection is LAZY (first call connects), one in-flight call at a
+    time (the router drives replicas sequentially; a lock makes
+    cross-thread misuse safe rather than silently interleaving
+    frames). Every per-call duration lands in ``rpc_s`` (bounded) —
+    the bench's per-RPC overhead sample set."""
+
+    IDEMPOTENT = frozenset({
+        "hello", "ping", "snapshot", "health", "metrics",
+        "journal_unfinished", "journal_known", "journal_handoff",
+        "begin_drain", "mark_dead",
+    })
+
+    def __init__(self, address: str, *,
+                 io_timeout_s: float = DEFAULT_IO_TIMEOUT_S,
+                 call_deadline_s: Optional[float] = 60.0,
+                 retries: int = 3, backoff_s: float = 0.05,
+                 idempotent: Optional[frozenset] = None,
+                 sleep: Callable[[float], None] = time.sleep):
+        host, _, port = address.rpartition(":")
+        if not host or not port.isdigit():
+            raise ValueError(
+                f"address must be 'host:port', got {address!r}")
+        self.address = address
+        self._host, self._port = host, int(port)
+        self.io_timeout_s = float(io_timeout_s)
+        self.call_deadline_s = call_deadline_s
+        self._retries = int(retries)
+        self._backoff_s = float(backoff_s)
+        self._sleep = sleep
+        self._idempotent = (self.IDEMPOTENT if idempotent is None
+                            else idempotent)
+        self._sock: Optional[socket.socket] = None
+        self._mu = threading.Lock()
+        self.rpc_s: List[float] = []  # per-call wall seconds (bounded)
+
+    # ---- connection lifecycle -----------------------------------------
+    def _connect(self) -> socket.socket:
+        maybe_fault(_SITE_CONNECT)
+        sock = socket.create_connection((self._host, self._port),
+                                        timeout=self.io_timeout_s)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return sock
+
+    def _ensure(self) -> socket.socket:
+        if self._sock is None:
+            # connecting is always safe to retry (no request has been
+            # sent yet), for idempotent and non-idempotent verbs alike
+            self._sock = retry_with_backoff(
+                self._connect, attempts=self._retries,
+                base_delay_s=self._backoff_s, sleep=self._sleep)
+        return self._sock
+
+    def _drop(self, only: Optional[socket.socket] = None) -> None:
+        if only is not None and self._sock is not only:
+            # an abandoned deadline worker waking up late: the
+            # connection IT used is already replaced — close the stale
+            # one, never the replacement a concurrent retry opened
+            try:
+                only.close()
+            except OSError:
+                pass
+            return
+        sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        with self._mu:
+            self._drop()
+
+    # ---- the call -----------------------------------------------------
+    def _exchange(self, header: Dict, arrays: Sequence[np.ndarray],
+                  io_timeout_s: Optional[float]
+                  ) -> Tuple[Dict, List[np.ndarray]]:
+        sock = self._ensure()
+        if io_timeout_s is not None:
+            sock.settimeout(io_timeout_s)
+        try:
+            send_frame(sock, header, arrays)
+            got = recv_frame(sock)
+        except BaseException:
+            # mid-exchange failure leaves the stream position unknown:
+            # this socket can never be trusted with another frame
+            # (drop only OUR socket — after a deadline fires, this
+            # worker may wake long after a retry reconnected)
+            self._drop(only=sock)
+            raise
+        finally:
+            if io_timeout_s is not None and self._sock is not None:
+                self._sock.settimeout(self.io_timeout_s)
+        assert got is not None  # idle_ok=False never returns None
+        return got
+
+    def call(self, verb: str, *, arrays: Sequence[np.ndarray] = (),
+             deadline_s: Optional[float] = -1.0,
+             io_timeout_s: Optional[float] = None,
+             **fields) -> Tuple[Dict, List[np.ndarray]]:
+        """One RPC: returns ``(response header, response arrays)``.
+
+        Typed application errors come back raised (the server's
+        ``ok=False`` responses are rehydrated by the CALLER layer —
+        this layer returns them as-is); transport failures raise
+        :class:`WireDead` after the idempotent-verb retry policy has
+        run its course. ``deadline_s=-1`` means "use the client
+        default"; ``None`` disables the whole-call watchdog (socket
+        timeouts still bound every individual op)."""
+        if deadline_s == -1.0:
+            deadline_s = self.call_deadline_s
+        header = {"verb": verb}
+        header.update(fields)
+        nbytes_out = sum(int(np.asarray(a).nbytes) for a in arrays)
+
+        def once() -> Tuple[Dict, List[np.ndarray]]:
+            if deadline_s is None:
+                return self._exchange(header, arrays, io_timeout_s)
+            try:
+                return run_with_timeout(
+                    lambda: self._exchange(header, arrays,
+                                           io_timeout_s),
+                    deadline_s, f"wire.rpc {verb} -> {self.address}",
+                    hint="the replica server is wedged or the "
+                         "network path is gone; the caller treats "
+                         "this replica as lost")
+            except FaultTimeout:
+                # the worker thread may still own the socket; never
+                # reuse a connection whose stream position is unknown
+                self._drop()
+                raise
+
+        t0 = time.perf_counter()
+        with self._mu, graftscope.span(
+                "wire.rpc", cat="wire", verb=verb,
+                nbytes_out=nbytes_out) as sp:
+            try:
+                # WireError counts as a transport failure here: a
+                # corrupted RESPONSE frame desyncs the stream exactly
+                # like a reset does (the socket is already dropped),
+                # so idempotent verbs reconnect-retry and everything
+                # else converts to the named WireDead — corruption
+                # never escapes raw past the health mirror
+                if verb in self._idempotent:
+                    resp, arrs = retry_with_backoff(
+                        once, attempts=self._retries,
+                        base_delay_s=self._backoff_s,
+                        retry_on=(OSError, FaultTimeout, WireError),
+                        sleep=self._sleep)
+                else:
+                    resp, arrs = once()
+            except (OSError, FaultTimeout, WireError) as e:
+                raise WireDead(
+                    f"wire: {verb!r} to {self.address} failed "
+                    f"({type(e).__name__}: {e}) — treating the "
+                    "replica as lost"
+                    + ("" if verb in self._idempotent else
+                       "; the verb is not idempotent, so the failure "
+                       "is commit-ambiguous and redelivery (not a "
+                       "retry) is the exactly-once recovery")) from e
+            nbytes_in = sum(int(a.nbytes) for a in arrs)
+            sp.note(nbytes_in=nbytes_in)
+        _note_bytes(rpcs=1)
+        if len(self.rpc_s) < 200_000:
+            self.rpc_s.append(time.perf_counter() - t0)
+        return resp, arrs
+
+
+# ---------------------------------------------------------------- server
+
+class WireServer:
+    """A verb-dispatching frame server: threaded accept loop, one
+    handler thread per connection, handlers serialized under one lock
+    (the hosted engine is not thread-safe — the wire must not invent
+    concurrency the in-process seam never had).
+
+    ``handlers`` maps verb -> ``fn(header, arrays) -> dict | (dict,
+    arrays)``. Handler exceptions become typed ``ok=False`` responses
+    (``etype`` + ``msg``) — the client side rehydrates them; the
+    connection survives application errors and drops only on framing/
+    transport errors. ``decorate(resp)`` (optional) runs under the
+    handler lock on every response — the replica server uses it to
+    piggyback a live stats/health snapshot so the remote handle's
+    mirror refreshes with every exchange, at zero extra RPCs."""
+
+    def __init__(self, handlers: Dict[str, Callable], *,
+                 host: str = "127.0.0.1", port: int = 0,
+                 accept_timeout_s: float = 0.2,
+                 io_timeout_s: float = DEFAULT_IO_TIMEOUT_S,
+                 decorate: Optional[Callable[[Dict], None]] = None,
+                 name: str = "wire"):
+        self._handlers = dict(handlers)
+        self._decorate = decorate
+        self._io_timeout_s = float(io_timeout_s)
+        self._mu = threading.Lock()       # serializes verb handlers
+        # the connection LIST has its own lock: kill_connections()
+        # must abort sockets NOW even while a long handler (a drain)
+        # holds the handler lock — process death does not queue
+        self._conns_mu = threading.Lock()
+        self._stop = threading.Event()
+        self._listener = socket.create_server((host, port))
+        self._listener.settimeout(accept_timeout_s)
+        self.host = host
+        self.port = self._listener.getsockname()[1]
+        self.address = f"{host}:{self.port}"
+        self._conns: List[socket.socket] = []
+        self._threads: List[threading.Thread] = []
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"pmdt-{name}-accept")
+
+    def start(self) -> "WireServer":
+        self._accept_thread.start()
+        return self
+
+    def __enter__(self) -> "WireServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def stop(self) -> None:
+        """Graceful shutdown: stop accepting, close the listener and
+        every live connection, join the handler threads."""
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        self.kill_connections()
+        if self._accept_thread.is_alive():
+            self._accept_thread.join(timeout=2.0)
+        for t in self._threads:
+            t.join(timeout=2.0)
+
+    def kill_connections(self) -> None:
+        """Abort every live connection NOW (no drain, no goodbye
+        frame) — the test/bench hook that simulates process death at
+        the socket level: clients see a reset exactly as they would
+        from a SIGKILLed process."""
+        with self._conns_mu:
+            conns, self._conns = self._conns, []
+        for conn in conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # ---- loops --------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _addr = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break  # listener closed
+            conn.settimeout(self._io_timeout_s)
+            try:
+                conn.setsockopt(socket.IPPROTO_TCP,
+                                socket.TCP_NODELAY, 1)
+            except OSError:
+                pass
+            with self._conns_mu:
+                self._conns.append(conn)
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True,
+                                 name="pmdt-wire-conn")
+            # prune finished handlers: a long-lived server whose
+            # clients reconnect must not accrete dead Thread objects
+            self._threads = [x for x in self._threads if x.is_alive()]
+            self._threads.append(t)
+            t.start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            while not self._stop.is_set():
+                try:
+                    got = recv_frame(conn, idle_ok=True)
+                except (WireError, OSError, EOFError):
+                    break  # desync/corruption/hangup: drop the conn
+                if got is None:
+                    continue  # idle poll
+                header, arrays = got
+                resp, resp_arrays = self._dispatch(header, arrays)
+                try:
+                    send_frame(conn, resp, resp_arrays)
+                except OSError:
+                    break
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            with self._conns_mu:
+                if conn in self._conns:
+                    self._conns.remove(conn)
+
+    def _dispatch(self, header: Dict, arrays: List[np.ndarray]
+                  ) -> Tuple[Dict, Sequence[np.ndarray]]:
+        verb = header.pop("verb", None)
+        handler = self._handlers.get(verb)
+        resp: Dict
+        resp_arrays: Sequence[np.ndarray] = ()
+        if handler is None:
+            resp = {"ok": False, "etype": "WireError",
+                    "msg": f"unknown verb {verb!r} (server speaks: "
+                           f"{sorted(self._handlers)})"}
+        else:
+            with self._mu:
+                try:
+                    out = handler(header, arrays)
+                    if isinstance(out, tuple):
+                        resp, resp_arrays = out
+                    else:
+                        resp = out if out is not None else {}
+                    resp.setdefault("ok", True)
+                except (KeyboardInterrupt, SystemExit):
+                    raise
+                except BaseException as e:
+                    # every handler failure becomes a TYPED response —
+                    # the error is recorded on the bus and shipped to
+                    # the caller, never swallowed
+                    graftscope.emit("wire.serve_error", cat="wire",
+                                    verb=verb,
+                                    error=type(e).__name__)
+                    resp = {"ok": False, "etype": type(e).__name__,
+                            "msg": str(e)}
+                if self._decorate is not None:
+                    try:
+                        self._decorate(resp)
+                    except (KeyboardInterrupt, SystemExit):
+                        raise
+                    except BaseException as e:
+                        graftscope.emit("wire.serve_error", cat="wire",
+                                        verb=verb, where="decorate",
+                                        error=type(e).__name__)
+        return resp, resp_arrays

@@ -23,6 +23,8 @@ from .engine import ServingEngine
 from .kv_pages import PagePool, PagePoolExhausted, PrefixCache
 from .kv_slots import SlotPool
 from .params import init_params, load_params
+from .remote import (RemoteReplica, ReplicaServer,
+                     fleet_from_directory)
 from .replica import PageTransfer, ServingReplica
 from .router import (FleetDead, FleetSaturated, PrefixCacheDirectory,
                      Router)
@@ -40,4 +42,6 @@ __all__ = [
     # graftroute: fleet serving
     "Router", "ServingReplica", "PageTransfer",
     "PrefixCacheDirectory", "FleetSaturated", "FleetDead",
+    # graftwire: the socket transport behind the replica seam
+    "ReplicaServer", "RemoteReplica", "fleet_from_directory",
 ]

@@ -1,0 +1,908 @@
+"""graftwire's serving half: replicas in OTHER PROCESSES behind the
+exact :class:`~.replica.ServingReplica` handle surface.
+
+PR 14 shaped the replica seam so this module could exist: the router
+never reaches into an engine except through ``snapshot()``/``health()``
+dicts, four placement verbs, and numpy-block ``PageTransfer``\\ s. Here
+that seam crosses a socket:
+
+- :class:`ReplicaServer` hosts ONE :class:`~.engine.ServingEngine`
+  behind the graftwire verb surface (``submit`` / ``step`` /
+  ``begin_drain`` / ``drain`` / ``withdraw_queued`` / ``requeue`` /
+  ``admit_prefilled`` / ``prefill_detached`` / ``redeliver`` /
+  ``snapshot`` / ``health`` / ``metrics`` / journal reads). Every
+  response piggybacks a ``live`` snapshot (queue law, free slots/
+  pages, health, metrics, newly-FAILED requests), so the remote
+  handle's mirror refreshes with every exchange at ZERO extra RPCs —
+  the router's many per-step stat reads stay local attribute reads,
+  exactly as cheap as the in-process handle.
+
+- :class:`RemoteReplica` subclasses :class:`~.replica.ServingReplica`
+  with a :class:`_RemoteEngine` proxy in the engine seat: ALL router
+  logic — placement, AIMD windows, stealing, reap/redelivery, drain —
+  runs UNCHANGED against it. Token events come back as
+  ``(uid, token, finished)`` records and are re-bound to the router's
+  own :class:`~.scheduler.Request` mirrors (tokens, stamps and
+  terminal state accumulate client-side, so ``records()`` /
+  timelines / the journal-less reap fallback all keep working).
+
+**Failure semantics.** A transport failure surfaces as
+:class:`~..runtime.wire.WireDead` — a ``GraftFaultError`` exactly like
+an in-process engine fatal, so the router's existing reap traps catch
+it: the replica is reaped and its journal redelivers to peers. For a
+SIGKILLed replica-server PROCESS the journal RPC is gone too; the
+handle falls back to reading the WAL from the router-known path
+(``hello`` publishes it; same-host deployments — and the smoke/bench
+topology — share the filesystem, cross-host ones need shared storage
+or accept the journal-less fallback). With NO journal and no path the
+handle reports ``journal=None`` and the router reconstructs from its
+own records — which the client-side mirrors make complete (every
+delivered token is on them), so redelivery stays token-exact for
+everything the client actually saw.
+
+**Exactly-once.** Non-idempotent verbs never retry on transport
+failure (commit-ambiguous): the replica is treated as lost and the
+WAL/records redelivery path — whose replay-prefix dedup is already
+pinned — restores exactly-once delivery. One documented window
+remains: a victim socket dying INSIDE the steal handoff (thief
+accepted, victim's ``record_handoff`` unreachable) propagates the
+named fatal to the fleet step; the supervisor restart's
+``Router.recover`` dedups the uid across both WALs, the same
+crash-window rule the in-process fleet pins.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..runtime import heal
+from ..runtime import scope as graftscope
+from ..runtime.wire import (DEFAULT_IO_TIMEOUT_S, WireClient, WireDead,
+                            WireServer)
+from .replica import ROLES, ServingReplica
+from .scheduler import (DONE, FAILED, QUEUED, RUNNING, QueueFull,
+                        Request)
+
+__all__ = ["ReplicaServer", "RemoteReplica", "RemoteFatalError",
+           "RemoteRequestError", "fleet_from_directory"]
+
+
+class RemoteFatalError(WireDead):
+    """An engine-fatal error rehydrated off the wire (the server's
+    step/splice died named). Subclasses :class:`WireDead` (hence
+    ``GraftFaultError``): the router's reap traps treat a remotely
+    dead engine exactly like a locally dead one."""
+
+
+class RemoteRequestError(RuntimeError):
+    """A per-request failure reported by the replica server (the
+    quarantine path): recorded on the mirrored request's ``error`` so
+    clients read WHAT failed without reaching across the wire."""
+
+
+# --------------------------------------------------------- wire shapes
+
+def _req_wire(request: Request) -> Dict:
+    return {"uid": request.uid, "prompt": list(request.prompt),
+            "max_new_tokens": request.max_new_tokens,
+            "eos_id": request.eos_id,
+            "deadline_s": request.deadline_s}
+
+
+def _req_from_wire(d: Dict) -> Request:
+    return Request(d["prompt"], d["max_new_tokens"], d.get("eos_id"),
+                   d.get("uid"), deadline_s=d.get("deadline_s"))
+
+
+def _events_wire(events) -> List[Dict]:
+    out = []
+    for request, token, finished in events:
+        ev = {"u": request.uid, "t": int(token), "f": bool(finished)}
+        if finished:
+            ev["state"] = request.state
+            ev["reason"] = request.finish_reason
+        out.append(ev)
+    return out
+
+
+def _entry_wire(entry) -> Dict:
+    return {"uid": entry.uid, "prompt": list(entry.prompt),
+            "max_new_tokens": entry.max_new_tokens,
+            "eos_id": entry.eos_id, "tokens": list(entry.tokens)}
+
+
+def _entry_from_wire(d: Dict) -> heal.JournalEntry:
+    entry = heal.JournalEntry(d["uid"], d["prompt"],
+                              d["max_new_tokens"], d.get("eos_id"))
+    entry.tokens = [int(t) for t in d.get("tokens", ())]
+    return entry
+
+
+# ------------------------------------------------------------- server
+
+class ReplicaServer:
+    """One engine, one socket: hosts a :class:`~.engine.ServingEngine`
+    behind the graftwire verb surface so a router in ANOTHER process
+    drives it with in-process semantics.
+
+    The server never drives the engine itself — the remote router owns
+    placement, stepping and drain, exactly as the in-process router
+    owns its replicas. Verbs are serialized under one lock (the engine
+    is not thread-safe; the wire must not invent concurrency the
+    in-process seam never had). ``serve_forever`` returns when the
+    engine lands DEAD — i.e. after the router drained it — giving the
+    ``serve_lm.py --listen`` process its clean exit.
+
+    Args:
+      engine: the hosted engine (its ``journal`` — if any — is what
+        redelivers this replica's work after a crash; ``hello``
+        publishes its path for the router's SIGKILL fallback).
+      rid / role: replica identity, served from ``hello``.
+      store / run_uid: optional control-plane store — the server
+        publishes ``{role, state, address, published_at}`` via
+        :func:`~..runtime.fleet.publish_replica` so routers bootstrap
+        from the directory instead of a flag list.
+    """
+
+    def __init__(self, engine, *, rid: str = "r0", role: str = "both",
+                 host: str = "127.0.0.1", port: int = 0,
+                 store=None, run_uid: str = "run",
+                 io_timeout_s: float = DEFAULT_IO_TIMEOUT_S):
+        if role not in ROLES:
+            raise ValueError(f"role must be one of {ROLES}, got {role!r}")
+        self.engine = engine
+        self.rid = str(rid)
+        self.role = role
+        self.store = store
+        self.run_uid = str(run_uid)
+        self._tracked: Dict[object, Request] = {}
+        self._failed_reported: set = set()
+        self._withdrawn: Dict[object, Request] = {}
+        self._last_rpc = time.perf_counter()
+        self._last_publish = time.perf_counter()
+        handlers = {
+            "hello": self._h_hello,
+            "ping": lambda h, a: {},
+            "submit": self._h_submit,
+            "step": self._h_step,
+            "begin_drain": self._h_begin_drain,
+            "mark_dead": self._h_mark_dead,
+            "drain": self._h_drain,
+            "withdraw_queued": self._h_withdraw,
+            "requeue": self._h_requeue,
+            "admit_prefilled": self._h_admit_prefilled,
+            "prefill_detached": self._h_prefill_detached,
+            "redeliver": self._h_redeliver,
+            "snapshot": self._h_snapshot,
+            "health": self._h_health,
+            "metrics": self._h_metrics,
+            "journal_unfinished": self._h_journal_unfinished,
+            "journal_known": self._h_journal_known,
+            "journal_handoff": self._h_journal_handoff,
+        }
+        self._server = WireServer(handlers, host=host, port=port,
+                                  io_timeout_s=io_timeout_s,
+                                  decorate=self._decorate,
+                                  name=f"replica-{rid}")
+        self.address = self._server.address
+
+    # ---- lifecycle ----------------------------------------------------
+    def start(self) -> "ReplicaServer":
+        self._server.start()
+        if self.engine.health.state == heal.STARTING:
+            self.engine.health.to_ready("serving")
+        self._publish()
+        graftscope.emit("wire.listen", cat="wire", rid=self.rid,
+                        role=self.role, address=self.address)
+        return self
+
+    def __enter__(self) -> "ReplicaServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def stop(self) -> None:
+        """Shut the transport down (the engine is left as-is — a
+        drained engine is already DEAD, an undrained one keeps its WAL
+        for redelivery)."""
+        self._server.stop()
+
+    def kill(self) -> None:
+        """Process-death simulation at the socket level: every
+        connection aborts NOW, no goodbye frame, the engine is
+        abandoned mid-state with its WAL on disk — what a SIGKILL
+        looks like to the router, without needing a subprocess. The
+        fast tier-1 redelivery pins are built on this; the slow smoke
+        kills a real process."""
+        self._server.kill_connections()
+        self._server.stop()
+
+    def serve_forever(self, poll_s: float = 0.2,
+                      drain_deadline_s: Optional[float] = None,
+                      idle_grace_s: float = 10.0,
+                      publish_interval_s: float = 10.0) -> None:
+        """Block until the hosted engine lands DEAD — the
+        ``--listen`` process body. Two exits: the remote router drains
+        this replica (engine lands DEAD through the ``drain`` verb),
+        or the engine is DRAINING (a local SIGTERM through the
+        standard ``install_drain_handler``) with NO router activity
+        for ``idle_grace_s`` — then the server finishes the in-flight
+        work ITSELF under the verb lock, so a replica whose router
+        vanished still drains to a clean 0 with its WAL compacted.
+        The grace period is what keeps a self-drain from racing a
+        LIVE router's drain loop and emitting tokens to nobody: while
+        the router keeps stepping this replica, every response
+        refreshes the activity stamp and the server stays hands-off.
+
+        While alive the server RE-publishes its directory entry every
+        ``publish_interval_s`` — the ``published_at`` heartbeat a
+        :func:`~..runtime.fleet.replica_directory` TTL filter needs: a
+        healthy long-running replica stays fresh in the roster, and
+        only a CRASHED publisher's stamp ages out (keep the interval
+        well under the router's ``ttl_s`` — serve_lm's defaults are
+        10s vs 30s)."""
+        while not self.engine.health.dead:
+            self._tick(publish_interval_s)
+            if (self.engine.health.draining
+                    and time.perf_counter() - self._last_rpc
+                    > idle_grace_s):
+                self.drain(drain_deadline_s)
+                break
+            time.sleep(poll_s)
+        self.stop()
+
+    def _tick(self, publish_interval_s: float) -> None:
+        """One serve_forever housekeeping beat: refresh the
+        directory stamp when it is due (best-effort, like every
+        publish)."""
+        now = time.perf_counter()
+        if now - self._last_publish >= publish_interval_s:
+            self._publish()
+
+    def drain(self, deadline_s: Optional[float] = None):
+        """Drain the hosted engine under the verb lock (never racing a
+        concurrent router RPC against the engine's own drain loop)."""
+        with self._server._mu:
+            if self.engine.health.dead:
+                return []
+            return self.engine.drain(deadline_s)
+
+    def _publish(self) -> None:
+        self._last_publish = time.perf_counter()
+        if self.store is None:
+            return
+        from ..runtime import fleet as graftfleet
+
+        graftfleet.publish_replica(
+            self.store, self.rid, role=self.role,
+            state=self.engine.health.state, address=self.address,
+            run_uid=self.run_uid)
+
+    # ---- the live piggyback -------------------------------------------
+    def _decorate(self, resp: Dict) -> None:
+        self._last_rpc = time.perf_counter()
+        resp["live"] = self._live()
+
+    def _live(self) -> Dict:
+        engine = self.engine
+        failed = []
+        for uid in list(self._tracked):
+            request = self._tracked[uid]
+            if request.state == FAILED:
+                if uid not in self._failed_reported:
+                    self._failed_reported.add(uid)
+                    failed.append({
+                        "uid": uid,
+                        "reason": request.finish_reason or "error",
+                        "etype": (type(request.error).__name__
+                                  if request.error is not None
+                                  else "Error"),
+                        "msg": str(request.error or "")})
+                del self._tracked[uid]
+            elif request.state == DONE:
+                del self._tracked[uid]  # its final event carried fin
+        return {
+            "in_flight": engine.in_flight,
+            "queue_depth": engine.scheduler.queue_depth,
+            "free_slots": engine.pool.free_slots,
+            "free_pages": getattr(engine.pool, "free_pages", -1),
+            "health": engine.health.snapshot(),
+            "metrics": engine.metrics.snapshot(),
+            "failed": failed,
+        }
+
+    # ---- verbs --------------------------------------------------------
+    def _h_hello(self, header: Dict, arrays) -> Dict:
+        engine = self.engine
+        journal = engine.journal
+        return {
+            "rid": self.rid, "role": self.role, "pid": os.getpid(),
+            "max_slots": engine.pool.max_slots,
+            "s_max": engine.pool.s_max,
+            "page_size": getattr(engine.pool, "page_size", None),
+            "max_queue": engine.scheduler.max_queue,
+            "eos_id": engine.eos_id,
+            "prefill_chunk": engine._prefill_chunk,
+            "prefix_cache_armed":
+                getattr(engine, "_prefix_cache", None) is not None,
+            "journal": journal is not None,
+            "journal_path": (journal.path if journal is not None
+                             else None),
+        }
+
+    def _track(self, request: Request) -> Request:
+        self._tracked[request.uid] = request
+        return request
+
+    def _h_submit(self, header: Dict, arrays) -> Dict:
+        request = _req_from_wire(header["req"])
+        self.engine.enqueue(request)
+        self._track(request)
+        return {}
+
+    def _h_step(self, header: Dict, arrays) -> Dict:
+        return {"events": _events_wire(self.engine.step())}
+
+    def _h_begin_drain(self, header: Dict, arrays) -> Dict:
+        self.engine.begin_drain(header.get("reason", "drain"))
+        self._publish()
+        return {}
+
+    def _h_mark_dead(self, header: Dict, arrays) -> Dict:
+        if not self.engine.health.dead:
+            self.engine.health.to_dead(header.get("reason", "down"))
+        self._publish()
+        return {}
+
+    def _h_drain(self, header: Dict, arrays) -> Dict:
+        events = self.engine.drain(header.get("deadline"))
+        self._publish()
+        return {"events": _events_wire(events)}
+
+    def _h_withdraw(self, header: Dict, arrays) -> Dict:
+        out = self.engine.withdraw_queued(int(header.get("n", 1)))
+        for request in out:
+            # parked until the router either confirms the steal
+            # (journal_handoff) or puts it back (requeue) — the
+            # object's stamps survive a refused theft
+            self._withdrawn[request.uid] = request
+        return {"reqs": [_req_wire(r) for r in out]}
+
+    def _h_requeue(self, header: Dict, arrays) -> Dict:
+        d = header["req"]
+        request = self._withdrawn.pop(d["uid"], None)
+        if request is None:
+            request = _req_from_wire(d)
+        self.engine.scheduler.requeue_tail(request)
+        self._track(request)
+        return {}
+
+    def _h_admit_prefilled(self, header: Dict, arrays) -> Dict:
+        request = _req_from_wire(header["req"])
+        k_block, v_block = arrays
+        events = self.engine.admit_prefilled(
+            request, int(header["tok0"]), k_block, v_block)
+        self._track(request)
+        return {"events": _events_wire(events)}
+
+    def _h_prefill_detached(self, header: Dict, arrays
+                            ) -> Tuple[Dict, Sequence[np.ndarray]]:
+        request = _req_from_wire(header["req"])
+        tok0, k_pref, v_pref = self.engine.prefill_detached(
+            request, chunk=header.get("chunk"))
+        return ({"tok0": int(tok0)},
+                [np.asarray(k_pref), np.asarray(v_pref)])
+
+    def _h_redeliver(self, header: Dict, arrays) -> Dict:
+        entries = [_entry_from_wire(d) for d in header["entries"]]
+        events: List = []
+        redelivered = self.engine.redeliver(entries, events_out=events)
+        for request in redelivered:
+            self._track(request)
+        return {"uids": [r.uid for r in redelivered],
+                "events": _events_wire(events)}
+
+    def _h_snapshot(self, header: Dict, arrays) -> Dict:
+        return {"snapshot": self._live()}
+
+    def _h_health(self, header: Dict, arrays) -> Dict:
+        out = dict(self.engine.health.snapshot())
+        out["rid"] = self.rid
+        out["role"] = self.role
+        return {"health": out}
+
+    def _h_metrics(self, header: Dict, arrays) -> Dict:
+        return {"metrics": self.engine.metrics.snapshot()}
+
+    def _h_journal_unfinished(self, header: Dict, arrays) -> Dict:
+        journal = self.engine.journal
+        entries = journal.unfinished() if journal is not None else []
+        return {"entries": [_entry_wire(e) for e in entries]}
+
+    def _h_journal_known(self, header: Dict, arrays) -> Dict:
+        journal = self.engine.journal
+        return {"known": (journal is not None
+                          and journal.known(header["uid"]))}
+
+    def _h_journal_handoff(self, header: Dict, arrays) -> Dict:
+        uid = header["uid"]
+        request = self._withdrawn.pop(uid, None)
+        self._tracked.pop(uid, None)
+        journal = self.engine.journal
+        if journal is not None:
+            shim = request
+            if shim is None:
+                class _Shim:  # record_handoff only reads .uid
+                    pass
+
+                shim = _Shim()
+                shim.uid = uid
+            journal.record_handoff(shim, to=header.get("to", ""))
+        return {}
+
+
+# ------------------------------------------------------- client mirror
+
+class _RemoteHealth:
+    """Client-side mirror of the server engine's
+    :class:`~..runtime.heal.HealthState`: refreshed from the live
+    piggyback, forward-only like the real machine, and pinned DEAD the
+    moment the transport dies (a later stale frame can never resurrect
+    a replica the router already reaped)."""
+
+    _ORDER = {heal.STARTING: 0, heal.READY: 1, heal.DRAINING: 2,
+              heal.DEAD: 3}
+
+    def __init__(self, engine: "_RemoteEngine"):
+        self._engine = engine
+        self.state = heal.STARTING
+        self.reason = "connecting"
+        self._snap: Dict = {"state": self.state,
+                            "state_name": self.state.upper(),
+                            "reason": self.reason, "since_s": 0.0}
+
+    def apply(self, snap: Optional[Dict]) -> None:
+        if not snap or self.state == heal.DEAD:
+            return  # locally-dead is terminal; stale frames ignored
+        state = snap.get("state", self.state)
+        if self._ORDER.get(state, 0) < self._ORDER[self.state]:
+            return  # forward-only, like the real machine
+        self.state = state
+        self.reason = snap.get("reason", self.reason)
+        self._snap = dict(snap)
+
+    def _local(self, state: str, reason: str) -> None:
+        if self._ORDER[state] < self._ORDER[self.state]:
+            return
+        self.state = state
+        self.reason = reason
+        self._snap.update(state=state, state_name=state.upper(),
+                          reason=reason)
+
+    def mark_wire_dead(self, why: str) -> None:
+        self._local(heal.DEAD, f"WireDead: {why}")
+
+    def to_draining(self, reason: str = "drain") -> None:
+        self._local(heal.DRAINING, reason)
+        self._engine._control("begin_drain", reason=reason)
+
+    def to_dead(self, reason: str = "down") -> None:
+        self._local(heal.DEAD, reason)
+        self._engine._control("mark_dead", reason=reason)
+
+    @property
+    def ready(self) -> bool:
+        return self.state == heal.READY
+
+    @property
+    def draining(self) -> bool:
+        return self.state == heal.DRAINING
+
+    @property
+    def dead(self) -> bool:
+        return self.state == heal.DEAD
+
+    def snapshot(self) -> Dict:
+        return dict(self._snap)
+
+
+class _RemotePool:
+    """Static capacity from ``hello`` + live occupancy from the
+    piggyback — the attribute surface the router and the base replica
+    read (never an RPC per read)."""
+
+    def __init__(self, hello: Dict):
+        self.max_slots = int(hello["max_slots"])
+        self.s_max = int(hello["s_max"])
+        page_size = hello.get("page_size")
+        if page_size is not None:
+            self.page_size = int(page_size)
+        self.free_slots = self.max_slots
+        self.free_pages = -1
+
+
+class _RemoteScheduler:
+    def __init__(self, engine: "_RemoteEngine", hello: Dict):
+        self._engine = engine
+        max_queue = hello.get("max_queue")
+        self.max_queue = None if max_queue is None else int(max_queue)
+        self.queue_depth = 0
+
+    def requeue_tail(self, request: Request) -> None:
+        """A refused theft goes back on the victim's tail. If the
+        victim's socket died in the window, the request stays mirrored
+        here and the reap redelivers it from the WAL/records — never
+        dropped on a failed requeue."""
+        try:
+            self._engine._rpc("requeue", req=_req_wire(request))
+        except WireDead:
+            pass  # mirror retained below; the reap owns it now
+        self._engine._requests[request.uid] = request
+
+
+class _RemoteMetrics:
+    """Mirrored counters the router/replica layers read per step, plus
+    a full-snapshot fetch for the fleet merge (cached — a dead replica
+    still contributes its last-known counters, which by construction
+    count exactly the tokens the router actually saw delivered)."""
+
+    _MIRROR = ("page_holds", "requests_shed", "requests_completed",
+               "requests_redelivered", "tokens_generated",
+               "decode_elapsed_s")
+
+    def __init__(self, engine: "_RemoteEngine"):
+        self._engine = engine
+        self._last: Dict = {}
+        self._local_failures = 0
+        for key in self._MIRROR:
+            setattr(self, key, 0)
+        self.decode_elapsed_s = 0.0
+
+    def apply(self, snap: Optional[Dict]) -> None:
+        if not snap:
+            return
+        self._last = dict(snap)
+        for key in self._MIRROR:
+            if key in snap:
+                setattr(self, key, snap[key])
+
+    def record_failure(self) -> None:
+        # a prefill-intake failure happens before the server ever saw
+        # the request: counted here and folded into the snapshot
+        self._local_failures += 1
+
+    def snapshot(self) -> Dict:
+        if not self._engine.health.dead:
+            # a DEAD transport is never redialed: every scrape would
+            # otherwise pay the full reconnect-retry timeout ladder
+            # for a replica that cannot answer
+            try:
+                header, _ = self._engine._rpc("metrics")
+                self._last = dict(header["metrics"])
+            except WireDead:
+                pass  # last-known counters (the dead-replica merge)
+        out = dict(self._last)
+        if self._local_failures:
+            out["requests_failed"] = (out.get("requests_failed", 0)
+                                      + self._local_failures)
+        return out
+
+
+class _RemoteJournal:
+    """The dead-or-alive journal view: RPC while the server answers,
+    the router-known WAL path read-only once it does not (the SIGKILL
+    case — same-host/shared-storage deployments), empty otherwise
+    (the caller's router-records fallback takes over)."""
+
+    def __init__(self, engine: "_RemoteEngine", path: Optional[str]):
+        self._engine = engine
+        self.path = path
+
+    def _disk(self) -> List[heal.JournalEntry]:
+        if not self.path:
+            return []
+        return heal.load_journal_entries(self.path)
+
+    def known(self, uid) -> bool:
+        try:
+            header, _ = self._engine._rpc("journal_known", uid=uid)
+            return bool(header["known"])
+        except WireDead:
+            return any(e.uid == uid for e in self._disk())
+
+    def unfinished(self) -> List[heal.JournalEntry]:
+        try:
+            header, _ = self._engine._rpc("journal_unfinished")
+            return [_entry_from_wire(d) for d in header["entries"]]
+        except WireDead:
+            return [e for e in self._disk() if not e.done]
+
+    def record_handoff(self, request, to: str = "") -> None:
+        # propagates WireDead on a dead victim: the handoff window's
+        # crash rule (supervisor restart + Router.recover cross-WAL
+        # dedup) is the exactly-once recovery, same as in-process
+        self._engine._rpc("journal_handoff", uid=request.uid, to=to)
+
+
+class _RemoteEngine:
+    """The engine-shaped proxy a :class:`RemoteReplica` hands to the
+    unchanged :class:`~.replica.ServingReplica`/Router logic: state
+    reads hit client-side mirrors (refreshed by every response's
+    ``live`` piggyback), verbs are RPCs with typed errors rehydrated
+    (``QueueFull``/``ValueError`` pass through; anything else fatal
+    comes back as :class:`RemoteFatalError`), and token events re-bind
+    to the router-side ``Request`` mirrors registered at placement."""
+
+    def __init__(self, client: WireClient, hello: Dict):
+        self._client = client
+        self.health = _RemoteHealth(self)
+        self.pool = _RemotePool(hello)
+        self.scheduler = _RemoteScheduler(self, hello)
+        self.metrics = _RemoteMetrics(self)
+        self.eos_id = hello.get("eos_id")
+        self._prefill_chunk = hello.get("prefill_chunk")
+        self._prefix_cache = (True if hello.get("prefix_cache_armed")
+                              else None)
+        self.journal = None  # RemoteReplica wires the proxy in
+        self.journal_path = hello.get("journal_path")
+        self.pid = hello.get("pid")
+        self._requests: Dict[object, Request] = {}
+        self._in_flight = 0
+        self._apply_live(hello.get("live"))
+
+    # ---- transport ----------------------------------------------------
+    def _rpc(self, verb: str, *, arrays: Sequence[np.ndarray] = (),
+             deadline_s: Optional[float] = -1.0,
+             io_timeout_s: Optional[float] = None, **fields
+             ) -> Tuple[Dict, List[np.ndarray]]:
+        try:
+            header, arrs = self._client.call(
+                verb, arrays=arrays, deadline_s=deadline_s,
+                io_timeout_s=io_timeout_s, **fields)
+        except WireDead as e:
+            self.health.mark_wire_dead(str(e).split("—")[0].strip())
+            raise
+        live = header.get("live")
+        if live:
+            self._apply_live(live)
+        if not header.get("ok", True):
+            raise self._rehydrate(header)
+        return header, arrs
+
+    def _control(self, verb: str, **fields) -> None:
+        """Best-effort drain-control RPC: a replica whose transport is
+        already gone cannot be told to drain — the local mirror move
+        stands and the next step reaps it."""
+        try:
+            self._rpc(verb, **fields)
+        except WireDead:
+            pass
+
+    @staticmethod
+    def _rehydrate(header: Dict) -> BaseException:
+        etype = header.get("etype", "Error")
+        msg = header.get("msg", "")
+        if etype == "QueueFull":
+            return QueueFull(msg)
+        if etype == "ValueError":
+            return ValueError(msg)
+        return RemoteFatalError(f"replica reported {etype}: {msg}")
+
+    def _apply_live(self, live: Optional[Dict]) -> None:
+        if not live:
+            return
+        self._in_flight = int(live.get("in_flight", self._in_flight))
+        self.pool.free_slots = int(
+            live.get("free_slots", self.pool.free_slots))
+        self.pool.free_pages = int(live.get("free_pages", -1))
+        self.scheduler.queue_depth = int(
+            live.get("queue_depth", self.scheduler.queue_depth))
+        self.health.apply(live.get("health"))
+        self.metrics.apply(live.get("metrics"))
+        for rec in live.get("failed", ()):
+            request = self._requests.pop(rec.get("uid"), None)
+            if request is None:
+                continue
+            request.state = FAILED
+            request.finish_reason = rec.get("reason", "error")
+            request.error = RemoteRequestError(
+                f"{rec.get('etype', 'Error')}: {rec.get('msg', '')} "
+                f"(on replica)")
+            request.finish_time = time.perf_counter()
+            graftscope.emit("request.failed", cat="request",
+                            req=request.uid,
+                            error=rec.get("etype", "Error"),
+                            where="remote_replica")
+
+    def _events(self, wire_events) -> List[Tuple[Request, int, bool]]:
+        out: List[Tuple[Request, int, bool]] = []
+        for ev in wire_events:
+            request = self._requests.get(ev["u"])
+            if request is None:
+                # an event for a uid this handle never placed would be
+                # a protocol bug — surface it on the bus, never drop
+                # it silently into a correct-looking stream
+                graftscope.emit("wire.orphan_event", cat="wire",
+                                req=ev.get("u"))
+                continue
+            token = int(ev["t"])
+            finished = bool(ev.get("f"))
+            if request.first_token_time is None:
+                request.first_token_time = time.perf_counter()
+            if request.state == QUEUED:
+                request.state = RUNNING
+            request.tokens.append(token)
+            if finished:
+                request.state = ev.get("state", DONE)
+                request.finish_reason = ev.get("reason")
+                request.finish_time = time.perf_counter()
+                self._requests.pop(request.uid, None)
+            out.append((request, token, finished))
+        return out
+
+    # ---- engine verb surface ------------------------------------------
+    @property
+    def in_flight(self) -> int:
+        return self._in_flight
+
+    def enqueue(self, request: Request) -> Request:
+        if request.submit_time is None:
+            request.submit_time = time.perf_counter()
+        self._rpc("submit", req=_req_wire(request))
+        self._requests[request.uid] = request
+        return request
+
+    def step(self) -> List[Tuple[Request, int, bool]]:
+        header, _ = self._rpc("step")
+        return self._events(header.get("events", ()))
+
+    def begin_drain(self, reason: str = "drain") -> None:
+        self.health._local(heal.DRAINING, reason)
+        self._control("begin_drain", reason=reason)
+
+    def drain(self, deadline_s: Optional[float] = None
+              ) -> List[Tuple[Request, int, bool]]:
+        # one long RPC: the server loop runs the whole drain; bound
+        # the call by the drain deadline (plus slack) when one exists
+        # — an UNBOUNDED drain gets a generous io window instead (the
+        # engine drain loop always terminates: finite in-flight work)
+        call_deadline = (600.0 if deadline_s is None
+                         else float(deadline_s) + 60.0)
+        try:
+            header, _ = self._rpc("drain", deadline_s=call_deadline,
+                                  io_timeout_s=call_deadline,
+                                  deadline=deadline_s)
+        except WireDead:
+            return []  # gone mid-drain: its WAL owns the rest
+        return self._events(header.get("events", ()))
+
+    def withdraw_queued(self, max_n: int = 1) -> List[Request]:
+        try:
+            header, _ = self._rpc("withdraw_queued", n=int(max_n))
+        except WireDead:
+            return []  # nothing withdrawn; the reap owns this replica
+        out: List[Request] = []
+        for d in header.get("reqs", ()):
+            request = self._requests.pop(d["uid"], None)
+            if request is None:
+                request = _req_from_wire(d)
+            out.append(request)
+        return out
+
+    def admit_prefilled(self, request: Request, tok0: int, k_pref,
+                        v_pref) -> List[Tuple[Request, int, bool]]:
+        header, _ = self._rpc(
+            "admit_prefilled", req=_req_wire(request), tok0=int(tok0),
+            arrays=[np.asarray(k_pref), np.asarray(v_pref)])
+        self._requests[request.uid] = request
+        return self._events(header.get("events", ()))
+
+    def prefill_detached(self, request: Request,
+                         chunk: Optional[int] = None):
+        header, arrs = self._rpc("prefill_detached",
+                                 req=_req_wire(request), chunk=chunk)
+        k_pref, v_pref = arrs
+        return int(header["tok0"]), k_pref, v_pref
+
+    def redeliver(self, entries, events_out: Optional[list] = None
+                  ) -> List[Request]:
+        wire_entries = [_entry_wire(e) for e in entries]
+        by_uid = {}
+        for entry in entries:
+            request = Request(entry.prompt, entry.max_new_tokens,
+                              entry.eos_id, uid=entry.uid)
+            by_uid[entry.uid] = request
+        header, _ = self._rpc("redeliver", entries=wire_entries)
+        out: List[Request] = []
+        for uid in header.get("uids", ()):
+            request = by_uid[uid]
+            request.submit_time = time.perf_counter()
+            self._requests[uid] = request
+            out.append(request)
+        events = self._events(header.get("events", ()))
+        if events_out is not None:
+            events_out.extend(events)
+        return out
+
+
+class RemoteReplica(ServingReplica):
+    """A :class:`~.replica.ServingReplica` whose engine lives in
+    another process: same handle surface, same router — the transport
+    is the only change (the PR 14 design goal, realized).
+
+    The AIMD admission window, the prefill intake queue and the
+    placement stats logic all run CLIENT-side in the inherited base
+    class, against mirrors the response piggyback keeps fresh; the
+    jitted work happens wherever the :class:`ReplicaServer` lives.
+
+    Args:
+      address: the replica server's ``host:port``.
+      rid: override the server-reported replica id (directory
+        bootstraps pass the roster key).
+      journal_path: override the ``hello``-reported WAL path for the
+        SIGKILL disk fallback (cross-host shared-storage mounts).
+    """
+
+    def __init__(self, address: str, *, rid: Optional[str] = None,
+                 journal_path: Optional[str] = None,
+                 client: Optional[WireClient] = None, **client_kw):
+        client = (WireClient(address, **client_kw) if client is None
+                  else client)
+        hello, _ = client.call("hello")
+        engine = _RemoteEngine(client, hello)
+        path = journal_path or hello.get("journal_path")
+        journal = None
+        if hello.get("journal") or path:
+            journal = _RemoteJournal(engine, path)
+        engine.journal = journal
+        self._client = client
+        super().__init__(rid or hello.get("rid", address),
+                         engine, role=hello.get("role", "both"),
+                         journal=journal, address=address)
+
+    def close(self) -> None:
+        self._client.close()
+
+    def __repr__(self) -> str:
+        return (f"RemoteReplica(rid={self.rid!r}, role={self.role!r}, "
+                f"address={self.address!r}, "
+                f"state={self.engine.health.state!r})")
+
+
+def fleet_from_directory(store, *, run_uid: str = "run",
+                         prefix: str = "fleet",
+                         ttl_s: Optional[float] = None,
+                         **client_kw) -> List[RemoteReplica]:
+    """Bootstrap remote handles from the store-published replica
+    directory (:func:`~..runtime.fleet.replica_directory`): every
+    roster entry with a live address and a non-dead state becomes a
+    :class:`RemoteReplica`. ``ttl_s`` filters entries whose
+    ``published_at`` stamp is stale — a crashed publisher's address is
+    SKIPPED, not dialed forever; an entry that is fresh in the
+    directory but refuses the dial is skipped with a stderr note (the
+    directory is advisory, exactly like the prefix directory)."""
+    from ..runtime import fleet as graftfleet
+
+    directory = graftfleet.replica_directory(
+        store, run_uid=run_uid, prefix=prefix, ttl_s=ttl_s)
+    replicas: List[RemoteReplica] = []
+    for rid in sorted(directory):
+        rec = directory[rid]
+        address = rec.get("address")
+        if not address or rec.get("state") == heal.DEAD:
+            continue
+        try:
+            replicas.append(RemoteReplica(address, rid=rid,
+                                          **client_kw))
+        except (WireDead, OSError, ValueError) as e:
+            print(f"graftwire: directory entry {rid!r} at "
+                  f"{address!r} did not answer "
+                  f"({type(e).__name__}: {e}); skipping it "
+                  "(stale publisher?)", file=sys.stderr)
+    return replicas

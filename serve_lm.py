@@ -215,6 +215,55 @@ parser.add_argument('--router_port', default=0, type=int,
                          '(redelivery-deduped) on /metrics + '
                          '/snapshot.json, aggregated per-replica '
                          'states on /healthz (0 = off)')
+# --- graftwire: the socket transport behind the replica seam ---
+parser.add_argument('--listen', default='', type=str,
+                    metavar='HOST:PORT',
+                    help='graftwire: host THIS engine as ONE replica '
+                         'server behind the framed socket RPC surface '
+                         '(a remote --connect router drives it with '
+                         'in-process semantics). HOST defaults to '
+                         '127.0.0.1, PORT 0 picks a free port — the '
+                         'bound address is printed as "graftwire: '
+                         'listening on HOST:PORT". The process exits '
+                         '0 once a router drains it; SIGTERM flips it '
+                         'DRAINING and, after an idle grace with no '
+                         'router traffic, it drains itself. Pair with '
+                         '--rid/--role (single role) and --journal '
+                         '(the WAL a router redelivers from if this '
+                         'process is killed)')
+parser.add_argument('--rid', default='r0', type=str,
+                    help='graftwire: replica id this server announces '
+                         'in its hello (journal names, directory keys '
+                         'and straggler reports use it)')
+parser.add_argument('--connect', default='', type=str,
+                    metavar='ADDR[,ADDR...]',
+                    help='graftwire: build the fleet from REMOTE '
+                         'replica servers at these host:port '
+                         'addresses instead of in-process engines — '
+                         'the same Router, placement, stealing and '
+                         'redelivery logic runs over the socket '
+                         'transport (streams byte-identical to the '
+                         'in-process fleet). Omit it but pass '
+                         '--fleet_store to bootstrap from the '
+                         'store-published replica_directory roster')
+parser.add_argument('--fleet_store', default='', type=str,
+                    metavar='HOST:PORT',
+                    help='graftwire: TCPStore control-plane address. '
+                         'With --listen the server publishes {role, '
+                         'state, address, published_at} there; with '
+                         'neither --listen nor --connect it is the '
+                         'roster the fleet bootstraps from '
+                         '(stale entries TTL-filtered)')
+parser.add_argument('--fleet_run', default='run', type=str,
+                    help='graftwire: run uid namespacing the replica '
+                         'directory keys on the fleet store')
+parser.add_argument('--fleet_ttl', default=30.0, type=float,
+                    help='graftwire: replica_directory staleness '
+                         'filter — roster entries whose published_at '
+                         'stamp is older than this many seconds are '
+                         'skipped (a crashed publisher ages out '
+                         'instead of being dialed forever; 0 = no '
+                         'filter)')
 # --- graftheal: elastic runtime ---
 parser.add_argument('--drain_deadline_s', default=0.0, type=float,
                     help='graceful-drain bound: on SIGTERM (or source '
@@ -239,6 +288,18 @@ parser.add_argument('--restart_backoff', default=1.0, type=float,
                     help='first-restart delay in seconds (doubles per '
                          'restart, capped at 30s)')
 graftscope.add_cli_args(parser, stats_port=True)
+
+
+def _fleet_store(addr):
+    """Dial the control-plane TCPStore behind --fleet_store."""
+    from pytorch_multiprocessing_distributed_tpu.runtime.store import (
+        TCPStore)
+
+    host, _, port = addr.rpartition(':')
+    if not port.isdigit():
+        raise SystemExit(
+            f"--fleet_store must be HOST:PORT, got {addr!r}")
+    return TCPStore(host or '127.0.0.1', int(port))
 
 
 def _load_requests(args, vocab_size, skipped):
@@ -391,6 +452,82 @@ def main():
             draft_model=draft_model,
             draft_params=draft_params,
             journal=journal)
+
+    # ---- graftwire: host this engine as one replica server ----------
+    if args.listen:
+        if args.replicas > 1 or args.connect:
+            raise SystemExit(
+                "--listen hosts ONE replica server; run one process "
+                "per replica and point a --connect router at them")
+        if args.role not in ('both', 'prefill', 'decode'):
+            raise SystemExit(
+                "--listen needs a single role: --role both|prefill|"
+                "decode (the 'split'/csv forms describe a whole "
+                "fleet, which the --connect router owns)")
+        from pytorch_multiprocessing_distributed_tpu.serving import (
+            ReplicaServer)
+
+        journal = (heal.RequestJournal(args.journal) if args.journal
+                   else None)
+        engine = build_engine(journal)
+        store = (_fleet_store(args.fleet_store) if args.fleet_store
+                 else None)
+        host, _, port = args.listen.rpartition(':')
+        if not port.isdigit():
+            raise SystemExit(
+                f"--listen must be HOST:PORT (PORT 0 = pick free), "
+                f"got {args.listen!r}")
+        server = ReplicaServer(
+            engine, rid=args.rid, role=args.role,
+            host=host or '127.0.0.1', port=int(port), store=store,
+            run_uid=args.fleet_run)
+        server.start()
+        print(f"graftwire: listening on {server.address} "
+              f"(rid={args.rid} role={args.role})", flush=True)
+        prev_handler = heal.install_drain_handler(engine)
+        stats_server = None
+        if args.stats_port:
+            engine.metrics.bound_samples(8192)
+
+            def live_snapshot():
+                snap = engine.metrics.snapshot()
+                ledger = hbm.active_ledger()
+                if ledger is not None:
+                    snap.update(ledger.snapshot())
+                from pytorch_multiprocessing_distributed_tpu.runtime \
+                    import wire as graftwire
+
+                snap.update(graftwire.wire_meter())
+                return snap
+
+            stats_server = graftscope.start_stats_server(
+                live_snapshot, port=args.stats_port,
+                health_fn=lambda: heal.healthz(
+                    engine.health, heal.active_monitor()),
+                events_fn=graftscope.scope_events_fn)
+            print(f"stats: http://127.0.0.1:"
+                  f"{stats_server.server_address[1]}/metrics "
+                  f"(+ /healthz)", flush=True)
+        try:
+            with graftscope.flight_recorder("serve_lm replica server"):
+                server.serve_forever(
+                    drain_deadline_s=args.drain_deadline_s or None)
+        finally:
+            heal.restore_drain_handler(prev_handler)
+            if stats_server is not None:
+                stats_server.shutdown()
+        from pytorch_multiprocessing_distributed_tpu.runtime import (
+            wire as graftwire)
+
+        snap = engine.metrics.snapshot()
+        snap.update(graftwire.wire_meter())
+        print("metrics: " + json.dumps(snap, sort_keys=True),
+              flush=True)
+        if args.metrics_out:
+            with open(args.metrics_out, "w") as f:
+                json.dump(snap, f, indent=2, sort_keys=True)
+        graftscope.export_from_args(args)
+        return
 
     def emit(events):
         if args.quiet:
@@ -551,41 +688,79 @@ def main():
                 stats_server.shutdown()
         return engine
 
-    # ---- graftroute: in-process fleet behind one router -------------
-    fleet_mode = args.replicas > 1 or args.role != 'both'
+    # ---- graftroute: fleet behind one router (in-process replicas,
+    # or graftwire remote replica servers via --connect/--fleet_store)
+    remote_mode = bool(args.connect or args.fleet_store)
+    fleet_mode = (args.replicas > 1 or args.role != 'both'
+                  or remote_mode)
     if fleet_mode:
         from pytorch_multiprocessing_distributed_tpu.serving import (
-            FleetSaturated, Router, ServingReplica)
+            FleetSaturated, RemoteReplica, Router, ServingReplica,
+            fleet_from_directory)
 
-        if args.replicas < 1:
-            raise SystemExit("--replicas must be >= 1")
-        if args.role == 'both':
-            roles = ['both'] * args.replicas
-        elif args.role == 'split':
-            if args.replicas < 2:
+        roles = []
+        if not remote_mode:
+            if args.replicas < 1:
+                raise SystemExit("--replicas must be >= 1")
+            if args.role == 'both':
+                roles = ['both'] * args.replicas
+            elif args.role == 'split':
+                if args.replicas < 2:
+                    raise SystemExit(
+                        "--role split needs --replicas >= 2 (one "
+                        "prefill replica handing KV blocks to >= 1 "
+                        "decode replica)")
+                roles = ['prefill'] + ['decode'] * (args.replicas - 1)
+            else:
+                roles = [r.strip() for r in args.role.split(',')]
+                if len(roles) != args.replicas:
+                    raise SystemExit(
+                        f"--role lists {len(roles)} role(s) for "
+                        f"--replicas {args.replicas}")
+            if not any(r in ('both', 'decode') for r in roles):
                 raise SystemExit(
-                    "--role split needs --replicas >= 2 (one prefill "
-                    "replica handing KV blocks to >= 1 decode replica)")
-            roles = ['prefill'] + ['decode'] * (args.replicas - 1)
-        else:
-            roles = [r.strip() for r in args.role.split(',')]
-            if len(roles) != args.replicas:
-                raise SystemExit(
-                    f"--role lists {len(roles)} role(s) for "
-                    f"--replicas {args.replicas}")
-        if not any(r in ('both', 'decode') for r in roles):
-            raise SystemExit(
-                "at least one replica must be decode-capable (role "
-                "'both' or 'decode') — a prefill-only fleet can never "
-                "emit a token")
+                    "at least one replica must be decode-capable "
+                    "(role 'both' or 'decode') — a prefill-only "
+                    "fleet can never emit a token")
 
-        def serve_fleet_once(attempt):
-            """One fleet incarnation: build N replicas behind one
-            router (replaying each replica's journal token-exact),
-            pump the source through fleet placement, drain
-            gracefully. A replica death mid-run is absorbed INSIDE
-            the router (journal redelivery to peers); only a
-            whole-fleet fatal (FleetDead) reaches the supervisor."""
+        def build_fleet():
+            """The fleet's replica handles: remote graftwire servers
+            (roles/journals live server-side, announced in hello), or
+            the classic in-process engines."""
+            def require_decode(replicas):
+                # the remote twin of the in-process roles check —
+                # validated HERE, at build time, so a prefill-only
+                # fleet exits named instead of burning the whole
+                # supervisor restart budget on FleetDead loops
+                if not any(r.role in ('both', 'decode')
+                           for r in replicas):
+                    raise SystemExit(
+                        "graftwire: no decode-capable replica among "
+                        "the remote servers (roles: "
+                        + ", ".join(f"{r.rid}={r.role}"
+                                    for r in replicas)
+                        + ") — a prefill-only fleet can never emit "
+                        "a token")
+                return replicas
+
+            if args.connect:
+                addrs = [a.strip() for a in args.connect.split(',')
+                         if a.strip()]
+                return require_decode([RemoteReplica(a)
+                                       for a in addrs])
+            if args.fleet_store:
+                replicas = fleet_from_directory(
+                    _fleet_store(args.fleet_store),
+                    run_uid=args.fleet_run,
+                    ttl_s=args.fleet_ttl or None)
+                if not replicas:
+                    raise SystemExit(
+                        "graftwire: the replica directory at "
+                        f"{args.fleet_store!r} (run "
+                        f"{args.fleet_run!r}) yielded no live "
+                        "replica — are the --listen servers up and "
+                        "publishing?")
+                return require_decode(replicas)
             replicas = []
             for i, role in enumerate(roles):
                 rid = f"r{i}"
@@ -596,6 +771,16 @@ def main():
                 replicas.append(ServingReplica(
                     rid, build_engine(journal), role=role,
                     journal=journal))
+            return replicas
+
+        def serve_fleet_once(attempt):
+            """One fleet incarnation: build N replicas behind one
+            router (replaying each replica's journal token-exact),
+            pump the source through fleet placement, drain
+            gracefully. A replica death mid-run is absorbed INSIDE
+            the router (journal redelivery to peers); only a
+            whole-fleet fatal (FleetDead) reaches the supervisor."""
+            replicas = build_fleet()
             router = Router(replicas)
             if attempt:
                 print(f"graftheal: restart {attempt}: fleet rebuilt "
@@ -690,6 +875,11 @@ def main():
             snap.get("per_replica", {})))
         snap["fleet_state"] = router.healthz()["state_name"]
         snap.update(fleet.goodput_gauges())
+        if remote_mode:
+            from pytorch_multiprocessing_distributed_tpu.runtime \
+                import wire as graftwire
+
+            snap.update(graftwire.wire_meter())
         print("metrics: " + json.dumps(snap, sort_keys=True),
               flush=True)
         if args.metrics_out:

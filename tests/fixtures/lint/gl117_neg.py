@@ -1,0 +1,54 @@
+"""GL117 near-miss negatives: every blocking socket op here has a
+timeout/deadline established in its scope chain (function, class, or
+via a bounded-call helper), plus a lookalike ``.connect`` on a
+non-socket receiver."""
+import socket
+
+
+def read_reply(sock):
+    sock.settimeout(2.0)
+    return sock.recv(4096)
+
+
+def serve(listener):
+    listener.settimeout(0.5)
+    conn, _ = listener.accept()
+    return conn
+
+
+def dial(host, port):
+    return socket.create_connection((host, port), 5.0)
+
+
+def dial_kw(host, port):
+    return socket.create_connection((host, port), timeout=5.0)
+
+
+class Client:
+    # the configure-in-__init__, read-in-a-method shape: class-level
+    # evidence clears every method's socket ops
+    def __init__(self, sock):
+        self._sock = sock
+        self._sock.settimeout(3.0)
+
+    def read(self):
+        return self._sock.recv(1024)
+
+    def redial(self, host, port):
+        self._sock.connect((host, port))
+
+
+def bounded(sock, run_with_timeout):
+    # a watchdog-bounded call IS the deadline
+    return run_with_timeout(lambda: sock.recv(64), 1.0, "recv")
+
+
+def guarded(sock, ensure_timeout):
+    # the repo's canonical guard helper (wire._ensure_timeout shape)
+    ensure_timeout(sock)
+    return sock.recv(64)
+
+
+def lookalike(message_bus):
+    # not a socket: a pub/sub client's connect verb
+    return message_bus.connect("topic")

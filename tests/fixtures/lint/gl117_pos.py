@@ -1,0 +1,40 @@
+"""GL117 positives: blocking socket ops with no timeout/deadline in
+scope — the distributed-hang class. A silent peer parks each of these
+forever: no named error, no timeline, no recovery."""
+import socket
+
+
+def read_reply(sock):
+    return sock.recv(4096)                      # <- GL117
+
+
+def serve(listener):
+    conn, _ = listener.accept()                 # <- GL117
+    return conn
+
+
+def dial(host, port):
+    sock = socket.socket()
+    sock.connect((host, port))                  # <- GL117
+    return sock
+
+
+def dial_convenience(host, port):
+    return socket.create_connection((host, port))   # <- GL117
+
+
+def dial_explicitly_unbounded(host, port):
+    # timeout=None REQUESTS an unbounded connect: not evidence, and
+    # flagged itself — the keyword's mere presence is no deadline
+    return socket.create_connection((host, port), timeout=None)  # <- GL117
+
+
+def stream_lines(sock):
+    return sock.makefile("rb").readline()       # <- GL117
+
+
+def unrelated_scope_has_timeout(other_sock):
+    # evidence here must NOT clear the functions above: a timeout on a
+    # DIFFERENT socket in a DIFFERENT scope is exactly the false
+    # comfort that leaves the accept loop unbounded
+    other_sock.settimeout(1.0)

@@ -37,6 +37,7 @@ from pytorch_multiprocessing_distributed_tpu.serving import (
 from pytorch_multiprocessing_distributed_tpu.parallel import dist  # noqa: F401
 from pytorch_multiprocessing_distributed_tpu.runtime import heal
 from pytorch_multiprocessing_distributed_tpu.runtime import store  # noqa: F401
+from pytorch_multiprocessing_distributed_tpu.runtime import wire
 from pytorch_multiprocessing_distributed_tpu.runtime.store import MemStore
 from pytorch_multiprocessing_distributed_tpu.train import (  # noqa: F401
     checkpoint as ckpt_mod, orbax_ckpt)
@@ -459,6 +460,94 @@ def _scenario_restart(chaos):
     assert calls == [0, 2]
 
 
+def _scenario_wire_connect(chaos):
+    """error x1 at the TCP connect: the lazy-connect retry path dials
+    again and the (idempotent) call lands; unlimited connect errors
+    fail fast as a NAMED WireDead — a replica that cannot be dialed
+    is a lost replica, never a spin."""
+    with wire.WireServer({"ping": lambda h, a: {}}) as server:
+        plan = FaultPlan([FaultRule("wire.connect", "error", times=1)])
+        with armed(plan):
+            client = wire.WireClient(server.address, backoff_s=0.0)
+            assert client.call("ping")[0]["ok"]
+            client.close()
+        assert plan.triggered() == 1
+        with armed(FaultPlan([FaultRule("wire.connect", "error",
+                                        times=0)])):
+            client = wire.WireClient(server.address, backoff_s=0.0)
+            with pytest.raises(wire.WireDead):
+                client.call("ping")
+            client.close()
+
+
+def _scenario_wire_send(chaos):
+    """error x1 at the frame send: an IDEMPOTENT verb reconnects and
+    retries to success; a NON-idempotent verb fails fast as a named
+    WireDead (commit-ambiguous — redelivery, not a retry, is the
+    exactly-once recovery); a CORRUPT send is detected by the
+    receiver's frame sanity checks, the connection drops, and the
+    idempotent retry resends clean."""
+    handlers = {"ping": lambda h, a: {}, "mutate": lambda h, a: {}}
+    with wire.WireServer(handlers) as server:
+        plan = FaultPlan([FaultRule("wire.send", "error", times=1)])
+        with armed(plan):
+            client = wire.WireClient(server.address, backoff_s=0.0)
+            assert client.call("ping")[0]["ok"]
+        assert plan.triggered() == 1
+        client.close()
+        with armed(FaultPlan([FaultRule("wire.send", "error",
+                                        times=1)])):
+            client = wire.WireClient(server.address, backoff_s=0.0)
+            with pytest.raises(wire.WireDead,
+                               match="not idempotent"):
+                client.call("mutate")
+            client.close()
+        corrupt = FaultPlan([FaultRule("wire.send", "corrupt",
+                                       times=1)])
+        with armed(corrupt):
+            client = wire.WireClient(server.address, backoff_s=0.0,
+                                     call_deadline_s=5.0)
+            assert client.call("ping")[0]["ok"]
+            client.close()
+        assert corrupt.triggered() == 1
+        # corruption of the RESPONSE frame (after=1 skips the
+        # client's request send and flips the server's reply): the
+        # CLIENT's frame sanity checks raise WireError, the socket
+        # drops, and the idempotent retry recovers — corruption
+        # never escapes raw in either direction
+        resp_corrupt = FaultPlan([FaultRule("wire.send", "corrupt",
+                                            times=1, after=1)])
+        with armed(resp_corrupt):
+            client = wire.WireClient(server.address, backoff_s=0.0,
+                                     call_deadline_s=5.0)
+            assert client.call("ping")[0]["ok"]
+            client.close()
+        assert resp_corrupt.triggered() == 1
+
+
+def _scenario_wire_recv(chaos):
+    """error x1 at the frame receive (fires on whichever side reads
+    the next arriving frame): the connection drops and the idempotent
+    retry recovers; a HANG at recv is bounded by the per-call
+    run_with_timeout deadline — recovered on the retry, never a
+    distributed hang."""
+    with wire.WireServer({"ping": lambda h, a: {}}) as server:
+        plan = FaultPlan([FaultRule("wire.recv", "error", times=1)])
+        with armed(plan):
+            client = wire.WireClient(server.address, backoff_s=0.0)
+            assert client.call("ping")[0]["ok"]
+            client.close()
+        assert plan.triggered() == 1
+        hang = FaultPlan([FaultRule("wire.recv", "hang", times=1,
+                                    hang_s=1.0)])
+        with armed(hang):
+            client = wire.WireClient(server.address, backoff_s=0.0,
+                                     call_deadline_s=0.3)
+            assert client.call("ping")[0]["ok"]
+            client.close()
+        assert hang.triggered() == 1
+
+
 SCENARIOS = {
     "serving.decode_dispatch": _scenario_dispatch,
     "serving.horizon_readback": _scenario_readback,
@@ -475,6 +564,9 @@ SCENARIOS = {
     "heartbeat.read": _scenario_heartbeat_read,
     "heal.journal_write": _scenario_journal_write,
     "heal.restart": _scenario_restart,
+    "wire.connect": _scenario_wire_connect,
+    "wire.send": _scenario_wire_send,
+    "wire.recv": _scenario_wire_recv,
 }
 
 

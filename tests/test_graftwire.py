@@ -1,0 +1,600 @@
+"""graftwire: the socket transport behind the fleet's replica seam.
+
+The headline pins (ISSUE 15 acceptance):
+- a 2-replica SOCKET fleet streams byte-identical to the single-engine
+  baseline (and, transitively through test_graftroute's pin, to the
+  in-process fleet) — dense and paged, chunked prefill, H>1;
+- a replica whose server dies mid-run (socket-level kill — the fast
+  stand-in for SIGKILL; the slow smoke kills a real process)
+  redelivers its journal to peers under ORIGINAL uids token-exact,
+  and the fleet metrics merge dedups the replayed prefix;
+- the journal-less fallback holds over the wire too: the router's own
+  records (client-side mirrors) reconstruct the redelivery;
+- ``PageTransfer`` crosses the wire as raw framed numpy (split-mode
+  prefill->decode token-exact vs monolithic, bytes metered);
+- framing rejects garbage loudly (bad magic / oversized header /
+  truncation = named ``WireError``, never a silent resync);
+- transport failures are NAMED and bounded: deadlines through
+  ``run_with_timeout``, reconnect-retries on idempotent verbs only, a
+  commit-ambiguous failure on a non-idempotent verb = ``WireDead``
+  (the same class the reap traps already catch);
+- the store-published replica directory ages out crashed publishers
+  (``published_at`` + TTL) instead of serving a dead address forever.
+
+All host-side: graftcheck fingerprints and cost budgets cannot move
+(no jitted program changes — ``make check`` pins that globally).
+"""
+
+import json
+import os
+import socket
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+from pytorch_multiprocessing_distributed_tpu import models
+from pytorch_multiprocessing_distributed_tpu.runtime import (
+    faults, fleet as graftfleet, heal, wire)
+from pytorch_multiprocessing_distributed_tpu.runtime.store import (
+    MemStore)
+from pytorch_multiprocessing_distributed_tpu.runtime.wire import (
+    WireClient, WireDead, WireError, WireServer, recv_frame,
+    send_frame)
+from pytorch_multiprocessing_distributed_tpu.serving import (
+    RemoteReplica, ReplicaServer, Router, ServingEngine,
+    ServingReplica, init_params)
+from pytorch_multiprocessing_distributed_tpu.serving.scheduler import (
+    Request)
+
+pytestmark = pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+
+
+def _tiny(**kw):
+    return models.GPT(vocab_size=61, max_seq_len=64, hidden_size=32,
+                      num_layers=2, num_heads=2, mlp_dim=64,
+                      attn_impl="xla", **kw)
+
+
+@pytest.fixture(scope="module")
+def served():
+    model = _tiny()
+    params = init_params(model, 1)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, model.vocab_size, (n,)).tolist()
+               for n in (3, 7, 12, 5, 9, 6)]
+    return model, params, prompts
+
+
+def _engine(model, params, **kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("s_max", 32)
+    kw.setdefault("min_bucket", 8)
+    kw.setdefault("retry_backoff_s", 0.0)
+    return ServingEngine(model, params, **kw)
+
+
+@pytest.fixture(scope="module")
+def baseline(served):
+    """Single-engine reference streams (uid -> tokens), max_new=6."""
+    model, params, prompts = served
+    engine = _engine(model, params)
+    done = engine.serve([(p, 6) for p in prompts])
+    return {f"u{i}": list(r.tokens) for i, r in enumerate(done)}
+
+
+def _remote(address, **kw):
+    kw.setdefault("backoff_s", 0.0)
+    return RemoteReplica(address, **kw)
+
+
+def _socket_fleet(served, journals=None, roles=None, **ekw):
+    """N ReplicaServers (threaded, real localhost sockets) + their
+    RemoteReplica handles behind one Router."""
+    model, params, prompts = served
+    roles = roles or ["both", "both"]
+    servers = []
+    for i, role in enumerate(roles):
+        journal = journals[i] if journals else None
+        engine = _engine(model, params, journal=journal, **ekw)
+        servers.append(ReplicaServer(engine, rid=f"r{i}",
+                                     role=role).start())
+    replicas = [_remote(s.address) for s in servers]
+    return Router(replicas), servers, replicas
+
+
+def _stop_all(servers):
+    for s in servers:
+        s.stop()
+
+
+# ------------------------------------------------------------- framing
+
+def test_frame_round_trip_preserves_arrays():
+    a, b = socket.socketpair()
+    try:
+        a.settimeout(5.0)
+        b.settimeout(5.0)
+        k = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        v = np.array([[1, -2], [3, 4]], dtype=np.int32)
+        send_frame(a, {"verb": "x", "n": 7, "s": "hi"}, [k, v])
+        header, arrays = recv_frame(b)
+        assert header["verb"] == "x" and header["n"] == 7
+        assert header["s"] == "hi"
+        assert len(arrays) == 2
+        np.testing.assert_array_equal(arrays[0], k)
+        assert arrays[0].dtype == np.float32
+        np.testing.assert_array_equal(arrays[1], v)
+        assert arrays[1].dtype == np.int32
+    finally:
+        a.close()
+        b.close()
+
+
+def test_frame_round_trip_bf16():
+    ml_dtypes = pytest.importorskip("ml_dtypes")
+    a, b = socket.socketpair()
+    try:
+        a.settimeout(5.0)
+        b.settimeout(5.0)
+        blk = np.arange(8, dtype=np.float32).astype(
+            ml_dtypes.bfloat16).reshape(2, 4)
+        send_frame(a, {"verb": "kv"}, [blk])
+        _, arrays = recv_frame(b)
+        assert arrays[0].dtype == blk.dtype
+        np.testing.assert_array_equal(
+            arrays[0].astype(np.float32), blk.astype(np.float32))
+    finally:
+        a.close()
+        b.close()
+
+
+def test_frame_rejects_garbage_named():
+    # a WireError marks the CONNECTION desynced (the reader drops it),
+    # so each corruption case gets a fresh pair
+    def fresh():
+        a, b = socket.socketpair()
+        a.settimeout(5.0)
+        b.settimeout(5.0)
+        return a, b
+
+    a, b = fresh()
+    a.sendall(b"NOPE" + b"\x00\x00\x00\x04junk")
+    with pytest.raises(WireError, match="magic"):
+        recv_frame(b)
+    a.close()
+    b.close()
+    # oversized header claim: named, never a giant allocation
+    a, b = fresh()
+    a.sendall(wire.MAGIC + (2**31 - 1).to_bytes(4, "big"))
+    with pytest.raises(WireError, match="claims"):
+        recv_frame(b)
+    a.close()
+    b.close()
+    # truncation mid-frame: the peer hangs up -> ConnectionError
+    a, b = fresh()
+    a.sendall(wire.MAGIC + (64).to_bytes(4, "big") + b"{Truncat")
+    a.close()
+    with pytest.raises((ConnectionError, OSError)):
+        recv_frame(b)
+    b.close()
+    # a descriptor whose nbytes contradicts its own shape x dtype is
+    # a TYPED WireError, never a raw reshape ValueError
+    a, b = fresh()
+    head = json.dumps({"_arrays": [{"shape": [4, 4],
+                                    "dtype": "float32",
+                                    "nbytes": 100}]}).encode()
+    a.sendall(wire.MAGIC + len(head).to_bytes(4, "big") + head
+              + b"\x00" * 100)
+    with pytest.raises(WireError, match="descriptor"):
+        recv_frame(b)
+    a.close()
+    b.close()
+
+
+# ------------------------------------------------------- client/server
+
+def test_rpc_echo_and_unknown_verb():
+    calls = []
+
+    def echo(header, arrays):
+        calls.append(header)
+        return ({"echo": header.get("x")},
+                [np.asarray(a) * 2 for a in arrays])
+
+    with WireServer({"echo": echo}) as server:
+        client = WireClient(server.address, backoff_s=0.0)
+        resp, arrays = client.call("echo", x=41,
+                                   arrays=[np.ones(3, np.float32)])
+        assert resp["ok"] and resp["echo"] == 41
+        np.testing.assert_array_equal(
+            arrays[0], 2 * np.ones(3, np.float32))
+        # unknown verb: a typed refusal naming what the server speaks
+        resp, _ = client.call("nope")
+        assert resp["ok"] is False and "unknown verb" in resp["msg"]
+        # the meter saw both directions
+        meter = wire.wire_meter()
+        assert meter["wire_bytes_sent"] > 0
+        assert meter["wire_bytes_recv"] > 0
+        client.close()
+
+
+def test_rpc_deadline_names_the_hang():
+    """A wedged handler surfaces as a NAMED WireDead (chaining the
+    FaultTimeout) within the per-call deadline — never a hang."""
+    def slow(header, arrays):
+        time.sleep(2.0)
+        return {}
+
+    with WireServer({"slow": slow}) as server:
+        client = WireClient(server.address, call_deadline_s=0.3,
+                            backoff_s=0.0)
+        t0 = time.perf_counter()
+        with pytest.raises(WireDead, match="slow"):
+            client.call("slow")
+        assert time.perf_counter() - t0 < 1.5
+        client.close()
+
+
+def test_transport_failure_semantics():
+    """Idempotent verbs reconnect-and-retry through a server restart
+    window; non-idempotent verbs fail NAMED (commit-ambiguous) the
+    moment the transport dies."""
+    server = WireServer({"ping": lambda h, a: {},
+                         "mutate": lambda h, a: {}}).start()
+    client = WireClient(server.address, backoff_s=0.0)
+    assert client.call("ping")[0]["ok"]
+    # kill every live connection: the next idempotent call sees a
+    # dead socket, reconnects, and succeeds
+    server.kill_connections()
+    assert client.call("ping")[0]["ok"]
+    # now the server is GONE: non-idempotent -> WireDead, named
+    addr = server.address
+    server.stop()
+    client2 = WireClient(addr, backoff_s=0.0)
+    with pytest.raises(WireDead, match="not idempotent"):
+        client2.call("mutate")
+    client.close()
+    client2.close()
+
+
+# ---------------------------------------------- socket fleet: identity
+
+def test_socket_fleet_streams_byte_identical(served, baseline):
+    """THE acceptance pin: 2 replicas in (thread-hosted) separate
+    servers over real localhost sockets, every stream byte-identical
+    to the single-engine baseline, merged token count exact, both
+    replicas actually serving."""
+    model, params, prompts = served
+    router, servers, _ = _socket_fleet(served)
+    try:
+        # submit with explicit uids so streams key against baseline
+        records = []
+        for i, p in enumerate(prompts):
+            records.append(router.submit(p, 6, uid=f"u{i}"))
+        for _ in router.run():
+            pass
+        for i, request in enumerate(records):
+            assert request.state == "done"
+            assert list(request.tokens) == baseline[f"u{i}"], \
+                f"stream u{i} diverged over the wire"
+        merged = router.merged_metrics()
+        assert merged["tokens_generated"] == sum(
+            len(t) for t in baseline.values())
+        per = merged["per_replica"]
+        assert all(s["requests_completed"] > 0 for s in per.values())
+    finally:
+        _stop_all(servers)
+
+
+def test_split_fleet_paged_chunked_horizon_over_wire(served):
+    """The hard matrix point AND the PageTransfer framing pin in one
+    fleet (engine builds are the fast-suite budget — no compile is
+    spent twice): a prefill/decode split fleet with paged KV + chunked
+    prefill + H=4 horizon serves token-exact vs the same-config
+    single engine, every prompt's KV block riding the wire as raw
+    framed numpy spliced at the decode replica's OWN write_ids, with
+    transfer bytes metered at BOTH layers (PageTransfer payload and
+    the wire meter)."""
+    model, params, prompts = served
+    cfg = dict(kv_layout="paged", page_size=8, prefill_chunk=4,
+               decode_horizon=4)
+    ref = [list(r.tokens) for r in _engine(model, params, **cfg).serve(
+        (p, 6) for p in prompts)]
+    meter0 = wire.wire_meter()["wire_bytes_sent"]
+    router, servers, _ = _socket_fleet(
+        served, roles=["prefill", "decode"], **cfg)
+    try:
+        out = router.serve([(p, 6) for p in prompts])
+        assert [list(r.tokens) for r in out] == ref
+        assert router.transfers_routed == len(prompts)
+        assert router.transfer_bytes > 0
+        # the wire carried at least the KV payload bytes
+        sent = wire.wire_meter()["wire_bytes_sent"] - meter0
+        assert sent >= router.transfer_bytes
+    finally:
+        _stop_all(servers)
+
+
+# ------------------------------------------------ death -> redelivery
+
+def test_killed_server_redelivers_token_exact(served, baseline,
+                                              tmp_path):
+    """The SIGKILL semantics pin (socket-level kill — the slow smoke
+    does it to a real process): the victim's sockets die mid-run, the
+    router reaps it on the named WireDead, reads its WAL from the
+    router-known path, redelivers to the peer under ORIGINAL uids
+    token-exact, and the merged metrics dedup the replayed prefix."""
+    model, params, prompts = served
+    journals = [heal.RequestJournal(str(tmp_path / f"wal{i}.jsonl"))
+                for i in range(2)]
+    router, servers, replicas = _socket_fleet(served,
+                                              journals=journals)
+    try:
+        for i, p in enumerate(prompts):
+            router.submit(p, 6, uid=f"u{i}")
+        for _ in range(3):
+            router.step()  # tokens into both WALs before the kill
+        victim = max(replicas, key=lambda r: r.in_flight)
+        assert victim.in_flight > 0
+        servers[replicas.index(victim)].kill()
+        while router.in_flight:
+            router.step()
+        assert victim.reaped
+        assert victim.engine.health.dead
+        assert "WireDead" in victim.engine.health.reason
+        assert router.requests_redelivered >= 1
+        records = router.records()
+        for uid, want in baseline.items():
+            assert list(records[uid].tokens) == want, \
+                f"stream {uid} diverged across the kill"
+        merged = router.merged_metrics()
+        assert merged["tokens_generated"] == sum(
+            len(t) for t in baseline.values()), \
+            "redelivery dedup broke the fleet token count"
+        hz = router.healthz()
+        assert hz["state_name"] == "READY"
+        assert hz["replicas"][victim.rid]["state_name"] == "DEAD"
+    finally:
+        _stop_all(servers)
+
+
+@pytest.mark.slow
+def test_journal_less_kill_falls_back_to_records(served, baseline):
+    """The documented journal-less fallback, over the wire: with no
+    WAL anywhere, the router's own records — the client-side mirrors,
+    which hold every token actually delivered — reconstruct the
+    redelivery, still token-exact."""
+    model, params, prompts = served
+    router, servers, replicas = _socket_fleet(served)
+    try:
+        for i, p in enumerate(prompts):
+            router.submit(p, 6, uid=f"u{i}")
+        for _ in range(3):
+            router.step()
+        victim = max(replicas, key=lambda r: r.in_flight)
+        assert victim.journal is None  # no WAL, no path: records path
+        servers[replicas.index(victim)].kill()
+        while router.in_flight:
+            router.step()
+        records = router.records()
+        for uid, want in baseline.items():
+            assert list(records[uid].tokens) == want
+    finally:
+        _stop_all(servers)
+
+
+@pytest.mark.slow
+def test_recover_replays_wals_over_wire(served, baseline, tmp_path):
+    """Whole-fleet supervised-restart recovery across processes: both
+    servers die mid-run (named FleetDead at the router), fresh servers
+    reopen the same WAL paths, a fresh router's ``recover`` replays
+    every journal over RPC — streams complete token-exact."""
+    model, params, prompts = served
+    paths = [str(tmp_path / f"wal{i}.jsonl") for i in range(2)]
+    journals = [heal.RequestJournal(p) for p in paths]
+    router, servers, replicas = _socket_fleet(served,
+                                              journals=journals)
+    for i, p in enumerate(prompts):
+        router.submit(p, 6, uid=f"u{i}")
+    for _ in range(3):
+        router.step()
+    for s in servers:
+        s.kill()
+    with pytest.raises(faults.GraftFaultError):
+        while True:
+            router.step()
+    # fresh incarnation on the SAME WALs
+    router2, servers2, _ = _socket_fleet(
+        served, journals=[heal.RequestJournal(p) for p in paths])
+    try:
+        events = []
+        redelivered = router2.recover(events_out=events)
+        assert redelivered  # the crash left unfinished work
+        for _ in router2.run():
+            pass
+        records = router2.records()
+        for request in redelivered:
+            assert list(records[request.uid].tokens) == \
+                baseline[request.uid], \
+                f"recovered stream {request.uid} diverged"
+    finally:
+        _stop_all(servers2)
+
+
+# -------------------------------------------------- fleet verbs parity
+
+def test_remote_handle_surface_parity(served):
+    """The remote handle serves the SAME snapshot()/health() shapes as
+    the in-process one — the PR 14 seam contract, now across a
+    socket."""
+    model, params, prompts = served
+    local = ServingReplica("L", _engine(model, params))
+    server = ReplicaServer(_engine(model, params), rid="R").start()
+    try:
+        remote = _remote(server.address)
+        assert remote.rid == "R"
+        ls, rs = local.snapshot(), remote.snapshot()
+        assert set(ls) == set(rs), (
+            f"snapshot key drift: {set(ls) ^ set(rs)}")
+        lh, rh = local.health(), remote.health()
+        for key in ("rid", "role", "state", "state_name", "reason"):
+            assert key in lh and key in rh
+        assert rh["state_name"] == "READY"
+        assert remote.admittable()
+        assert remote.load()[0] == 0
+    finally:
+        server.stop()
+
+
+def test_withdraw_requeue_handoff_verbs(served, tmp_path):
+    """The work-stealing verb surface, host-side (no decode — the
+    cheap per-component pin; the full steal e2e is slow-marked):
+    withdraw parks the request server-side, requeue restores it with
+    its identity intact, and a handoff journals the transfer on the
+    victim so redelivery can never resurrect a stolen uid."""
+    model, params, prompts = served
+    journal = heal.RequestJournal(str(tmp_path / "wal.jsonl"))
+    server = ReplicaServer(
+        _engine(model, params, journal=journal), rid="V").start()
+    try:
+        victim = _remote(server.address)
+        r0 = victim.engine.enqueue(Request(prompts[0], 6, uid="s0"))
+        victim.engine.enqueue(Request(prompts[1], 6, uid="s1"))
+        assert server.engine.scheduler.queue_depth == 2
+        out = victim.engine.withdraw_queued(1)
+        assert [r.uid for r in out] == ["s1"]  # the tail
+        assert out[0] is not r0
+        assert server.engine.scheduler.queue_depth == 1
+        # refused theft: back on the victim's tail, same uid
+        victim.engine.scheduler.requeue_tail(out[0])
+        assert server.engine.scheduler.queue_depth == 2
+        # accepted theft: terminal "handoff" on the victim's WAL — a
+        # later crash of the victim can never redeliver a stolen uid
+        out = victim.engine.withdraw_queued(1)
+        assert out[0].uid == "s1"
+        victim.journal.record_handoff(out[0], to="thief")
+        assert victim.journal.known("s1")
+        assert all(e.uid != "s1"
+                   for e in victim.journal.unfinished())
+    finally:
+        server.stop()
+
+
+@pytest.mark.slow
+def test_steal_and_drain_over_wire(served, baseline):
+    """Work stealing's withdraw/requeue/handoff verbs and the fleet
+    drain all run over the transport: a victim's queue tail moves to
+    the idle thief (handoff journaled on the victim), every stream
+    stays byte-exact, and the drain lands every server engine DEAD
+    with its WAL compacted empty."""
+    model, params, prompts = served
+    with tempfile.TemporaryDirectory() as tmp:
+        journals = [heal.RequestJournal(os.path.join(tmp, f"w{i}"))
+                    for i in range(2)]
+        router, servers, replicas = _socket_fleet(served,
+                                                  journals=journals)
+        try:
+            thief, victim = replicas
+            thief.window = 0  # everything places on the victim
+            records = []
+            for i, p in enumerate(prompts[:4]):
+                records.append(router.submit(p, 6, uid=f"u{i}"))
+            assert victim.engine.scheduler.queue_depth >= 2
+            thief.window = thief.window_max
+            router.step()
+            assert router.steals >= 1
+            for _ in router.run():
+                pass
+            for i, request in enumerate(records):
+                assert list(request.tokens) == baseline[f"u{i}"], \
+                    f"stream u{i} diverged across the steal"
+            router.drain(None)
+            for server in servers:
+                assert server.engine.health.dead
+                assert server.engine.journal._fh is None  # compacted
+            assert os.path.getsize(journals[0].path) == 0
+        finally:
+            _stop_all(servers)
+
+
+def test_directory_ttl_ages_out_crashed_publisher(served):
+    """The staleness fix: a crashed publisher's roster entry (stale
+    ``published_at``) is skipped by the TTL filter — and
+    ``fleet_from_directory`` builds handles only for entries that
+    actually answer."""
+    from pytorch_multiprocessing_distributed_tpu.serving import (
+        fleet_from_directory)
+
+    model, params, _ = served
+    store = MemStore()
+    server = ReplicaServer(_engine(model, params), rid="live",
+                           store=store, run_uid="ttl").start()
+    try:
+        # a replica that crashed 300s ago: published once, never again
+        graftfleet.publish_replica(
+            store, "crashed", role="both", state="ready",
+            address="127.0.0.1:9", run_uid="ttl",
+            now=time.time() - 300.0)
+        full = graftfleet.replica_directory(store, run_uid="ttl")
+        assert set(full) == {"live", "crashed"}
+        fresh = graftfleet.replica_directory(store, run_uid="ttl",
+                                             ttl_s=60.0)
+        assert set(fresh) == {"live"}, (
+            "stale publisher served past its TTL")
+        # un-stamped legacy entries are kept (never silently dropped),
+        # and a GARBAGE stamp is treated as un-stamped — the
+        # best-effort read never raises on a malformed field
+        for rid, stamp in (("legacy", None), ("garbage", "not-a-ts")):
+            raw = {"rid": rid, "role": "both", "state": "ready"}
+            if stamp is not None:
+                raw["published_at"] = stamp
+            store.set(f"fleet/ttl/replica/{rid}",
+                      json.dumps(raw).encode())
+            n = store.add("fleet/ttl/replicas/n", 1) - 1
+            store.set(f"fleet/ttl/replicas/{n}", rid.encode())
+        kept = graftfleet.replica_directory(store, run_uid="ttl",
+                                            ttl_s=60.0)
+        assert "legacy" in kept and "garbage" in kept
+        # a LIVE server's serve_forever beat re-publishes: the stamp
+        # refreshes, so a healthy replica never ages out of a roster
+        # whose ttl exceeds the publish interval
+        before = graftfleet.replica_directory(
+            store, run_uid="ttl")["live"]["published_at"]
+        server._last_publish -= 1e6  # force the beat due
+        server._tick(publish_interval_s=10.0)
+        assert server._last_publish > time.perf_counter() - 60.0
+        after = graftfleet.replica_directory(
+            store, run_uid="ttl")["live"]["published_at"]
+        assert after >= before
+        # bootstrap: only the live server yields a handle (the
+        # crashed address would fail the dial even without TTL; with
+        # TTL it is never dialed at all)
+        replicas = fleet_from_directory(store, run_uid="ttl",
+                                        ttl_s=60.0, backoff_s=0.0)
+        assert [r.rid for r in replicas] == ["live"]
+        assert replicas[0].engine.health.ready
+    finally:
+        server.stop()
+
+
+@pytest.mark.slow
+def test_wire_smoke_end_to_end():
+    """The ``make wire`` smoke, in-process: real subprocess replica
+    servers, a SIGKILL, byte-identity and dedup — see
+    benchmarks/wire_smoke.py."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "wire_smoke", os.path.join(
+            os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))),
+            "benchmarks", "wire_smoke.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    out = mod.run_smoke(verbose=False)
+    assert out["killed"]
+    assert out["redelivered"] >= 1
+    assert out["streams_ok"]
