@@ -4,7 +4,8 @@
 PYTEST_ENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 
 .PHONY: test test-fast lint check check-update chaos soak scope meter \
-        fleet spec zero route wire dryrun bench bench-cpu store clean
+        fleet spec zero route wire scale dryrun bench bench-cpu store \
+        clean
 
 # graftlint: AST-only jit-hygiene gate (no jax import, milliseconds).
 # Exit 1 on any non-baselined finding; the tier-1 suite and
@@ -118,6 +119,18 @@ route:
 # test_wire_smoke_end_to_end in tests/test_graftwire.py).
 wire:
 	$(PYTEST_ENV) python benchmarks/wire_smoke.py
+
+# graftscale: elastic-fleet smoke — spawn-from-zero, a traffic burst
+# scaling REAL --listen replica subprocesses UP (sustained sheds ->
+# supervised spawn + prefix prewarm before admission), an idle
+# plateau draining them back DOWN (hysteresis + cooldown, children
+# exit on their own), then a rolling v1->v2 weight rollout under
+# continuous load: zero failed requests, every stream byte-exact to
+# ONE version, every child pid reaped loudly at exit. Same body runs
+# in tier-1 (slow-marked test_scale_smoke_script_end_to_end in
+# tests/test_graftscale.py).
+scale:
+	$(PYTEST_ENV) python benchmarks/scale_smoke.py
 
 # full suite on the virtual 8-device CPU mesh (incl. slow e2e CLI runs)
 test:

@@ -10,6 +10,9 @@
 #   benchmarks/serving_bench_spec_tpu.json (graftspec accepted/step)
 #   benchmarks/serving_bench_fleet_tpu.json (graftroute fleet/disagg/
 #                                      redelivery sweep)
+#   benchmarks/serving_bench_autoscale_tpu.json (graftscale traces +
+#                                      rollout sweep)
+#   benchmarks/scale_smoke_tpu.json    (graftscale subprocess lifecycle)
 #   benchmarks/mfu_tune_results.json   (resnet50 flag/batch sweep)
 #   benchmarks/convergence_record.json (framework-on-TPU vs torch-CPU)
 # Prints a section header per step; steps are independent — a failure
@@ -64,6 +67,18 @@ python benchmarks/serving_bench.py \
     --json_out benchmarks/serving_bench_wire_tpu.json \
     > benchmarks/serving_bench_wire_tpu.txt 2>&1
 tail -8 benchmarks/serving_bench_wire_tpu.txt >&2
+
+note "fleet autoscale smoke (graftscale: spawn/scale/rollout against real subprocesses)"
+python benchmarks/scale_smoke.py --out benchmarks/scale_smoke_tpu.json \
+    > benchmarks/scale_smoke_tpu.txt 2>&1
+tail -6 benchmarks/scale_smoke_tpu.txt >&2
+
+note "serving bench (graftscale: bursty/diurnal traces + rolling rollout)"
+python benchmarks/serving_bench.py \
+    --sweep autoscale \
+    --json_out benchmarks/serving_bench_autoscale_tpu.json \
+    > benchmarks/serving_bench_autoscale_tpu.txt 2>&1
+tail -8 benchmarks/serving_bench_autoscale_tpu.txt >&2
 
 note "serving bench (graftspec: accepted/target-step x k x draft source)"
 python benchmarks/serving_bench.py \

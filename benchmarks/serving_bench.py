@@ -111,6 +111,16 @@ Three sweeps over the continuous-batching :class:`ServingEngine`:
    peer, **kill→recovery TTFT** wall-clocked, streams exact, fleet
    metrics dedup-verified.
 
+10. **Autoscale sweep** (``--sweep autoscale``, graftscale): the
+    elastic fleet under time-varying load. A **bursty** (square-wave)
+    and a **diurnal** (ramp) arrival trace each drive the
+    :class:`FleetAutoscaler` over a 1..3-replica in-process fleet —
+    **replicas-over-time** (change-points), **shed rate**, and **TTFT
+    p50/p99 across the scale events** per point, every admitted
+    request asserted complete. Then a **rolling v1→v2 rollout** under
+    steady load: wall-clock **rollout duration**, zero failed
+    requests, every stream byte-exact to exactly one weight version.
+
 ``offered=inf`` is the closed-loop limit: every request submitted
 up front, measuring peak engine throughput. CPU-runnable (shapes clamp
 down off-TPU, same convention as ``generate_bench.py``), TPU-ready.
@@ -1206,6 +1216,178 @@ def run_wire_sweep(model, params, args, rng):
     return results
 
 
+def run_autoscale_sweep(model, params, args, rng):
+    """graftscale (sweep 10): the elastic-fleet evidence — (1) a
+    BURSTY arrival trace (square-wave offered load) and (2) a
+    DIURNAL one (ramp up, plateau, ramp down) each drive the
+    autoscaler over a 1..3-replica fleet: replicas-over-time, shed
+    rate, and TTFT p50/p99 ACROSS the scale events land in the
+    record; (3) a rolling v1->v2 weight rollout under steady load:
+    duration on the clock, zero failed requests, every stream
+    byte-exact to one version."""
+    from pytorch_multiprocessing_distributed_tpu.serving import (
+        EngineReplicaSpawner, FleetAutoscaler, FleetSaturated,
+        RollingRollout, Router, ServingEngine, ServingReplica,
+        init_params)
+
+    new_tokens = max(4, min(args.new_tokens, 8))
+    prompt_hi = max(2, min(args.prompt_max,
+                           model.max_seq_len - new_tokens) - 1)
+    s_max = min(model.max_seq_len, prompt_hi + new_tokens)
+    slots = int(args.slots.split(",")[0])
+    prompts = [rng.integers(0, model.vocab_size, (int(rng.integers(
+        max(1, prompt_hi // 2), prompt_hi + 1)),)).tolist()
+        for _ in range(8)]
+    versions = {"v1": params, "v2": init_params(model, 2)}
+
+    def mk(tag="v1"):
+        return ServingEngine(model, versions[tag], max_slots=slots,
+                             s_max=s_max, decode_buckets=(),
+                             retry_backoff_s=0.0)
+
+    def mk_fleet(n=1, **scale_kw):
+        router = Router(
+            [ServingReplica(f"r{i}", mk(), model_tag="v1")
+             for i in range(n)], max_pending=4)
+        scale_kw.setdefault("min_replicas", n)
+        scale_kw.setdefault("max_replicas", 3)
+        scale_kw.setdefault("up_after", 2)
+        scale_kw.setdefault("down_after", 8)
+        scale_kw.setdefault("cooldown", 4)
+        scaler = FleetAutoscaler(
+            router, EngineReplicaSpawner(
+                lambda tag, journal: mk(tag or "v1")),
+            model_tag="v1", sleep=lambda s: None, **scale_kw)
+        return router, scaler
+
+    # arrival traces: offered requests per tick
+    def bursty(t):
+        return 3 if (t // 20) % 2 == 0 else 0  # square wave
+
+    def diurnal(t):
+        # ramp 0 -> peak -> 0 over the trace (the day curve)
+        period = 80
+        phase = (t % period) / period
+        return round(3 * min(phase, 1 - phase) * 2)
+
+    results = []
+    for trace_name, trace in (("bursty", bursty),
+                              ("diurnal", diurnal)):
+        router, scaler = mk_fleet(1)
+        router.submit(list(prompts[0]), 2, uid="warm0")
+        while router.in_flight:  # compiles off the clock
+            router.step()
+        uid, shed = 0, 0
+        replicas_over_time = [(0, 1)]
+        t0 = time.perf_counter()
+        for t in range(80):
+            for _ in range(trace(t)):
+                try:
+                    router.submit(
+                        list(prompts[uid % len(prompts)]),
+                        new_tokens, uid=f"u{uid}")
+                    uid += 1
+                except FleetSaturated:
+                    shed += 1
+            router.step()
+            scaler.tick()
+            if replicas_over_time[-1][1] != len(router.replicas):
+                replicas_over_time.append(
+                    (t + 1, len(router.replicas)))
+        steps, idle_tail = 80, 0
+        while (router.in_flight or router.pending_depth
+               or idle_tail < 30):  # tail: let scale-down fire too
+            if not (router.in_flight or router.pending_depth):
+                idle_tail += 1
+            router.step()
+            scaler.tick()
+            steps += 1
+            if replicas_over_time[-1][1] != len(router.replicas):
+                replicas_over_time.append(
+                    (steps, len(router.replicas)))
+        wall_s = time.perf_counter() - t0
+        finished = [r for u, r in router.records().items()
+                    if not str(u).startswith("warm")
+                    and r.state == "done"
+                    and r.first_token_time is not None]
+        ttfts = [r.first_token_time - r.submit_time
+                 for r in finished]
+        point = {
+            "mode": "autoscale", "trace": trace_name,
+            "slots": slots, "offered": uid + shed,
+            "completed": len(finished),
+            "shed": shed,
+            "shed_rate": shed / max(1, uid + shed),
+            "scale_ups": scaler.scale_ups,
+            "scale_downs": scaler.scale_downs,
+            "peak_replicas": max(n for _, n in replicas_over_time),
+            "replicas_over_time": replicas_over_time,
+            "scale_events": [e.to_dict() for e in scaler.events],
+            "ttft_p50_ms": 1e3 * _percentile(ttfts, 50),
+            "ttft_p99_ms": 1e3 * _percentile(ttfts, 99),
+            "wall_s": wall_s,
+        }
+        assert len(finished) == uid, (
+            f"{trace_name}: {uid - len(finished)} admitted "
+            "request(s) never completed")
+        print(f"autoscale {trace_name:8s}  peak={point['peak_replicas']} "
+              f"replicas  ups={scaler.scale_ups} "
+              f"downs={scaler.scale_downs}  "
+              f"shed={100 * point['shed_rate']:4.1f}%  "
+              f"ttft p99={point['ttft_p99_ms']:7.1f} ms", flush=True)
+        results.append(point)
+
+    # ---- rolling rollout under steady load, duration on the clock
+    router, scaler = mk_fleet(2, cooldown=0, down_after=50)
+    router.submit(list(prompts[0]), 2, uid="warm0")
+    while router.in_flight:
+        router.step()
+    ref = {}
+    for tag in ("v1", "v2"):
+        out = mk(tag).serve([(list(p), new_tokens) for p in prompts])
+        ref[tag] = {tuple(prompts[i]): list(r.tokens)
+                    for i, r in enumerate(out)}
+    rollout = RollingRollout(scaler, "v2")
+    uid = 0
+    total = 3 * len(prompts)
+    for _ in range(5000):
+        if uid < total:
+            try:
+                router.submit(list(prompts[uid % len(prompts)]),
+                              new_tokens, uid=f"u{uid}")
+                uid += 1
+            except FleetSaturated:
+                pass
+        router.step()
+        scaler.tick()
+        rollout.tick()
+        if (rollout.done and uid >= total and not router.in_flight
+                and not router.pending_depth):
+            break
+    recs = {u: r for u, r in router.records().items()
+            if not u.startswith("warm")}
+    failed = [u for u, r in recs.items() if r.state != "done"]
+    mixed = [u for u, r in recs.items()
+             if list(r.tokens) not in (
+                 ref["v1"].get(tuple(r.prompt)),
+                 ref["v2"].get(tuple(r.prompt)))]
+    assert rollout.done and not failed and not mixed, (
+        f"rollout: done={rollout.done} failed={failed} "
+        f"mixed-version={mixed}")
+    point = {
+        "mode": "rollout", "slots": slots, "requests": len(recs),
+        "replaced": rollout.replaced,
+        "duration_s": rollout.duration_s,
+        "failed": 0, "version_exact": True,
+    }
+    print(f"rollout  v1->v2  {len(rollout.replaced)} replica(s) in "
+          f"{rollout.duration_s:6.2f}s under load  "
+          f"({len(recs)} streams, 0 failed, version-exact)",
+          flush=True)
+    results.append(point)
+    return results
+
+
 def main():
     _common.apply_platform_env()
     p = argparse.ArgumentParser()
@@ -1221,7 +1403,8 @@ def main():
                         "submitted up front)")
     p.add_argument("--sweep", default="load,length,horizon", type=str,
                    help="which sweeps to run: load, length, horizon, "
-                        "chaos, drain, paged, spec, fleet, wire, or "
+                        "chaos, drain, paged, spec, fleet, wire, "
+                        "autoscale, or "
                         "any comma list")
     p.add_argument("--chaos_every", default=5, type=int,
                    help="chaos sweep: inject one transient fault every "
@@ -1292,7 +1475,7 @@ def main():
               "s_max": s_max, "load_sweep": [], "length_sweep": [],
               "horizon_sweep": [], "chaos_sweep": [], "drain_sweep": [],
               "paged_sweep": [], "spec_sweep": [], "fleet_sweep": [],
-              "wire_sweep": []}
+              "wire_sweep": [], "autoscale_sweep": []}
     sweeps = args.sweep.split(",")
 
     if "load" in sweeps:
@@ -1348,6 +1531,10 @@ def main():
     if "wire" in sweeps:
         record["wire_sweep"] = run_wire_sweep(model, params, args,
                                               rng)
+
+    if "autoscale" in sweeps:
+        record["autoscale_sweep"] = run_autoscale_sweep(
+            model, params, args, rng)
 
     if args.json_out:
         with open(args.json_out, "w") as f:

@@ -128,6 +128,17 @@ RULES: Dict[str, str] = {
              "process forever, with no named error and no timeline "
              "(graftwire's sockets are all deadline-bounded; keep it "
              "that way)",
+    "GL118": "child-process spawn with no reaping evidence in scope "
+             "(subprocess.Popen or multiprocessing.Process in a "
+             "scope — function, class, or module top level — with no "
+             ".wait/.join/.kill/.terminate/.communicate anywhere in "
+             "that scope chain): the orphan-child class — a spawned "
+             "replica/worker that nothing ever reaps leaks a zombie "
+             "on every crash path and outlives the run holding "
+             "ports, devices and file locks (graftscale's "
+             "ProcessReplicaSpawner discipline: every Popen has a "
+             "wait-then-kill release in the same class; "
+             "subprocess.run/check_call/check_output self-reap)",
 }
 
 # wrappers that COMPILE (jit family) — GL105/106/107/108 anchor on these
@@ -1432,6 +1443,84 @@ def _check_blocking_socket(file: _File, out: List[Finding]):
             "every socket op has a deadline)"))
 
 
+_REAP_ATTRS = {"wait", "join", "kill", "terminate", "communicate"}
+
+
+def _check_spawn_reap(file: _File, out: List[Finding]):
+    """GL118 — child-process spawn with no reaping evidence IN SCOPE:
+    the orphan-child class graftscale must never reintroduce. A
+    ``subprocess.Popen(...)`` or ``multiprocessing.Process(...)``
+    call is flagged unless reaping evidence exists in the call's
+    scope chain:
+
+    - the enclosing function (any enclosing def) contains a
+      ``.wait``/``.join``/``.kill``/``.terminate``/``.communicate``
+      attribute call;
+    - or the enclosing CLASS does, anywhere in its body — the
+      spawn-in-``spawn``, reap-in-``release`` shape
+      (ProcessReplicaSpawner's discipline);
+    - or the module's top level does.
+
+    ``subprocess.run``/``check_call``/``check_output`` self-reap and
+    are never flagged. Evidence in an UNRELATED sibling function does
+    not count: a ``wait`` on a different child in a different scope
+    is exactly the false comfort that leaks the zombie.
+    """
+    evidence_fns: Set[int] = set()
+    evidence_cls: Set[int] = set()
+    module_evidence = [False]
+    spawns: List[Tuple[ast.Call, Tuple[int, ...], Optional[int],
+                       str]] = []
+
+    def _classify(call: ast.Call, fns: Tuple[int, ...],
+                  cls: Optional[int]) -> None:
+        func = call.func
+        attr = func.attr if isinstance(func, ast.Attribute) else None
+        if attr in _REAP_ATTRS:
+            evidence_fns.update(fns)
+            if cls is not None:
+                evidence_cls.add(cls)
+            if not fns and cls is None:
+                module_evidence[0] = True
+            return
+        d = _dotted(func, file) or ""
+        if d == "subprocess.Popen" or d.endswith(".subprocess.Popen"):
+            spawns.append((call, fns, cls, "subprocess.Popen"))
+        elif d in ("multiprocessing.Process",
+                   "torch.multiprocessing.Process") \
+                or d.endswith(".multiprocessing.Process"):
+            spawns.append((call, fns, cls, "multiprocessing.Process"))
+
+    def _visit(node: ast.AST, fns: Tuple[int, ...],
+               cls: Optional[int]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fns = fns + (id(node),)
+        elif isinstance(node, ast.ClassDef):
+            cls = id(node)
+        if isinstance(node, ast.Call):
+            _classify(node, fns, cls)
+        for child in ast.iter_child_nodes(node):
+            _visit(child, fns, cls)
+
+    _visit(file.tree, (), None)
+    for call, fns, cls, label in spawns:
+        if any(f in evidence_fns for f in fns):
+            continue
+        if cls is not None and cls in evidence_cls:
+            continue
+        if module_evidence[0]:
+            continue
+        out.append(Finding(
+            file.path, call.lineno, call.col_offset, "GL118",
+            f"child-process spawn ({label}) with no reaping evidence "
+            "in scope — nothing here ever wait/join/kill/terminates "
+            "the child: every crash path leaks a zombie that "
+            "outlives the run holding ports and file locks; reap it "
+            "in the same scope (the graftscale spawner discipline: "
+            "wait with a deadline, then kill LOUDLY), or use "
+            "subprocess.run, which self-reaps"))
+
+
 def _check_jit_in_loop(file: _File, out: List[Finding]):
     """GL105: jax.jit(...) lexically inside a for/while body."""
     loops: List[ast.AST] = [n for n in ast.walk(file.tree)
@@ -1564,6 +1653,7 @@ def analyze_files(paths: Sequence[str],
         _check_unpaired_trace(f, findings)
         _check_signal_discard(f, findings)
         _check_blocking_socket(f, findings)
+        _check_spawn_reap(f, findings)
         _check_unsynced_timing(f, findings)
         for fn in f.funcs:
             if fn.jit_scoped:

@@ -83,7 +83,8 @@ __all__ = [
     "note_arrival", "publish_endpoint", "monitor_from_env",
     "arm_goodput", "disarm_goodput", "active_goodput",
     "goodput_gauges", "static_collective_bytes",
-    "publish_replica", "replica_directory", "fleet_serving_report",
+    "publish_replica", "unpublish_replica", "replica_directory",
+    "fleet_serving_report",
 ]
 
 
@@ -836,6 +837,7 @@ def goodput_gauges() -> Dict[str, float]:
 def publish_replica(store, rid: str, *, role: str = "both",
                     state: str = "starting",
                     address: Optional[str] = None,
+                    model_tag: Optional[str] = None,
                     run_uid: str = "run", prefix: str = "fleet",
                     now: Optional[float] = None) -> bool:
     """Publish one serving replica's identity to the control-plane
@@ -857,6 +859,11 @@ def publish_replica(store, rid: str, *, role: str = "both",
                                      else now)}
     if address is not None:
         payload["address"] = str(address)
+    if model_tag is not None:
+        # weight-version label (graftscale rolling rollout): a
+        # directory reader can tell which version each replica
+        # serves without dialing it
+        payload["model_tag"] = str(model_tag)
     try:
         store.set(_k(prefix, run_uid, "replica", rid),
                   json.dumps(payload, sort_keys=True).encode())
@@ -877,6 +884,29 @@ def publish_replica(store, rid: str, *, role: str = "both",
             idx = int(store.add(base + "/n", 1)) - 1
             store.set(f"{base}/{idx}", str(rid).encode())
     except (OSError, ValueError):
+        return False
+    return True
+
+
+def unpublish_replica(store, rid: str, *, run_uid: str = "run",
+                      prefix: str = "fleet") -> bool:
+    """Delete ``rid``'s directory record — the REAP path (graftscale
+    satellite fix): a replica that dies mid-``begin_drain`` stops
+    refreshing its ``published_at`` stamp, so before this existed its
+    corpse sat in the directory until the TTL filter aged it out (and
+    FOREVER for readers that pass no ``ttl_s``). The router now drops
+    the record the moment it reaps, so :func:`replica_directory`
+    never returns a reaped rid — the roster slot stays claimed
+    (append-only by design), but a slot whose record is gone is
+    skipped by every reader. Best-effort like every graftfleet write:
+    a store outage returns False and the reader-side TTL remains the
+    backstop."""
+    try:
+        store.delete(_k(prefix, run_uid, "replica", rid))
+    except (OSError, ValueError) as e:
+        print(f"graftroute: replica unpublish {rid!r} failed "
+              f"({type(e).__name__}: {e}); the TTL filter ages the "
+              "stale record out instead", file=sys.stderr)
         return False
     return True
 

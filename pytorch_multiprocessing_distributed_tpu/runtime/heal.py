@@ -576,6 +576,10 @@ class Supervisor:
         (``GraftFaultError``: PeerLostError, PoolPoisonedError,
         exhausted-retry errors, injected fatals).
       sleep: injectable (tests never wait).
+      name: label for the supervised body, carried on the
+        ``heal.restart`` events and the budget-exhaustion message —
+        a process running SEVERAL supervisors (graftscale runs one
+        per spawned child) needs its restart storms attributable.
     """
 
     def __init__(self, target: Callable[[int], object], *,
@@ -583,7 +587,8 @@ class Supervisor:
                  max_backoff_s: float = 30.0,
                  rendezvous: Optional[Callable[[], None]] = None,
                  restartable: Tuple[type, ...] = (GraftFaultError,),
-                 sleep: Callable[[float], None] = time.sleep):
+                 sleep: Callable[[float], None] = time.sleep,
+                 name: str = ""):
         if max_restarts < 0:
             raise ValueError(
                 f"max_restarts must be >= 0, got {max_restarts}")
@@ -594,6 +599,7 @@ class Supervisor:
         self.rendezvous = rendezvous
         self.restartable = restartable
         self.sleep = sleep
+        self.name = str(name)
         self.restarts = 0  # realized restarts (observable)
 
     def run(self):
@@ -614,8 +620,9 @@ class Supervisor:
                 if isinstance(e, RestartBudgetExhausted):
                     raise  # never supervise the supervisor's own verdict
                 if attempt >= self.max_restarts:
+                    what = f" ({self.name})" if self.name else ""
                     raise RestartBudgetExhausted(
-                        f"restart budget exhausted: {attempt} "
+                        f"restart budget exhausted{what}: {attempt} "
                         f"restart(s) allowed and the run still died "
                         f"with {type(e).__name__}: {e}") from e
                 attempt += 1
@@ -626,6 +633,7 @@ class Supervisor:
                                 attempt=attempt,
                                 of=self.max_restarts,
                                 backoff_s=delay,
+                                who=self.name,
                                 error=type(e).__name__)
                 if delay > 0:
                     self.sleep(delay)

@@ -16,9 +16,17 @@ graftroute (``router``/``replica``) composes N engines into ONE
 fleet: cache- and load-aware placement, AIMD admission windows +
 work stealing, prefill/decode disaggregation over a host
 ``PageTransfer`` seam, and journal redelivery across replica death.
-CLI: repo-root ``serve_lm.py`` (``--replicas N`` for the fleet).
+graftscale (``autoscale``) closes the loop: traffic decides the
+fleet size (supervised spawn/drain from the router's own signals,
+per-role, hysteresis + cooldown) and ``RollingRollout`` upgrades
+weights under continuous load with zero failed requests.
+CLI: repo-root ``serve_lm.py`` (``--replicas N`` for the fleet,
+``--autoscale MIN,MAX`` / ``--rollout PATH`` for graftscale).
 """
 
+from .autoscale import (AutoscaleError, EngineReplicaSpawner,
+                        FleetAutoscaler, ProcessReplicaSpawner,
+                        RollingRollout, ScaleEvent, SpawnFailed)
 from .engine import ServingEngine
 from .kv_pages import PagePool, PagePoolExhausted, PrefixCache
 from .kv_slots import SlotPool
@@ -44,4 +52,8 @@ __all__ = [
     "PrefixCacheDirectory", "FleetSaturated", "FleetDead",
     # graftwire: the socket transport behind the replica seam
     "ReplicaServer", "RemoteReplica", "fleet_from_directory",
+    # graftscale: traffic-driven autoscaling + rolling rollout
+    "FleetAutoscaler", "RollingRollout", "EngineReplicaSpawner",
+    "ProcessReplicaSpawner", "ScaleEvent", "AutoscaleError",
+    "SpawnFailed",
 ]

@@ -82,15 +82,23 @@ class PageTransfer:
     receiver-chosen redistribution, the property that makes the seam
     portable across hosts)."""
 
-    __slots__ = ("request", "tok0", "k_block", "v_block", "src_rid")
+    __slots__ = ("request", "tok0", "k_block", "v_block", "src_rid",
+                 "src_tag")
 
     def __init__(self, request: Request, tok0: int, k_block, v_block,
-                 src_rid: Optional[str] = None):
+                 src_rid: Optional[str] = None,
+                 src_tag: Optional[str] = None):
         self.request = request
         self.tok0 = int(tok0)
         self.k_block = k_block
         self.v_block = v_block
         self.src_rid = src_rid
+        # the producing replica's weight version (graftscale rolling
+        # rollout): a mid-rollout fleet holds BOTH versions, and a
+        # block prefilled under v1 spliced into a v2 decode would mix
+        # weights mid-stream — the router only places a tagged
+        # transfer on a same-tag decode replica
+        self.src_tag = src_tag
 
     @property
     def nbytes(self) -> int:
@@ -119,12 +127,19 @@ class ServingReplica:
         derive from the engine (``max_slots`` + queue allowance).
       address: optional ``host:port`` of this replica's live stats
         server (published to the fleet store for remote routers).
+      model_tag: optional weight-version label (graftscale rolling
+        rollout) — published to the fleet directory, carried on every
+        :class:`PageTransfer` this replica produces, and used by the
+        router to keep a request's prefill and decode on ONE version.
+        None = untagged (the single-version fleet; no placement
+        constraint).
     """
 
     def __init__(self, rid: str, engine, role: str = "both",
                  journal=None, min_window: int = 1,
                  window_max: Optional[int] = None,
-                 address: Optional[str] = None):
+                 address: Optional[str] = None,
+                 model_tag: Optional[str] = None):
         if role not in ROLES:
             raise ValueError(
                 f"role must be one of {ROLES}, got {role!r}")
@@ -133,6 +148,8 @@ class ServingReplica:
         self.role = role
         self.journal = journal if journal is not None else engine.journal
         self.address = address
+        self.model_tag = (None if model_tag is None
+                          else str(model_tag))
         slots = engine.pool.max_slots
         queue_allow = engine.scheduler.max_queue
         if window_max is None:
@@ -155,6 +172,12 @@ class ServingReplica:
         self._prefill_s = 0.0  # prefill replicas' productive seconds
         self.transfers_out = 0
         self.reaped = False  # router bookkeeping: dead + redelivered
+        # graftscale prewarm accounting: tokens/requests this replica
+        # generated warming its compile + prefix caches BEFORE the
+        # router admitted client traffic — the merge subtracts them
+        # so fleet counters stay equal to client-delivered work
+        self.prewarm_tokens = 0
+        self.prewarm_requests = 0
 
     # ---- identity / health (the /healthz shape) -----------------------
     @property
@@ -213,6 +236,7 @@ class ServingReplica:
             "transfers_out": self.transfers_out,
             "admit_window": self.window,
             "goodput_frac": (productive / wall if wall > 0 else 0.0),
+            "model_tag": self.model_tag,
         }
         return snap
 
@@ -289,6 +313,45 @@ class ServingReplica:
         self._prefill_queue.clear()
         return out
 
+    # ---- graftscale: prewarm before first admission --------------------
+    def prewarm(self, prompts, max_new: int = 1,
+                max_steps: int = 10_000) -> int:
+        """Run ``prompts`` through this replica's engine BEFORE the
+        router admits client traffic to it (graftscale: a freshly
+        spawned decode replica warms its compile caches and — paged +
+        armed prefix cache — prefills the fleet's hot prefixes, so
+        its first routed request pays a warm TTFT, not a cold one).
+        Uses only the universal replica verbs (``enqueue``/``step``),
+        so a :class:`~.remote.RemoteReplica` prewarms over the wire
+        identically. Tokens generated here are accounted on
+        ``prewarm_tokens`` and subtracted from the fleet merge —
+        client-visible counters never include warm-up work. Returns
+        the number of prompts warmed."""
+        if not self.decode_capable:
+            return 0
+        warmed = []
+        for i, prompt in enumerate(prompts):
+            request = Request(list(prompt), int(max_new),
+                              self.engine.eos_id,
+                              uid=f"warm-{self.rid}-{i}")
+            try:
+                self.enqueue(request)
+            except QueueFull:
+                break  # window full: enough warming queued already
+            except ValueError:
+                continue  # never-fits on this geometry: skip it
+            warmed.append(request)
+        steps = 0
+        while self.engine.in_flight and steps < max_steps:
+            self.step()
+            steps += 1
+        self.prewarm_requests += len(warmed)
+        self.prewarm_tokens += sum(len(r.tokens) for r in warmed)
+        graftscope.emit("scale.prewarm", cat="serving", rid=self.rid,
+                        prompts=len(warmed),
+                        tokens=self.prewarm_tokens)
+        return len(warmed)
+
     # ---- drive --------------------------------------------------------
     def step(self) -> List[Tuple[Request, int, bool]]:
         """One engine step (decode-capable roles; a prefill replica's
@@ -338,7 +401,8 @@ class ServingReplica:
         self._prefill_s += time.perf_counter() - t0
         self.transfers_out += 1
         transfer = PageTransfer(request, tok0, k_block, v_block,
-                                src_rid=self.rid)
+                                src_rid=self.rid,
+                                src_tag=self.model_tag)
         graftscope.emit("route.transfer", cat="serving",
                         req=request.uid, src=self.rid,
                         nbytes=transfer.nbytes)

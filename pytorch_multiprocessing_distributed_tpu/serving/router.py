@@ -129,6 +129,7 @@ class PrefixCacheDirectory:
                               Tuple[Tuple[int, ...], str]] = {}
         self._full: Dict[int, Tuple[Tuple[int, ...], str]] = {}
         self._max_full = 0
+        self._hits: Dict[int, int] = {}  # full-prompt hit counts
 
     def __len__(self) -> int:
         return len(self._full) + len(self._by_prefix)
@@ -162,8 +163,10 @@ class PrefixCacheDirectory:
         token comparison verifies — identical to the engine cache's
         lookup discipline."""
         tokens = tuple(int(t) for t in prompt)
-        hit = self._full.get(self._key(tokens))
+        key = self._key(tokens)
+        hit = self._full.get(key)
         if hit is not None and hit[0] == tokens:
+            self._hits[key] = self._hits.get(key, 0) + 1
             return hit[1]
         ps = self.page_size
         for k in range(min(len(tokens) // ps, self._max_full), 0, -1):
@@ -182,6 +185,24 @@ class PrefixCacheDirectory:
                       if v[1] != rid}
         self._max_full = max(
             (k for k, _h in self._by_prefix), default=0)
+        self._hits = {k: v for k, v in self._hits.items()
+                      if k in self._full}
+
+    def hot_prompts(self, n: int) -> List[Tuple[int, ...]]:
+        """The up-to-``n`` hottest full prompts held anywhere in the
+        fleet (routing hit count, longest first as the tiebreak):
+        the PREWARM set for a joining decode replica — replaying
+        them through its engine populates its own prefix cache
+        before the router admits traffic to it, so its first client
+        request pays a warm TTFT."""
+        if n <= 0:
+            return []
+        ranked = sorted(
+            self._full.values(),
+            key=lambda tv: (self._hits.get(self._key(tv[0]), 0),
+                            len(tv[0])),
+            reverse=True)
+        return [tokens for tokens, _rid in ranked[:n]]
 
 
 class Router:
@@ -255,6 +276,14 @@ class Router:
         self.transfer_bytes = 0  # host-round-trip KV block payload
         self.requests_shed_fleet = 0
         self._draining = False
+        # graftscale: counters of replicas REMOVED from the fleet
+        # (drained + retired by the autoscaler / a rolling rollout) —
+        # folded into merged_metrics so scale-down never makes fleet
+        # totals go backwards
+        self._retired_totals: Dict[str, float] = {}
+        self._retired_prewarm_tokens = 0
+        self._retired_prewarm_requests = 0
+        self.replicas_retired = 0
         for r in self.replicas:
             self._publish(r)
 
@@ -269,7 +298,71 @@ class Router:
             role=replica.role,
             state=replica.engine.health.state,
             address=replica.address,
+            model_tag=replica.model_tag,
             run_uid=self.run_uid)
+
+    def _unpublish(self, replica: ServingReplica) -> None:
+        if self.store is None:
+            return
+        from ..runtime import fleet as graftfleet
+
+        graftfleet.unpublish_replica(self.store, replica.rid,
+                                     run_uid=self.run_uid)
+
+    # ---- graftscale: runtime membership -------------------------------
+    def add_replica(self, replica: ServingReplica) -> None:
+        """Join one replica to a LIVE fleet (graftscale scale-up /
+        rollout join): registered for placement immediately and
+        published to the store directory. The caller prewarms first
+        (:meth:`~.replica.ServingReplica.prewarm`) — by the time the
+        router sees the handle, its caches are hot."""
+        if replica.rid in self._by_rid:
+            raise ValueError(
+                f"duplicate replica id {replica.rid!r}: already in "
+                "the fleet")
+        self.replicas.append(replica)
+        self._by_rid[replica.rid] = replica
+        if (self._directory is None and replica.decode_capable
+                and getattr(replica.engine, "_prefix_cache", None)
+                is not None):
+            self._directory = PrefixCacheDirectory(
+                replica.engine.pool.page_size)
+        self._publish(replica)
+        graftscope.emit("scale.join", cat="serving", rid=replica.rid,
+                        role=replica.role, tag=replica.model_tag,
+                        replicas=len(self.replicas))
+
+    def remove_replica(self, rid: str) -> ServingReplica:
+        """Retire one DEAD (drained or reaped) replica from the fleet
+        (graftscale scale-down / rollout takeover): its counters fold
+        into the retired totals so the fleet merge never goes
+        backwards, its directory entries drop, and its store record
+        is deleted. Removing a live replica is a caller bug — drain
+        it first (``begin_drain`` + step to empty)."""
+        replica = self._by_rid.get(rid)
+        if replica is None:
+            raise ValueError(f"unknown replica id {rid!r}")
+        if not (replica.dead or replica.reaped):
+            raise ValueError(
+                f"replica {rid!r} is {replica.engine.health.state!r} "
+                "with work possibly in flight — drain it before "
+                "removing it from the fleet")
+        snap = replica.engine.metrics.snapshot()
+        for key in self._SUM_KEYS:
+            if key in snap:
+                self._retired_totals[key] = (
+                    self._retired_totals.get(key, 0) + snap[key])
+        self._retired_prewarm_tokens += replica.prewarm_tokens
+        self._retired_prewarm_requests += replica.prewarm_requests
+        self.replicas_retired += 1
+        if self._directory is not None:
+            self._directory.drop_replica(rid)
+        del self._by_rid[rid]
+        self.replicas.remove(replica)
+        self._unpublish(replica)
+        graftscope.emit("scale.retire", cat="serving", rid=rid,
+                        replicas=len(self.replicas))
+        return replica
 
     # ---- placement ----------------------------------------------------
     def _alive(self) -> List[ServingReplica]:
@@ -457,7 +550,13 @@ class Router:
         for _ in range(n):
             transfer = self._transfers.popleft()
             cands = [r for r in self._decode_replicas()
-                     if r.admittable()]
+                     if r.admittable()
+                     # version pinning (graftscale rollout): a block
+                     # prefilled under tag X only splices into a
+                     # same-tag decode — mixing weight versions
+                     # mid-stream would break per-version exactness
+                     and (transfer.src_tag is None
+                          or r.model_tag == transfer.src_tag)]
             placed = False
             for replica in sorted(cands, key=lambda r: r.load()):
                 try:
@@ -524,7 +623,12 @@ class Router:
                         reason=replica.engine.health.reason)
         if self._directory is not None:
             self._directory.drop_replica(replica.rid)
-        self._publish(replica)
+        # drop the store record at the reap (not a dead-state
+        # re-publish): a replica that died mid-drain would otherwise
+        # sit in the directory until the TTL filter aged it out — and
+        # forever for readers that pass no ttl_s. replica_directory
+        # never returns a reaped rid (test-pinned).
+        self._unpublish(replica)
         # un-prefilled intake: no tokens yet, a plain re-route is exact
         for request in replica.withdraw_prefill():
             if not self._dispatch_request(request):
@@ -562,6 +666,17 @@ class Router:
                 f"replica {replica.rid} died with "
                 f"{len(entries)} unfinished request(s) and no READY "
                 "decode-capable peer remains to redeliver to")
+        # mid-rollout version pinning: a journaled token prefix was
+        # generated under the dead replica's weights — replaying it
+        # on a different version would diverge (the journal's replay
+        # verification catches it, but loudly). Prefer same-tag
+        # peers; only a fleet with no same-version survivor falls
+        # back to any peer (untagged fleets: every tag is None, so
+        # this filter is the identity).
+        same_tag = [p for p in peers
+                    if p.model_tag == replica.model_tag]
+        if same_tag:
+            peers = same_tag
         for i, entry in enumerate(entries):
             peer = min(peers, key=lambda r: r.load())
             redelivered = peer.engine.redeliver([entry],
@@ -682,6 +797,26 @@ class Router:
         flight + every live replica's own in-flight."""
         return (len(self._pending) + len(self._transfers)
                 + sum(r.in_flight for r in self._alive()))
+
+    # ---- graftscale: the autoscaler's input signals --------------------
+    @property
+    def pending_depth(self) -> int:
+        """Requests the router holds because no replica admits — the
+        saturation signal the autoscaler (and /snapshot.json) reads."""
+        return len(self._pending)
+
+    @property
+    def transfer_depth(self) -> int:
+        """Finished prefills waiting for a decode replica to admit
+        them — the prefill→decode role-imbalance signal."""
+        return len(self._transfers)
+
+    @property
+    def transfer_backlog_full(self) -> bool:
+        """Decode-side backpressure visible to a scaler: the transfer
+        queue holds at least the decode replicas' combined admission
+        windows (the same predicate admission uses)."""
+        return self._transfer_backlog_full()
 
     def run(self):
         """Drive :meth:`step` until the fleet drains, streaming token
@@ -811,20 +946,33 @@ class Router:
         (goodput_frac included) ride along under ``per_replica``."""
         merged: Dict[str, object] = {}
         per_replica: Dict[str, Dict] = {}
-        totals: Dict[str, float] = {}
+        # retired replicas (graftscale scale-down / rollout) folded
+        # in first: fleet totals never go backwards on a removal
+        totals: Dict[str, float] = dict(self._retired_totals)
+        prewarm_tokens = self._retired_prewarm_tokens
+        prewarm_requests = self._retired_prewarm_requests
         for replica in self.replicas:
             snap = replica.engine.metrics.snapshot()
             per_replica[replica.rid] = replica.snapshot()
+            prewarm_tokens += replica.prewarm_tokens
+            prewarm_requests += replica.prewarm_requests
             for key in self._SUM_KEYS:
                 if key in snap:
                     totals[key] = totals.get(key, 0) + snap[key]
         merged.update(totals)
+        # two dedup rules: the redelivery replay prefix (counted on
+        # the dead replica AND the redelivering peer, delivered once)
+        # and prewarm work (generated warming a joining replica,
+        # delivered to no client at all)
         merged["tokens_generated"] = (
             int(totals.get("tokens_generated", 0))
-            - self.redelivery_replayed_tokens)
+            - self.redelivery_replayed_tokens - prewarm_tokens)
         merged["decode_tokens"] = (
             int(totals.get("decode_tokens", 0))
             - self.redelivery_replayed_decode_tokens)
+        merged["requests_completed"] = (
+            int(totals.get("requests_completed", 0))
+            - prewarm_requests)
         merged["redelivery_replayed_tokens"] = \
             self.redelivery_replayed_tokens
         merged["fleet_requests_redelivered"] = self.requests_redelivered
@@ -836,6 +984,19 @@ class Router:
         merged["fleet_replicas"] = len(self.replicas)
         merged["fleet_replicas_dead"] = sum(
             1 for r in self.replicas if r.dead or r.reaped)
+        # graftscale inputs on the operator snapshot (satellite fix:
+        # the autoscaler and an external scraper read the SAME
+        # signals /snapshot.json shows): router-held depth, transfer
+        # backlog, and every replica's live admission window
+        merged["fleet_pending"] = len(self._pending)
+        merged["fleet_transfers_pending"] = len(self._transfers)
+        merged["fleet_admit_windows"] = {
+            r.rid: r.window for r in self.replicas}
+        merged["fleet_admit_window_total"] = sum(
+            r.window for r in self._decode_replicas())
+        merged["fleet_replicas_retired"] = self.replicas_retired
+        merged["fleet_prewarm_tokens"] = prewarm_tokens
+        merged["fleet_prewarm_requests"] = prewarm_requests
         merged["per_replica"] = per_replica
         return merged
 

@@ -264,6 +264,29 @@ parser.add_argument('--fleet_ttl', default=30.0, type=float,
                          'skipped (a crashed publisher ages out '
                          'instead of being dialed forever; 0 = no '
                          'filter)')
+# --- graftscale: traffic-driven autoscaling + rolling rollout ---
+parser.add_argument('--autoscale', default='', type=str,
+                    metavar='MIN,MAX',
+                    help='graftscale: let TRAFFIC size the in-process '
+                         'fleet between MIN and MAX decode-capable '
+                         'replicas — sustained FleetSaturated sheds / '
+                         'pending depth above the combined admission '
+                         'windows scale UP, sustained idleness drains '
+                         'the least-loaded replica DOWN (hysteresis + '
+                         'cooldown: never flaps). --replicas seeds the '
+                         'initial size; prefill-role replicas scale '
+                         'independently')
+parser.add_argument('--rollout', default='', type=str,
+                    metavar='PARAMS',
+                    help='graftscale: rolling weight rollout under '
+                         'load — spawn new-version replicas '
+                         '(model_tag v1) from this checkpoint, warm '
+                         'them, drain the v0 fleet one replica at a '
+                         'time; zero failed requests, every stream '
+                         'served start-to-finish by exactly one '
+                         'version. PARAMS is a checkpoint path, or '
+                         "'seed:N' (random init, smoke runs). "
+                         'Implies --autoscale 1,R+1 if not set')
 # --- graftheal: elastic runtime ---
 parser.add_argument('--drain_deadline_s', default=0.0, type=float,
                     help='graceful-drain bound: on SIGTERM (or source '
@@ -425,9 +448,9 @@ def main():
         else:
             draft_params = init_params(draft_model, args.seed + 1)
 
-    def build_engine(journal):
+    def build_engine(journal, params_override=None):
         return ServingEngine(
-            model, params,
+            model, params if params_override is None else params_override,
             max_slots=args.max_slots,
             s_max=args.s_max or None,
             mesh=mesh,
@@ -691,12 +714,51 @@ def main():
     # ---- graftroute: fleet behind one router (in-process replicas,
     # or graftwire remote replica servers via --connect/--fleet_store)
     remote_mode = bool(args.connect or args.fleet_store)
+    scale_mode = bool(args.autoscale or args.rollout)
     fleet_mode = (args.replicas > 1 or args.role != 'both'
-                  or remote_mode)
+                  or remote_mode or scale_mode)
     if fleet_mode:
         from pytorch_multiprocessing_distributed_tpu.serving import (
-            FleetSaturated, RemoteReplica, Router, ServingReplica,
+            FleetAutoscaler, FleetSaturated, EngineReplicaSpawner,
+            RemoteReplica, RollingRollout, Router, ServingReplica,
             fleet_from_directory)
+
+        # ---- graftscale arming: bounds, rollout weights ------------
+        scale_min = scale_max = 0
+        if scale_mode:
+            if remote_mode:
+                raise SystemExit(
+                    "graftscale: --autoscale/--rollout drive the "
+                    "in-process fleet (the subprocess spawner lives "
+                    "in benchmarks/scale_smoke.py) — drop --connect/"
+                    "--fleet_store")
+            spec = args.autoscale or f"1,{args.replicas + 1}"
+            try:
+                scale_min, scale_max = (int(x) for x in
+                                        spec.split(','))
+            except ValueError:
+                raise SystemExit(
+                    f"--autoscale must be MIN,MAX (two ints), got "
+                    f"{args.autoscale!r}")
+        rollout_params = None
+        if args.rollout:
+            if args.rollout.startswith('seed:'):
+                rollout_params = init_params(
+                    model, int(args.rollout[5:]))
+            else:
+                rollout_params = load_params(
+                    model, args.rollout, args.ckpt_backend, None)
+            if mesh is not None:
+                rollout_params = shard_params_for_tp_decode(
+                    rollout_params, mesh)
+        # per-version engine factory: the spawner's seam. v1 IS the
+        # rollout checkpoint; anything else serves the base weights
+        base_tag = 'v0' if scale_mode else None
+
+        def build_tagged(model_tag, journal):
+            override = (rollout_params if model_tag == 'v1'
+                        else None)
+            return build_engine(journal, params_override=override)
 
         roles = []
         if not remote_mode:
@@ -770,7 +832,7 @@ def main():
                         f"{args.journal}.{rid}")
                 replicas.append(ServingReplica(
                     rid, build_engine(journal), role=role,
-                    journal=journal))
+                    journal=journal, model_tag=base_tag))
             return replicas
 
         def serve_fleet_once(attempt):
@@ -782,6 +844,25 @@ def main():
             whole-fleet fatal (FleetDead) reaches the supervisor."""
             replicas = build_fleet()
             router = Router(replicas)
+            scaler = rollout = None
+            if scale_mode:
+                scaler = FleetAutoscaler(
+                    router,
+                    EngineReplicaSpawner(build_tagged),
+                    min_replicas=scale_min, max_replicas=scale_max,
+                    min_prefill=roles.count('prefill'),
+                    max_prefill=(scale_max if 'prefill' in roles
+                                 else 0),
+                    model_tag=base_tag)
+                if rollout_params is not None:
+                    rollout = RollingRollout(scaler, 'v1')
+
+            def pump():
+                emit(router.step())
+                if scaler is not None:
+                    scaler.tick()
+                if rollout is not None:
+                    rollout.tick()
             if attempt:
                 print(f"graftheal: restart {attempt}: fleet rebuilt "
                       f"({len(replicas)} replica(s))", flush=True)
@@ -834,7 +915,7 @@ def main():
                                 handled = True
                                 break
                             except FleetSaturated:
-                                emit(router.step())
+                                pump()
                             except QueueFull:
                                 break  # fleet draining: closed
                             except ValueError as e:
@@ -847,15 +928,27 @@ def main():
                             pending_src[0] = None
                         if router.draining:
                             break
-                        if args.stdin:
-                            emit(router.step())
-                    while router.in_flight and not router.draining:
-                        emit(router.step())
+                        if args.stdin or scaler is not None:
+                            pump()
+                    while ((router.in_flight
+                            or (rollout is not None
+                                and not rollout.done))
+                           and not router.draining):
+                        pump()
                     emit(router.drain(args.drain_deadline_s or None))
             finally:
                 heal.restore_drain_handler(prev_handler)
+                if scaler is not None:
+                    scaler.shutdown()
                 if stats_server is not None:
                     stats_server.shutdown()
+            if scaler is not None:
+                router.scale_metrics = scaler.metrics()
+                if rollout is not None:
+                    router.scale_metrics["rollout_duration_s"] = \
+                        rollout.duration_s
+                    router.scale_metrics["rollout_replaced"] = \
+                        rollout.replaced
             return router
 
         if args.max_restarts:
@@ -870,6 +963,7 @@ def main():
             graftscope.emit("request.timeline", cat="request",
                             **request.timeline())
         snap = router.merged_metrics()
+        snap.update(getattr(router, "scale_metrics", {}))
         snap["rejected"] = rejected[0] + len(skipped)
         snap.update(fleet.fleet_serving_report(
             snap.get("per_replica", {})))

@@ -1,0 +1,52 @@
+"""GL118 near-miss negatives: every child-process spawn here has
+reaping evidence in its scope chain (function, class, or module top
+level), plus the self-reaping subprocess helpers that must never be
+flagged."""
+import multiprocessing
+import subprocess
+
+
+def run_and_reap(argv):
+    proc = subprocess.Popen(argv)
+    try:
+        return proc.wait(timeout=30.0)
+    finally:
+        proc.kill()
+
+
+def join_worker(target):
+    proc = multiprocessing.Process(target=target)
+    proc.start()
+    proc.join(timeout=10.0)
+    return proc.exitcode
+
+
+def communicate_reaps(argv):
+    # communicate waits the child to completion: reaping evidence
+    proc = subprocess.Popen(argv, stdout=subprocess.PIPE)
+    out, _ = proc.communicate(timeout=30.0)
+    return out
+
+
+def self_reaping_helpers(argv):
+    # run/check_call/check_output wait internally — never flagged
+    subprocess.run(argv, check=True)
+    subprocess.check_call(argv)
+    return subprocess.check_output(argv)
+
+
+class Spawner:
+    # the spawn-in-spawn, reap-in-release shape: class-level evidence
+    # clears every method's spawns (ProcessReplicaSpawner discipline)
+    def spawn(self, argv):
+        self._child = subprocess.Popen(argv)
+        return self._child
+
+    def release(self):
+        self._child.terminate()
+        self._child.wait(timeout=5.0)
+
+
+def lookalike_process(pool):
+    # a Process-named callable that is NOT multiprocessing.Process
+    return pool.Process(name="not-a-child")

@@ -1,0 +1,48 @@
+"""GL118 positives: child-process spawns with no reaping evidence in
+scope — the orphan-child class. Each of these leaks a zombie on every
+crash path: nothing in the spawn's scope chain ever waits, joins,
+kills or terminates the child."""
+import multiprocessing
+import subprocess
+import multiprocessing as mp
+
+
+def launch_replica(argv):
+    return subprocess.Popen(argv)               # <- GL118
+
+
+def launch_worker(target):
+    proc = multiprocessing.Process(target=target)   # <- GL118
+    proc.start()
+    return proc
+
+
+def launch_aliased(target):
+    # the alias resolves: mp.Process IS multiprocessing.Process
+    proc = mp.Process(target=target)            # <- GL118
+    proc.start()
+    return proc
+
+
+def fire_and_forget(argv, log):
+    # writing the pid down is not reaping it
+    child = subprocess.Popen(argv)              # <- GL118
+    log.write(f"spawned {child.pid}\n")
+
+
+class LeakySpawner:
+    # spawn in one method, NO release anywhere in the class: the
+    # class-scope evidence rule has nothing to find
+    def spawn(self, argv):
+        self._child = subprocess.Popen(argv)    # <- GL118
+        return self._child
+
+    def status(self):
+        return self._child.poll()  # poll observes; it does not reap
+
+
+def unrelated_scope_reaps(other_proc):
+    # evidence here must NOT clear the spawns above: a wait on a
+    # DIFFERENT child in a DIFFERENT scope is exactly the false
+    # comfort that leaks the zombie
+    other_proc.wait(timeout=5.0)

@@ -1,0 +1,488 @@
+"""graftscale: traffic-driven fleet autoscaling + zero-downtime
+weight rollout.
+
+The headline pins (ISSUE 16 acceptance):
+- sustained saturation (FleetSaturated sheds / pending depth above
+  the combined admission windows) scales the fleet UP; sustained
+  idleness drains the least-loaded replica DOWN — with hysteresis +
+  cooldown, so a square-wave load produces a bounded event sequence,
+  never a flap;
+- a rolling weight rollout under CONTINUOUS load completes with zero
+  failed requests and every stream byte-identical to a fixed fleet
+  of its serving version (per-version token exactness);
+- a freshly spawned decode replica is prewarmed through the fleet
+  prefix directory BEFORE the router admits traffic, and the warm-up
+  tokens never pollute the merged client counters;
+- satellite pins: /snapshot.json surfaces router-held pending depth
+  + per-replica admission windows; a reaped replica's directory
+  entry drops AT the reap (not by TTL); Supervisor budget exhaustion
+  under repeated child-spawn failure raises NAMED and never spins.
+
+All host-side: the autoscaler composes existing jitted programs, so
+graftcheck's fingerprints and cost budgets cannot move.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from pytorch_multiprocessing_distributed_tpu import models
+from pytorch_multiprocessing_distributed_tpu.runtime import (
+    faults, fleet as graftfleet, heal)
+from pytorch_multiprocessing_distributed_tpu.runtime.store import (
+    MemStore)
+from pytorch_multiprocessing_distributed_tpu.serving import (
+    EngineReplicaSpawner, FleetAutoscaler, FleetSaturated,
+    PrefixCacheDirectory, ProcessReplicaSpawner, RollingRollout,
+    Router, ServingEngine, ServingReplica, SpawnFailed, init_params)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tiny(**kw):
+    return models.GPT(vocab_size=61, max_seq_len=64, hidden_size=32,
+                      num_layers=2, num_heads=2, mlp_dim=64,
+                      attn_impl="xla", **kw)
+
+
+@pytest.fixture(scope="module")
+def served():
+    model = _tiny()
+    params = init_params(model, 1)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, model.vocab_size, (n,)).tolist()
+               for n in (3, 7, 12, 5, 9, 6, 4, 8)]
+    return model, params, prompts
+
+
+def _engine(model, params, **kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("s_max", 32)
+    kw.setdefault("min_bucket", 8)
+    kw.setdefault("retry_backoff_s", 0.0)
+    return ServingEngine(model, params, **kw)
+
+
+def _scaler(router, model, params, **kw):
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 3)
+    kw.setdefault("up_after", 2)
+    kw.setdefault("down_after", 6)
+    kw.setdefault("cooldown", 3)
+    kw.setdefault("sleep", lambda s: None)
+    return FleetAutoscaler(
+        router, EngineReplicaSpawner(
+            lambda tag, journal: _engine(model, params)), **kw)
+
+
+def _drive(router, scaler, rollout=None):
+    done = router.step()
+    scaler.tick()
+    if rollout is not None:
+        rollout.tick()
+    return done
+
+
+# ------------------------------------------------- scale-up / -down
+
+def test_scale_up_on_sustained_saturation(served):
+    """Sustained offered load past one replica's capacity grows the
+    fleet (bounded by max_replicas), every request completes, and
+    the merged token count stays exact across the joins."""
+    model, params, prompts = served
+    router = Router(
+        [ServingReplica("r0", _engine(model, params))], max_pending=4)
+    scaler = _scaler(router, model, params)
+    uid = 0
+    for _ in range(30):  # 2 new requests per tick: a real burst
+        for _ in range(2):
+            try:
+                router.submit(list(prompts[uid % len(prompts)]), 6,
+                              uid=f"u{uid}")
+                uid += 1
+            except FleetSaturated:
+                pass
+        _drive(router, scaler)
+    assert scaler.scale_ups >= 1
+    assert len(router.replicas) > 1
+    assert len(router.replicas) <= 3
+    steps = 0
+    while (router.in_flight or router.pending_depth) and steps < 3000:
+        _drive(router, scaler)
+        steps += 1
+    recs = router.records()
+    done = [u for u, r in recs.items() if r.state == "done"]
+    assert len(done) == uid, "every admitted request completes"
+    merged = router.merged_metrics()
+    assert merged["tokens_generated"] == sum(
+        len(recs[u].tokens) for u in done)
+
+
+def test_scale_down_to_min_with_hysteresis_never_flaps(served):
+    """After the burst drains, sustained idleness drains the fleet
+    back to min_replicas — and the event timeline shows hysteresis:
+    consecutive membership changes are separated by more than the
+    cooldown, and an idle fleet at min NEVER spawns or drains."""
+    model, params, prompts = served
+    router = Router(
+        [ServingReplica("r0", _engine(model, params))], max_pending=4)
+    scaler = _scaler(router, model, params, cooldown=3, down_after=6)
+    uid = 0
+    for _ in range(25):
+        for _ in range(2):
+            try:
+                router.submit(list(prompts[uid % len(prompts)]), 6,
+                              uid=f"u{uid}")
+                uid += 1
+            except FleetSaturated:
+                pass
+        _drive(router, scaler)
+    steps = 0
+    while (router.in_flight or router.pending_depth) and steps < 3000:
+        _drive(router, scaler)
+        steps += 1
+    assert scaler.scale_ups >= 1
+    for _ in range(60):  # a long idle plateau
+        _drive(router, scaler)
+    assert len(router.replicas) == 1, "idleness drains back to min"
+    assert router.replicas_retired == scaler.scale_ups
+    # hysteresis pin: membership changes never closer than cooldown
+    changes = [e for e in scaler.events
+               if e.action in ("spawn", "drain")]
+    for a, b in zip(changes, changes[1:]):
+        assert b.tick - a.tick > scaler.cooldown, (
+            f"flap: {a} then {b} within cooldown")
+    # stability pin: an idle fleet at min makes NO further changes
+    n_events = len(scaler.events)
+    for _ in range(30):
+        _drive(router, scaler)
+    assert len(scaler.events) == n_events
+
+
+def test_min_floor_respawns_reaped_capacity(served):
+    """A replica death mid-run (injected engine fatal) is absorbed:
+    the router reaps + redelivers, the scaler retires the corpse and
+    the min floor respawns capacity — streams stay complete and the
+    retired replica's counters stay in the merge."""
+    model, params, prompts = served
+    reps = [ServingReplica(f"r{i}",
+                           _engine(model, params, dispatch_retries=1))
+            for i in range(2)]
+    router = Router(reps)
+    scaler = _scaler(router, model, params, min_replicas=2,
+                     max_replicas=3)
+    for i, p in enumerate(prompts):
+        router.submit(list(p), 6, uid=f"u{i}")
+    for _ in range(3):
+        _drive(router, scaler)
+    plan = faults.FaultPlan(seed=1, rules=[faults.FaultRule(
+        "serving.decode_dispatch", "fatal", times=1)])
+    faults.arm(plan)
+    try:
+        steps = 0
+        while (router.in_flight or router.pending_depth) \
+                and steps < 3000:
+            _drive(router, scaler)
+            steps += 1
+    finally:
+        faults.disarm()
+    assert router.replicas_retired >= 1
+    assert any(e.action == "retire" and e.reason == "reaped"
+               for e in scaler.events)
+    alive = [r for r in router.replicas if not r.dead]
+    assert len(alive) >= 2, "min floor respawned the lost capacity"
+    recs = router.records()
+    assert all(recs[f"u{i}"].state == "done"
+               for i in range(len(prompts)))
+    merged = router.merged_metrics()
+    assert merged["requests_completed"] == len(prompts)
+
+
+def test_prefill_role_scales_independently(served):
+    """Role imbalance drives the RIGHT role's spawn: with the decode
+    side pinned at max, sustained prefill-window exhaustion spawns a
+    PREFILL replica (never a decode one)."""
+    model, params, prompts = served
+    reps = [ServingReplica("pf", _engine(model, params),
+                           role="prefill"),
+            ServingReplica("dc", _engine(model, params),
+                           role="decode")]
+    router = Router(reps)
+    scaler = _scaler(router, model, params, min_replicas=1,
+                     max_replicas=1, min_prefill=1, max_prefill=2,
+                     up_after=1, cooldown=0)
+    for i, p in enumerate(prompts * 2):
+        router.submit(list(p), 4, uid=f"u{i}")
+    steps = 0
+    while (router.in_flight or router.pending_depth) and steps < 3000:
+        _drive(router, scaler)
+        steps += 1
+    spawned = [e for e in scaler.events if e.action == "spawn"]
+    assert spawned, "prefill saturation must have spawned"
+    assert all(e.role == "prefill" for e in spawned)
+    recs = router.records()
+    assert all(r.state == "done" for r in recs.values())
+
+
+# ------------------------------------------------------ prewarm path
+
+def test_prewarm_before_admission_and_counter_hygiene(served):
+    """A joining decode replica replays the directory's hottest
+    prompts through its own engine BEFORE it is routable, and the
+    warm-up tokens are subtracted from the merged client counters."""
+    model, params, prompts = served
+    kw = dict(kv_layout="paged", page_size=8, num_pages=16,
+              prefix_cache=8)
+    router = Router(
+        [ServingReplica("r0", _engine(model, params, **kw))])
+    # serve once so the fleet prefix directory holds hot prompts
+    router.serve([(list(p), 4) for p in prompts[:4]])
+    base = router.merged_metrics()
+    scaler = _scaler(router, model, params)
+    scaler.spawner = EngineReplicaSpawner(
+        lambda tag, journal: _engine(model, params, **kw))
+    replica = scaler.spawn_replica("both", reason="test")
+    assert replica.prewarm_requests > 0, "joined cold"
+    assert replica.prewarm_tokens >= replica.prewarm_requests
+    merged = router.merged_metrics()
+    assert merged["fleet_prewarm_requests"] == \
+        replica.prewarm_requests
+    # client-facing counters must not move: warm-up is not traffic
+    assert merged["requests_completed"] == base["requests_completed"]
+    assert merged["tokens_generated"] == base["tokens_generated"]
+
+
+def test_hot_prompts_ranks_by_hits_then_length():
+    directory = PrefixCacheDirectory(page_size=4)
+    short, hot, long_ = [1] * 4, [2] * 8, [3] * 12
+    for p in (short, hot, long_):
+        directory.register(p, "r0")
+    directory.lookup(hot)
+    directory.lookup(hot)
+    ranked = directory.hot_prompts(2)
+    assert ranked[0] == tuple(hot), "most-hit prompt leads"
+    assert ranked[1] == tuple(long_), "length breaks the tie"
+    assert directory.hot_prompts(0) == []
+
+
+# -------------------------------------------------- satellite 1 + 2
+
+def test_merged_metrics_surfaces_pending_and_windows(served):
+    """Satellite pin: /snapshot.json (merged_metrics) carries the
+    router-held pending depth AND the per-replica admission windows
+    — the autoscaler's own signals, visible to operators."""
+    model, params, prompts = served
+    reps = [ServingReplica(f"r{i}", _engine(model, params))
+            for i in range(2)]
+    router = Router(reps, max_pending=8)
+    for i in range(10):
+        try:
+            router.submit(list(prompts[i % len(prompts)]), 4,
+                          uid=f"u{i}")
+        except FleetSaturated:
+            pass
+    merged = router.merged_metrics()
+    assert merged["fleet_pending"] == router.pending_depth > 0
+    assert merged["fleet_admit_windows"] == {
+        r.rid: r.window for r in reps}
+    assert merged["fleet_admit_window_total"] == sum(
+        r.window for r in reps)
+    assert merged["fleet_transfers_pending"] == 0
+    while router.in_flight or router.pending_depth:
+        router.step()
+
+
+def test_reap_drops_directory_entry_not_just_ttl(served):
+    """Satellite pin: a replica dying (reaped mid-drain or mid-run)
+    is UNPUBLISHED from the store directory at the reap — a reader
+    with NO ttl filter never sees the corpse, instead of waiting for
+    the entry to age out."""
+    model, params, prompts = served
+    store = MemStore()
+    reps = [ServingReplica(f"r{i}",
+                           _engine(model, params, dispatch_retries=1))
+            for i in range(2)]
+    router = Router(reps, store=store, run_uid="t")
+    directory = graftfleet.replica_directory(store, run_uid="t")
+    assert set(directory) == {"r0", "r1"}
+    for i, p in enumerate(prompts):
+        router.submit(list(p), 6, uid=f"u{i}")
+    for _ in range(3):
+        router.step()
+    # die DURING begin_drain: admission closed, work in flight, then
+    # the process is gone — the exact satellite scenario
+    r1 = router._by_rid["r1"]
+    r1.engine.begin_drain("test")
+    r1.engine.health.to_dead("crashed mid-drain")
+    while router.in_flight:
+        router.step()
+    reaped = [r.rid for r in router.replicas if r.reaped]
+    assert reaped == ["r1"]
+    # ttl_s=None: NO staleness filter — the pin is the delete itself
+    directory = graftfleet.replica_directory(store, run_uid="t")
+    assert reaped[0] not in directory, (
+        "reaped replica must drop at the reap, not age out by TTL")
+    survivor = ({"r0", "r1"} - set(reaped)).pop()
+    assert survivor in directory
+
+
+def test_unpublish_replica_roundtrip():
+    store = MemStore()
+    assert graftfleet.publish_replica(store, "r0", run_uid="u")
+    assert "r0" in graftfleet.replica_directory(store, run_uid="u")
+    assert graftfleet.unpublish_replica(store, "r0", run_uid="u")
+    assert "r0" not in graftfleet.replica_directory(store,
+                                                    run_uid="u")
+    # idempotent: unpublishing an absent rid is not an error
+    assert graftfleet.unpublish_replica(store, "r0", run_uid="u")
+
+
+# ------------------------------------------------------- satellite 3
+
+def test_spawn_budget_exhaustion_raises_named_never_spins(tmp_path):
+    """Satellite pin: repeated child-spawn failure (a child that dies
+    before publishing an address — the bad --listen shape) exhausts
+    the Supervisor budget and raises NAMED, with the spawn's name in
+    the message and a BOUNDED number of attempts/backoffs."""
+    sleeps = []
+    spawner = ProcessReplicaSpawner(
+        lambda rid, role, tag, addr_file: [
+            sys.executable, "-c", "import sys; sys.exit(3)"],
+        workdir=str(tmp_path), spawn_timeout_s=10.0, poll_s=0.01)
+    attempts = [0]
+
+    def body(attempt):
+        attempts[0] += 1
+        return spawner.spawn("s0", "both", None)
+
+    supervisor = heal.Supervisor(
+        body, max_restarts=2, backoff_s=1.0,
+        sleep=sleeps.append, name="graftscale spawn s0")
+    with pytest.raises(heal.RestartBudgetExhausted) as err:
+        supervisor.run()
+    assert "graftscale spawn s0" in str(err.value)
+    assert isinstance(err.value.__cause__, SpawnFailed)
+    assert attempts[0] == 3, "budget + 1 attempts, then STOP"
+    assert sleeps == [1.0, 2.0], "bounded exponential backoff"
+    assert spawner.children == {}, "no child leaked"
+
+
+def test_autoscaler_absorbs_opportunistic_spawn_failure(served):
+    """An OPPORTUNISTIC scale-up whose spawn budget exhausts is
+    absorbed (counted + cooled down), while a REQUIRED spawn (the
+    min floor) propagates the named exhaustion."""
+    model, params, _ = served
+
+    def explode(tag, journal):
+        raise RuntimeError("no capacity")
+
+    router = Router([ServingReplica("r0", _engine(model, params))])
+    scaler = _scaler(router, model, params)
+    scaler.spawner = EngineReplicaSpawner(explode)
+    assert scaler.spawn_replica("both", reason="test") is None
+    assert scaler.spawn_failures == 1
+    with pytest.raises(heal.RestartBudgetExhausted):
+        scaler.spawn_replica("both", required=True, reason="test")
+
+
+# ------------------------------------------------- rolling rollout
+
+def test_rollout_zero_failures_per_version_byte_exact(served):
+    """THE acceptance pin: a v1->v2 weight rollout under continuous
+    load completes with ZERO failed requests, every replica replaced,
+    and every stream byte-identical to a fixed fleet of its serving
+    version."""
+    model, params, prompts = served
+    params_v2 = init_params(model, 2)
+    versions = {"v1": params, "v2": params_v2}
+
+    def build(tag, journal):
+        return _engine(model, versions[tag])
+
+    router = Router(
+        [ServingReplica("r0", _engine(model, params),
+                        model_tag="v1"),
+         ServingReplica("r1", _engine(model, params),
+                        model_tag="v1")], max_pending=8)
+    scaler = FleetAutoscaler(
+        router, EngineReplicaSpawner(build), min_replicas=2,
+        max_replicas=4, up_after=2, down_after=50, cooldown=0,
+        sleep=lambda s: None)
+    rollout = RollingRollout(scaler, "v2")
+    total = len(prompts) * 3
+    submitted = 0
+    for _ in range(400):
+        if submitted < total:  # load flows THROUGH the rollout
+            try:
+                router.submit(
+                    list(prompts[submitted % len(prompts)]), 6,
+                    uid=f"u{submitted}")
+                submitted += 1
+            except FleetSaturated:
+                pass
+        _drive(router, scaler, rollout)
+        if (rollout.done and submitted == total
+                and not router.in_flight
+                and not router.pending_depth):
+            break
+    assert rollout.done
+    assert rollout.duration_s > 0
+    assert {w["old"] for w in rollout.replaced} == {"r0", "r1"}
+    assert all(r.model_tag == "v2" for r in router.replicas)
+    recs = router.records()
+    assert len(recs) == total
+    assert all(r.state == "done" for r in recs.values()), (
+        "zero failed requests across the rollout")
+    # per-version exactness: each stream matches a fixed single-
+    # version engine's output for its prompt
+    ref = {}
+    for tag in ("v1", "v2"):
+        engine = _engine(model, versions[tag])
+        out = engine.serve([(list(p), 6) for p in prompts])
+        ref[tag] = {tuple(prompts[i]): list(r.tokens)
+                    for i, r in enumerate(out)}
+    for i in range(total):
+        stream = list(recs[f"u{i}"].tokens)
+        key = tuple(prompts[i % len(prompts)])
+        assert stream in (ref["v1"][key], ref["v2"][key]), (
+            f"u{i}: stream matches NEITHER version — mixed weights")
+
+
+# ------------------------------------------------- process spawner
+
+def test_process_spawner_spawn_timeout_kills_child(tmp_path):
+    """A child that hangs without publishing an address is KILLED at
+    the spawn timeout — a half-started orphan is worse than a
+    retry."""
+    spawner = ProcessReplicaSpawner(
+        lambda rid, role, tag, addr_file: [
+            sys.executable, "-c", "import time; time.sleep(60)"],
+        workdir=str(tmp_path), spawn_timeout_s=0.3, poll_s=0.02)
+    with pytest.raises(SpawnFailed, match="no address"):
+        spawner.spawn("s0", "both", None)
+    assert spawner.children == {}
+
+
+@pytest.mark.slow
+def test_scale_smoke_script_end_to_end(tmp_path):
+    """The make-scale smoke, mirrored: spawn-from-zero -> burst ->
+    scale-up -> idle -> scale-down -> rolling rollout, with real
+    --listen replica subprocesses, children reaped loudly."""
+    out = tmp_path / "scale_smoke.json"
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "benchmarks", "scale_smoke.py"),
+         "--out", str(out)],
+        capture_output=True, text=True, timeout=900,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    import json
+    report = json.loads(out.read_text())
+    assert report["scale_ups"] >= 1
+    assert report["scale_downs"] >= 1
+    assert report["requests_failed"] == 0
+    assert report["rollout"]["duration_s"] > 0
+    assert report["leaked_children"] == []
