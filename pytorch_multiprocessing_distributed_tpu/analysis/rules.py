@@ -1459,12 +1459,15 @@ def _check_spawn_reap(file: _File, out: List[Finding]):
     - or the enclosing CLASS does, anywhere in its body — the
       spawn-in-``spawn``, reap-in-``release`` shape
       (ProcessReplicaSpawner's discipline);
-    - or the module's top level does.
+    - or, for a spawn at MODULE scope only, the module's top level
+      does (a script's spawn-then-join main block).
 
     ``subprocess.run``/``check_call``/``check_output`` self-reap and
     are never flagged. Evidence in an UNRELATED sibling function does
-    not count: a ``wait`` on a different child in a different scope
-    is exactly the false comfort that leaks the zombie.
+    not count, and module-level evidence never excuses a spawn inside
+    a function or class: a ``wait`` on a different child in a
+    different scope is exactly the false comfort that leaks the
+    zombie.
     """
     evidence_fns: Set[int] = set()
     evidence_cls: Set[int] = set()
@@ -1508,7 +1511,10 @@ def _check_spawn_reap(file: _File, out: List[Finding]):
             continue
         if cls is not None and cls in evidence_cls:
             continue
-        if module_evidence[0]:
+        # module-level evidence only excuses module-scope spawns: a
+        # top-level join() must not grant file-wide amnesty to spawns
+        # buried in unrelated functions
+        if module_evidence[0] and not fns and cls is None:
             continue
         out.append(Finding(
             file.path, call.lineno, call.col_offset, "GL118",

@@ -64,7 +64,7 @@ import numpy as np
 from ..runtime import scope as graftscope
 from ..runtime.faults import (DeadlineExceeded, FaultInjected,
                               GraftFaultError)
-from .scheduler import FAILED, QueueFull, Request
+from .scheduler import DONE, FAILED, QueueFull, Request
 
 __all__ = ["PageTransfer", "ServingReplica", "ROLES"]
 
@@ -345,7 +345,13 @@ class ServingReplica:
         while self.engine.in_flight and steps < max_steps:
             self.step()
             steps += 1
-        self.prewarm_requests += len(warmed)
+        # only requests that reached DONE count: the fleet merge
+        # subtracts prewarm_requests from requests_completed, and a
+        # warm request that failed (or ran out of max_steps) was
+        # never counted there — subtracting it would undercount
+        # client-completed work
+        self.prewarm_requests += sum(1 for r in warmed
+                                     if r.state == DONE)
         self.prewarm_tokens += sum(len(r.tokens) for r in warmed)
         graftscope.emit("scale.prewarm", cat="serving", rid=self.rid,
                         prompts=len(warmed),

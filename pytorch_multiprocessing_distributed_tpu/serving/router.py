@@ -274,6 +274,10 @@ class Router:
         self.steals = 0
         self.transfers_routed = 0
         self.transfer_bytes = 0  # host-round-trip KV block payload
+        # version-orphaned transfers recovered by re-prefill (rollout:
+        # the last same-tag decode replica left while the block was
+        # queued — the block drops, the request re-routes fresh)
+        self.transfers_withdrawn = 0
         self.requests_shed_fleet = 0
         self._draining = False
         # graftscale: counters of replicas REMOVED from the fleet
@@ -545,7 +549,13 @@ class Router:
         """Splice finished prefills into decode replicas; a transfer
         nobody admits stays queued (the fleet-level hold — the decode
         side's backpressure reaches the prefill side as a growing
-        transfer queue)."""
+        transfer queue). A version-pinned transfer whose tag no live
+        decode replica can EVER serve again (rollout: the last
+        same-tag decode began draining — forward-only health, it
+        never re-admits) is withdrawn instead of held forever: the
+        block drops and the request re-routes as fresh intake, which
+        is exact because a transfer carries no client-visible tokens
+        (tok0 is only delivered at the splice)."""
         n = len(self._transfers)
         for _ in range(n):
             transfer = self._transfers.popleft()
@@ -607,6 +617,29 @@ class Router:
                 placed = True
                 break
             if not placed:
+                if (transfer.src_tag is not None
+                        and not any(
+                            r.model_tag == transfer.src_tag
+                            and not r.engine.health.draining
+                            for r in self._decode_replicas())):
+                    # version-orphaned (graftscale rollout): no alive
+                    # same-tag decode replica remains that could ever
+                    # admit this block — requeueing would strand the
+                    # request forever while Router.in_flight never
+                    # reaches 0 (the rollout-hang class). Drop the
+                    # block and re-dispatch the request fresh — the
+                    # same recovery as the reap's withdraw_prefill
+                    # path, and exact for the same reason: no tokens
+                    # reached the client yet.
+                    self.transfers_withdrawn += 1
+                    self._assigned.pop(transfer.request.uid, None)
+                    graftscope.emit("route.transfer_withdrawn",
+                                    cat="serving",
+                                    req=transfer.request.uid,
+                                    tag=transfer.src_tag)
+                    if not self._dispatch_request(transfer.request):
+                        self._pending.append(transfer.request)
+                    continue
                 self._transfers.append(transfer)
 
     def _reap(self, replica: ServingReplica,
@@ -979,6 +1012,7 @@ class Router:
         merged["fleet_prefix_routed"] = self.prefix_routed
         merged["fleet_steals"] = self.steals
         merged["fleet_transfers_routed"] = self.transfers_routed
+        merged["fleet_transfers_withdrawn"] = self.transfers_withdrawn
         merged["fleet_transfer_bytes"] = self.transfer_bytes
         merged["fleet_requests_shed"] = self.requests_shed_fleet
         merged["fleet_replicas"] = len(self.replicas)

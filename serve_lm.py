@@ -846,9 +846,18 @@ def main():
             router = Router(replicas)
             scaler = rollout = None
             if scale_mode:
+                # spawned replicas get the same per-rid WAL the seed
+                # replicas get — an autoscaled/rollout replica must
+                # not silently downgrade its crash recovery to
+                # router-record reconstruction
+                journal_for = None
+                if args.journal:
+                    journal_for = (lambda rid: heal.RequestJournal(
+                        f"{args.journal}.{rid}"))
                 scaler = FleetAutoscaler(
                     router,
-                    EngineReplicaSpawner(build_tagged),
+                    EngineReplicaSpawner(build_tagged,
+                                         journal_for=journal_for),
                     min_replicas=scale_min, max_replicas=scale_max,
                     min_prefill=roles.count('prefill'),
                     max_prefill=(scale_max if 'prefill' in roles

@@ -46,3 +46,15 @@ def unrelated_scope_reaps(other_proc):
     # DIFFERENT child in a DIFFERENT scope is exactly the false
     # comfort that leaks the zombie
     other_proc.wait(timeout=5.0)
+
+
+def spawn_despite_module_evidence(argv):
+    # the MODULE-level wait below (a main block reaping some other
+    # child) must not excuse this function-scoped spawn: module
+    # evidence clears module-scope spawns only
+    return subprocess.Popen(argv)               # <- GL118
+
+
+_LEFTOVER_CHILD = None
+if _LEFTOVER_CHILD is not None:
+    _LEFTOVER_CHILD.wait(timeout=1.0)

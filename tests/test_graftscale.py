@@ -451,6 +451,124 @@ def test_rollout_zero_failures_per_version_byte_exact(served):
             f"u{i}: stream matches NEITHER version — mixed weights")
 
 
+def test_version_orphaned_transfer_recovers_never_hangs(served):
+    """Regression (rollout-hang class): a version-pinned transfer
+    whose last same-tag decode replica began draining while the
+    block sat in the router queue must NOT requeue forever — the
+    router withdraws it (drops the block, re-routes the request as
+    fresh prefill intake), the request completes on the NEW version
+    byte-exact, and the fleet drains to empty."""
+    model, params, prompts = served
+    params_v2 = init_params(model, 2)
+    p0 = ServingReplica("p0", _engine(model, params), role="prefill",
+                        model_tag="v1")
+    d0 = ServingReplica("d0", _engine(model, params), role="decode",
+                        model_tag="v1")
+    router = Router([p0, d0], max_pending=8)
+    router.submit(list(prompts[0]), 4, uid="u0")
+    # produce the v1-tagged transfer by hand so the interleaving is
+    # exactly the race: the block is queued BEFORE the router ever
+    # tries to place it
+    transfer = p0.prefill_step()
+    assert transfer is not None and transfer.src_tag == "v1"
+    router._transfers.append(transfer)
+    # mid-rollout takeover: the v2 replacements have joined, both v1
+    # replicas are draining — no v1 decode replica will EVER admit
+    # again (health is forward-only)
+    router.add_replica(ServingReplica(
+        "p1", _engine(model, params_v2), role="prefill",
+        model_tag="v2"))
+    router.add_replica(ServingReplica(
+        "d1", _engine(model, params_v2), role="decode",
+        model_tag="v2"))
+    p0.engine.health.to_draining("rollout")
+    d0.engine.begin_drain("rollout")
+    steps = 0
+    while router.in_flight and steps < 300:
+        router.step()
+        steps += 1
+    assert steps < 300, (
+        "fleet hung: the version-orphaned transfer was requeued "
+        "forever instead of withdrawn")
+    assert router.transfers_withdrawn == 1
+    assert router.merged_metrics()["fleet_transfers_withdrawn"] == 1
+    rec = router.records()["u0"]
+    assert rec.state == "done"
+    # the re-prefilled request ran start-to-finish on v2: its stream
+    # is byte-identical to a fixed v2 fleet's
+    ref = _engine(model, params_v2).serve([(list(prompts[0]), 4)])
+    assert list(rec.tokens) == list(ref[0].tokens)
+
+
+def test_rollout_on_disaggregated_fleet_completes(served):
+    """The serve_lm wiring the hang hid in: --rollout on a
+    prefill/decode split fleet (min_prefill pinned). The rollout
+    replaces BOTH roles under continuous load, completes with zero
+    failed requests, and leaves no transfer stranded."""
+    model, params, prompts = served
+    params_v2 = init_params(model, 2)
+    versions = {"v1": params, "v2": params_v2}
+
+    def build(tag, journal):
+        return _engine(model, versions[tag])
+
+    router = Router(
+        [ServingReplica("p0", _engine(model, params), role="prefill",
+                        model_tag="v1"),
+         ServingReplica("d0", _engine(model, params), role="decode",
+                        model_tag="v1")], max_pending=8)
+    scaler = FleetAutoscaler(
+        router, EngineReplicaSpawner(build), min_replicas=1,
+        max_replicas=2, min_prefill=1, max_prefill=2, up_after=2,
+        down_after=50, cooldown=0, sleep=lambda s: None)
+    total = len(prompts)
+    submitted = 0
+    # seed v1 work BEFORE the rollout arms, so v1-tagged transfers
+    # are genuinely in flight when the old decode side drains
+    for _ in range(4):
+        router.submit(list(prompts[submitted % len(prompts)]), 6,
+                      uid=f"u{submitted}")
+        submitted += 1
+        _drive(router, scaler)
+    rollout = RollingRollout(scaler, "v2")
+    for _ in range(600):
+        if submitted < total:
+            try:
+                router.submit(
+                    list(prompts[submitted % len(prompts)]), 6,
+                    uid=f"u{submitted}")
+                submitted += 1
+            except FleetSaturated:
+                pass
+        _drive(router, scaler, rollout)
+        if (rollout.done and submitted == total
+                and not router.in_flight):
+            break
+    assert rollout.done
+    assert submitted == total
+    assert not router.in_flight, (
+        "work stranded after the rollout (transfer-queue hang)")
+    assert router.transfer_depth == 0
+    assert all(r.model_tag == "v2" for r in router.replicas)
+    recs = router.records()
+    assert len(recs) == total
+    assert all(r.state == "done" for r in recs.values()), (
+        "zero failed requests across the disaggregated rollout")
+    # per-version exactness holds through the withdraw/re-prefill
+    # recovery: every stream matches a fixed fleet of SOME version
+    ref = {}
+    for tag in ("v1", "v2"):
+        out = _engine(model, versions[tag]).serve(
+            [(list(p), 6) for p in prompts])
+        ref[tag] = {tuple(prompts[i]): list(r.tokens)
+                    for i, r in enumerate(out)}
+    for i in range(total):
+        stream = list(recs[f"u{i}"].tokens)
+        key = tuple(prompts[i % len(prompts)])
+        assert stream in (ref["v1"][key], ref["v2"][key]), (
+            f"u{i}: stream matches NEITHER version — mixed weights")
+
+
 # ------------------------------------------------- process spawner
 
 def test_process_spawner_spawn_timeout_kills_child(tmp_path):
