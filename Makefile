@@ -4,8 +4,8 @@
 PYTEST_ENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 
 .PHONY: test test-fast lint check check-update chaos soak scope meter \
-        fleet spec zero route wire scale dryrun bench bench-cpu store \
-        clean
+        fleet spec zero route wire scale quant dryrun bench bench-cpu \
+        store clean
 
 # graftlint: AST-only jit-hygiene gate (no jax import, milliseconds).
 # Exit 1 on any non-baselined finding; the tier-1 suite and
@@ -85,6 +85,17 @@ fleet:
 # tests/test_graftspec.py).
 spec:
 	$(PYTEST_ENV) python benchmarks/spec_smoke.py
+
+# graftquant: int8-KV smoke — the quantized engine's greedy streams
+# (dense AND paged) must be byte-identical to the model-dtype engine
+# at the head_dim=64 geometry, per_slot_kv_bytes must match a real
+# int8 pool byte-for-byte with the bf16 ratio clearing 1.8x, the
+# teacher-forced logit delta must be NONZERO and < 5e-3, and a
+# quantized detached prefill must splice transcript-equal at < 0.6x
+# the model-dtype payload. Same body runs in tier-1
+# (test_quant_smoke_end_to_end in tests/test_graftquant.py).
+quant:
+	$(PYTEST_ENV) python benchmarks/quant_smoke.py
 
 # graftzero: sharded-weight-update smoke — on a 2-shard CPU mesh the
 # traced zero DP step must move grads as exactly ONE reduce-scatter +

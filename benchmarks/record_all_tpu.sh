@@ -8,6 +8,8 @@
 #   benchmarks/generate_bench_tpu.txt  (decode tokens/sec)
 #   benchmarks/serving_bench_tpu.json  (load + length-bucket sweeps)
 #   benchmarks/serving_bench_spec_tpu.json (graftspec accepted/step)
+#   benchmarks/serving_bench_quant_tpu.json (graftquant int8-KV
+#                                      residency + logit-delta sweep)
 #   benchmarks/serving_bench_fleet_tpu.json (graftroute fleet/disagg/
 #                                      redelivery sweep)
 #   benchmarks/serving_bench_autoscale_tpu.json (graftscale traces +
@@ -53,6 +55,13 @@ python benchmarks/serving_bench.py \
     --json_out benchmarks/serving_bench_paged_tpu.json \
     > benchmarks/serving_bench_paged_tpu.txt 2>&1
 tail -16 benchmarks/serving_bench_paged_tpu.txt >&2
+
+note "serving bench (graftquant: int8 KV vs model-dtype at fixed HBM + wire halving)"
+python benchmarks/serving_bench.py \
+    --sweep quant \
+    --json_out benchmarks/serving_bench_quant_tpu.json \
+    > benchmarks/serving_bench_quant_tpu.txt 2>&1
+tail -8 benchmarks/serving_bench_quant_tpu.txt >&2
 
 note "serving bench (graftroute: 2-replica fleet + disagg + redelivery)"
 python benchmarks/serving_bench.py \

@@ -121,6 +121,19 @@ Three sweeps over the continuous-batching :class:`ServingEngine`:
     steady load: wall-clock **rollout duration**, zero failed
     requests, every stream byte-exact to exactly one weight version.
 
+11. **Quant sweep** (``--sweep quant``, graftquant): int8 KV + f32
+    per-page-per-head scales vs model-dtype KV at **FIXED HBM**.
+    Point one: the planner inversion in both modes, pinned byte-exact
+    against real pools, with the per-slot KV byte ratio gated at its
+    own geometry floor (>= **1.8x** wherever ``head_dim >= 64`` —
+    every TPU registry model). Point two: ``run_point`` model-dtype
+    at the budget's dense slot count vs int8 at the planned quantized
+    count — resident requests and tok/s side by side at the same
+    byte budget. Point three: greedy transcripts asserted EQUAL on a
+    canonical subset and the max-abs teacher-forced **logit delta**
+    vs the model-dtype cache recorded and gated (audited, not
+    asserted away — int8 KV is not token-exact by construction).
+
 ``offered=inf`` is the closed-loop limit: every request submitted
 up front, measuring peak engine throughput. CPU-runnable (shapes clamp
 down off-TPU, same convention as ``generate_bench.py``), TPU-ready.
@@ -634,6 +647,136 @@ def run_paged_sweep(model, params, args, rng):
     return results
 
 
+def run_quant_sweep(model, params, args, rng):
+    """graftquant (sweep 11): int8 KV at fixed HBM — residency gain
+    (planner, byte-exact vs real pools), measured occupancy + tok/s
+    both modes, transcript equality on a canonical subset, and the
+    teacher-forced logit-delta audit. See module docstring."""
+    from pytorch_multiprocessing_distributed_tpu.analysis.meter import (
+        plan_capacity)
+    from pytorch_multiprocessing_distributed_tpu.inference import (
+        teacher_forced_logits)
+    from pytorch_multiprocessing_distributed_tpu.runtime import (
+        hbm as hbm_ledger)
+    from pytorch_multiprocessing_distributed_tpu.serving import (
+        ServingEngine, SlotPool)
+
+    new_tokens = args.new_tokens
+    s_max = model.max_seq_len
+    prompt_hi = max(2, min(args.prompt_max, s_max - new_tokens) - 1)
+    lengths = _draw_lengths(rng, "mixed", args.requests,
+                            max(1, prompt_hi // 8), prompt_hi)
+    prompts = [rng.integers(0, model.vocab_size, (n,)).tolist()
+               for n in lengths]
+    results = []
+
+    # ---- point (a): the byte claim, planner == allocator both modes
+    kv_model = SlotPool.per_slot_kv_bytes(model, s_max)
+    kv_int8 = SlotPool.per_slot_kv_bytes(model, s_max, "int8")
+    kv_ratio = kv_model / kv_int8
+    head_dim = model.hidden_size // model.num_heads
+    itemsize = jnp.dtype(model.dtype).itemsize
+    # int8 stores head_dim 1-byte lanes + one f32 scale per group:
+    # the achievable ratio IS itemsize*Dh/(Dh+4). Gate at that floor,
+    # clamped to the 1.8x headline it clears at head_dim >= 64
+    # (gpt_small/gpt_medium) for bf16 and at any registry geometry
+    # for f32 — a layout regression (fatter sidecar, padding) trips
+    # this before it ships
+    ratio_floor = min(1.8, itemsize * head_dim / (head_dim + 4)
+                      * 0.999)
+    assert kv_ratio >= ratio_floor, (
+        f"int8 per-slot KV ratio {kv_ratio:.3f} under the geometry "
+        f"floor {ratio_floor:.3f}")
+    # FIXED budget: params + exactly N model-dtype slots (KV + scalar
+    # state) — plan_ref inverts it back to N, plan_q to what int8
+    # fits in the same bytes. N >= 5 so integer slot-count floors
+    # cannot mask the gain at small --slots
+    slots_dense = max(int(args.slots.split(",")[0]), 5)
+    per_slot_full = kv_model + SlotPool.per_slot_state_bytes()
+    budget = (hbm_ledger.tree_nbytes(params)
+              + slots_dense * per_slot_full)
+    plan_ref = plan_capacity(model, s_max, budget, params=params)
+    plan_q = plan_capacity(model, s_max, budget, params=params,
+                           kv_dtype="int8")
+    assert plan_ref["max_slots"] == slots_dense
+    planned_gain = plan_q["max_slots"] / plan_ref["max_slots"]
+    assert planned_gain >= min(1.8, ratio_floor), (
+        f"planned residency gain {planned_gain:.2f}x under the floor "
+        f"at a {slots_dense}-slot budget")
+    # planner-vs-allocation byte-exactness pin (the graftmeter
+    # contract, quantized mode): a real int8 pool of the planned slot
+    # count registers exactly the planned KV bytes
+    with hbm_ledger.scoped_ledger() as ledger:
+        pool = SlotPool(model, plan_q["max_slots"], s_max,
+                        kv_dtype="int8")
+        kv_entry = ledger.entries()["serving.kv_pool"]
+    assert kv_entry[1] == plan_q["max_slots"] * kv_int8, (
+        "planner and quantized SlotPool disagree on the KV bytes")
+    del pool
+
+    # ---- point (b): measured residency + throughput at the budget
+    quant_slots = max(slots_dense + 1,
+                      min(plan_q["max_slots"], args.requests))
+    ref = run_point(model, params, prompts, new_tokens, slots_dense,
+                    float("inf"), s_max)
+    quant = run_point(model, params, prompts, new_tokens, quant_slots,
+                      float("inf"), s_max, kv_dtype="int8")
+    for mode, r, eng_slots in (("model", ref, slots_dense),
+                               ("int8", quant, quant_slots)):
+        r.update(mode=mode, kv_dtype=mode, slots=eng_slots,
+                 hbm_budget_bytes=budget, s_max=s_max,
+                 per_slot_kv_bytes=(kv_int8 if mode == "int8"
+                                    else kv_model),
+                 per_slot_kv_ratio=kv_ratio,
+                 resident_requests=r["occupancy_max"],
+                 planner_max_slots=(plan_q if mode == "int8"
+                                    else plan_ref)["max_slots"],
+                 planned_residency_gain=planned_gain)
+        results.append(r)
+    print(f"quant    KV/slot {kv_model} -> {kv_int8} B "
+          f"({kv_ratio:.2f}x, head_dim={head_dim})  planned slots "
+          f"{plan_ref['max_slots']} -> {plan_q['max_slots']} "
+          f"({planned_gain:.2f}x at {budget / (1 << 20):.1f} MiB)  "
+          f"resident {ref['occupancy_max']} -> "
+          f"{quant['occupancy_max']}  "
+          f"{ref['tokens_per_sec']:.1f} -> "
+          f"{quant['tokens_per_sec']:.1f} tok/s", flush=True)
+
+    # ---- point (c): quality audit — transcripts + logit delta.
+    # int8 KV is NOT token-exact by construction; the bench pins the
+    # canonical subset byte-equal and puts the honest logit delta on
+    # the record (gated at the committed tolerance per dtype)
+    eng_ref = ServingEngine(model, params, max_slots=2, s_max=s_max)
+    eng_q = ServingEngine(model, params, max_slots=2, s_max=s_max,
+                          kv_dtype="int8")
+    canon = prompts[:4]
+    out_ref = eng_ref.serve([(p, new_tokens) for p in canon])
+    out_q = eng_q.serve([(p, new_tokens) for p in canon])
+    for i, (a, b) in enumerate(zip(out_q, out_ref)):
+        assert list(a.tokens) == list(b.tokens), (
+            f"int8 stream {i} diverged from the model-dtype engine")
+    full = jnp.asarray(list(canon[0])
+                       + list(out_ref[0].tokens))[None, :]
+    lg_ref = teacher_forced_logits(model, params, full, len(canon[0]))
+    lg_q = teacher_forced_logits(model, params, full, len(canon[0]),
+                                 kv_dtype="int8")
+    delta = float(np.max(np.abs(np.asarray(lg_ref)
+                                - np.asarray(lg_q))))
+    tol = 5e-3 if itemsize >= 4 else 6e-2
+    assert 0.0 < delta < tol, (
+        f"teacher-forced logit delta {delta:.2e} outside (0, {tol})")
+    point = {
+        "mode": "quant_quality", "kv_dtype": "int8",
+        "requests": len(canon), "transcripts_equal": True,
+        "logit_delta_max": delta, "logit_delta_tol": tol,
+    }
+    results.append(point)
+    print(f"quant    {len(canon)} canonical streams byte-equal, "
+          f"max |logit delta| = {delta:.2e} (tol {tol:.0e})",
+          flush=True)
+    return results
+
+
 def train_repetitive(model, params, motif, steps=60, lr=0.1,
                      seq=64, batch=8, seed=0):
     """Quick plain-SGD fit of ``model`` on the cyclic ``motif``
@@ -1024,8 +1167,10 @@ def run_wire_sweep(model, params, args, rng):
     seam it mirrors — (1) same fleet, two transports: tok/s side by
     side, streams byte-identical, per-RPC overhead p50/p95; (2)
     disaggregation over the wire: PageTransfer bytes/request at the
-    payload and framing layers; (3) socket-level kill -> WAL
-    redelivery with the recovery TTFT on the clock."""
+    payload and framing layers — then the SAME split with int8 KV
+    (graftquant), bytes/request halved vs the model-dtype run; (3)
+    socket-level kill -> WAL redelivery with the recovery TTFT on
+    the clock."""
     import tempfile
 
     from pytorch_multiprocessing_distributed_tpu.runtime import (
@@ -1044,18 +1189,21 @@ def run_wire_sweep(model, params, args, rng):
         max(1, prompt_hi // 2), prompt_hi + 1)),)).tolist()
         for _ in range(n_req)]
 
-    def mk(journal=None, dispatch_retries=3):
+    def mk(journal=None, dispatch_retries=3, kv_dtype="model"):
         return ServingEngine(model, params, max_slots=slots,
                              s_max=s_max, decode_buckets=(),
                              retry_backoff_s=0.0, journal=journal,
-                             dispatch_retries=dispatch_retries)
+                             dispatch_retries=dispatch_retries,
+                             kv_dtype=kv_dtype)
 
-    def socket_fleet(journals=None, roles=("both", "both")):
+    def socket_fleet(journals=None, roles=("both", "both"),
+                     kv_dtype="model"):
         servers = []
         for i, role in enumerate(roles):
             journal = journals[i] if journals else None
             servers.append(ReplicaServer(
-                mk(journal, dispatch_retries=1 if journals else 3),
+                mk(journal, dispatch_retries=1 if journals else 3,
+                   kv_dtype=kv_dtype),
                 rid=f"r{i}", role=role).start())
         replicas = [RemoteReplica(s.address, backoff_s=0.0)
                     for s in servers]
@@ -1150,6 +1298,61 @@ def run_wire_sweep(model, params, args, rng):
               f"{point['transfer_bytes_per_request']} KV B/req over "
               f"{router.transfers_routed} transfers (token-exact)",
               flush=True)
+        results.append(point)
+        model_bytes_per_request = point["transfer_bytes_per_request"]
+    finally:
+        for server in servers:
+            server.stop()
+
+    # ---- point 2b: the SAME disaggregation, int8 KV on the wire
+    # (graftquant): the PageTransfer rides as int8 blocks + f32
+    # scales (4 raw segments). int8 is not token-exact vs the
+    # model-dtype fleet, so the reference is an in-process int8
+    # engine — transport must not change ONE token of it — and the
+    # headline is transfer bytes/request against point 2's run
+    eng_q = mk(kv_dtype="int8")
+    ref_q = eng_q.serve([(p, new_tokens) for p in prompts])
+    ref_q_tokens = {i: list(r.tokens) for i, r in enumerate(ref_q)}
+    q_tokens = sum(len(t) for t in ref_q_tokens.values())
+    meter0 = wire.wire_meter()["wire_bytes_sent"]
+    router, servers, replicas = socket_fleet(
+        roles=("prefill", "decode"), kv_dtype="int8")
+    try:
+        router.serve([(prompts[0], 2)])
+        t0 = time.perf_counter()
+        out = router.serve([(p, new_tokens) for p in prompts])
+        quant_s = time.perf_counter() - t0
+        for i, r in enumerate(out):
+            assert r.state == "done" and \
+                list(r.tokens) == ref_q_tokens[i], (
+                    f"quantized wire-disagg stream {i} diverged from "
+                    "the in-process int8 engine")
+        wire_sent = wire.wire_meter()["wire_bytes_sent"] - meter0
+        bpr = router.transfer_bytes // max(1, router.transfers_routed)
+        point = {
+            "mode": "wire_disagg_quant", "kv_dtype": "int8",
+            "slots": slots, "requests": n_req,
+            "tokens_per_sec": q_tokens / quant_s,
+            "transfers": router.transfers_routed,
+            "transfer_bytes": router.transfer_bytes,
+            "transfer_bytes_per_request": bpr,
+            "model_dtype_bytes_per_request": model_bytes_per_request,
+            "transfer_bytes_ratio": bpr / model_bytes_per_request,
+            "wire_bytes_sent": wire_sent,
+            "token_exact_vs_int8_engine": True,
+        }
+        assert wire_sent >= router.transfer_bytes
+        # the halving claim: int8 lanes + f32 scales vs model-dtype
+        # blocks over the SAME prompt set — (Dh+4)/(itemsize*Dh),
+        # < 0.6 for bf16 at head_dim >= 16 and any f32 geometry
+        assert bpr < 0.6 * model_bytes_per_request, (
+            f"quantized transfer {bpr} B/req is not < 0.6x the "
+            f"model-dtype {model_bytes_per_request} B/req")
+        print(f"wire     prefill->decode int8  "
+              f"{point['tokens_per_sec']:9.1f} tok/s  "
+              f"{bpr} KV B/req vs {model_bytes_per_request} "
+              f"model-dtype ({point['transfer_bytes_ratio']:.2f}x, "
+              f"token-exact vs int8 engine)", flush=True)
         results.append(point)
     finally:
         for server in servers:
@@ -1404,7 +1607,7 @@ def main():
     p.add_argument("--sweep", default="load,length,horizon", type=str,
                    help="which sweeps to run: load, length, horizon, "
                         "chaos, drain, paged, spec, fleet, wire, "
-                        "autoscale, or "
+                        "autoscale, quant, or "
                         "any comma list")
     p.add_argument("--chaos_every", default=5, type=int,
                    help="chaos sweep: inject one transient fault every "
@@ -1475,7 +1678,8 @@ def main():
               "s_max": s_max, "load_sweep": [], "length_sweep": [],
               "horizon_sweep": [], "chaos_sweep": [], "drain_sweep": [],
               "paged_sweep": [], "spec_sweep": [], "fleet_sweep": [],
-              "wire_sweep": [], "autoscale_sweep": []}
+              "wire_sweep": [], "autoscale_sweep": [],
+              "quant_sweep": []}
     sweeps = args.sweep.split(",")
 
     if "load" in sweeps:
@@ -1535,6 +1739,10 @@ def main():
     if "autoscale" in sweeps:
         record["autoscale_sweep"] = run_autoscale_sweep(
             model, params, args, rng)
+
+    if "quant" in sweeps:
+        record["quant_sweep"] = run_quant_sweep(model, params, args,
+                                                rng)
 
     if args.json_out:
         with open(args.json_out, "w") as f:

@@ -225,7 +225,8 @@ def plan_capacity(model, s_max: int, hbm_budget: int, *,
                   zero_shards: int = 1,
                   reserved_bytes: int = 0,
                   page_size: Optional[int] = None,
-                  length_dist: Optional[Sequence[int]] = None) -> dict:
+                  length_dist: Optional[Sequence[int]] = None,
+                  kv_dtype: str = "model") -> dict:
     """Invert the HBM ledger: how much serving capacity fits a chip.
 
     Args:
@@ -262,6 +263,12 @@ def plan_capacity(model, s_max: int, hbm_budget: int, *,
       length_dist: per-request TOTAL token counts (prompt + generated)
         of the traffic to plan for; paged mode averages their page
         demand to predict resident requests at the budget.
+      kv_dtype: ``"model"`` or ``"int8"`` (graftquant) — the pool's
+        element layout; int8 charges 1 byte per KV element plus the
+        4-byte f32 per-token-per-head scale, the exact bytes the
+        quantized ``SlotPool``/``PagePool`` allocates, so the
+        inversion stays byte-exact in BOTH modes (meter smoke pins
+        it against a real pool).
 
     Returns the plan dict: ``params_bytes``, ``opt_state_bytes``,
     ``per_slot_bytes`` (dense worst-case KV + per-slot scalar state —
@@ -296,12 +303,12 @@ def plan_capacity(model, s_max: int, hbm_budget: int, *,
     else:
         per_moment = params_bytes
     opt_bytes = int(optimizer_moments) * per_moment
-    per_slot = (SlotPool.per_slot_kv_bytes(model, s_max)
+    per_slot = (SlotPool.per_slot_kv_bytes(model, s_max, kv_dtype)
                 + SlotPool.per_slot_state_bytes())
     fixed = params_bytes + opt_bytes + int(reserved_bytes)
     free = hbm_budget - fixed
     max_slots = max(0, free // per_slot)
-    per_row = SlotPool.per_slot_kv_bytes(model, s_max)
+    per_row = SlotPool.per_slot_kv_bytes(model, s_max, kv_dtype)
     plan = {
         "hbm_budget": int(hbm_budget),
         "params_bytes": params_bytes,
@@ -314,6 +321,7 @@ def plan_capacity(model, s_max: int, hbm_budget: int, *,
         "max_generate_batch": int(max(0, free // per_row)),
         "s_max": int(s_max),
         "zero_shards": int(zero_shards),
+        "kv_dtype": kv_dtype,
         "fits": fixed <= hbm_budget,
     }
     if page_size is None:
@@ -324,7 +332,7 @@ def plan_capacity(model, s_max: int, hbm_budget: int, *,
     # smoke); the scratch page is charged before pages are counted.
     from ..serving.kv_pages import PagePool
 
-    page_bytes = PagePool.page_kv_bytes(model, page_size)
+    page_bytes = PagePool.page_kv_bytes(model, page_size, kv_dtype)
     max_pages = max(0, (free - page_bytes) // page_bytes)  # - scratch
     plan.update({
         "page_size": int(page_size),
@@ -452,6 +460,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         help="--plan: graftzero DP degree — moments "
                              "sharded over N ranks cost shard_bytes "
                              "per chip instead of params_bytes")
+    parser.add_argument("--kv_dtype", default="model",
+                        choices=("model", "int8"),
+                        help="--plan: KV-pool element layout — int8 "
+                             "(graftquant) charges 1 byte/element + "
+                             "the f32 per-token-per-head scale")
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -467,7 +480,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                              int(args.hbm_gb * (1 << 30)),
                              optimizer_moments=args.optimizer_moments,
                              zero_shards=args.zero_shards,
-                             page_size=args.page_size)
+                             page_size=args.page_size,
+                             kv_dtype=args.kv_dtype)
         if args.as_json:
             print(json.dumps(plan, indent=2, sort_keys=True))
         else:
@@ -481,7 +495,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                       + (f" (zero_shards={plan['zero_shards']})"
                          if args.zero_shards > 1 else ""))
             print(f"  per KV slot       "
-                  f"{plan['per_slot_bytes'] / (1 << 20):10.1f} MiB")
+                  f"{plan['per_slot_bytes'] / (1 << 20):10.1f} MiB"
+                  + (" (int8 + f32 scales)"
+                     if args.kv_dtype == "int8" else ""))
             print(f"  max resident slots {plan['max_slots']:9d}")
             print(f"  max generate batch {plan['max_generate_batch']:9d}")
             print(f"  headroom          "

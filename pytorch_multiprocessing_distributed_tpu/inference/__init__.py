@@ -1,5 +1,8 @@
 """Inference: KV-cached autoregressive generation for the LM family."""
 
-from .generate import beam_search, generate, shard_params_for_tp_decode
+from .generate import (beam_search, generate,
+                       shard_params_for_tp_decode,
+                       teacher_forced_logits)
 
-__all__ = ["beam_search", "generate", "shard_params_for_tp_decode"]
+__all__ = ["beam_search", "generate", "shard_params_for_tp_decode",
+           "teacher_forced_logits"]

@@ -25,6 +25,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..ops.kv_quant import (QuantizedKV, kv_slice_in_dim, quantize_kv,
+                            stack_kv)
 from ..ops.pallas.decode_attention import (decode_attention,
                                            paged_decode_attention,
                                            paged_verify_decode_attention,
@@ -257,9 +259,20 @@ def _block_decode_slots(p, x_t, k_cache, v_cache, positions, h, dtype,
             page_table, (positions // ps)[:, None], axis=1)[:, 0]
         offs = positions % ps
         # per-row write through the table: row j's K/V lands at its
-        # own (page, offset) — pages [P, H, ps, Dh], k[:, 0] [N, H, Dh]
-        k_cache = k_cache.at[page_ids, :, offs].set(k[:, 0])
-        v_cache = v_cache.at[page_ids, :, offs].set(v[:, 0])
+        # own (page, offset) — pages [P, H, ps, Dh], k[:, 0] [N, H, Dh].
+        # graftquant pages quantize the fresh token's K/V over Dh and
+        # write BOTH leaves at the same (page, offset)
+        if isinstance(k_cache, QuantizedKV):
+            qk, qv = quantize_kv(k[:, 0]), quantize_kv(v[:, 0])
+            k_cache = QuantizedKV(
+                k_cache.data.at[page_ids, :, offs].set(qk.data),
+                k_cache.scale.at[page_ids, :, offs].set(qk.scale))
+            v_cache = QuantizedKV(
+                v_cache.data.at[page_ids, :, offs].set(qv.data),
+                v_cache.scale.at[page_ids, :, offs].set(qv.scale))
+        else:
+            k_cache = k_cache.at[page_ids, :, offs].set(k[:, 0])
+            v_cache = v_cache.at[page_ids, :, offs].set(v[:, 0])
         n_win = (-(-int(window) // ps) if window is not None
                  else page_table.shape[1])
         ids = jax.lax.slice_in_dim(page_table, 0,
@@ -276,6 +289,17 @@ def _block_decode_slots(p, x_t, k_cache, v_cache, positions, h, dtype,
             k_cache, k, (0, positions[0], 0, 0))
         v_cache = jax.lax.dynamic_update_slice(
             v_cache, v, (0, positions[0], 0, 0))
+    elif isinstance(k_cache, QuantizedKV):
+        # graftquant slots: quantize the fresh K/V over Dh, scatter
+        # data AND scale to each slot's own column
+        rows = jnp.arange(n)
+        qk, qv = quantize_kv(k[:, 0]), quantize_kv(v[:, 0])
+        k_cache = QuantizedKV(
+            k_cache.data.at[rows, positions].set(qk.data),
+            k_cache.scale.at[rows, positions].set(qk.scale))
+        v_cache = QuantizedKV(
+            v_cache.data.at[rows, positions].set(qv.data),
+            v_cache.scale.at[rows, positions].set(qv.scale))
     else:
         # per-slot column write: slot j's K/V lands at its own position
         # (generate's dynamic_update_slice, vectorized)
@@ -283,8 +307,8 @@ def _block_decode_slots(p, x_t, k_cache, v_cache, positions, h, dtype,
         k_cache = k_cache.at[rows, positions].set(k[:, 0])
         v_cache = v_cache.at[rows, positions].set(v[:, 0])
     if window is not None and window < k_cache.shape[1]:
-        k_win = jax.lax.slice_in_dim(k_cache, 0, window, axis=1)
-        v_win = jax.lax.slice_in_dim(v_cache, 0, window, axis=1)
+        k_win = kv_slice_in_dim(k_cache, 0, window, axis=1)
+        v_win = kv_slice_in_dim(v_cache, 0, window, axis=1)
         valid_win = (None if kv_valid is None
                      else jax.lax.slice_in_dim(kv_valid, 0, window,
                                                axis=1))
@@ -363,8 +387,17 @@ def _block_verify_slots(p, x_t, k_cache, v_cache, positions, h, dtype,
             page_table, jnp.clip(blk, 0, n_tab - 1), axis=1)
         page_ids = jnp.where(blk < n_tab, page_ids, 0)
         offs = cols % ps
-        k_cache = k_cache.at[page_ids, :, offs].set(k)
-        v_cache = v_cache.at[page_ids, :, offs].set(v)
+        if isinstance(k_cache, QuantizedKV):
+            qk, qv = quantize_kv(k), quantize_kv(v)
+            k_cache = QuantizedKV(
+                k_cache.data.at[page_ids, :, offs].set(qk.data),
+                k_cache.scale.at[page_ids, :, offs].set(qk.scale))
+            v_cache = QuantizedKV(
+                v_cache.data.at[page_ids, :, offs].set(qv.data),
+                v_cache.scale.at[page_ids, :, offs].set(qv.scale))
+        else:
+            k_cache = k_cache.at[page_ids, :, offs].set(k)
+            v_cache = v_cache.at[page_ids, :, offs].set(v)
         n_win = (-(-int(window) // ps) if window is not None
                  else page_table.shape[1])
         ids = jax.lax.slice_in_dim(page_table, 0,
@@ -375,11 +408,22 @@ def _block_verify_slots(p, x_t, k_cache, v_cache, positions, h, dtype,
             impl=attn_impl, interpret=interpret)
     else:
         rows = jnp.arange(n)[:, None]
-        k_cache = k_cache.at[rows, cols].set(k, mode="drop")
-        v_cache = v_cache.at[rows, cols].set(v, mode="drop")
+        if isinstance(k_cache, QuantizedKV):
+            qk, qv = quantize_kv(k), quantize_kv(v)
+            k_cache = QuantizedKV(
+                k_cache.data.at[rows, cols].set(qk.data, mode="drop"),
+                k_cache.scale.at[rows, cols].set(qk.scale,
+                                                 mode="drop"))
+            v_cache = QuantizedKV(
+                v_cache.data.at[rows, cols].set(qv.data, mode="drop"),
+                v_cache.scale.at[rows, cols].set(qv.scale,
+                                                 mode="drop"))
+        else:
+            k_cache = k_cache.at[rows, cols].set(k, mode="drop")
+            v_cache = v_cache.at[rows, cols].set(v, mode="drop")
         if window is not None and window < k_cache.shape[1]:
-            k_win = jax.lax.slice_in_dim(k_cache, 0, window, axis=1)
-            v_win = jax.lax.slice_in_dim(v_cache, 0, window, axis=1)
+            k_win = kv_slice_in_dim(k_cache, 0, window, axis=1)
+            v_win = kv_slice_in_dim(v_cache, 0, window, axis=1)
         else:
             k_win, v_win = k_cache, v_cache
         att = verify_decode_attention(q, k_win, v_win, positions,
@@ -544,7 +588,7 @@ def _decode_horizon(model, params, k_caches, v_caches, positions,
         positions = jnp.where(active, positions + 1, positions)
         last_tokens = jnp.where(active, nxt, last_tokens)
         active = jnp.logical_and(active, jnp.logical_not(finished))
-        return (cs_cache(jnp.stack(new_k)), cs_cache(jnp.stack(new_v)),
+        return (cs_cache(stack_kv(new_k)), cs_cache(stack_kv(new_v)),
                 positions, last_tokens, active, remaining), emitted
 
     carry, tokens = jax.lax.scan(
@@ -674,7 +718,7 @@ def _decode_horizon_spec(model, params, k_caches, v_caches, positions,
             active, jnp.logical_or(hit_eos, remaining <= 0))
         positions = positions + e
         active = jnp.logical_and(active, jnp.logical_not(finished))
-        out = (cs_cache(jnp.stack(new_k)), cs_cache(jnp.stack(new_v)),
+        out = (cs_cache(stack_kv(new_k)), cs_cache(stack_kv(new_v)),
                positions, last_tokens, active, remaining)
         if draft_model is not None:
             out = out + (dk, dv)
@@ -1085,9 +1129,77 @@ def beam_search(
     return jnp.concatenate([prompt_k, history], axis=2), scores
 
 
+# ----------------------------------------------------------- graftquant
+
+def teacher_forced_logits(model, params, tokens, prompt_len: int, *,
+                          kv_dtype: str = "model", attn_impl: str = "xla",
+                          block_k: int = 256, interpret=None):
+    """Decode-path logits along a FIXED transcript with the KV cache in
+    ``kv_dtype`` — the graftquant quality instrument.
+
+    Prefills ``tokens[:, :prompt_len]``, (optionally) quantizes the
+    prefilled cache exactly as the serving engine's insert does, then
+    teacher-forces ``tokens[:, prompt_len:]`` through the shared decode
+    body (:func:`_block_decode_slots`, per-slot scatter writes — the
+    engine's path). Step ``j`` consumes ``tokens[:, prompt_len + j]``
+    and yields the logits predicting position ``prompt_len + j + 1``.
+
+    Returns ``[T - prompt_len, B, V]`` f32: row 0 is the prefill's
+    next-token logits (predicting position ``prompt_len``), row ``j``
+    predicts position ``prompt_len + j``. Because the transcript is
+    held fixed, running this twice (``kv_dtype="model"`` vs ``"int8"``)
+    isolates the cache representation: the elementwise max-abs delta is
+    the quantization's logit cost, free of divergence compounding —
+    the number the quant bench budgets and the tests pin."""
+    b, total = tokens.shape
+    steps = total - int(prompt_len)
+    if steps < 1:
+        raise ValueError(
+            f"need at least one decode position: prompt_len="
+            f"{prompt_len} vs {total} tokens")
+    dtype = model.dtype
+    eps = getattr(model, "ln_eps", _LN_EPS)
+    moe_k = getattr(model, "moe_top_k", 1)
+    h = model.num_heads
+    n_layers = model.num_layers
+    x, k_caches, v_caches = _prefill(
+        model, params, tokens[:, :prompt_len], total)
+    first = _logits(params, x[:, -1:], eps)[:, 0]         # [B, V]
+    if kv_dtype == "int8":
+        # whole-cache quantization == insert-time quantization: the
+        # untouched tail columns are zeros -> (data 0, scale 1), the
+        # empty-pool layout
+        k_caches, v_caches = quantize_kv(k_caches), quantize_kv(v_caches)
+    if steps == 1:
+        return first[None]
+
+    def step(carry, inp):
+        k_caches, v_caches = carry
+        tok, p = inp
+        pos = jnp.full((b,), p, jnp.int32)
+        x_t = (params["embed"][tok][:, None, :].astype(dtype)
+               + params["pos_embed"][p][None, None, :].astype(dtype))
+        new_k, new_v = [], []
+        for i in range(n_layers):
+            x_t, kc, vc = _block_decode_slots(
+                params[f"block_{i}"], x_t, k_caches[i], v_caches[i],
+                pos, h, dtype, eps, _no_cs, moe_k, attn_impl=attn_impl,
+                block_k=block_k, interpret=interpret)
+            new_k.append(kc)
+            new_v.append(vc)
+        logits = _logits(params, x_t, eps)[:, 0]
+        return (stack_kv(new_k), stack_kv(new_v)), logits
+
+    xs = (jnp.moveaxis(tokens[:, prompt_len:-1], 0, 1),
+          jnp.arange(prompt_len, total - 1, dtype=jnp.int32))
+    _, rest = jax.lax.scan(step, (k_caches, v_caches), xs)
+    return jnp.concatenate([first[None], rest], axis=0)
+
+
 # ----------------------------------------------------------- graftmeter
 
-def generate_kv_bytes(model, batch: int, s_max: int) -> int:
+def generate_kv_bytes(model, batch: int, s_max: int,
+                      kv_dtype: str = "model") -> int:
     """Worst-case K+V cache bytes one :func:`generate` call holds
     resident: the exact ``[L, B, s_max, H, Dh]`` x2 allocation
     ``_prefill`` makes — ``batch`` rows of the SAME per-slot product
@@ -1097,7 +1209,8 @@ def generate_kv_bytes(model, batch: int, s_max: int) -> int:
     entry together). Lazy import: ``serving`` imports this module."""
     from ..serving.kv_slots import SlotPool
 
-    return int(batch) * SlotPool.per_slot_kv_bytes(model, int(s_max))
+    return int(batch) * SlotPool.per_slot_kv_bytes(model, int(s_max),
+                                                   kv_dtype)
 
 
 def register_generate_hbm(model, batch: int, s_max: int) -> None:

@@ -20,6 +20,17 @@ Matmuls stay in the input dtype (bf16 hits the MXU's native rate),
 accumulation is f32, outputs are f32 (the engine casts back to model
 dtype after the residual add, matching the XLA path's dtypes exactly).
 
+**graftquant**: every kernel (and every XLA reference) also takes the
+KV operand as a :class:`...kv_quant.QuantizedKV` pair — int8 data plus
+a per-(token, head) f32 scale streamed beside it (dense: a ``[B*H,
+S]`` row per block; paged: the ``[ps]`` sidecar of the SAME page the
+scalar-prefetched table steers in). The dequant is ONE multiply in the
+VMEM stream, applied before the existing MXU dot — so the decode step's
+dominant HBM bytes term (the K/V read) halves while the matmul dtype
+and f32 accumulation stay exactly as above. The XLA fallbacks dequant
+with the identical expression before the reference einsum, so CPU
+tier-1 pins the exact math the TPU kernel runs.
+
 ``impl="xla"`` is the reference fallback — the exact einsum/softmax
 math the engine shipped with (and ``inference.generate`` still uses),
 kept here so both paths live side by side and the equivalence test has
@@ -38,6 +49,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..kv_quant import QuantizedKV, dequantize_kv
 from .flash_attention import NEG_INF
 
 __all__ = ["decode_attention", "paged_decode_attention",
@@ -47,10 +59,25 @@ __all__ = ["decode_attention", "paged_decode_attention",
            "xla_paged_verify_decode_attention"]
 
 
-def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, acc, m_scr,
-                   l_scr, *, scale, block_k):
+def _kernel_dequant(blk, scale_row, dtype):
+    """graftquant's ONE in-kernel dequant expression: int8 lanes times
+    the per-(token, head) f32 scale, cast to the MXU compute dtype —
+    the same math as :func:`...kv_quant.dequantize_kv`, so the XLA
+    fallbacks pin exactly what the kernel streams."""
+    return (blk.astype(jnp.float32)
+            * scale_row[..., None]).astype(dtype)
+
+
+def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, *rest, scale, block_k,
+                   quant):
     """One (slot*head, k-block) grid cell; k is the innermost axis so
-    the softmax state lives in VMEM scratch across the K/V stream."""
+    the softmax state lives in VMEM scratch across the K/V stream.
+    ``quant`` (static) inserts two scale refs after v_ref and dequants
+    each K/V block in the VMEM stream before the dot."""
+    if quant:
+        ks_ref, vs_ref, o_ref, acc, m_scr, l_scr = rest
+    else:
+        o_ref, acc, m_scr, l_scr = rest
     kb = pl.program_id(1)
     n_k = pl.num_programs(1)
 
@@ -69,6 +96,9 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, acc, m_scr,
         q = q_ref[0]          # [1, d]
         kblk = k_ref[0]       # [bk, d]
         vblk = v_ref[0]
+        if quant:
+            kblk = _kernel_dequant(kblk, ks_ref[0], q.dtype)
+            vblk = _kernel_dequant(vblk, vs_ref[0], q.dtype)
         s = jnp.dot(q, kblk.T,
                     preferred_element_type=jnp.float32) * scale  # [1, bk]
         col = kb * block_k + jax.lax.broadcasted_iota(
@@ -89,40 +119,62 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, acc, m_scr,
         o_ref[0] = acc[:] / jnp.maximum(l_scr[:], 1e-30)
 
 
-def _pallas_decode(q, k, v, positions, scale, block_k, interpret):
+def _pallas_decode(q, k, v, positions, scale, block_k, interpret,
+                   k_scale=None, v_scale=None):
     """q [B, 1, H, Dh]; k/v [B, S, H, Dh]; positions [B] -> f32
     [B, 1, H, Dh]. Heads merge into the grid's batch axis (one
-    (slot, head) pair per row program), K/V stream blockwise."""
+    (slot, head) pair per row program), K/V stream blockwise.
+    graftquant: with ``k_scale``/``v_scale`` (``[B, S, H]`` f32) the
+    K/V operands are int8 and each block dequants in VMEM."""
     b, _, h, d = q.shape
     s = k.shape[1]
+    quant = k_scale is not None
     block_k = max(8, min(block_k, ((s + 7) // 8) * 8))
     pad = (-s) % block_k
     if pad:
         k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        if quant:
+            k_scale = jnp.pad(k_scale, ((0, 0), (0, pad), (0, 0)))
+            v_scale = jnp.pad(v_scale, ((0, 0), (0, pad), (0, 0)))
     n_k = k.shape[1] // block_k
 
     def merge(x):  # [B, S, H, Dh] -> [B*H, S, Dh]
         return jnp.moveaxis(x, 2, 1).reshape(b * h, x.shape[1], d)
+
+    def merge_scale(x):  # [B, S, H] -> [B*H, S]
+        return jnp.moveaxis(x, 2, 1).reshape(b * h, x.shape[1])
 
     q3 = merge(q)                      # [B*H, 1, Dh]
     k3, v3 = merge(k), merge(v)
     # one position scalar per (slot, head) row program
     pos_bh = jnp.repeat(positions.astype(jnp.int32), h)
 
+    in_specs = [
+        pl.BlockSpec((1,), lambda i, kb: (i,),
+                     memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, 1, d), lambda i, kb: (i, 0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, d), lambda i, kb: (i, kb, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, d), lambda i, kb: (i, kb, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    operands = [pos_bh, q3, k3, v3]
+    if quant:
+        in_specs += [
+            pl.BlockSpec((1, block_k), lambda i, kb: (i, kb),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k), lambda i, kb: (i, kb),
+                         memory_space=pltpu.VMEM),
+        ]
+        operands += [merge_scale(k_scale), merge_scale(v_scale)]
+
     out = pl.pallas_call(
-        functools.partial(_decode_kernel, scale=scale, block_k=block_k),
+        functools.partial(_decode_kernel, scale=scale, block_k=block_k,
+                          quant=quant),
         grid=(b * h, n_k),
-        in_specs=[
-            pl.BlockSpec((1,), lambda i, kb: (i,),
-                         memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, 1, d), lambda i, kb: (i, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda i, kb: (i, kb, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda i, kb: (i, kb, 0),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, d), lambda i, kb: (i, 0, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((b * h, 1, d), jnp.float32),
@@ -132,19 +184,24 @@ def _pallas_decode(q, k, v, positions, scale, block_k, interpret):
             pltpu.VMEM((1, 1), jnp.float32),   # running denominator
         ],
         interpret=interpret,
-    )(pos_bh, q3, k3, v3)
+    )(*operands)
     return jnp.moveaxis(out.reshape(b, h, 1, d), 1, 2)  # [B, 1, H, Dh]
 
 
-def _paged_decode_kernel(pos_ref, tab_ref, q_ref, k_ref, v_ref, o_ref,
-                         acc, m_scr, l_scr, *, scale, page_size, heads):
+def _paged_decode_kernel(pos_ref, tab_ref, q_ref, k_ref, v_ref, *rest,
+                         scale, page_size, heads, quant):
     """One (slot*head, page) grid cell of the PAGED flash-decode: the
     same online-softmax recurrence as :func:`_decode_kernel`, but the
     K/V block for step ``kb`` is whatever PAGE the scalar-prefetched
     table maps column-block ``kb`` to — the index map does the
     indirection BEFORE the DMA, so the stream through VMEM is still
     one pass over exactly the pages the slot owns (never a gathered
-    contiguous copy in HBM)."""
+    contiguous copy in HBM). ``quant`` (static) inserts the two scale
+    sidecars, steered by the SAME table indirection."""
+    if quant:
+        ks_ref, vs_ref, o_ref, acc, m_scr, l_scr = rest
+    else:
+        o_ref, acc, m_scr, l_scr = rest
     i = pl.program_id(0)
     kb = pl.program_id(1)
     n_k = pl.num_programs(1)
@@ -166,6 +223,9 @@ def _paged_decode_kernel(pos_ref, tab_ref, q_ref, k_ref, v_ref, o_ref,
         q = q_ref[0]             # [1, d]
         kblk = k_ref[0, 0]       # [ps, d]
         vblk = v_ref[0, 0]
+        if quant:
+            kblk = _kernel_dequant(kblk, ks_ref[0, 0], q.dtype)
+            vblk = _kernel_dequant(vblk, vs_ref[0, 0], q.dtype)
         s = jnp.dot(q, kblk.T,
                     preferred_element_type=jnp.float32) * scale
         col = kb * page_size + jax.lax.broadcasted_iota(
@@ -187,29 +247,43 @@ def _paged_decode_kernel(pos_ref, tab_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def _pallas_paged_decode(q, k_pages, v_pages, page_table, positions,
-                         scale, interpret):
+                         scale, interpret, k_scale=None, v_scale=None):
     """q [B, 1, H, Dh]; k/v pages [P, H, ps, Dh]; page_table
     [B, n_win] int32; positions [B] -> f32 [B, 1, H, Dh]. Grid is
     (slot*head, page); the table rides in SMEM via scalar prefetch and
-    steers each page block's DMA."""
+    steers each page block's DMA. graftquant: ``k_scale``/``v_scale``
+    (``[P, H, ps]`` f32) ride the same indirection as their pages."""
     b, _, h, d = q.shape
     ps = k_pages.shape[2]
     n_win = page_table.shape[1]
+    quant = k_scale is not None
     q3 = jnp.moveaxis(q, 2, 1).reshape(b * h, 1, d)  # [B*H, 1, Dh]
 
+    in_specs = [
+        pl.BlockSpec((1, 1, d),
+                     lambda i, kb, pos, tab: (i, 0, 0)),
+        pl.BlockSpec((1, 1, ps, d),
+                     lambda i, kb, pos, tab:
+                     (tab[i // h, kb], i % h, 0, 0)),
+        pl.BlockSpec((1, 1, ps, d),
+                     lambda i, kb, pos, tab:
+                     (tab[i // h, kb], i % h, 0, 0)),
+    ]
+    operands = [q3, k_pages, v_pages]
+    if quant:
+        in_specs += [
+            pl.BlockSpec((1, 1, ps),
+                         lambda i, kb, pos, tab:
+                         (tab[i // h, kb], i % h, 0)),
+            pl.BlockSpec((1, 1, ps),
+                         lambda i, kb, pos, tab:
+                         (tab[i // h, kb], i % h, 0)),
+        ]
+        operands += [k_scale, v_scale]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,  # positions, page table
         grid=(b * h, n_win),
-        in_specs=[
-            pl.BlockSpec((1, 1, d),
-                         lambda i, kb, pos, tab: (i, 0, 0)),
-            pl.BlockSpec((1, 1, ps, d),
-                         lambda i, kb, pos, tab:
-                         (tab[i // h, kb], i % h, 0, 0)),
-            pl.BlockSpec((1, 1, ps, d),
-                         lambda i, kb, pos, tab:
-                         (tab[i // h, kb], i % h, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, d),
                                lambda i, kb, pos, tab: (i, 0, 0)),
         scratch_shapes=[
@@ -220,13 +294,37 @@ def _pallas_paged_decode(q, k_pages, v_pages, page_table, positions,
     )
     out = pl.pallas_call(
         functools.partial(_paged_decode_kernel, scale=scale,
-                          page_size=ps, heads=h),
+                          page_size=ps, heads=h, quant=quant),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b * h, 1, d), jnp.float32),
         interpret=interpret,
     )(positions.astype(jnp.int32), page_table.astype(jnp.int32),
-      q3, k_pages, v_pages)
+      *operands)
     return jnp.moveaxis(out.reshape(b, h, 1, d), 1, 2)  # [B, 1, H, Dh]
+
+
+def _gather_paged_window(pages, page_table, q_dtype,
+                         window: Optional[int] = None):
+    """``take``-gather windowed pages into the contiguous
+    ``[B, W, H, Dh]`` view the dense references consume. graftquant
+    pages gather BOTH leaves through the same table, then dequant with
+    the kernel's exact expression — per-element identical to the
+    in-VMEM dequant, which is what keeps the XLA fallback the pin."""
+    b, n_win = page_table.shape
+    if isinstance(pages, QuantizedKV):
+        h, ps, d = pages.shape[1], pages.shape[2], pages.shape[3]
+        gd = jnp.take(pages.data, page_table, axis=0)
+        gd = jnp.moveaxis(gd, 3, 2).reshape(b, n_win * ps, h, d)
+        gs = jnp.take(pages.scale, page_table, axis=0)
+        gs = jnp.moveaxis(gs, 3, 2).reshape(b, n_win * ps, h)
+        g = dequantize_kv(QuantizedKV(gd, gs), q_dtype)
+    else:
+        h, ps, d = pages.shape[1], pages.shape[2], pages.shape[3]
+        g = jnp.take(pages, page_table, axis=0)  # [B, n_win, H, ps, Dh]
+        g = jnp.moveaxis(g, 3, 2).reshape(b, n_win * ps, h, d)
+    if window is not None and window < n_win * ps:
+        g = jax.lax.slice_in_dim(g, 0, window, axis=1)
+    return g
 
 
 def xla_paged_decode_attention(q, k_pages, v_pages, page_table,
@@ -235,20 +333,10 @@ def xla_paged_decode_attention(q, k_pages, v_pages, page_table,
     the contiguous ``[B, W, H, Dh]`` view and run the EXACT dense
     reference math (:func:`xla_decode_attention`) — bit-identical to
     the dense-slot engine on the same logical columns, which is the
-    seam the paged==dense equivalence pin rests on."""
-    b = q.shape[0]
-    h, d = q.shape[2], q.shape[3]
-    ps = k_pages.shape[2]
-    n_win = page_table.shape[1]
-
-    def gather(pages):
-        g = jnp.take(pages, page_table, axis=0)  # [B, n_win, H, ps, Dh]
-        g = jnp.moveaxis(g, 3, 2).reshape(b, n_win * ps, h, d)
-        if window is not None and window < n_win * ps:
-            g = jax.lax.slice_in_dim(g, 0, window, axis=1)
-        return g
-
-    k_win, v_win = gather(k_pages), gather(v_pages)
+    seam the paged==dense equivalence pin rests on. Quantized pages
+    dequant at the gather (the kernel's exact per-element math)."""
+    k_win = _gather_paged_window(k_pages, page_table, q.dtype, window)
+    v_win = _gather_paged_window(v_pages, page_table, q.dtype, window)
     mask = (jnp.arange(k_win.shape[1])[None, :] <= positions[:, None])
     return xla_decode_attention(q, k_win, v_win, mask)
 
@@ -270,7 +358,9 @@ def paged_decode_attention(
       q: ``[B, 1, H, Dh]`` — one pending query token per slot.
       k_pages, v_pages: ``[P, H, page_size, Dh]`` page storage (ONE
         layer's pages — heads before the column offset so the Pallas
-        block's trailing dims are the tileable ``[page_size, Dh]``).
+        block's trailing dims are the tileable ``[page_size, Dh]``),
+        or a :class:`...kv_quant.QuantizedKV` pair (int8 data + the
+        ``[P, H, page_size]`` f32 scale sidecar, dequanted in-stream).
       page_table: ``[B, n_win]`` int32 — slot ``b``'s logical column
         block ``kb`` lives in page ``page_table[b, kb]``. Callers pass
         the WINDOWED slice of the full table (``ceil(window /
@@ -294,6 +384,11 @@ def paged_decode_attention(
 
             interpret = default_interpret()
         scale = q.shape[-1] ** -0.5
+        if isinstance(k_pages, QuantizedKV):
+            return _pallas_paged_decode(
+                q, k_pages.data, v_pages.data, page_table, positions,
+                scale, bool(interpret), k_scale=k_pages.scale,
+                v_scale=v_pages.scale)
         return _pallas_paged_decode(q, k_pages, v_pages, page_table,
                                     positions, scale, bool(interpret))
     if impl != "xla":
@@ -331,7 +426,9 @@ def decode_attention(
     Args:
       q: ``[B, 1, H, Dh]`` — one pending query token per slot.
       k, v: ``[B, S, H, Dh]`` KV window (the engine passes the
-        length-bucketed prefix slice of its slot caches).
+        length-bucketed prefix slice of its slot caches), or a
+        :class:`...kv_quant.QuantizedKV` pair (int8 data + the
+        ``[B, S, H]`` f32 scale sidecar, dequanted in-stream).
       positions: ``[B]`` int — slot ``b`` attends columns
         ``[0, positions[b]]`` inclusive. Required for the Pallas path;
         the XLA path derives ``mask`` from it when ``mask`` is None.
@@ -360,6 +457,10 @@ def decode_attention(
 
             interpret = default_interpret()
         scale = q.shape[-1] ** -0.5
+        if isinstance(k, QuantizedKV):
+            return _pallas_decode(q, k.data, v.data, positions, scale,
+                                  int(block_k), bool(interpret),
+                                  k_scale=k.scale, v_scale=v.scale)
         return _pallas_decode(q, k, v, positions, scale, int(block_k),
                               bool(interpret))
     if impl != "xla":
@@ -370,6 +471,8 @@ def decode_attention(
             raise ValueError("xla path needs positions or mask")
         mask = (jnp.arange(k.shape[1])[None, :]
                 <= positions[:, None])
+    if isinstance(k, QuantizedKV):
+        k, v = dequantize_kv(k, q.dtype), dequantize_kv(v, q.dtype)
     return xla_decode_attention(q, k, v, mask)
 
 
@@ -390,10 +493,17 @@ def decode_attention(
 # with a [K1, d] query block instead of [1, d].
 
 
-def _verify_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, acc, m_scr,
-                   l_scr, *, scale, block_k, k1):
+def _verify_kernel(pos_ref, q_ref, k_ref, v_ref, *rest, scale, block_k,
+                   k1, quant):
     """One (slot*head, k-block) grid cell; the softmax state is [K1]
-    rows of the same online recurrence as :func:`_decode_kernel`."""
+    rows of the same online recurrence as :func:`_decode_kernel`.
+    ``quant`` (static): dequant each K/V block in-stream — the verify
+    pass reads the SAME quantized pages one decode step reads, so
+    spec-decode bandwidth halves with it."""
+    if quant:
+        ks_ref, vs_ref, o_ref, acc, m_scr, l_scr = rest
+    else:
+        o_ref, acc, m_scr, l_scr = rest
     kb = pl.program_id(1)
     n_k = pl.num_programs(1)
 
@@ -413,6 +523,9 @@ def _verify_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, acc, m_scr,
         q = q_ref[0]          # [K1, d]
         kblk = k_ref[0]       # [bk, d]
         vblk = v_ref[0]
+        if quant:
+            kblk = _kernel_dequant(kblk, ks_ref[0], q.dtype)
+            vblk = _kernel_dequant(vblk, vs_ref[0], q.dtype)
         s = jnp.dot(q, kblk.T,
                     preferred_element_type=jnp.float32) * scale  # [K1, bk]
         col = kb * block_k + jax.lax.broadcasted_iota(
@@ -434,39 +547,59 @@ def _verify_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, acc, m_scr,
         o_ref[0] = acc[:] / jnp.maximum(l_scr[:], 1e-30)
 
 
-def _pallas_verify(q, k, v, positions, scale, block_k, interpret):
+def _pallas_verify(q, k, v, positions, scale, block_k, interpret,
+                   k_scale=None, v_scale=None):
     """q [B, K1, H, Dh]; k/v [B, S, H, Dh]; positions [B] -> f32
-    [B, K1, H, Dh]."""
+    [B, K1, H, Dh]. graftquant: ``k_scale``/``v_scale`` ([B, S, H]
+    f32) mark the K/V operands int8, dequanted per block in VMEM."""
     b, k1, h, d = q.shape
     s = k.shape[1]
+    quant = k_scale is not None
     block_k = max(8, min(block_k, ((s + 7) // 8) * 8))
     pad = (-s) % block_k
     if pad:
         k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        if quant:
+            k_scale = jnp.pad(k_scale, ((0, 0), (0, pad), (0, 0)))
+            v_scale = jnp.pad(v_scale, ((0, 0), (0, pad), (0, 0)))
     n_k = k.shape[1] // block_k
 
     def merge(x):  # [B, S, H, Dh] -> [B*H, S, Dh]
         return jnp.moveaxis(x, 2, 1).reshape(b * h, x.shape[1], d)
 
+    def merge_scale(x):  # [B, S, H] -> [B*H, S]
+        return jnp.moveaxis(x, 2, 1).reshape(b * h, x.shape[1])
+
     q3 = merge(q)                      # [B*H, K1, Dh]
     k3, v3 = merge(k), merge(v)
     pos_bh = jnp.repeat(positions.astype(jnp.int32), h)
 
+    in_specs = [
+        pl.BlockSpec((1,), lambda i, kb: (i,),
+                     memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, k1, d), lambda i, kb: (i, 0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, d), lambda i, kb: (i, kb, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, d), lambda i, kb: (i, kb, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    operands = [pos_bh, q3, k3, v3]
+    if quant:
+        in_specs += [
+            pl.BlockSpec((1, block_k), lambda i, kb: (i, kb),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k), lambda i, kb: (i, kb),
+                         memory_space=pltpu.VMEM),
+        ]
+        operands += [merge_scale(k_scale), merge_scale(v_scale)]
+
     out = pl.pallas_call(
         functools.partial(_verify_kernel, scale=scale, block_k=block_k,
-                          k1=k1),
+                          k1=k1, quant=quant),
         grid=(b * h, n_k),
-        in_specs=[
-            pl.BlockSpec((1,), lambda i, kb: (i,),
-                         memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, k1, d), lambda i, kb: (i, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda i, kb: (i, kb, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda i, kb: (i, kb, 0),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, k1, d), lambda i, kb: (i, 0, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((b * h, k1, d), jnp.float32),
@@ -476,16 +609,20 @@ def _pallas_verify(q, k, v, positions, scale, block_k, interpret):
             pltpu.VMEM((k1, 1), jnp.float32),   # running denominator
         ],
         interpret=interpret,
-    )(pos_bh, q3, k3, v3)
+    )(*operands)
     return jnp.moveaxis(out.reshape(b, h, k1, d), 1, 2)  # [B, K1, H, Dh]
 
 
-def _paged_verify_kernel(pos_ref, tab_ref, q_ref, k_ref, v_ref, o_ref,
-                         acc, m_scr, l_scr, *, scale, page_size, heads,
-                         k1):
+def _paged_verify_kernel(pos_ref, tab_ref, q_ref, k_ref, v_ref, *rest,
+                         scale, page_size, heads, k1, quant):
     """Paged k-query verify: :func:`_paged_decode_kernel`'s
     scalar-prefetched page indirection with the [K1, d] query block
-    and the row-staggered column mask."""
+    and the row-staggered column mask. ``quant`` (static): the scale
+    sidecars ride the same table indirection."""
+    if quant:
+        ks_ref, vs_ref, o_ref, acc, m_scr, l_scr = rest
+    else:
+        o_ref, acc, m_scr, l_scr = rest
     i = pl.program_id(0)
     kb = pl.program_id(1)
     n_k = pl.num_programs(1)
@@ -503,6 +640,9 @@ def _paged_verify_kernel(pos_ref, tab_ref, q_ref, k_ref, v_ref, o_ref,
         q = q_ref[0]             # [K1, d]
         kblk = k_ref[0, 0]       # [ps, d]
         vblk = v_ref[0, 0]
+        if quant:
+            kblk = _kernel_dequant(kblk, ks_ref[0, 0], q.dtype)
+            vblk = _kernel_dequant(vblk, vs_ref[0, 0], q.dtype)
         s = jnp.dot(q, kblk.T,
                     preferred_element_type=jnp.float32) * scale
         col = kb * page_size + jax.lax.broadcasted_iota(
@@ -525,27 +665,41 @@ def _paged_verify_kernel(pos_ref, tab_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def _pallas_paged_verify(q, k_pages, v_pages, page_table, positions,
-                         scale, interpret):
+                         scale, interpret, k_scale=None, v_scale=None):
     """q [B, K1, H, Dh]; pages [P, H, ps, Dh]; page_table [B, n_win]
-    -> f32 [B, K1, H, Dh]."""
+    -> f32 [B, K1, H, Dh]. graftquant: ``k_scale``/``v_scale``
+    ([P, H, ps] f32) ride the same indirection as their pages."""
     b, k1, h, d = q.shape
     ps = k_pages.shape[2]
     n_win = page_table.shape[1]
+    quant = k_scale is not None
     q3 = jnp.moveaxis(q, 2, 1).reshape(b * h, k1, d)
 
+    in_specs = [
+        pl.BlockSpec((1, k1, d),
+                     lambda i, kb, pos, tab: (i, 0, 0)),
+        pl.BlockSpec((1, 1, ps, d),
+                     lambda i, kb, pos, tab:
+                     (tab[i // h, kb], i % h, 0, 0)),
+        pl.BlockSpec((1, 1, ps, d),
+                     lambda i, kb, pos, tab:
+                     (tab[i // h, kb], i % h, 0, 0)),
+    ]
+    operands = [q3, k_pages, v_pages]
+    if quant:
+        in_specs += [
+            pl.BlockSpec((1, 1, ps),
+                         lambda i, kb, pos, tab:
+                         (tab[i // h, kb], i % h, 0)),
+            pl.BlockSpec((1, 1, ps),
+                         lambda i, kb, pos, tab:
+                         (tab[i // h, kb], i % h, 0)),
+        ]
+        operands += [k_scale, v_scale]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,  # positions, page table
         grid=(b * h, n_win),
-        in_specs=[
-            pl.BlockSpec((1, k1, d),
-                         lambda i, kb, pos, tab: (i, 0, 0)),
-            pl.BlockSpec((1, 1, ps, d),
-                         lambda i, kb, pos, tab:
-                         (tab[i // h, kb], i % h, 0, 0)),
-            pl.BlockSpec((1, 1, ps, d),
-                         lambda i, kb, pos, tab:
-                         (tab[i // h, kb], i % h, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, k1, d),
                                lambda i, kb, pos, tab: (i, 0, 0)),
         scratch_shapes=[
@@ -556,12 +710,12 @@ def _pallas_paged_verify(q, k_pages, v_pages, page_table, positions,
     )
     out = pl.pallas_call(
         functools.partial(_paged_verify_kernel, scale=scale,
-                          page_size=ps, heads=h, k1=k1),
+                          page_size=ps, heads=h, k1=k1, quant=quant),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b * h, k1, d), jnp.float32),
         interpret=interpret,
     )(positions.astype(jnp.int32), page_table.astype(jnp.int32),
-      q3, k_pages, v_pages)
+      *operands)
     return jnp.moveaxis(out.reshape(b, h, k1, d), 1, 2)
 
 
@@ -585,22 +739,12 @@ def xla_verify_decode_attention(q, k, v, positions):
 def xla_paged_verify_decode_attention(q, k_pages, v_pages, page_table,
                                       positions,
                                       window: Optional[int] = None):
-    """Paged reference verify: the same take-gather as
-    :func:`xla_paged_decode_attention`, then the dense reference."""
-    b = q.shape[0]
-    h, d = q.shape[2], q.shape[3]
-    ps = k_pages.shape[2]
-    n_win = page_table.shape[1]
-
-    def gather(pages):
-        g = jnp.take(pages, page_table, axis=0)
-        g = jnp.moveaxis(g, 3, 2).reshape(b, n_win * ps, h, d)
-        if window is not None and window < n_win * ps:
-            g = jax.lax.slice_in_dim(g, 0, window, axis=1)
-        return g
-
-    return xla_verify_decode_attention(q, gather(k_pages),
-                                       gather(v_pages), positions)
+    """Paged reference verify: the same take-gather (+ graftquant
+    dequant) as :func:`xla_paged_decode_attention`, then the dense
+    reference."""
+    k_win = _gather_paged_window(k_pages, page_table, q.dtype, window)
+    v_win = _gather_paged_window(v_pages, page_table, q.dtype, window)
+    return xla_verify_decode_attention(q, k_win, v_win, positions)
 
 
 def verify_decode_attention(
@@ -621,7 +765,9 @@ def verify_decode_attention(
         ``positions[b] + i`` (the pending token, then the k drafts).
       k, v: ``[B, S, H, Dh]`` KV window (the caller has already
         written the K1 in-flight columns, so row ``i`` sees its
-        predecessors' keys — the causal verify set).
+        predecessors' keys — the causal verify set). May be
+        :class:`...ops.kv_quant.QuantizedKV` (graftquant int8 +
+        scale) — dequantized in the kernel's VMEM stream.
       positions: ``[B]`` int — row ``i`` attends ``[0, positions[b]
         + i]`` inclusive.
       impl / block_k / interpret: as :func:`decode_attention`.
@@ -635,11 +781,18 @@ def verify_decode_attention(
 
             interpret = default_interpret()
         scale = q.shape[-1] ** -0.5
+        if isinstance(k, QuantizedKV):
+            return _pallas_verify(q, k.data, v.data, positions, scale,
+                                  int(block_k), bool(interpret),
+                                  k_scale=k.scale, v_scale=v.scale)
         return _pallas_verify(q, k, v, positions, scale, int(block_k),
                               bool(interpret))
     if impl != "xla":
         raise ValueError(
             f"impl must be 'pallas', 'xla' or 'auto', got {impl!r}")
+    if isinstance(k, QuantizedKV):
+        k = dequantize_kv(k, q.dtype)
+        v = dequantize_kv(v, q.dtype)
     return xla_verify_decode_attention(q, k, v, positions)
 
 
@@ -656,7 +809,8 @@ def paged_verify_decode_attention(
 ) -> jax.Array:
     """Paged twin of :func:`verify_decode_attention` (graftspec x
     graftpage): the k-query verify reads KV through the same windowed
-    page-table slice the single-query paged step uses."""
+    page-table slice the single-query paged step uses. Pages may be
+    :class:`...ops.kv_quant.QuantizedKV` (graftquant)."""
     if impl == "auto":
         impl = "pallas" if jax.default_backend() == "tpu" else "xla"
     if impl == "pallas":
@@ -665,6 +819,11 @@ def paged_verify_decode_attention(
 
             interpret = default_interpret()
         scale = q.shape[-1] ** -0.5
+        if isinstance(k_pages, QuantizedKV):
+            return _pallas_paged_verify(
+                q, k_pages.data, v_pages.data, page_table, positions,
+                scale, bool(interpret),
+                k_scale=k_pages.scale, v_scale=v_pages.scale)
         return _pallas_paged_verify(q, k_pages, v_pages, page_table,
                                     positions, scale, bool(interpret))
     if impl != "xla":

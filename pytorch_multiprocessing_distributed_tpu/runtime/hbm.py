@@ -73,7 +73,17 @@ def shard_nbytes(x) -> int:
     metadata, no device read), a replicated/unplaced leaf its full
     size. The graftzero/FSDP ledger truth: ``hbm_*`` gauges describe
     ONE chip's HBM, so a ``P(data)``-sharded moment bucket must count
-    ``1/data`` of itself."""
+    ``1/data`` of itself.
+
+    A graftquant ``QuantizedKV`` pair (duck-typed: ``.data`` +
+    ``.scale`` attributes) charges per leaf — each side carries its
+    OWN sharding, and the pair's aggregate ``.nbytes`` would miscount
+    a head-sharded cache."""
+    data = getattr(x, "data", None)
+    scale = getattr(x, "scale", None)
+    if (scale is not None and data is not None
+            and hasattr(scale, "dtype")):
+        return shard_nbytes(data) + shard_nbytes(scale)
     sharding = getattr(x, "sharding", None)
     shape = getattr(x, "shape", None)
     dtype = getattr(x, "dtype", None)

@@ -53,6 +53,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..ops.kv_quant import KV_DTYPES, QuantizedKV
 from ..runtime import hbm
 
 
@@ -90,11 +91,15 @@ class PagePool:
         than worst case while raising ``max_slots``.
       mesh: optional ``Mesh`` with a ``model`` axis — pages are then
         resident head-sharded (``[L, P, H/tp, ps, Dh]`` per chip).
+      kv_dtype: ``"model"`` or ``"int8"`` (graftquant: pages become a
+        :class:`...ops.kv_quant.QuantizedKV` pair — int8 data + a
+        ``[L, P, H, ps]`` f32 scale sidecar beside the page table).
     """
 
     def __init__(self, model, max_slots: int, s_max: Optional[int] = None,
                  mesh: Optional[Mesh] = None, *, page_size: int,
-                 num_pages: Optional[int] = None):
+                 num_pages: Optional[int] = None,
+                 kv_dtype: str = "model"):
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
         s_max = int(s_max or model.max_seq_len)
@@ -106,10 +111,14 @@ class PagePool:
         if page_size < 1:
             raise ValueError(
                 f"page_size must be >= 1, got {page_size}")
+        if kv_dtype not in KV_DTYPES:
+            raise ValueError(
+                f"kv_dtype must be one of {KV_DTYPES}, got {kv_dtype!r}")
         self.model = model
         self.max_slots = int(max_slots)
         self.s_max = s_max
         self.mesh = mesh
+        self.kv_dtype = kv_dtype
         self.page_size = page_size
         self.pages_per_slot = -(-s_max // page_size)
         worst = self.max_slots * self.pages_per_slot + 1
@@ -121,8 +130,8 @@ class PagePool:
         h = model.num_heads
         shape = (model.num_layers, self.num_pages, h, page_size,
                  model.hidden_size // h)
-        self.k_pages = self._cache_sharded(jnp.zeros(shape, model.dtype))
-        self.v_pages = self._cache_sharded(jnp.zeros(shape, model.dtype))
+        self.k_pages = self._cache_sharded(self._empty_pages(shape))
+        self.v_pages = self._cache_sharded(self._empty_pages(shape))
         # per-slot decode state — identical to SlotPool's (the decode
         # horizon's freeze gates do not care where the columns live)
         self.positions = self._replicated(
@@ -168,10 +177,26 @@ class PagePool:
                          category="kv")
             self._note_pages_ledger()
 
+    def _empty_pages(self, shape):
+        """Zeroed pages in the pool's element layout: model dtype, or
+        the graftquant ``(int8 data, f32 scale)`` pair (scale = ones —
+        untouched pages dequantize to the zeros dense pages hold)."""
+        if self.kv_dtype == "int8":
+            return QuantizedKV(jnp.zeros(shape, jnp.int8),
+                               jnp.ones(shape[:-1], jnp.float32))
+        return jnp.zeros(shape, self.model.dtype)
+
     def _cache_sharded(self, c):
         if self.mesh is None:
             return c
-        # heads live at axis 2 in the paged layout
+        # heads live at axis 2 in the paged layout — in BOTH leaves of
+        # a quantized pair (scale only drops the trailing head_dim)
+        if isinstance(c, QuantizedKV):
+            return QuantizedKV(
+                jax.device_put(c.data, NamedSharding(
+                    self.mesh, P(None, None, "model", None, None))),
+                jax.device_put(c.scale, NamedSharding(
+                    self.mesh, P(None, None, "model", None))))
         return jax.device_put(
             c, NamedSharding(self.mesh,
                              P(None, None, "model", None, None)))
@@ -183,15 +208,22 @@ class PagePool:
 
     # ---- capacity accounting (graftmeter) ------------------------------
     @staticmethod
-    def page_kv_bytes(model, page_size: int) -> int:
+    def page_kv_bytes(model, page_size: int,
+                      kv_dtype: str = "model") -> int:
         """K+V bytes of ONE page — the exact shape x dtype product
         ``__init__`` allocates per page (``2 x layers x heads x
-        page_size x head_dim x itemsize``), the planner's paged-mode
-        unit (:func:`...analysis.meter.plan_capacity`)."""
+        page_size x head_dim x itemsize``; graftquant int8 charges 1
+        byte per element PLUS one f32 scale per ``head_dim`` group),
+        the planner's paged-mode unit
+        (:func:`...analysis.meter.plan_capacity`), byte-exact in BOTH
+        modes."""
         head_dim = model.hidden_size // model.num_heads
-        itemsize = jnp.dtype(model.dtype).itemsize
+        if kv_dtype == "int8":
+            group_bytes = head_dim * 1 + 4  # int8 lanes + f32 scale
+        else:
+            group_bytes = head_dim * jnp.dtype(model.dtype).itemsize
         return (2 * model.num_layers * model.num_heads * int(page_size)
-                * head_dim * itemsize)
+                * group_bytes)
 
     @staticmethod
     def pages_for(total_tokens: int, page_size: int) -> int:
@@ -200,7 +232,8 @@ class PagePool:
 
     @property
     def page_bytes(self) -> int:
-        return self.page_kv_bytes(self.model, self.page_size)
+        return self.page_kv_bytes(self.model, self.page_size,
+                                  self.kv_dtype)
 
     @property
     def per_slot_bytes(self) -> int:

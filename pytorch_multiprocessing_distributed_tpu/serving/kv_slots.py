@@ -53,6 +53,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..ops.kv_quant import KV_DTYPES, QuantizedKV
 from ..runtime import hbm
 
 
@@ -71,10 +72,14 @@ class SlotPool:
       mesh: optional ``Mesh`` with a ``model`` axis — caches are then
         resident head-sharded (``[L, N, S, H/tp, Dh]`` per chip), the
         same 1/tp KV-memory win as TP ``generate``.
+      kv_dtype: ``"model"`` (cache dtype == model dtype, the historical
+        layout) or ``"int8"`` (graftquant: int8 data + a per-token-per-
+        head f32 scale sidecar, a :class:`...ops.kv_quant.QuantizedKV`
+        pair — same jitted signatures, half the KV bytes).
     """
 
     def __init__(self, model, max_slots: int, s_max: Optional[int] = None,
-                 mesh: Optional[Mesh] = None):
+                 mesh: Optional[Mesh] = None, kv_dtype: str = "model"):
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
         s_max = int(s_max or model.max_seq_len)
@@ -82,15 +87,19 @@ class SlotPool:
             raise ValueError(
                 f"s_max must be in [2, max_seq_len={model.max_seq_len}], "
                 f"got {s_max}")
+        if kv_dtype not in KV_DTYPES:
+            raise ValueError(
+                f"kv_dtype must be one of {KV_DTYPES}, got {kv_dtype!r}")
         self.model = model
         self.max_slots = int(max_slots)
         self.s_max = s_max
         self.mesh = mesh
+        self.kv_dtype = kv_dtype
         h = model.num_heads
         shape = (model.num_layers, self.max_slots, s_max, h,
                  model.hidden_size // h)
-        self.k_caches = self._cache_sharded(jnp.zeros(shape, model.dtype))
-        self.v_caches = self._cache_sharded(jnp.zeros(shape, model.dtype))
+        self.k_caches = self._cache_sharded(self._empty_cache(shape))
+        self.v_caches = self._cache_sharded(self._empty_cache(shape))
         # per-slot decode state: next write column, pending token, live?
         # Mesh runs commit these replicated from the START — the jitted
         # step returns them mesh-committed, and a first call with plain
@@ -129,9 +138,27 @@ class SlotPool:
                              self.active, self.budgets, self.eos_ids)),
                          category="kv")
 
+    def _empty_cache(self, shape):
+        """A zeroed cache in the pool's element layout: a plain
+        model-dtype array, or the graftquant ``(int8 data, f32 scale)``
+        pair (scale = ones so an untouched column dequantizes to the
+        same zeros the dense pool holds)."""
+        if self.kv_dtype == "int8":
+            return QuantizedKV(jnp.zeros(shape, jnp.int8),
+                               jnp.ones(shape[:-1], jnp.float32))
+        return jnp.zeros(shape, self.model.dtype)
+
     def _cache_sharded(self, c):
         if self.mesh is None:
             return c
+        # head axis is index 3 in BOTH leaves of a quantized pair (the
+        # scale sidecar only drops the trailing head_dim axis)
+        if isinstance(c, QuantizedKV):
+            return QuantizedKV(
+                jax.device_put(c.data, NamedSharding(
+                    self.mesh, P(None, None, None, "model", None))),
+                jax.device_put(c.scale, NamedSharding(
+                    self.mesh, P(None, None, None, "model"))))
         return jax.device_put(
             c, NamedSharding(self.mesh,
                              P(None, None, None, "model", None)))
@@ -143,16 +170,22 @@ class SlotPool:
 
     # ---- capacity accounting (graftmeter) ------------------------------
     @staticmethod
-    def per_slot_kv_bytes(model, s_max: int) -> int:
+    def per_slot_kv_bytes(model, s_max: int,
+                          kv_dtype: str = "model") -> int:
         """Dense worst-case K+V bytes ONE slot reserves for ``s_max``
         tokens — the exact shape x dtype product ``__init__``
         allocates (``2 x layers x s_max x heads x head_dim x
-        itemsize``), so :func:`...analysis.meter.plan_capacity`'s
-        inversion matches real allocation byte-for-byte."""
+        itemsize``; graftquant int8 charges 1 byte per element PLUS the
+        4-byte f32 scale each ``head_dim`` group carries), so
+        :func:`...analysis.meter.plan_capacity`'s inversion matches
+        real allocation byte-for-byte in BOTH modes."""
         head_dim = model.hidden_size // model.num_heads
-        itemsize = jnp.dtype(model.dtype).itemsize
+        if kv_dtype == "int8":
+            group_bytes = head_dim * 1 + 4  # int8 lanes + f32 scale
+        else:
+            group_bytes = head_dim * jnp.dtype(model.dtype).itemsize
         return (2 * model.num_layers * int(s_max) * model.num_heads
-                * head_dim * itemsize)
+                * group_bytes)
 
     @staticmethod
     def per_slot_state_bytes() -> int:
@@ -164,7 +197,8 @@ class SlotPool:
     def per_slot_bytes(self) -> int:
         """Worst-case resident bytes per slot (KV + scalar state) —
         the ledger's ``hbm_per_slot_bytes`` gauge."""
-        return (self.per_slot_kv_bytes(self.model, self.s_max)
+        return (self.per_slot_kv_bytes(self.model, self.s_max,
+                                       self.kv_dtype)
                 + self.per_slot_state_bytes())
 
     @property

@@ -329,6 +329,7 @@ class ReplicaServer:
             "max_queue": engine.scheduler.max_queue,
             "eos_id": engine.eos_id,
             "prefill_chunk": engine._prefill_chunk,
+            "kv_dtype": engine.pool.kv_dtype,
             "prefix_cache_armed":
                 getattr(engine, "_prefix_cache", None) is not None,
             "journal": journal is not None,
@@ -385,19 +386,29 @@ class ReplicaServer:
 
     def _h_admit_prefilled(self, header: Dict, arrays) -> Dict:
         request = _req_from_wire(header["req"])
-        k_block, v_block = arrays
+        # 2 segments = model-dtype block (the historical payload);
+        # 4 = graftquant int8 blocks + f32 scale sidecars — same
+        # framing, the extra arrays just ride the descriptor list
+        if len(arrays) == 4:
+            k_block, v_block, k_scale, v_scale = arrays
+        else:
+            (k_block, v_block), k_scale, v_scale = arrays, None, None
         events = self.engine.admit_prefilled(
-            request, int(header["tok0"]), k_block, v_block)
+            request, int(header["tok0"]), k_block, v_block,
+            k_scale=k_scale, v_scale=v_scale)
         self._track(request)
         return {"events": _events_wire(events)}
 
     def _h_prefill_detached(self, header: Dict, arrays
                             ) -> Tuple[Dict, Sequence[np.ndarray]]:
         request = _req_from_wire(header["req"])
-        tok0, k_pref, v_pref = self.engine.prefill_detached(
-            request, chunk=header.get("chunk"))
-        return ({"tok0": int(tok0)},
-                [np.asarray(k_pref), np.asarray(v_pref)])
+        (tok0, k_block, v_block, k_scale,
+         v_scale) = self.engine.prefill_detached_wire(
+             request, chunk=header.get("chunk"))
+        out = [k_block, v_block]
+        if k_scale is not None:  # graftquant: half the wire bytes
+            out += [k_scale, v_scale]
+        return ({"tok0": int(tok0)}, out)
 
     def _h_redeliver(self, header: Dict, arrays) -> Dict:
         entries = [_entry_from_wire(d) for d in header["entries"]]
@@ -520,6 +531,7 @@ class _RemotePool:
     def __init__(self, hello: Dict):
         self.max_slots = int(hello["max_slots"])
         self.s_max = int(hello["s_max"])
+        self.kv_dtype = hello.get("kv_dtype", "model")
         page_size = hello.get("page_size")
         if page_size is not None:
             self.page_size = int(page_size)
@@ -647,6 +659,7 @@ class _RemoteEngine:
         self.metrics = _RemoteMetrics(self)
         self.eos_id = hello.get("eos_id")
         self._prefill_chunk = hello.get("prefill_chunk")
+        self._kv_quant = self.pool.kv_dtype == "int8"
         self._prefix_cache = (True if hello.get("prefix_cache_armed")
                               else None)
         self.journal = None  # RemoteReplica wires the proxy in
@@ -796,10 +809,17 @@ class _RemoteEngine:
         return out
 
     def admit_prefilled(self, request: Request, tok0: int, k_pref,
-                        v_pref) -> List[Tuple[Request, int, bool]]:
+                        v_pref, k_scale=None, v_scale=None
+                        ) -> List[Tuple[Request, int, bool]]:
+        arrays = [np.asarray(k_pref), np.asarray(v_pref)]
+        if k_scale is not None:
+            # graftquant payload: int8 blocks + f32 scale sidecars as
+            # two extra raw segments in the SAME framing (~half the
+            # model-dtype payload's bytes on the wire)
+            arrays += [np.asarray(k_scale), np.asarray(v_scale)]
         header, _ = self._rpc(
             "admit_prefilled", req=_req_wire(request), tok0=int(tok0),
-            arrays=[np.asarray(k_pref), np.asarray(v_pref)])
+            arrays=arrays)
         self._requests[request.uid] = request
         return self._events(header.get("events", ()))
 
@@ -807,8 +827,23 @@ class _RemoteEngine:
                          chunk: Optional[int] = None):
         header, arrs = self._rpc("prefill_detached",
                                  req=_req_wire(request), chunk=chunk)
+        if len(arrs) == 4:
+            raise ValueError(
+                "remote prefill returned a quantized block; call "
+                "prefill_detached_wire to receive the scale sidecars")
         k_pref, v_pref = arrs
         return int(header["tok0"]), k_pref, v_pref
+
+    def prefill_detached_wire(self, request: Request,
+                              chunk: Optional[int] = None):
+        header, arrs = self._rpc("prefill_detached",
+                                 req=_req_wire(request), chunk=chunk)
+        if len(arrs) == 4:
+            k_block, v_block, k_scale, v_scale = arrs
+        else:
+            (k_block, v_block), k_scale, v_scale = arrs, None, None
+        return (int(header["tok0"]), k_block, v_block, k_scale,
+                v_scale)
 
     def redeliver(self, entries, events_out: Optional[list] = None
                   ) -> List[Request]:

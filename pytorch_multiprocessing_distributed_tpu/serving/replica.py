@@ -59,8 +59,6 @@ import time
 from collections import deque
 from typing import Deque, Dict, List, Optional, Tuple
 
-import numpy as np
-
 from ..runtime import scope as graftscope
 from ..runtime.faults import (DeadlineExceeded, FaultInjected,
                               GraftFaultError)
@@ -80,18 +78,28 @@ class PageTransfer:
     write_ids and splices through the existing insert program — the
     block never dictates where it lands (arXiv:2112.01075's
     receiver-chosen redistribution, the property that makes the seam
-    portable across hosts)."""
+    portable across hosts).
 
-    __slots__ = ("request", "tok0", "k_block", "v_block", "src_rid",
-                 "src_tag")
+    graftquant: when the producing engine runs ``kv_dtype="int8"``
+    the blocks travel ALREADY QUANTIZED — int8 data plus the f32
+    per-token-per-head ``k_scale``/``v_scale`` sidecars — so the wire
+    (or host copy) moves ~half the bytes and the receiver splices
+    them bit-identical, no requantization. Scales are ``None`` on a
+    model-dtype transfer (the historical payload, unchanged)."""
+
+    __slots__ = ("request", "tok0", "k_block", "v_block", "k_scale",
+                 "v_scale", "src_rid", "src_tag")
 
     def __init__(self, request: Request, tok0: int, k_block, v_block,
+                 k_scale=None, v_scale=None,
                  src_rid: Optional[str] = None,
                  src_tag: Optional[str] = None):
         self.request = request
         self.tok0 = int(tok0)
         self.k_block = k_block
         self.v_block = v_block
+        self.k_scale = k_scale
+        self.v_scale = v_scale
         self.src_rid = src_rid
         # the producing replica's weight version (graftscale rolling
         # rollout): a mid-rollout fleet holds BOTH versions, and a
@@ -103,8 +111,12 @@ class PageTransfer:
     @property
     def nbytes(self) -> int:
         """Transferred payload bytes (the number a device-to-device
-        path would move instead)."""
-        return int(self.k_block.nbytes) + int(self.v_block.nbytes)
+        path would move instead) — scale sidecars included, so the
+        quant sweep's bytes-per-request comparison is honest."""
+        n = int(self.k_block.nbytes) + int(self.v_block.nbytes)
+        if self.k_scale is not None:
+            n += int(self.k_scale.nbytes) + int(self.v_scale.nbytes)
+        return n
 
 
 class ServingReplica:
@@ -379,12 +391,13 @@ class ServingReplica:
         request = self._prefill_queue.popleft()
         t0 = time.perf_counter()
         try:
-            tok0, k_pref, v_pref = self.engine.prefill_detached(
-                request, chunk=self.engine._prefill_chunk)
             # the host round-trip: device blocks -> numpy (the seam a
-            # device-to-device path would replace)
-            k_block = np.asarray(k_pref)
-            v_block = np.asarray(v_pref)
+            # device-to-device path would replace). On a graftquant
+            # engine the blocks arrive already int8 + scale sidecars —
+            # half the bytes leave this replica
+            (tok0, k_block, v_block, k_scale,
+             v_scale) = self.engine.prefill_detached_wire(
+                 request, chunk=self.engine._prefill_chunk)
         except (KeyboardInterrupt, SystemExit):
             raise
         except BaseException as e:
@@ -407,6 +420,7 @@ class ServingReplica:
         self._prefill_s += time.perf_counter() - t0
         self.transfers_out += 1
         transfer = PageTransfer(request, tok0, k_block, v_block,
+                                k_scale=k_scale, v_scale=v_scale,
                                 src_rid=self.rid,
                                 src_tag=self.model_tag)
         graftscope.emit("route.transfer", cat="serving",

@@ -572,7 +572,9 @@ class Router:
                 try:
                     evs = replica.engine.admit_prefilled(
                         transfer.request, transfer.tok0,
-                        transfer.k_block, transfer.v_block)
+                        transfer.k_block, transfer.v_block,
+                        k_scale=transfer.k_scale,
+                        v_scale=transfer.v_scale)
                 except QueueFull:
                     replica.note_pressure()
                     continue

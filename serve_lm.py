@@ -143,6 +143,17 @@ parser.add_argument('--num_pages', default=0, type=int,
                          'with `python -m ...analysis.meter --plan '
                          'MODEL --page_size N` to the real HBM '
                          'budget)')
+parser.add_argument('--kv_dtype', default='model',
+                    choices=['model', 'int8'],
+                    help='graftquant KV element layout: model dtype, '
+                         'or int8 lanes + one f32 scale per '
+                         'head_dim group (~half the KV bytes at '
+                         'bf16 — ~1.9x resident requests at fixed '
+                         'HBM, size it with `python -m '
+                         '...analysis.meter --plan MODEL --kv_dtype '
+                         'int8`; greedy transcripts equal on the '
+                         'pinned configs, logit delta budgeted in '
+                         'tests — audited, not exact)')
 parser.add_argument('--prefix_cache', default=0, type=int,
                     help='paged+greedy mode: LRU entries of the '
                          'shared-prefix cache — identical prompts '
@@ -465,6 +476,7 @@ def main():
             decode_horizon=args.decode_horizon,
             decode_attn=args.decode_attn,
             kv_layout=args.kv_layout,
+            kv_dtype=args.kv_dtype,
             page_size=(args.page_size or None
                        if args.kv_layout == 'paged' else None),
             num_pages=(args.num_pages or None

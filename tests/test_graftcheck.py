@@ -280,6 +280,13 @@ def test_serving_ladder_fingerprints_cover_decode_programs():
                  "serving_decode_spec_w32_h4_k4",
                  "serving_decode_spec_paged_w32_h4_k4",
                  "serving_decode_spec_draft_w32_h4_k4"}
+    # graftquant: the int8-KV decode step (dense + paged) beside its
+    # model-dtype twin at the same geometry — the costs.json pair is
+    # what pins the KV argument-bytes halving
+    expected |= {"serving_decode_quant_w32_h4",
+                 "serving_decode_quantref_w32_h4",
+                 "serving_decode_quant_paged_w32_h4",
+                 "serving_decode_quantref_paged_w32_h4"}
     assert names == expected
     committed = graftcheck.load_fingerprints(
         graftcheck.default_fingerprints_path())
