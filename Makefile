@@ -5,7 +5,7 @@ PYTEST_ENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cp
 
 .PHONY: test test-fast lint check check-update chaos soak scope meter \
         fleet spec zero route wire scale quant dryrun bench bench-cpu \
-        store clean
+        store trace clean
 
 # graftlint: AST-only jit-hygiene gate (no jax import, milliseconds).
 # Exit 1 on any non-baselined finding; the tier-1 suite and
@@ -36,6 +36,16 @@ check-update:
 # operations on every run. Part of tier-1; this target runs it alone.
 chaos:
 	$(PYTEST_ENV) python -m pytest tests/test_graftfault.py tests/test_runtime_store.py -q
+
+# graftrace: the concurrency gate alone — the GL119/120/121 static
+# pass over the package (part of `make lint`, split out here) plus
+# the deterministic-interleaving suite: pinned adversarial schedules
+# over the real runtime objects (the PR-15 stale-worker canary,
+# kill-vs-drain, journal close-vs-fsync), exhaustive small-schedule
+# enumeration, and the realized-vs-static lock-graph audit.
+trace:
+	python -m pytorch_multiprocessing_distributed_tpu.analysis.lint
+	$(PYTEST_ENV) python -m pytest tests/test_graftrace.py -q
 
 # graftheal: the elastic-supervision suite (liveness gate, coordinated
 # abort, supervised restart, graceful drain + redelivery journal) PLUS

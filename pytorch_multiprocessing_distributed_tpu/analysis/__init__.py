@@ -10,6 +10,13 @@ PR*:
 
 - :mod:`.rules` — the AST rule engine (pure ``ast``, NO jax import:
   the tier-1 lint gate must cost milliseconds, not a backend bring-up);
+- :mod:`.concurrency` — **graftrace**, the concurrency pass riding the
+  same engine: a package-wide lock model (declarations keyed by
+  construction site, held-sets through ``with``/acquire-release
+  scopes, thread entries, the shared call-graph closure) behind GL119
+  lock-order cycles, GL120 blocking-under-lock, GL121 unguarded
+  thread-shared state; ``static_lock_model()`` feeds
+  :mod:`..runtime.sched`'s realized-graph subgraph audit;
 - :mod:`.lint` — CLI / JSON output / per-line suppressions /
   committed-baseline workflow (``python -m
   pytorch_multiprocessing_distributed_tpu.analysis.lint``);

@@ -1,0 +1,850 @@
+"""graftrace: concurrency static analysis — the lock model behind
+GL119/GL120/GL121.
+
+PRs 14-17 grew a threaded host substrate (graftwire's accept/handler
+threads, the store clients, the heartbeat writers, the WAL) and every
+concurrency bug shipped so far was caught by manual review: the
+WireClient stale-worker teardown race, ``kill_connections()`` queued
+behind a drain handler holding the verb lock, the fleet-roster
+read-modify-write race. This module makes the lock discipline
+machine-checked the same way :mod:`.rules` checks jit hygiene: pure
+``ast``, no jax import, milliseconds over the package.
+
+The pass builds a package-wide **lock model**:
+
+- **lock objects** — ``threading.Lock/RLock/Condition`` bound to
+  ``self.<attr>`` in a method or to a module-level name (each keyed by
+  its construction site, so the runtime twin
+  :mod:`..runtime.sched` can match live locks back to the model);
+- **acquisition scopes** — ``with self._mu:`` items and explicit
+  ``acquire()``/``release()`` pairs, tracked as a held-set while
+  walking each function body (lock-suffixed names — ``*_mu``,
+  ``*_lock``, ``*_cv`` — resolve as *opaque* locks even when the
+  construction site is out of view, e.g. ``self._server._mu``);
+- **thread entry points** — ``threading.Thread(target=...)`` where the
+  target is a bound method, a local/nested function, or a name;
+- **a resolved call graph** — the same resolution discipline
+  :mod:`.rules` uses for jit-scope closure (local names, ``self.``
+  methods preferring the enclosing class, intra-package imports,
+  module-attr calls like ``graftscope.emit``), extended with
+  *argument engagement*: a function passed as an argument under a lock
+  (``retry_with_backoff(once, ...)``) is analyzed as if called there.
+
+Three rules run over the model:
+
+- **GL119** — lock-order cycles: lock B acquired (directly or through
+  resolved callees) while A is held at one site, A under B elsewhere.
+  The finding names the full cycle with every acquisition site.
+  Re-acquiring a non-reentrant ``Lock`` already held (a guaranteed
+  self-deadlock) reports as a one-lock cycle.
+- **GL120** — blocking operation under a held lock: socket
+  recv/accept/connect/sendall, ``time.sleep``, subprocess
+  run/wait/communicate, ``os.fsync``, ``Thread.join``-shaped joins,
+  wire RPC ``.call`` — direct, through resolved callees, or through a
+  blocking function passed as an argument.
+- **GL121** — thread-shared mutable attribute with no common lock: an
+  attribute written (outside ``__init__``) inside a thread target's
+  reachable body and accessed from methods outside that closure, with
+  no single lock held at every involved site.
+
+Known limits (deliberate, like every :mod:`.rules` rule): no type
+inference — a lock reached through a local variable or a callback
+stored in an attribute (``self._decorate``) is invisible; callables
+dispatched through containers (``handlers[verb]``) are not resolved;
+GL121 only partitions classes that spawn their own threads, so an
+object handed to another class's thread (the ReplicaServer
+``decorate=`` seam) must carry its own lock evidence. The runtime
+audit closes the gap from the other side: :mod:`..runtime.sched`
+records the *realized* acquisition-order graph under the tier-1
+concurrency tests and fails loudly if it is not a subgraph of this
+static model — a lock the static pass can't see is a named finding,
+not silence.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .rules import (Finding, _File, _Func, _dotted, _modkey_for,
+                    _resolve_local)
+
+__all__ = ["LockModel", "check_concurrency", "static_lock_model"]
+
+# constructors that make an acquirable lock / a sync primitive
+_LOCK_CTORS = {"threading.Lock": "Lock", "threading.RLock": "RLock",
+               "threading.Condition": "Condition"}
+_SYNC_CTORS = set(_LOCK_CTORS) | {
+    "threading.Event", "threading.Semaphore",
+    "threading.BoundedSemaphore", "threading.Barrier",
+}
+# names that read as locks even without a visible construction site
+_LOCKISH = re.compile(r"(?:^|_)(?:mu|mutex|lock|mtx|cv|cond)$")
+_BLOCKING_SOCKET = {"recv", "recv_into", "recvfrom", "accept",
+                    "sendall", "makefile"}
+_SUBPROC_RUNNERS = {"subprocess.run", "subprocess.call",
+                    "subprocess.check_call", "subprocess.check_output"}
+# container mutators count as writes for GL121 (same set GL104 uses)
+_MUTATORS = {"append", "extend", "insert", "add", "update", "pop",
+             "setdefault", "remove", "discard", "clear", "popitem"}
+_THREADISH = re.compile(r"thread|worker|proc|child", re.IGNORECASE)
+
+
+@dataclass(frozen=True, order=True)
+class LockId:
+    """Canonical lock identity: module dotted path + owning class
+    ("" for module globals) + attribute/name. Opaque locks (matched by
+    name suffix only, no construction site) carry line 0 in the
+    model's declaration table."""
+    module: str
+    cls: str
+    name: str
+
+    def label(self) -> str:
+        own = f"{self.cls}." if self.cls else ""
+        return f"{self.module.rsplit('.', 1)[-1]}.{own}{self.name}"
+
+
+@dataclass
+class _LockDecl:
+    kind: str      # "Lock" | "RLock" | "Condition" | "opaque"
+    path: str
+    line: int      # construction-site line; 0 for opaque
+
+
+@dataclass
+class _Site:
+    """One attribute access for GL121."""
+    fn: _Func
+    line: int
+    col: int
+    write: bool
+    held: frozenset  # of LockId
+
+
+@dataclass
+class _Ctx:
+    files: Sequence[_File]
+    index: Dict[Tuple[Tuple[str, ...], str], _Func]
+    locks: Dict[LockId, _LockDecl] = field(default_factory=dict)
+    sync_attrs: Set[Tuple[str, str, str]] = field(default_factory=set)
+    # (a, b) -> (b_path, b_line, a_line): b acquired at site while a
+    # held since a_line (first registration wins — deterministic)
+    edges: Dict[Tuple[LockId, LockId],
+                Tuple[str, int, int]] = field(default_factory=dict)
+    # per-func direct blocking ops: [(label, path, line)]
+    direct_block: Dict[int, List[Tuple[str, str, int]]] = \
+        field(default_factory=dict)
+    # per-func direct acquisitions: [(lid, path, line)]
+    direct_acq: Dict[int, List[Tuple[LockId, str, int]]] = \
+        field(default_factory=dict)
+    # per-func engaged funcs (callees + function-valued args)
+    engaged: Dict[int, List[_Func]] = field(default_factory=dict)
+    # calls made while holding >=1 lock:
+    # (fn, call node, engaged funcs, direct label or None, held)
+    under: List[Tuple[_Func, ast.Call, List[_Func], Optional[str],
+                      Tuple[Tuple[LockId, int], ...]]] = \
+        field(default_factory=list)
+    # GL121 bookkeeping per (path, class)
+    attr_sites: Dict[Tuple[str, str],
+                     Dict[str, List[_Site]]] = field(default_factory=dict)
+    entries: Dict[Tuple[str, str], Set[int]] = field(default_factory=dict)
+    methods: Dict[Tuple[str, str], Dict[str, _Func]] = \
+        field(default_factory=dict)
+    findings: List[Finding] = field(default_factory=list)
+
+
+def _class_of(fn: _Func) -> str:
+    top = fn
+    while top.parent is not None:
+        top = top.parent
+    return top.qual.rsplit(".", 1)[0] if "." in top.qual else ""
+
+
+def _mod(file: _File) -> str:
+    return ".".join(file.modkey)
+
+
+def _iter_expr(node: ast.AST):
+    """Every node under ``node`` except nested def/class bodies
+    (lambda bodies ARE yielded — they run where they're called). A
+    def/class ROOT is entered — only nested ones are skipped."""
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+        stack = list(ast.iter_child_nodes(node))
+    else:
+        stack = [node]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _attr_chain(expr: ast.AST) -> Optional[str]:
+    parts: List[str] = []
+    node = expr
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+# ----------------------------------------------------------- lock model
+
+def _collect_locks(ctx: _Ctx) -> None:
+    for file in ctx.files:
+        mod = _mod(file)
+        # module-level sync constructions
+        for st in file.tree.body:
+            if not (isinstance(st, ast.Assign) and len(st.targets) == 1
+                    and isinstance(st.targets[0], ast.Name)
+                    and isinstance(st.value, ast.Call)):
+                continue
+            d = _dotted(st.value.func, file)
+            if d in _SYNC_CTORS:
+                ctx.sync_attrs.add((mod, "", st.targets[0].id))
+                if d in _LOCK_CTORS:
+                    lid = LockId(mod, "", st.targets[0].id)
+                    ctx.locks.setdefault(lid, _LockDecl(
+                        _LOCK_CTORS[d], file.path, st.lineno))
+        # self.<attr> = threading.Lock() in any method
+        for fn in file.funcs:
+            cls = _class_of(fn)
+            if not cls:
+                continue
+            ctx.methods.setdefault((file.path, cls), {}).setdefault(
+                fn.name, fn)
+            for node in _iter_expr(fn.node):
+                if not (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Attribute)
+                        and isinstance(node.targets[0].value, ast.Name)
+                        and node.targets[0].value.id == "self"
+                        and isinstance(node.value, ast.Call)):
+                    continue
+                d = _dotted(node.value.func, file)
+                if d in _SYNC_CTORS:
+                    attr = node.targets[0].attr
+                    ctx.sync_attrs.add((mod, cls, attr))
+                    if d in _LOCK_CTORS:
+                        lid = LockId(mod, cls, attr)
+                        ctx.locks.setdefault(lid, _LockDecl(
+                            _LOCK_CTORS[d], file.path, node.lineno))
+
+
+def _resolve_lock(expr: ast.AST, fn: _Func, ctx: _Ctx
+                  ) -> Optional[LockId]:
+    file = fn.file
+    mod = _mod(file)
+    if isinstance(expr, ast.Name):
+        lid = LockId(mod, "", expr.id)
+        if lid in ctx.locks:
+            return lid
+        if expr.id in file.pkg_imports:
+            mk, orig = file.pkg_imports[expr.id]
+            lid = LockId(".".join(mk), "", orig)
+            if lid in ctx.locks:
+                return lid
+        if _LOCKISH.search(expr.id):
+            lid = LockId(mod, "", expr.id)
+            ctx.locks.setdefault(lid, _LockDecl("opaque", file.path, 0))
+            return lid
+        return None
+    if (isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id in ("self", "cls")):
+        cls = _class_of(fn)
+        lid = LockId(mod, cls, expr.attr)
+        if lid in ctx.locks:
+            return lid
+        if _LOCKISH.search(expr.attr):
+            ctx.locks.setdefault(lid, _LockDecl("opaque", file.path, 0))
+            return lid
+        return None
+    if isinstance(expr, ast.Attribute) and _LOCKISH.search(expr.attr):
+        chain = _attr_chain(expr)
+        if chain:
+            # e.g. ``with self._server._mu:`` — identity by expression
+            # text within the enclosing class (no construction site)
+            lid = LockId(mod, _class_of(fn), chain)
+            ctx.locks.setdefault(lid, _LockDecl("opaque", file.path, 0))
+            return lid
+    return None
+
+
+# -------------------------------------------------- call classification
+
+def _resolve_callee(call: ast.Call, fn: _Func, ctx: _Ctx
+                    ) -> Optional[_Func]:
+    file = fn.file
+    f = call.func
+    if isinstance(f, ast.Name):
+        t = _resolve_local(file, f.id, fn)
+        if t is None and f.id in file.pkg_imports:
+            t = ctx.index.get(file.pkg_imports[f.id])
+        return t
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        if f.value.id in ("self", "cls"):
+            cls = _class_of(fn)
+            t = ctx.methods.get((file.path, cls), {}).get(f.attr)
+            return t or file.by_name.get(f.attr)
+        if f.value.id in file.pkg_imports:
+            mk, orig = file.pkg_imports[f.value.id]
+            return ctx.index.get((mk + (orig,), f.attr))
+    return None
+
+
+def _resolve_funcref(expr: ast.AST, fn: _Func, ctx: _Ctx
+                     ) -> Optional[_Func]:
+    """A bare function REFERENCE (thread target, callback argument)."""
+    file = fn.file
+    if isinstance(expr, ast.Name):
+        t = _resolve_local(file, expr.id, fn)
+        if t is None and expr.id in file.pkg_imports:
+            t = ctx.index.get(file.pkg_imports[expr.id])
+        return t
+    if (isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id in ("self", "cls")):
+        cls = _class_of(fn)
+        t = ctx.methods.get((file.path, cls), {}).get(expr.attr)
+        return t or file.by_name.get(expr.attr)
+    return None
+
+
+def _recv_name(expr: ast.AST) -> str:
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    return ""
+
+
+def _classify_blocking(call: ast.Call, fn: _Func,
+                       resolved: Optional[_Func]) -> Optional[str]:
+    """A short label when ``call`` is a known blocking operation; a
+    call that resolves to an analyzed function is never labeled here
+    (its body speaks for itself through the engagement closure)."""
+    if resolved is not None:
+        return None
+    file = fn.file
+    f = call.func
+    attr = f.attr if isinstance(f, ast.Attribute) else None
+    d = _dotted(f, file) or ""
+    if d == "time.sleep":
+        return "time.sleep()"
+    if d == "os.fsync":
+        return "os.fsync() (a disk flush)"
+    if d in _SUBPROC_RUNNERS or d.split(".")[-2:] == ["subprocess",
+                                                     "run"]:
+        return f"{d}() (waits for the child)"
+    if d.endswith("socket.create_connection"):
+        return "socket.create_connection()"
+    if attr in _BLOCKING_SOCKET:
+        return f".{attr}()"
+    recv = f.value if isinstance(f, ast.Attribute) else None
+    name = _recv_name(recv) if recv is not None else ""
+    if attr == "connect" and "sock" in name.lower():
+        return ".connect() on a socket"
+    if attr in ("wait", "communicate") and not isinstance(
+            recv, ast.Constant):
+        return f".{attr}() (a child/event wait)"
+    if (attr == "join" and recv is not None
+            and not isinstance(recv, ast.Constant)
+            and "path" not in d
+            and (not call.args or _THREADISH.search(name))):
+        return ".join() (a thread/child wait)"
+    if attr == "call" and re.search(r"client|wire|rpc", name,
+                                    re.IGNORECASE):
+        return ".call() (a wire RPC round-trip)"
+    return None
+
+
+# ----------------------------------------------------- function walking
+
+def _scan_function(fn: _Func, ctx: _Ctx) -> None:
+    file = fn.file
+    fid = id(fn)
+    ctx.direct_block.setdefault(fid, [])
+    ctx.direct_acq.setdefault(fid, [])
+    ctx.engaged.setdefault(fid, [])
+    cls = _class_of(fn)
+    ckey = (file.path, cls)
+
+    def note_acquire(lid: LockId, line: int,
+                     held: Tuple[Tuple[LockId, int], ...]) -> None:
+        ctx.direct_acq[fid].append((lid, file.path, line))
+        decl = ctx.locks.get(lid)
+        if (decl is not None and decl.kind == "Lock"
+                and any(h == lid for h, _ in held)):
+            ctx.findings.append(Finding(
+                file.path, line, 0, "GL119",
+                f"re-acquiring non-reentrant lock `{lid.label()}` "
+                f"already held in this scope (acquired at line "
+                f"{[l for h, l in held if h == lid][0]}) — "
+                "threading.Lock does not re-enter: this thread "
+                "deadlocks against itself, unconditionally (use one "
+                "scope, or an RLock if re-entry is the design)"))
+            return
+        for h, hline in held:
+            if h != lid:
+                ctx.edges.setdefault((h, lid),
+                                     (file.path, line, hline))
+
+    def visit_leaf(node: ast.AST,
+                   held: Tuple[Tuple[LockId, int], ...]) -> None:
+        heldset = frozenset(h for h, _ in held)
+        for n in _iter_expr(node):
+            if isinstance(n, ast.Call):
+                resolved = _resolve_callee(n, fn, ctx)
+                label = _classify_blocking(n, fn, resolved)
+                engaged: List[_Func] = []
+                if resolved is not None:
+                    engaged.append(resolved)
+                for a in list(n.args) + [k.value for k in n.keywords]:
+                    t = _resolve_funcref(a, fn, ctx)
+                    if t is not None:
+                        engaged.append(t)
+                if label is not None:
+                    ctx.direct_block[fid].append(
+                        (label, file.path, n.lineno))
+                ctx.engaged[fid].extend(engaged)
+                if held and (label is not None or engaged):
+                    ctx.under.append((fn, n, engaged, label, held))
+            if cls and isinstance(n, ast.Attribute) and isinstance(
+                    n.value, ast.Name) and n.value.id == "self":
+                write = isinstance(n.ctx, (ast.Store, ast.Del))
+                ctx.attr_sites.setdefault(ckey, {}).setdefault(
+                    n.attr, []).append(_Site(fn, n.lineno,
+                                             n.col_offset, write,
+                                             heldset))
+            # self.x[i] = v and self.x.append(v) are writes to x
+            if cls and isinstance(n, ast.Subscript) and isinstance(
+                    n.ctx, (ast.Store, ast.Del)):
+                v = n.value
+                if (isinstance(v, ast.Attribute)
+                        and isinstance(v.value, ast.Name)
+                        and v.value.id == "self"):
+                    ctx.attr_sites.setdefault(ckey, {}).setdefault(
+                        v.attr, []).append(_Site(fn, n.lineno,
+                                                 n.col_offset, True,
+                                                 heldset))
+            if cls and isinstance(n, ast.Call) and isinstance(
+                    n.func, ast.Attribute) and n.func.attr in _MUTATORS:
+                v = n.func.value
+                if (isinstance(v, ast.Attribute)
+                        and isinstance(v.value, ast.Name)
+                        and v.value.id == "self"):
+                    ctx.attr_sites.setdefault(ckey, {}).setdefault(
+                        v.attr, []).append(_Site(fn, n.lineno,
+                                                 n.col_offset, True,
+                                                 heldset))
+            # thread entry points
+            if isinstance(n, ast.Call):
+                d = _dotted(n.func, file) or ""
+                if d == "threading.Thread" or d.endswith(
+                        ".threading.Thread"):
+                    for kw in n.keywords:
+                        if kw.arg != "target":
+                            continue
+                        t = _resolve_funcref(kw.value, fn, ctx)
+                        if t is not None:
+                            tcls = _class_of(t)
+                            if tcls:
+                                ctx.entries.setdefault(
+                                    (t.file.path, tcls),
+                                    set()).add(id(t))
+
+    def acquire_stmt(st: ast.stmt) -> Optional[Tuple[LockId, int, str]]:
+        if not (isinstance(st, ast.Expr)
+                and isinstance(st.value, ast.Call)
+                and isinstance(st.value.func, ast.Attribute)
+                and st.value.func.attr in ("acquire", "release")):
+            return None
+        lid = _resolve_lock(st.value.func.value, fn, ctx)
+        if lid is None:
+            return None
+        return lid, st.value.lineno, st.value.func.attr
+
+    def walk_body(stmts: Sequence[ast.stmt],
+                  held: Tuple[Tuple[LockId, int], ...]) -> None:
+        explicit: List[Tuple[LockId, int]] = []
+        for st in stmts:
+            now = held + tuple(explicit)
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                entered: List[Tuple[LockId, int]] = []
+                for item in st.items:
+                    visit_leaf(item.context_expr, now + tuple(entered))
+                    lid = _resolve_lock(item.context_expr, fn, ctx)
+                    if lid is not None:
+                        note_acquire(lid, item.context_expr.lineno,
+                                     now + tuple(entered))
+                        entered.append((lid,
+                                        item.context_expr.lineno))
+                walk_body(st.body, now + tuple(entered))
+                continue
+            acq = acquire_stmt(st)
+            if acq is not None:
+                lid, line, op = acq
+                if op == "acquire":
+                    note_acquire(lid, line, now)
+                    explicit.append((lid, line))
+                else:
+                    for i in range(len(explicit) - 1, -1, -1):
+                        if explicit[i][0] == lid:
+                            del explicit[i]
+                            break
+                continue
+            if isinstance(st, (ast.If, ast.While)):
+                visit_leaf(st.test, now)
+                walk_body(st.body, now)
+                walk_body(st.orelse, now)
+            elif isinstance(st, (ast.For, ast.AsyncFor)):
+                visit_leaf(st.iter, now)
+                visit_leaf(st.target, now)
+                walk_body(st.body, now)
+                walk_body(st.orelse, now)
+            elif isinstance(st, ast.Try):
+                walk_body(st.body, now)
+                for h in st.handlers:
+                    walk_body(h.body, now)
+                walk_body(st.orelse, now)
+                walk_body(st.finalbody, now)
+            else:
+                visit_leaf(st, now)
+
+    walk_body(fn.node.body, ())
+
+
+# ------------------------------------------------------------ fixpoints
+
+def _closure(ctx: _Ctx, seed: Dict[int, List[Tuple]],
+             ) -> Dict[int, List[Tuple]]:
+    """Propagate per-function facts through the engagement graph until
+    stable: a function inherits its engaged functions' facts (each
+    tagged tuple keeps its ORIGIN site, so findings can cite the
+    ultimate line)."""
+    out: Dict[int, List[Tuple]] = {k: list(v) for k, v in seed.items()}
+    changed = True
+    while changed:
+        changed = False
+        for file in ctx.files:
+            for fn in file.funcs:
+                fid = id(fn)
+                have = out.setdefault(fid, [])
+                keys = {t[:1] + t[1:] for t in have}
+                for g in ctx.engaged.get(fid, ()):
+                    for fact in out.get(id(g), ()):
+                        if fact not in keys:
+                            have.append(fact)
+                            keys.add(fact)
+                            changed = True
+    return out
+
+
+# --------------------------------------------------------------- GL119
+
+def _cycles(ctx: _Ctx) -> None:
+    adj: Dict[LockId, Set[LockId]] = {}
+    for (a, b) in ctx.edges:
+        adj.setdefault(a, set()).add(b)
+        adj.setdefault(b, set())
+    # iterative Tarjan SCC
+    order: Dict[LockId, int] = {}
+    low: Dict[LockId, int] = {}
+    on: Set[LockId] = set()
+    stack: List[LockId] = []
+    sccs: List[List[LockId]] = []
+    counter = [0]
+
+    def strong(v: LockId) -> None:
+        work = [(v, iter(sorted(adj.get(v, ()))))]
+        order[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in order:
+                    order[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on.add(w)
+                    work.append((w, iter(sorted(adj.get(w, ())))))
+                    advanced = True
+                    break
+                if w in on:
+                    low[node] = min(low[node], order[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == order[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                sccs.append(comp)
+
+    for v in sorted(adj):
+        if v not in order:
+            strong(v)
+
+    for comp in sccs:
+        if len(comp) < 2:
+            continue
+        comp = sorted(comp)
+        in_scc = sorted((a, b) for (a, b) in ctx.edges
+                        if a in comp and b in comp
+                        and a in comp and b in comp)
+        parts = []
+        for a, b in in_scc:
+            path, line, hline = ctx.edges[(a, b)]
+            parts.append(f"`{b.label()}` acquired at "
+                         f"{os.path.basename(path)}:{line} while "
+                         f"holding `{a.label()}` (held since line "
+                         f"{hline})")
+        anchor = min((ctx.edges[e][0], ctx.edges[e][1]) for e in in_scc)
+        ctx.findings.append(Finding(
+            anchor[0], anchor[1], 0, "GL119",
+            "lock-order cycle between "
+            + " and ".join(f"`{c.label()}`" for c in comp)
+            + ": " + "; ".join(parts)
+            + " — two threads entering in opposite order deadlock "
+            "permanently with no named error; pick ONE global order "
+            "and acquire in it everywhere"))
+
+
+# --------------------------------------------------------------- GL120
+
+def _blocking_under_lock(ctx: _Ctx,
+                         may_block: Dict[int, List[Tuple]]) -> None:
+    seen: Set[Tuple[str, int]] = set()
+    for fn, call, engaged, label, held in ctx.under:
+        key = (fn.file.path, call.lineno)
+        if key in seen:
+            continue
+        locks = ", ".join(sorted({f"`{h.label()}`" for h, _ in held}))
+        if label is not None:
+            seen.add(key)
+            ctx.findings.append(Finding(
+                fn.file.path, call.lineno, call.col_offset, "GL120",
+                f"blocking operation ({label}) while holding {locks} "
+                "— every thread contending that lock parks behind "
+                "this wait for its full duration (the class PR 15 "
+                "fixed by hand in WireServer: a kill queued behind a "
+                "drain holding the verb lock); move the slow work "
+                "outside the lock or give it its own lock"))
+            continue
+        for g in engaged:
+            facts = may_block.get(id(g), ())
+            if not facts:
+                continue
+            blabel, bpath, bline = facts[0]
+            seen.add(key)
+            ctx.findings.append(Finding(
+                fn.file.path, call.lineno, call.col_offset, "GL120",
+                f"call reaches a blocking operation while holding "
+                f"{locks}: `{g.qual}` blocks in {blabel} at "
+                f"{os.path.basename(bpath)}:{bline} — every thread "
+                "contending that lock parks behind the wait; move "
+                "the blocking call outside the lock scope"))
+            break
+
+
+# --------------------------------------------------------------- GL121
+
+def _shared_attrs(ctx: _Ctx) -> None:
+    for ckey in sorted(ctx.entries):
+        path, cls = ckey
+        methods = ctx.methods.get(ckey, {})
+        file_mod = ""
+        for file in ctx.files:
+            if file.path == path:
+                file_mod = _mod(file)
+                break
+        # closure: thread entries + everything they reach via
+        # same-class calls (by simple name — self.m() and m() alike)
+        by_id: Dict[int, _Func] = {}
+        for m in methods.values():
+            by_id[id(m)] = m
+            for nested in _descend(m):
+                by_id[id(nested)] = nested
+        closure: Set[int] = set(ctx.entries[ckey])
+        work = [by_id[i] for i in closure if i in by_id]
+        while work:
+            f = work.pop()
+            for name in sorted(f.calls):
+                t = methods.get(name)
+                if t is not None and id(t) not in closure:
+                    closure.add(id(t))
+                    work.append(t)
+        entry_names = sorted(by_id[i].name for i in ctx.entries[ckey]
+                             if i in by_id)
+        sites_by_attr = ctx.attr_sites.get(ckey, {})
+        for attr in sorted(sites_by_attr):
+            if _LOCKISH.search(attr):
+                continue
+            if (file_mod, cls, attr) in ctx.sync_attrs:
+                continue
+            sites = sites_by_attr[attr]
+
+            def _in_closure(s: _Site) -> bool:
+                top = s.fn
+                while top.parent is not None and id(top) not in closure:
+                    top = top.parent
+                return id(top) in closure or id(s.fn) in closure
+
+            def _is_init(s: _Site) -> bool:
+                top = s.fn
+                while top.parent is not None:
+                    top = top.parent
+                return top.name == "__init__"
+
+            thread_writes = [s for s in sites
+                             if s.write and _in_closure(s)
+                             and not _is_init(s)]
+            other = [s for s in sites
+                     if not _in_closure(s) and not _is_init(s)]
+            if not thread_writes or not other:
+                continue
+            involved = thread_writes + [s for s in sites
+                                        if s.write and not _in_closure(s)
+                                        and not _is_init(s)] + other
+            common = frozenset.intersection(
+                *[s.held for s in involved]) if involved else frozenset()
+            if common:
+                continue
+            anchor = min(thread_writes, key=lambda s: (s.line, s.col))
+            peer = min(other, key=lambda s: (s.line, s.col))
+            ctx.findings.append(Finding(
+                path, anchor.line, anchor.col, "GL121",
+                f"`self.{attr}` is written here inside the "
+                f"`{'`/`'.join(entry_names)}` thread body and "
+                f"accessed from `{peer.fn.qual}` (line {peer.line}) "
+                "with no common lock held at every site — a lost "
+                "update / torn read that only surfaces under load; "
+                "guard every access with ONE shared lock, or confine "
+                "the attribute to a single thread"))
+
+
+def _descend(fn: _Func) -> List[_Func]:
+    out: List[_Func] = []
+    stack = list(fn.nested.values())
+    while stack:
+        x = stack.pop()
+        out.append(x)
+        stack.extend(x.nested.values())
+    return out
+
+
+# ------------------------------------------------------------ top level
+
+def check_concurrency(files: Sequence[_File], index,
+                      findings: List[Finding]) -> None:
+    """The GL119/GL120/GL121 pass :func:`..rules.analyze_files` runs
+    after the jit-scope rules (same file set, same index)."""
+    ctx = _Ctx(files=files, index=index)
+    _collect_locks(ctx)
+    for file in files:
+        for fn in file.funcs:
+            _scan_function(fn, ctx)
+    may_block = _closure(ctx, ctx.direct_block)
+    acquires = _closure(ctx, ctx.direct_acq)
+    # cross-function lock-order edges: a call made while holding H
+    # contributes H -> every lock the callee (transitively) acquires
+    for fn, call, engaged, _label, held in ctx.under:
+        for g in engaged:
+            for lid, apath, aline in acquires.get(id(g), ()):
+                for h, hline in held:
+                    if h != lid:
+                        ctx.edges.setdefault(
+                            (h, lid), (apath, aline, hline))
+    _cycles(ctx)
+    _blocking_under_lock(ctx, may_block)
+    _shared_attrs(ctx)
+    findings.extend(ctx.findings)
+
+
+@dataclass
+class LockModel:
+    """The static lock model the runtime harness audits against.
+
+    ``decls`` maps each declared lock to its construction site
+    (relpath, line) — the key :mod:`..runtime.sched`'s observer uses
+    to name live locks. ``edge_sites`` is the static acquisition-order
+    graph over those sites: the realized graph recorded under the
+    tier-1 concurrency tests must be a subgraph of it."""
+    decls: Dict[LockId, Tuple[str, int]]
+    edges: Set[Tuple[LockId, LockId]]
+
+    def edge_sites(self) -> Set[Tuple[Tuple[str, int],
+                                      Tuple[str, int]]]:
+        out = set()
+        for a, b in self.edges:
+            if a in self.decls and b in self.decls:
+                out.add((self.decls[a], self.decls[b]))
+        return out
+
+    def decl_sites(self) -> Set[Tuple[str, int]]:
+        return set(self.decls.values())
+
+
+def static_lock_model(paths: Optional[Sequence[str]] = None,
+                      package_parent: Optional[str] = None) -> LockModel:
+    """Build the package lock model standalone (no findings) — the
+    export :mod:`..runtime.sched` cross-checks realized acquisition
+    order against. Paths default to the whole package."""
+    from .lint import discover, package_root
+    from .rules import _collect_file, _fill_owners
+
+    base = package_parent or os.path.dirname(package_root())
+    files: List[_File] = []
+    for path in discover(list(paths) if paths else [package_root()]):
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                src = fh.read()
+            f = _collect_file(path, src, _modkey_for(path, base))
+        except SyntaxError:
+            continue
+        _fill_owners(f)
+        files.append(f)
+    index: Dict[Tuple[Tuple[str, ...], str], _Func] = {}
+    for f in files:
+        for name, fn in f.by_name.items():
+            index.setdefault((f.modkey, name), fn)
+    ctx = _Ctx(files=files, index=index)
+    _collect_locks(ctx)
+    for file in files:
+        for fn in file.funcs:
+            _scan_function(fn, ctx)
+    acquires = _closure(ctx, ctx.direct_acq)
+    for fn, call, engaged, _label, held in ctx.under:
+        for g in engaged:
+            for lid, apath, aline in acquires.get(id(g), ()):
+                for h, hline in held:
+                    if h != lid:
+                        ctx.edges.setdefault(
+                            (h, lid), (apath, aline, hline))
+    decls = {lid: (os.path.relpath(d.path, base), d.line)
+             for lid, d in ctx.locks.items() if d.line}
+    return LockModel(decls=decls, edges=set(ctx.edges))

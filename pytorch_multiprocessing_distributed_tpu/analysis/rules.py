@@ -139,6 +139,34 @@ RULES: Dict[str, str] = {
              "ProcessReplicaSpawner discipline: every Popen has a "
              "wait-then-kill release in the same class; "
              "subprocess.run/check_call/check_output self-reap)",
+    "GL119": "lock-order cycle across the package lock graph (lock B "
+             "acquired while holding A at one site, A while holding B "
+             "at another — directly or through the resolved call "
+             "graph; re-acquiring a non-reentrant threading.Lock "
+             "already held reports as a one-lock cycle): two threads "
+             "entering in opposite order deadlock permanently with no "
+             "named error — pick ONE global acquisition order "
+             "(graftrace reports the full cycle with every "
+             "acquisition site)",
+    "GL120": "blocking operation under a held lock (socket recv/"
+             "accept/connect/sendall, time.sleep, subprocess run/"
+             "wait/communicate, os.fsync, Thread.join, wire RPC "
+             ".call — direct, through resolved callees, or through a "
+             "function passed as an argument inside the lock scope): "
+             "every thread contending that lock parks behind one "
+             "slow peer/disk/child for the full wait — the exact "
+             "class PR 15's review fixed by hand in WireServer "
+             "(kill_connections queued behind a drain handler "
+             "holding the verb lock); move the slow work outside "
+             "the lock or give it its own lock",
+    "GL121": "thread-shared mutable attribute with no common lock in "
+             "evidence (attribute written outside __init__ from a "
+             "Thread(target=...) entry point's reachable body and "
+             "accessed from methods outside that closure, with no "
+             "single lock held at every involved site): the lost-"
+             "update / torn-read class that only surfaces under "
+             "load — guard every access with ONE shared lock, or "
+             "confine the attribute to a single thread",
 }
 
 # wrappers that COMPILE (jit family) — GL105/106/107/108 anchor on these
@@ -1669,5 +1697,10 @@ def analyze_files(paths: Sequence[str],
                 _check_static_defaults(fn, findings)
                 _check_missing_donate(fn, findings)
                 _check_ctrl_body_scalars(fn, findings)
+    # graftrace: the GL119/GL120/GL121 concurrency pass shares this
+    # file set and index (imported here to avoid a module cycle)
+    from .concurrency import check_concurrency
+    check_concurrency(files, index, findings)
+
     findings.sort(key=lambda x: (x.path, x.line, x.rule))
     return findings

@@ -876,7 +876,14 @@ def publish_replica(store, rid: str, *, role: str = "both",
     # keep a roster so readers can discover rids without a scan API
     # on the store: append-only slots claimed through the store's
     # atomic ``add`` — concurrent publishers (the remote rendezvous
-    # case) can never lose each other to a read-modify-write race
+    # case) can never lose each other to a read-modify-write race.
+    # GL121 audit: this module holds NO lock of its own across any
+    # publish/republish writer — the store's internal lock is the
+    # evidence (every set/add is one atomic store op; the only
+    # read-modify-write, claim-a-slot, is delegated to ``add``), so
+    # the concurrency pass stays quiet and the adversarial-schedule
+    # pin lives in tests/test_graftrace.py
+    # (test_fleet_roster_publish_claims_distinct_slots)
     base = _k(prefix, run_uid, "replicas")
     try:
         known = _roster_rids(store, base)
@@ -913,7 +920,11 @@ def unpublish_replica(store, rid: str, *, run_uid: str = "run",
 
 def _roster_rids(store, base: str) -> List[str]:
     """The claimed roster slots, in claim order, deduped (a re-publish
-    race can claim two slots for one rid — harmless)."""
+    race can claim two slots for one rid — harmless). GL121 audit:
+    lock-free BY DESIGN — each loop step is one atomic store read,
+    and a slot claimed concurrently with this scan (``n`` grows after
+    we read it) is simply picked up by the caller's next scan; a
+    claimed-but-unwritten slot reads empty and is skipped."""
     n = int(store.add(base + "/n", 0))
     rids: List[str] = []
     for i in range(n):

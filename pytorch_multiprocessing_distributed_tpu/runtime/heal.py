@@ -826,7 +826,6 @@ class RequestJournal:
             maybe_fault(_SITE_JOURNAL)
             self._fh.write(payload)
             self._fh.flush()
-            os.fsync(self._fh.fileno())
 
         try:
             retry_with_backoff(once, attempts=self._retries,
@@ -839,6 +838,41 @@ class RequestJournal:
                 f"({type(e).__name__}: {e}) — a WAL that stops "
                 "recording voids the redelivery guarantee, so this "
                 "fails loudly") from e
+
+    def _sync_durable(self) -> None:
+        """Push the last append's bytes to disk — called by every
+        record_* method AFTER releasing ``_mu`` (GL120: an fsync held
+        under the journal lock parks every other recorder behind one
+        disk flush; tests/test_graftrace.py pins the schedule). The
+        durability contract is unchanged — a record_* call still
+        returns only after its batch is synced — but writers queue
+        behind the lock only for the in-memory append, never the
+        disk. Ordering is safe lock-free: fsync flushes the WHOLE
+        file, so a sync that runs after a later append just covers
+        both batches."""
+        fh = self._fh
+        if fh is None:
+            return  # closed concurrently: close() owns the tail now
+
+        def once():
+            try:
+                os.fsync(fh.fileno())
+            except ValueError:
+                # closed between the lookup and the sync — the
+                # compaction rewrite (write_atomic_durable) is
+                # durable by construction, nothing left to sync
+                return
+
+        try:
+            retry_with_backoff(once, attempts=self._retries,
+                               base_delay_s=self._backoff_s,
+                               sleep=self._sleep)
+        except OSError as e:
+            raise GraftFaultError(
+                f"heal: journal sync of {self.path!r} still failing "
+                f"after {self._retries} attempt(s) "
+                f"({type(e).__name__}: {e}) — an unsynced WAL voids "
+                "the redelivery guarantee, so this fails loudly") from e
 
     def record_admit(self, request) -> None:
         """Journal one admitted request. Idempotent by uid: a
@@ -854,6 +888,7 @@ class RequestJournal:
                            "prompt": entry.prompt,
                            "max_new_tokens": entry.max_new_tokens,
                            "eos_id": entry.eos_id}])
+        self._sync_durable()
 
     def note_events(self, events) -> None:
         """Journal one engine step's token events (one fsync'd batch).
@@ -894,6 +929,8 @@ class RequestJournal:
                                 "state": request.state,
                                 "reason": request.finish_reason})
             self._append(ops)
+        if ops:
+            self._sync_durable()
 
     def record_handoff(self, request, to: str = "") -> None:
         """Journal a QUEUED request leaving this engine for a peer
@@ -912,6 +949,7 @@ class RequestJournal:
             self._append([{"op": "done", "uid": request.uid,
                            "state": entry.state,
                            "reason": entry.reason}])
+        self._sync_durable()
 
     def record_failed(self, request) -> None:
         """Journal a quarantined request as terminal — a FAILED
@@ -926,6 +964,7 @@ class RequestJournal:
             self._append([{"op": "done", "uid": request.uid,
                            "state": request.state,
                            "reason": request.finish_reason}])
+        self._sync_durable()
 
     def close(self, compact: bool = True) -> None:
         """Close the WAL; with ``compact`` (default) rewrite it
@@ -955,7 +994,12 @@ class RequestJournal:
                         {"op": "tok", "uid": entry.uid,
                          "tokens": entry.tokens}, sort_keys=True))
             payload = ("\n".join(lines) + "\n") if lines else ""
-            write_atomic_durable(self.path, payload.encode("utf-8"))
+            # the ONE deliberate disk wait under _mu: close is
+            # terminal — compaction must be atomic w.r.t. every
+            # recorder (a record_* landing between the rewrite and
+            # the rename would be silently dropped), and after it the
+            # lock has no writers left to park
+            write_atomic_durable(self.path, payload.encode("utf-8"))  # graftlint: disable=GL120 terminal compaction must exclude recorders
 
 
 # ------------------------------------------------- SIGTERM drain handler

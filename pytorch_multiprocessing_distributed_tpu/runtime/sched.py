@@ -1,0 +1,615 @@
+"""graftrace runtime twin: deterministic interleaving harness.
+
+The static pass (:mod:`..analysis.concurrency`) proves properties of
+the lock GRAPH; this module makes individual interleavings
+*replayable*, so every finding class has a schedule that demonstrates
+it and every fix pins that schedule as a regression test (the
+WireClient stale-worker teardown race PR 15 fixed by hand is the
+canary — see tests/test_graftrace.py).
+
+Two arming modes, stdlib-only, following the faults/scope discipline
+(module global sentinel; disarmed = one global read, zero overhead):
+
+**armed(...)** — cooperative deterministic replay. Patches
+``threading.Lock``/``threading.RLock`` with *gating* wrappers and
+``threading.Thread`` with an adopting wrapper (only for objects
+constructed from package/test frames — stdlib-internal constructions
+pass through untouched, so ``Event``/``Condition``/``queue`` keep
+their real locks). Exactly ONE managed thread runs at a time; control
+transfers only at yield points — explicit :func:`point` markers, lock
+acquire (before taking), lock release (after dropping) — chosen by an
+explicit schedule (a list of thread names: each entry runs that
+thread to its next yield point) or a seeded RNG (same seed -> same
+interleaving, byte-for-byte). All managed threads blocked on held
+locks -> :class:`SchedDeadlock` naming every holder and waiter (the
+GL119 class, demonstrated live); a thread that stops yielding ->
+:class:`SchedHang` naming it.
+
+**observed()** — passive recording for real concurrent runs (real
+sockets, real OS blocking; nothing gated). Locks constructed from
+package frames are wrapped to record, per thread, the realized
+acquisition-order graph keyed by each lock's CONSTRUCTION SITE
+(relpath, line) — the same key the static model uses for its
+declarations. :func:`audit_subgraph` then closes the
+audited-not-asserted loop: the realized graph must be a subgraph of
+the static model, and a lock or edge the static pass can't see comes
+back as a NAMED finding string, never silence.
+
+Known limits (documented, same policy as the static pass): gating
+covers locks only — a managed thread that parks in a real OS wait
+(``queue.get``, socket recv) while holding the token trips SchedHang
+rather than interleaving; locks constructed BEFORE arming (module
+globals) are enrolled explicitly via ``observed(enroll=...)``;
+``Condition`` wait/notify is not modeled (the package uses none).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import random
+import sys
+import threading
+from typing import (Callable, Dict, Iterable, Iterator, List, Optional,
+                    Sequence, Set, Tuple)
+
+__all__ = ["Sched", "SchedDeadlock", "SchedHang", "armed", "observed",
+           "point", "enumerate_schedules", "audit_subgraph",
+           "Observation"]
+
+# the REAL primitives, captured before any patching can happen
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+_REAL_THREAD = threading.Thread
+_REAL_EVENT = threading.Event
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_PKG_PARENT = os.path.dirname(_PKG_DIR)
+
+# THE sentinel: disarmed = this one global read (faults/scope style)
+_SCHED: Optional["Sched"] = None
+
+
+def _caller_site(depth: int = 2) -> Tuple[str, int]:
+    f = sys._getframe(depth)
+    return f.f_code.co_filename, f.f_lineno
+
+
+def _instrument_site(filename: str) -> bool:
+    """Instrument constructions from package or test frames; leave
+    stdlib internals (threading's own Condition/Event plumbing,
+    queue, subprocess) on real locks."""
+    if filename.startswith("<"):
+        return True  # exec/stdin scenarios: never a stdlib frame
+    path = os.path.abspath(filename)
+    if path.startswith(_PKG_DIR + os.sep):
+        return True
+    return os.sep + "tests" + os.sep in path or \
+        os.path.basename(os.path.dirname(path)) == "tests"
+
+
+def _rel_site(site: Tuple[str, int]) -> Tuple[str, int]:
+    path, line = site
+    try:
+        return os.path.relpath(os.path.abspath(path), _PKG_PARENT), line
+    except ValueError:
+        return path, line
+
+
+def point(name: str = "") -> None:
+    """Explicit yield point. Disarmed: one global read, returns."""
+    sched = _SCHED
+    if sched is None:
+        return
+    sched._yield_current(("point", name))
+
+
+class SchedDeadlock(RuntimeError):
+    """Every live managed thread is blocked on a lock another one
+    holds — the runtime demonstration of a GL119 cycle."""
+
+
+class SchedHang(RuntimeError):
+    """A granted thread neither yielded nor finished inside the hang
+    timeout (usually: a real OS wait entered while holding the
+    scheduler token — outside the harness's cooperative model)."""
+
+
+class _Managed:
+    def __init__(self, sched: "Sched", name: str,
+                 fn: Callable[[], None]):
+        self.sched = sched
+        self.name = name
+        self.fn = fn
+        self.gate = _REAL_EVENT()
+        self.done = False
+        self.error: Optional[BaseException] = None
+        self.blocked_on: Optional["_GatedLock"] = None
+        self.held: List["_GatedLock"] = []
+        self.thread = _REAL_THREAD(target=self._body, daemon=True,
+                                   name=f"sched-{name}")
+
+    def _body(self) -> None:
+        self.sched._register_current(self)
+        self.gate.wait()
+        self.gate.clear()
+        try:
+            self.fn()
+        except BaseException as e:  # reported by run(), never lost
+            self.error = e
+        finally:
+            self.done = True
+            self.sched._control.set()
+
+    def runnable(self) -> bool:
+        if self.done:
+            return False
+        b = self.blocked_on
+        return b is None or b._holder is None
+
+
+class _GatedLock:
+    """Mode-A lock: mutual exclusion comes from the scheduler token
+    (one thread runs at a time), so the lock is a flag plus yield
+    points — acquisition order is entirely schedule-driven. Falls
+    back to a real lock whenever its scheduler is not driving (before
+    run(), after run(), unmanaged threads): teardown code keeps
+    working after the harness exits."""
+
+    def __init__(self, sched: "Sched", site: Tuple[str, int],
+                 reentrant: bool):
+        self._sched = sched
+        self._site = _rel_site(site)
+        self._reentrant = reentrant
+        self._real = _REAL_RLOCK() if reentrant else _REAL_LOCK()
+        self._holder: Optional[_Managed] = None
+        self._depth = 0
+
+    @property
+    def name(self) -> str:
+        return f"{self._site[0]}:{self._site[1]}"
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        m = self._sched._current_managed()
+        if m is None:
+            if timeout is None or timeout < 0:
+                return self._real.acquire(blocking)
+            return self._real.acquire(blocking, timeout)
+        if self._holder is m and self._reentrant:
+            self._depth += 1
+            return True
+        m.blocked_on = self
+        self._sched._yield_current(("acquire", self.name))
+        while self._holder is not None:
+            if not blocking:
+                m.blocked_on = None
+                return False
+            self._sched._yield_current(("blocked", self.name))
+        m.blocked_on = None
+        self._holder = m
+        self._depth = 1
+        self._sched._record_acquire(m, self)
+        m.held.append(self)
+        return True
+
+    def release(self) -> None:
+        m = self._sched._current_managed()
+        if m is None:
+            self._real.release()
+            return
+        if self._holder is not m:
+            raise RuntimeError(
+                f"sched: release of {self.name} by {m.name!r} which "
+                f"does not hold it")
+        self._depth -= 1
+        if self._depth:
+            return
+        self._holder = None
+        for i in range(len(m.held) - 1, -1, -1):
+            if m.held[i] is self:
+                del m.held[i]
+                break
+        self._sched._yield_current(("release", self.name))
+
+    def locked(self) -> bool:
+        return self._holder is not None or self._real.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class Sched:
+    """One deterministic run: spawn managed threads, then drive them
+    with :meth:`run`. The realized trace (every yield point, in
+    order) and acquisition-order edge set are exposed afterward for
+    pinning and auditing."""
+
+    def __init__(self, schedule: Optional[Sequence[str]] = None,
+                 seed: Optional[int] = None,
+                 hang_timeout_s: float = 10.0):
+        self._schedule = list(schedule) if schedule is not None else []
+        self._sidx = 0
+        self._rng = random.Random(seed) if seed is not None else None
+        self._hang_timeout_s = float(hang_timeout_s)
+        self._threads: Dict[str, _Managed] = {}
+        self._order: List[str] = []
+        self._by_ident: Dict[int, _Managed] = {}
+        self._control = _REAL_EVENT()
+        self._driving = False
+        self._rr = 0
+        self.trace: List[Tuple[str, str, str]] = []
+        self.edges: Set[Tuple[Tuple[str, int], Tuple[str, int]]] = set()
+        self.sites: Set[Tuple[str, int]] = set()
+
+    # ---- building the scenario ----------------------------------------
+    def spawn(self, name: str, fn: Callable[..., None], *args,
+              **kwargs) -> None:
+        if name in self._threads:
+            raise ValueError(f"sched: duplicate thread name {name!r}")
+        m = _Managed(self, name,
+                     (lambda: fn(*args, **kwargs)))
+        self._threads[name] = m
+        self._order.append(name)
+
+    def adopt(self, thread: "_REAL_THREAD", started: Callable[[], None]
+              ) -> None:
+        """Registration hook for package-spawned threads (the
+        threading.Thread patch): the thread becomes schedulable under
+        its own ``.name``."""
+        name = thread.name
+        i = 2
+        while name in self._threads:
+            name = f"{thread.name}#{i}"
+            i += 1
+        m = _Managed(self, name, started)
+        m.thread = thread  # runs on the adopted thread, not its own
+        self._threads[name] = m
+        self._order.append(name)
+
+    # ---- managed-thread plumbing --------------------------------------
+    def _register_current(self, m: _Managed) -> None:
+        self._by_ident[threading.get_ident()] = m
+
+    def _current_managed(self) -> Optional[_Managed]:
+        if not self._driving:
+            return None
+        return self._by_ident.get(threading.get_ident())
+
+    def _yield_current(self, event: Tuple[str, str]) -> None:
+        m = self._current_managed()
+        if m is None:
+            return
+        self.trace.append((m.name,) + event)
+        self._control.set()
+        m.gate.wait()
+        m.gate.clear()
+
+    def _record_acquire(self, m: _Managed, lock: _GatedLock) -> None:
+        self.sites.add(lock._site)
+        for h in m.held:
+            self.edges.add((h._site, lock._site))
+
+    # ---- driving ------------------------------------------------------
+    def _pick(self, runnable: List[_Managed]) -> _Managed:
+        while self._sidx < len(self._schedule):
+            name = self._schedule[self._sidx]
+            self._sidx += 1
+            if name not in self._threads:
+                raise ValueError(f"sched: schedule names unknown "
+                                 f"thread {name!r} (have "
+                                 f"{sorted(self._threads)})")
+            m = self._threads[name]
+            if m.runnable():
+                return m
+        if self._rng is not None:
+            return self._rng.choice(
+                sorted(runnable, key=lambda m: m.name))
+        # schedule exhausted, no RNG: fair round-robin to completion
+        self._rr += 1
+        return runnable[self._rr % len(runnable)]
+
+    def _describe_block(self) -> str:
+        parts = []
+        for name in self._order:
+            m = self._threads[name]
+            if m.done:
+                continue
+            b = m.blocked_on
+            holds = ", ".join(h.name for h in m.held) or "nothing"
+            wants = b.name if b is not None else "nothing"
+            holder = (b._holder.name if b is not None and b._holder
+                      else "-")
+            parts.append(f"{name!r} holds [{holds}] and waits for "
+                         f"{wants} (held by {holder!r})")
+        return "; ".join(parts)
+
+    def run(self, max_steps: int = 100_000) -> "Sched":
+        """Drive every spawned thread to completion (or raise
+        SchedDeadlock/SchedHang). Re-raises the first managed-thread
+        exception after the drive, so test assertions inside threads
+        surface normally."""
+        global _SCHED
+        if _SCHED is not self:
+            raise RuntimeError("sched: run() outside armed() — the "
+                               "lock patches are not mine to drive")
+        self._driving = True
+        try:
+            for m in self._threads.values():
+                if not m.thread.is_alive() and not m.done \
+                        and m.thread._started.is_set() is False:
+                    m.thread.start()
+            steps = 0
+            while any(not m.done for m in self._threads.values()):
+                steps += 1
+                if steps > max_steps:
+                    raise SchedHang(
+                        f"sched: {max_steps} steps without quiescing "
+                        f"— {self._describe_block()}")
+                runnable = [self._threads[n] for n in self._order
+                            if self._threads[n].runnable()]
+                if not runnable:
+                    raise SchedDeadlock(
+                        "sched: every live thread is blocked — the "
+                        "realized GL119 cycle: "
+                        + self._describe_block())
+                m = self._pick(runnable)
+                self._control.clear()
+                m.gate.set()
+                if not self._control.wait(self._hang_timeout_s):
+                    raise SchedHang(
+                        f"sched: thread {m.name!r} neither yielded "
+                        f"nor finished in {self._hang_timeout_s}s — "
+                        "a real OS wait entered while holding the "
+                        "scheduler token?")
+        finally:
+            self._driving = False
+        for name in self._order:
+            err = self._threads[name].error
+            if err is not None:
+                raise err
+        return self
+
+    def trace_names(self) -> List[str]:
+        return [t[0] for t in self.trace]
+
+
+class _AdoptingThread(_REAL_THREAD):
+    """threading.Thread patch under armed(): package/test-frame
+    constructions become schedulable; everything else behaves real."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        site = _caller_site(2)
+        self._sched_managed = (_SCHED is not None
+                               and _instrument_site(site[0]))
+
+    def start(self) -> None:
+        sched = _SCHED
+        if not self._sched_managed or sched is None:
+            super().start()
+            return
+        target = super().run
+
+        def gated() -> None:
+            m = sched._threads[managed_name]
+            sched._register_current(m)
+            m.gate.wait()
+            m.gate.clear()
+            try:
+                target()
+            except BaseException as e:
+                m.error = e
+            finally:
+                m.done = True
+                sched._control.set()
+
+        sched.adopt(self, lambda: None)
+        managed_name = sched._order[-1]
+        m = sched._threads[managed_name]
+        m.thread = self
+        self.run = gated  # type: ignore[method-assign]
+        super().start()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        sched = _SCHED
+        if (self._sched_managed and sched is not None
+                and sched._current_managed() is not None):
+            # cooperative join: yield until the scheduler has run the
+            # joined thread to completion (a real join here would
+            # hold the token and hang the harness)
+            while self.is_alive():
+                sched._yield_current(("join-wait", self.name))
+                for m in sched._threads.values():
+                    if m.thread is self and m.done:
+                        return
+        super().join(timeout)
+
+
+@contextlib.contextmanager
+def armed(schedule: Optional[Sequence[str]] = None,
+          seed: Optional[int] = None,
+          hang_timeout_s: float = 10.0) -> Iterator[Sched]:
+    """Install the gating patches and yield the scheduler. Locks and
+    threads constructed from package/test frames inside the block are
+    schedulable; on exit everything is restored and surviving gated
+    locks quietly fall back to their real twins."""
+    global _SCHED
+    if _SCHED is not None:
+        raise RuntimeError("sched: already armed (no nesting)")
+    sched = Sched(schedule=schedule, seed=seed,
+                  hang_timeout_s=hang_timeout_s)
+
+    def lock_factory():
+        if _SCHED is sched and _instrument_site(
+                sys._getframe(1).f_code.co_filename):
+            return _GatedLock(sched, _caller_site(2), reentrant=False)
+        return _REAL_LOCK()
+
+    def rlock_factory():
+        if _SCHED is sched and _instrument_site(
+                sys._getframe(1).f_code.co_filename):
+            return _GatedLock(sched, _caller_site(2), reentrant=True)
+        return _REAL_RLOCK()
+
+    _SCHED = sched
+    threading.Lock = lock_factory  # type: ignore[misc]
+    threading.RLock = rlock_factory  # type: ignore[misc]
+    threading.Thread = _AdoptingThread  # type: ignore[misc]
+    try:
+        yield sched
+    finally:
+        threading.Lock = _REAL_LOCK  # type: ignore[misc]
+        threading.RLock = _REAL_RLOCK  # type: ignore[misc]
+        threading.Thread = _REAL_THREAD  # type: ignore[misc]
+        _SCHED = None
+
+
+def enumerate_schedules(names: Sequence[str], steps: int
+                        ) -> Iterator[Tuple[str, ...]]:
+    """Every schedule of ``steps`` entries over ``names`` —
+    len(names)**steps of them, for bounded systematic exploration
+    (slow-mark anything past ~4 threads x 6 steps; the fast tier
+    pins single adversarial schedules instead)."""
+    if steps == 0:
+        yield ()
+        return
+    for head in names:
+        for tail in enumerate_schedules(names, steps - 1):
+            yield (head,) + tail
+
+
+# ------------------------------------------------------------- observer
+
+class _RecordingLock:
+    """Mode-B lock: a real lock that records per-thread held stacks
+    and realized acquisition-order edges, keyed by construction
+    site. No gating — safe under real sockets and OS blocking."""
+
+    def __init__(self, obs: "Observation", site: Tuple[str, int],
+                 real=None):
+        self._obs = obs
+        self._site = _rel_site(site)
+        self._real = real if real is not None else _REAL_LOCK()
+        obs.sites.add(self._site)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        if timeout is None or timeout < 0:
+            got = self._real.acquire(blocking)
+        else:
+            got = self._real.acquire(blocking, timeout)
+        if got:
+            self._obs._note_acquire(self._site)
+        return got
+
+    def release(self) -> None:
+        self._real.release()
+        self._obs._note_release(self._site)
+
+    def locked(self) -> bool:
+        return self._real.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class Observation:
+    """The realized acquisition-order graph of one observed window:
+    ``sites`` = construction sites of every instrumented lock that
+    was built (or enrolled), ``edges`` = (outer site, inner site)
+    pairs realized by some thread actually nesting them."""
+
+    def __init__(self):
+        self.sites: Set[Tuple[str, int]] = set()
+        self.edges: Set[Tuple[Tuple[str, int], Tuple[str, int]]] = set()
+        self._tls = threading.local()
+        self._mu = _REAL_LOCK()
+
+    def _stack(self) -> List[Tuple[str, int]]:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def _note_acquire(self, site: Tuple[str, int]) -> None:
+        st = self._stack()
+        with self._mu:
+            for held in st:
+                self.edges.add((held, site))
+        st.append(site)
+
+    def _note_release(self, site: Tuple[str, int]) -> None:
+        st = self._stack()
+        for i in range(len(st) - 1, -1, -1):
+            if st[i] == site:
+                del st[i]
+                break
+
+
+@contextlib.contextmanager
+def observed(enroll: Sequence[Tuple[object, str, Tuple[str, int]]] = ()
+             ) -> Iterator[Observation]:
+    """Record the realized lock graph inside the block. ``enroll``
+    wraps locks that already exist (module globals, built before this
+    window) as ``(module_or_object, attribute_name, (relpath, line))``
+    — the site must be the lock's real construction site so the
+    static model can match it. Restores everything on exit."""
+    obs = Observation()
+
+    def lock_factory():
+        if _instrument_site(sys._getframe(1).f_code.co_filename):
+            return _RecordingLock(obs, _caller_site(2))
+        return _REAL_LOCK()
+
+    saved_lock = threading.Lock
+    saved = []
+    threading.Lock = lock_factory  # type: ignore[misc]
+    try:
+        for owner, attr, site in enroll:
+            real = getattr(owner, attr)
+            saved.append((owner, attr, real))
+            inner = getattr(real, "_real", real)
+            setattr(owner, attr, _RecordingLock(obs, site, real=inner))
+        yield obs
+    finally:
+        for owner, attr, real in saved:
+            setattr(owner, attr, real)
+        threading.Lock = saved_lock  # type: ignore[misc]
+
+
+def audit_subgraph(obs: Observation, model=None) -> List[str]:
+    """The audited-not-asserted close: every realized lock site and
+    acquisition-order edge must exist in the static model. Returns
+    NAMED findings (empty list = audit passes) — a lock the static
+    pass can't see is a finding, not silence."""
+    if model is None:
+        from ..analysis.concurrency import static_lock_model
+        model = static_lock_model()
+    problems: List[str] = []
+    decl_sites = model.decl_sites()
+    edge_sites = model.edge_sites()
+    for site in sorted(obs.sites):
+        if site not in decl_sites:
+            problems.append(
+                f"GRAFTRACE-AUDIT: lock constructed at {site[0]}:"
+                f"{site[1]} is INVISIBLE to the static model — "
+                "analysis/concurrency.py cannot check what it cannot "
+                "see; declare it as a plain `threading.Lock()` "
+                "attribute/global (or teach the pass the new shape)")
+    for a, b in sorted(obs.edges):
+        if (a, b) not in edge_sites:
+            problems.append(
+                f"GRAFTRACE-AUDIT: realized acquisition order "
+                f"{a[0]}:{a[1]} -> {b[0]}:{b[1]} is not an edge of "
+                "the static lock model — the call path that nests "
+                "these locks is invisible to the resolver, so GL119 "
+                "cannot vet it; make the path resolvable or document "
+                "the edge")
+    return problems

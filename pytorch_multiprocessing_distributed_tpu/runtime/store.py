@@ -55,7 +55,11 @@ def _load() -> ctypes.CDLL:
         os.makedirs(os.path.join(_CSRC, "build"), exist_ok=True)
         with open(os.path.join(_CSRC, "build", ".make.lock"), "w") as lockf:
             fcntl.flock(lockf, fcntl.LOCK_EX)
-            subprocess.run(
+            # the build-wait under _lib_lock is the point: every
+            # concurrent loader in THIS process must park until the
+            # .so exists — releasing the lock around the child would
+            # just hand them a dlopen of a half-written library
+            subprocess.run(  # graftlint: disable=GL120 first-loader build barrier: waiters NEED the .so
                 ["make", "-C", _CSRC], check=True, capture_output=True
             )
         lib = ctypes.CDLL(_SO)

@@ -444,14 +444,19 @@ class WireClient:
                 # so idempotent verbs reconnect-retry and everything
                 # else converts to the named WireDead — corruption
                 # never escapes raw past the health mirror
+                # blocking socket I/O under _mu is the CONTRACT here,
+                # not an accident: WireClient serializes to ONE
+                # in-flight RPC per connection (a second caller
+                # interleaving frames mid-exchange would corrupt the
+                # stream for both), so the lock must span the wait
                 if verb in self._idempotent:
-                    resp, arrs = retry_with_backoff(
+                    resp, arrs = retry_with_backoff(  # graftlint: disable=GL120 single-in-flight RPC: the lock IS the frame serializer
                         once, attempts=self._retries,
                         base_delay_s=self._backoff_s,
                         retry_on=(OSError, FaultTimeout, WireError),
                         sleep=self._sleep)
                 else:
-                    resp, arrs = once()
+                    resp, arrs = once()  # graftlint: disable=GL120 single-in-flight RPC: the lock IS the frame serializer
             except (OSError, FaultTimeout, WireError) as e:
                 raise WireDead(
                     f"wire: {verb!r} to {self.address} failed "
@@ -533,7 +538,13 @@ class WireServer:
         self.kill_connections()
         if self._accept_thread.is_alive():
             self._accept_thread.join(timeout=2.0)
-        for t in self._threads:
+        # snapshot under the lock, join OUTSIDE it: the accept loop
+        # writes this list (GL121 — pinned in tests/test_graftrace.py),
+        # and joining while holding the lock would park the pruner
+        # behind a 2s-per-thread wait (GL120)
+        with self._conns_mu:
+            threads = list(self._threads)
+        for t in threads:
             t.join(timeout=2.0)
 
     def kill_connections(self) -> None:
@@ -570,9 +581,14 @@ class WireServer:
                                  daemon=True,
                                  name="pmdt-wire-conn")
             # prune finished handlers: a long-lived server whose
-            # clients reconnect must not accrete dead Thread objects
-            self._threads = [x for x in self._threads if x.is_alive()]
-            self._threads.append(t)
+            # clients reconnect must not accrete dead Thread objects.
+            # Under _conns_mu — stop() snapshots this list from
+            # another thread, and an unguarded swap races the
+            # snapshot into joining a stale list (GL121)
+            with self._conns_mu:
+                self._threads = [x for x in self._threads
+                                 if x.is_alive()]
+                self._threads.append(t)
             t.start()
 
     def _serve_conn(self, conn: socket.socket) -> None:

@@ -1,0 +1,44 @@
+"""GL119 near-miss negatives: the same multi-lock shapes with ONE
+global acquisition order everywhere, a legal RLock re-entry (direct
+AND through a locked helper), and an acyclic three-lock chain."""
+import threading
+
+_A = threading.Lock()
+_B = threading.Lock()
+_C = threading.Lock()
+
+
+def first_caller():
+    with _A:
+        with _B:
+            pass
+
+
+def second_caller():
+    # same pair, SAME order — an edge, not a cycle
+    with _A:
+        with _B:
+            pass
+
+
+def chain():
+    # A -> B -> C extends the order without closing a loop
+    with _A:
+        with _B:
+            with _C:
+                pass
+
+
+class Journal:
+    def __init__(self):
+        self._mu = threading.RLock()
+
+    def flush(self):
+        with self._mu:
+            self._flush_locked()
+
+    def _flush_locked(self):
+        # RLock held by the same thread re-enters by design; only a
+        # plain Lock self-nest is the guaranteed deadlock
+        with self._mu:
+            pass

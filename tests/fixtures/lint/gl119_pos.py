@@ -1,0 +1,54 @@
+"""GL119 positives: lock-order cycles — one pair inverted directly,
+one pair inverted through a callee (the call-graph hop), and a
+non-reentrant re-acquire (a guaranteed self-deadlock). Each cycle
+reports ONCE, anchored at its lexically-first acquisition site."""
+import threading
+
+_A = threading.Lock()
+_B = threading.Lock()
+
+
+def ship_then_meter():
+    with _A:
+        with _B:                                # <- GL119
+            pass
+
+
+def meter_then_ship():
+    with _B:
+        with _A:
+            pass
+
+
+_C = threading.Lock()
+_D = threading.Lock()
+
+
+def grab_d():
+    with _D:                                    # <- GL119
+        pass
+
+
+def c_then_d():
+    with _C:
+        grab_d()
+
+
+def grab_c():
+    with _C:
+        pass
+
+
+def d_then_c():
+    with _D:
+        grab_c()
+
+
+class Journal:
+    def __init__(self):
+        self._mu = threading.Lock()
+
+    def flush(self):
+        with self._mu:
+            with self._mu:                      # <- GL119
+                pass

@@ -1,0 +1,46 @@
+"""GL120 near-miss negatives: the slow work happens OUTSIDE the lock
+scope (the fix shape), lock-free helpers run under the lock, and the
+``.join`` lookalikes (str.join, os.path.join, a separator join) stay
+silent."""
+import os
+import threading
+import time
+
+_MU = threading.Lock()
+
+
+def sleepy_outside():
+    with _MU:
+        stamp = time.monotonic()
+    time.sleep(0.5)
+    return stamp
+
+
+def sync_before(fh):
+    os.fsync(fh.fileno())
+    with _MU:
+        return fh.tell()
+
+
+def quick_helper(items):
+    return len(items)
+
+
+def fast_under_lock(items):
+    with _MU:
+        return quick_helper(items)
+
+
+def string_join(parts):
+    with _MU:
+        return "".join(parts)
+
+
+def path_join(root):
+    with _MU:
+        return os.path.join(root, "shard.bin")
+
+
+def separator_join(sep, parts):
+    with _MU:
+        return sep.join(parts)

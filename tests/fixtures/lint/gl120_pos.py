@@ -1,0 +1,46 @@
+"""GL120 positives: blocking operations under a held lock — direct
+(sleep, fsync, subprocess, a thread join), one call-graph hop away,
+and through a function passed as an argument into the lock scope."""
+import os
+import subprocess
+import threading
+import time
+
+_MU = threading.Lock()
+
+
+def sleepy():
+    with _MU:
+        time.sleep(0.5)                         # <- GL120
+
+
+def syncy(fh):
+    with _MU:
+        os.fsync(fh.fileno())                   # <- GL120
+
+
+def runny():
+    with _MU:
+        subprocess.run(["true"], check=True)    # <- GL120
+
+
+def joiner(worker_thread):
+    with _MU:
+        worker_thread.join()                    # <- GL120
+
+
+def slow_helper():
+    time.sleep(1.0)
+
+
+def transitive():
+    with _MU:
+        slow_helper()                           # <- GL120
+
+
+def engaged(retry):
+    def once():
+        time.sleep(0.2)
+
+    with _MU:
+        retry(once)                             # <- GL120

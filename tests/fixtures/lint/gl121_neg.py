@@ -1,0 +1,57 @@
+"""GL121 near-miss negatives: the same thread-plus-methods shapes
+with real evidence — one shared lock at EVERY site, init-only writes,
+a thread-confined attribute nobody else touches, and a sync-primitive
+attribute (its cross-thread use is its whole job)."""
+import threading
+
+
+class GuardedMeter:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.samples = []
+        threading.Thread(target=self._pump, daemon=True).start()
+
+    def _pump(self):
+        while True:
+            with self._mu:
+                self.samples.append(1)
+
+    def snapshot(self):
+        with self._mu:
+            return list(self.samples)
+
+
+class InitOnly:
+    def __init__(self, cfg):
+        self.cfg = dict(cfg)  # written once, before the thread exists
+        threading.Thread(target=self._pump, daemon=True).start()
+
+    def _pump(self):
+        while self.cfg:
+            pass
+
+    def describe(self):
+        return sorted(self.cfg)
+
+
+class OwnedByThread:
+    def __init__(self):
+        self.ticks = 0
+        threading.Thread(target=self._pump, daemon=True).start()
+
+    def _pump(self):
+        while True:
+            self.ticks = self.ticks + 1  # confined: no one else reads
+
+
+class EventGuarded:
+    def __init__(self):
+        self._stop = threading.Event()
+        threading.Thread(target=self._pump, daemon=True).start()
+
+    def _pump(self):
+        while not self._stop.is_set():
+            pass
+
+    def stop(self):
+        self._stop.set()

@@ -1,0 +1,21 @@
+"""GL121 positives: attributes written inside a thread target's
+reachable body and read from other methods, with a lock DECLARED but
+held at none of the sites — one finding per attribute, anchored at
+the thread-side write."""
+import threading
+
+
+class Meter:
+    def __init__(self):
+        self._mu = threading.Lock()  # declared, never used: no evidence
+        self.samples = []
+        self.total = 0
+        threading.Thread(target=self._pump, daemon=True).start()
+
+    def _pump(self):
+        while True:
+            self.samples.append(1)              # <- GL121
+            self.total = self.total + 1         # <- GL121
+
+    def snapshot(self):
+        return list(self.samples), self.total

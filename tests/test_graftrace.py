@@ -1,0 +1,455 @@
+"""graftrace: the deterministic interleaving harness and the
+static/runtime lock-model audit.
+
+Four layers:
+- harness mechanics: same seed -> same interleaving byte-for-byte,
+  explicit schedules drive exact thread orders, an all-blocked state
+  raises SchedDeadlock naming holders and waiters (GL119, live);
+- pinned adversarial schedules over the real runtime objects: the
+  PR-15 WireClient stale-worker teardown race (the canary — the fix
+  survives the schedule, the pre-fix code fails it), kill-vs-drain on
+  WireServer's split locks, the journal close-vs-fsync window this
+  PR's heal fix opened (and made safe), MemStore ``add`` atomicity
+  under exhaustive small-schedule enumeration, concurrent fleet
+  roster publishes;
+- the static pass's regression net: the PRE-fix WireServer thread
+  bookkeeping shape must report GL121 (the historical bug cannot
+  silently come back);
+- the audited-not-asserted close: the realized acquisition-order
+  graph of a real client/server exchange must be a subgraph of the
+  static lock model, and a lock the model can't see must come back
+  as a NAMED finding.
+"""
+
+import json
+import os
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from pytorch_multiprocessing_distributed_tpu.analysis.concurrency import (
+    static_lock_model)
+from pytorch_multiprocessing_distributed_tpu.analysis.rules import (
+    analyze_files)
+from pytorch_multiprocessing_distributed_tpu.runtime import fleet
+from pytorch_multiprocessing_distributed_tpu.runtime import sched as S
+from pytorch_multiprocessing_distributed_tpu.runtime import wire
+from pytorch_multiprocessing_distributed_tpu.runtime.heal import (
+    RequestJournal)
+from pytorch_multiprocessing_distributed_tpu.runtime.store import MemStore
+from pytorch_multiprocessing_distributed_tpu.runtime.wire import (
+    WireClient, WireServer)
+
+WIRE_REL = "pytorch_multiprocessing_distributed_tpu/runtime/wire.py"
+
+
+# ------------------------------------------------------ harness basics
+
+class _Counter:
+    """The textbook GL121 shape: read, yield, write back."""
+
+    def __init__(self):
+        self.v = 0
+
+    def bump(self):
+        x = self.v
+        S.point("mid")
+        self.v = x + 1
+
+
+def test_pinned_schedule_demonstrates_lost_update():
+    c = _Counter()
+    with S.armed(schedule=["a", "b", "a", "b"]) as sc:
+        sc.spawn("a", c.bump)
+        sc.spawn("b", c.bump)
+        sc.run()
+    # both threads read 0 before either wrote: one update LOST —
+    # deterministically, every run
+    assert c.v == 1
+
+
+def test_serial_schedule_keeps_both_updates():
+    c = _Counter()
+    with S.armed(schedule=["a", "a", "b", "b"]) as sc:
+        sc.spawn("a", c.bump)
+        sc.spawn("b", c.bump)
+        sc.run()
+    assert c.v == 2
+
+
+def test_same_seed_same_interleaving():
+    def run(seed):
+        c = _Counter()
+        with S.armed(seed=seed) as sc:
+            sc.spawn("a", c.bump)
+            sc.spawn("b", c.bump)
+            sc.run()
+            return list(sc.trace), c.v
+
+    t7a, v7a = run(7)
+    t7b, v7b = run(7)
+    t9, _ = run(9)
+    assert t7a == t7b and v7a == v7b
+    assert isinstance(t9, list)  # a different seed still completes
+
+
+def test_deadlock_detection_names_holders_and_waiters():
+    with S.armed(schedule=["x", "y", "x", "y", "x", "y"]) as sc:
+        l1 = threading.Lock()
+        l2 = threading.Lock()
+
+        def x():
+            with l1:
+                S.point("x-holds-l1")
+                with l2:
+                    pass
+
+        def y():
+            with l2:
+                S.point("y-holds-l2")
+                with l1:
+                    pass
+
+        sc.spawn("x", x)
+        sc.spawn("y", y)
+        with pytest.raises(S.SchedDeadlock) as ei:
+            sc.run()
+    msg = str(ei.value)
+    assert "'x'" in msg and "'y'" in msg and "waits for" in msg
+
+
+def test_gated_locks_record_realized_edges():
+    with S.armed(schedule=["a"] * 12) as sc:
+        outer = threading.Lock()
+        inner = threading.Lock()
+
+        def a():
+            with outer:
+                with inner:
+                    S.point("nested")
+
+        sc.spawn("a", a)
+        sc.run()
+        assert len(sc.edges) == 1
+
+
+def test_disarmed_is_one_global_read():
+    # point() outside armed() must be free and silent
+    S.point("nobody-listening")
+    assert S._SCHED is None
+
+
+# ------------------------------------------- the canary: PR-15's race
+
+class _FakeSock:
+    """Just enough socket for send_frame: a timeout, a sendall that
+    parks at a yield point then dies, a close that records itself."""
+
+    def __init__(self, name, fail=True):
+        self.name = name
+        self.fail = fail
+        self.closed = False
+        self._timeout = 1.0
+
+    def gettimeout(self):
+        return self._timeout
+
+    def settimeout(self, t):
+        self._timeout = t
+
+    def sendall(self, data):
+        S.point(f"{self.name}-pre-send")
+        if self.fail:
+            raise OSError(f"{self.name}: connection reset")
+
+    def close(self):
+        self.closed = True
+
+
+_CANARY_SCHEDULE = ["worker", "swapper", "swapper", "worker", "worker"]
+
+
+def _drive_stale_worker_race(client, sock_old, sock_new):
+    """The PR-15 shape: a deadline-abandoned worker wakes up holding
+    a socket a concurrent retry already replaced, its send fails, and
+    its error path decides which socket to tear down."""
+
+    def worker():
+        with pytest.raises(OSError):
+            client._exchange({"verb": "ping"}, (), None)
+
+    def swapper():
+        client._sock = sock_new  # the retry's fresh connection
+
+    with S.armed(schedule=list(_CANARY_SCHEDULE)) as sc:
+        sc.spawn("worker", worker)
+        sc.spawn("swapper", swapper)
+        sc.run()
+
+
+def test_canary_stale_worker_drop_only_fixed_code():
+    """Same schedule as the pre-fix reproduction below: with
+    ``_drop(only=)`` the stale worker closes ITS dead socket and the
+    replacement survives."""
+    client = WireClient("127.0.0.1:1", call_deadline_s=None)
+    sock_old = _FakeSock("old")
+    sock_new = _FakeSock("new", fail=False)
+    client._sock = sock_old
+    _drive_stale_worker_race(client, sock_old, sock_new)
+    assert sock_old.closed, "the failed socket must be torn down"
+    assert not sock_new.closed, \
+        "the retry's replacement connection must survive the stale " \
+        "worker's teardown"
+    assert client._sock is sock_new
+
+
+def test_canary_stale_worker_prefix_code_fails(monkeypatch):
+    """The historical bug, reproduced deterministically: the pre-fix
+    ``_drop`` (no ``only=``) under the SAME schedule closes the
+    replacement connection the concurrent retry just opened."""
+
+    def prefix_drop(self, only=None):
+        sock, self._sock = self._sock, None
+        if sock is not None:
+            sock.close()
+
+    monkeypatch.setattr(WireClient, "_drop", prefix_drop)
+    client = WireClient("127.0.0.1:1", call_deadline_s=None)
+    sock_old = _FakeSock("old")
+    sock_new = _FakeSock("new", fail=False)
+    client._sock = sock_old
+    _drive_stale_worker_race(client, sock_old, sock_new)
+    # same seedless schedule, same interleaving -> the bug, every time
+    assert sock_new.closed, \
+        "pre-fix _drop must close the replacement (the bug)"
+    assert not sock_old.closed
+
+
+# ------------------------------------- pinned schedules over runtime
+
+def test_kill_connections_never_waits_on_the_verb_lock():
+    """PR-15's other hand-found bug, as a schedule: kill_connections
+    must complete while a drain handler still HOLDS the verb lock —
+    the split ``_conns_mu`` is what makes that possible."""
+    with S.armed(schedule=[
+            "drain", "drain",            # acquire + take _mu, park
+            "kill", "kill", "kill", "kill",  # run kill to completion
+            "drain", "drain", "drain"]) as sc:
+        srv = WireServer({})
+        doomed = _FakeSock("conn", fail=False)
+        srv._conns.append(doomed)
+        finished = []
+
+        def drain():
+            with srv._mu:
+                S.point("draining")
+                S.point("still-draining")
+
+        def kill():
+            srv.kill_connections()
+            finished.append("kill")
+
+        sc.spawn("drain", drain)
+        sc.spawn("kill", kill)
+        sc.run()
+        trace = sc.trace
+    srv._listener.close()
+    assert doomed.closed and finished == ["kill"]
+    # kill ran START to FINISH inside drain's _mu hold window
+    names = [(t[0], t[1]) for t in trace]
+    drain_release = names.index(("drain", "release"))
+    kill_events = [i for i, t in enumerate(trace) if t[0] == "kill"]
+    assert kill_events and max(kill_events) < drain_release
+    # and kill's lock traffic is ONLY the connection lock (wire.py
+    # _conns_mu site), never the verb lock
+    kill_locks = {t[2] for t in trace
+                  if t[0] == "kill" and t[1] in ("acquire", "release")}
+    assert kill_locks == {f"{WIRE_REL}:507"}, kill_locks
+
+
+def test_journal_close_between_append_and_fsync(tmp_path):
+    """The window this PR's heal fix opened on purpose: a recorder
+    releases ``_mu`` after appending, close() compacts the journal in
+    that gap, the recorder's deferred fsync then finds the handle
+    gone — and must treat that as close owning durability, not
+    crash."""
+    path = str(tmp_path / "wal.jsonl")
+    req = SimpleNamespace(uid="r1", prompt=[1, 2], max_new_tokens=4,
+                          eos_id=0)
+    # warm close()'s lazy import OUTSIDE the harness: import machinery
+    # inside a gated thread would add yields the schedule doesn't name
+    import pytorch_multiprocessing_distributed_tpu.train.checkpoint  # noqa: F401
+    with S.armed(schedule=[
+            "rec", "rec",       # acquire + take _mu, append, release
+            "closer", "closer", "closer",  # compact inside the gap
+            "rec", "rec"]) as sc:
+        j = RequestJournal(path)
+
+        def rec():
+            j.record_admit(req)
+
+        def closer():
+            j.close(compact=True)
+
+        sc.spawn("rec", rec)
+        sc.spawn("closer", closer)
+        sc.run()  # re-raises any thread exception: none expected
+    lines = [json.loads(x) for x in
+             open(path).read().splitlines() if x]
+    assert [x["op"] for x in lines] == ["admit"]
+    assert lines[0]["uid"] == "r1"
+
+
+def test_memstore_add_atomic_under_all_small_schedules():
+    """MemStore.add is the fleet's slot-claim primitive: under EVERY
+    4-step schedule of two adders the count is exactly 2 — the lock
+    make the read-modify-write one step, so no interleaving loses an
+    update (contrast: the unguarded counter test above)."""
+    for schedule in S.enumerate_schedules(("a", "b"), 4):
+        with S.armed(schedule=list(schedule)) as sc:
+            ms = MemStore()
+            sc.spawn("a", ms.add, "k")
+            sc.spawn("b", ms.add, "k")
+            sc.run()
+            assert int(ms.get("k")) == 2, schedule
+
+
+def test_fleet_roster_publish_claims_distinct_slots():
+    """Heartbeat publish path under adversarial seeds: two replicas
+    publishing concurrently must each claim their OWN roster slot
+    (the store's atomic ``add`` is the lock evidence fleet.py cites
+    for GL121)."""
+    for seed in range(6):
+        with S.armed(seed=seed) as sc:
+            ms = MemStore()
+            sc.spawn("a", fleet.publish_replica, ms, "rep-a",
+                     address="127.0.0.1:1")
+            sc.spawn("b", fleet.publish_replica, ms, "rep-b",
+                     address="127.0.0.1:2")
+            sc.run()
+        assert int(ms.get("fleet/run/replicas/n")) == 2
+        slots = {ms.get("fleet/run/replicas/0"),
+                 ms.get("fleet/run/replicas/1")}
+        assert slots == {b"rep-a", b"rep-b"}, (seed, slots)
+
+
+@pytest.mark.slow
+def test_memstore_add_atomic_exhaustive_three_threads():
+    """Bounded systematic exploration: every 6-step schedule over
+    three adders (729 runs) — the heavyweight tier of the same
+    invariant the fast test pins."""
+    for schedule in S.enumerate_schedules(("a", "b", "c"), 6):
+        with S.armed(schedule=list(schedule)) as sc:
+            ms = MemStore()
+            for name in ("a", "b", "c"):
+                sc.spawn(name, ms.add, "k")
+            sc.run()
+            assert int(ms.get("k")) == 3, schedule
+
+
+# ---------------------------------------- the static regression net
+
+_PREFIX_WIRESERVER_SHAPE = '''
+import threading
+
+
+class Server:
+    def __init__(self):
+        self._conns_mu = threading.Lock()
+        self._threads = []
+        self._accept = threading.Thread(target=self._accept_loop,
+                                        daemon=True)
+
+    def _accept_loop(self):
+        while True:
+            t = object()
+            self._threads = [x for x in self._threads if x]
+            self._threads.append(t)
+
+    def stop(self):
+        for t in self._threads:
+            pass
+'''
+
+
+def test_gl121_catches_the_prefix_wireserver_shape(tmp_path):
+    """The exact bookkeeping shape this PR fixed in WireServer —
+    prune-and-append from the accept thread, snapshot from stop(),
+    no common lock — must keep reporting GL121 forever."""
+    p = tmp_path / "prefix_shape.py"
+    p.write_text(_PREFIX_WIRESERVER_SHAPE)
+    found = [(f.rule, "self._threads" in f.message)
+             for f in analyze_files([str(p)]) if f.rule == "GL121"]
+    assert found == [("GL121", True)], found
+
+
+# ------------------------------------- audited, not asserted: Mode B
+
+def test_realized_lock_graph_is_subgraph_of_static_model(tmp_path):
+    """THE close: run a real client/server RPC exchange, MemStore
+    traffic and a journal write under the observer, then check every
+    realized lock site and acquisition-order edge against the static
+    model. A lock the static pass can't see fails here BY NAME."""
+    model = static_lock_model()
+    assert model.decls, "static model found no locks — resolver broke"
+    with S.observed(enroll=[(wire, "_METER_MU",
+                             (WIRE_REL, 120))]) as obs:
+
+        def echo(header, arrays):
+            return {"y": header.get("x")}, arrays
+
+        with WireServer({"echo": echo}) as server:
+            client = WireClient(server.address, backoff_s=0.0)
+            # deadline_s=None: the watchdog would run _exchange on a
+            # helper thread, and the per-thread held stacks would
+            # never see the client-lock -> meter-lock nesting
+            resp, arrs = client.call(
+                "echo", x=5, deadline_s=None,
+                arrays=[np.arange(3, dtype=np.float32)])
+            assert resp["ok"] and resp["y"] == 5
+            client.close()
+
+        ms = MemStore()
+        ms.add("k")
+        ms.set("k2", b"v")
+        assert ms.get("k2") == b"v"
+
+        j = RequestJournal(str(tmp_path / "wal.jsonl"))
+        j.record_admit(SimpleNamespace(uid="u", prompt=[1],
+                                       max_new_tokens=2, eos_id=0))
+        j.close()
+
+    problems = S.audit_subgraph(obs, model)
+    assert problems == [], "\n".join(problems)
+    # the client->meter nesting REALIZED and matched the model's one
+    # cross-lock edge — the audit exercised a real edge, not silence
+    assert ((WIRE_REL, 336), (WIRE_REL, 120)) in obs.edges
+    assert (WIRE_REL, 503) in obs.sites  # server verb lock was live
+
+
+def test_audit_names_an_invisible_lock():
+    """A lock the static model can't see must surface as a NAMED
+    finding, never silence."""
+    model = static_lock_model()
+    with S.observed() as obs:
+        rogue = threading.Lock()  # constructed from a TEST frame
+        with rogue:
+            pass
+    problems = S.audit_subgraph(obs, model)
+    assert any("INVISIBLE to the static model" in p for p in problems)
+
+
+def test_observer_restores_and_stays_passive():
+    before = threading.Lock
+    with S.observed() as obs:
+        lk = threading.Lock()
+        t0 = time.perf_counter()
+        with lk:
+            pass
+        assert time.perf_counter() - t0 < 1.0  # no gating in Mode B
+    assert threading.Lock is before
+    assert obs.sites  # the test-frame lock was recorded
+    assert wire._METER_MU.__class__.__name__ != "_RecordingLock"
