@@ -1163,15 +1163,22 @@ def run_fleet_sweep(model, params, args, rng):
 
 
 def run_wire_sweep(model, params, args, rng):
-    """graftwire (sweep 9): the socket transport vs the in-process
-    seam it mirrors — (1) same fleet, two transports: tok/s side by
-    side, streams byte-identical, per-RPC overhead p50/p95; (2)
+    """graftwire + graftlink (sweep 9): the socket transport vs the
+    in-process seam it mirrors — (1) same fleet, THREE transports
+    (in-process, blocking wire, pipelined wire): tok/s side by side,
+    streams byte-identical, per-RPC overhead p50/p95, and a scraper
+    thread hammering the snapshot verb through the timed run so the
+    sweep records snapshot p99 with a long engine verb in flight (the
+    head-of-line headline: blocking queues the scrape behind every
+    step RPC, pipelined answers it on the obs lane); (2)
     disaggregation over the wire: PageTransfer bytes/request at the
-    payload and framing layers — then the SAME split with int8 KV
-    (graftquant), bytes/request halved vs the model-dtype run; (3)
-    socket-level kill -> WAL redelivery with the recovery TTFT on
-    the clock."""
+    payload and framing layers (wire bytes ~ payload bytes — the
+    zero-copy scatter-gather claim) plus prefill->decode handoff
+    latency — then the SAME split with int8 KV (graftquant),
+    bytes/request halved vs the model-dtype run; (3) socket-level
+    kill -> WAL redelivery with the recovery TTFT on the clock."""
     import tempfile
+    import threading
 
     from pytorch_multiprocessing_distributed_tpu.runtime import (
         heal, wire)
@@ -1197,7 +1204,7 @@ def run_wire_sweep(model, params, args, rng):
                              kv_dtype=kv_dtype)
 
     def socket_fleet(journals=None, roles=("both", "both"),
-                     kv_dtype="model"):
+                     kv_dtype="model", pipelined=True):
         servers = []
         for i, role in enumerate(roles):
             journal = journals[i] if journals else None
@@ -1205,7 +1212,8 @@ def run_wire_sweep(model, params, args, rng):
                 mk(journal, dispatch_retries=1 if journals else 3,
                    kv_dtype=kv_dtype),
                 rid=f"r{i}", role=role).start())
-        replicas = [RemoteReplica(s.address, backoff_s=0.0)
+        replicas = [RemoteReplica(s.address, backoff_s=0.0,
+                                  pipelined=pipelined)
                     for s in servers]
         return Router(replicas), servers, replicas
 
@@ -1232,39 +1240,89 @@ def run_wire_sweep(model, params, args, rng):
     ref_tokens = {i: list(r.tokens) for i, r in enumerate(ref)}
     total_tokens = sum(len(t) for t in ref_tokens.values())
 
-    router, servers, replicas = socket_fleet()
-    try:
-        router.serve([(p, new_tokens) for p in prompts])  # same warmup
-        for replica in replicas:
-            replica._client.rpc_s.clear()
-        t0 = time.perf_counter()
-        out = router.serve([(p, new_tokens) for p in prompts])
-        socket_s = time.perf_counter() - t0
-        for i, r in enumerate(out):
-            assert r.state == "done" and \
-                list(r.tokens) == ref_tokens[i], (
-                    f"socket-fleet stream {i} diverged from the "
-                    "in-process fleet")
-        point = {
-            "mode": "wire_fleet", "replicas": 2, "slots": slots,
-            "requests": n_req,
-            "inproc_tokens_per_sec": total_tokens / inproc_s,
-            "tokens_per_sec": total_tokens / socket_s,
-            "wire_overhead_frac": socket_s / inproc_s - 1.0,
-            "byte_identical": True,
-        }
-        point.update(rpc_stats(replicas))
-        print(f"wire     2 replicas  {point['tokens_per_sec']:9.1f} "
-              f"tok/s (in-process: "
-              f"{point['inproc_tokens_per_sec']:9.1f})  "
-              f"overhead={point['wire_overhead_frac'] * 100:5.1f}%  "
-              f"rpc p50={point.get('rpc_p50_ms', 0):6.2f} ms "
-              f"p95={point.get('rpc_p95_ms', 0):6.2f} ms "
-              f"({point['rpcs']} rpcs)", flush=True)
-        results.append(point)
-    finally:
-        for server in servers:
-            server.stop()
+    # the SAME socket fleet twice: blocking (pipelined=False — the
+    # pre-graftlink wire, one exchange at a time) then pipelined (the
+    # default). A scraper thread hits replica 0's snapshot verb
+    # through the timed run: blocking queues each scrape behind the
+    # in-flight step RPC (head-of-line), pipelined answers it from
+    # the obs lane — snapshot p99 under load is the HOL headline.
+    by_transport = {}
+    for transport, pipelined in (("blocking", False),
+                                 ("pipelined", True)):
+        router, servers, replicas = socket_fleet(pipelined=pipelined)
+        stop = threading.Event()
+        scrape_s = []
+
+        def scrape_loop(replica=replicas[0], samples=scrape_s):
+            while not stop.is_set():
+                t_s = time.perf_counter()
+                try:
+                    replica.scrape()
+                except Exception:
+                    return
+                samples.append(time.perf_counter() - t_s)
+                stop.wait(0.002)
+
+        try:
+            router.serve([(p, new_tokens) for p in prompts])  # warmup
+            for replica in replicas:
+                replica._client.rpc_s.clear()
+            scraper = threading.Thread(target=scrape_loop, daemon=True)
+            scraper.start()
+            t0 = time.perf_counter()
+            out = router.serve([(p, new_tokens) for p in prompts])
+            socket_s = time.perf_counter() - t0
+            stop.set()
+            scraper.join(timeout=10.0)
+            for i, r in enumerate(out):
+                assert r.state == "done" and \
+                    list(r.tokens) == ref_tokens[i], (
+                        f"{transport} socket-fleet stream {i} "
+                        "diverged from the in-process fleet")
+            point = {
+                "mode": "wire_fleet", "transport": transport,
+                "replicas": 2, "slots": slots, "requests": n_req,
+                "inproc_tokens_per_sec": total_tokens / inproc_s,
+                "tokens_per_sec": total_tokens / socket_s,
+                "wire_overhead_frac": socket_s / inproc_s - 1.0,
+                "byte_identical": True,
+                "snapshot_scrapes": len(scrape_s),
+            }
+            if scrape_s:
+                point["snapshot_p50_ms"] = \
+                    _percentile(scrape_s, 50) * 1e3
+                point["snapshot_p99_ms"] = \
+                    _percentile(scrape_s, 99) * 1e3
+            point.update(rpc_stats(replicas))
+            by_transport[transport] = point
+            print(f"wire     2 replicas {transport:9s} "
+                  f"{point['tokens_per_sec']:9.1f} tok/s "
+                  f"(in-process: "
+                  f"{point['inproc_tokens_per_sec']:9.1f})  "
+                  f"overhead="
+                  f"{point['wire_overhead_frac'] * 100:5.1f}%  "
+                  f"rpc p50={point.get('rpc_p50_ms', 0):6.2f} ms "
+                  f"p95={point.get('rpc_p95_ms', 0):6.2f} ms  "
+                  f"snapshot p99="
+                  f"{point.get('snapshot_p99_ms', 0):7.2f} ms "
+                  f"({point['snapshot_scrapes']} scrapes)",
+                  flush=True)
+            results.append(point)
+        finally:
+            stop.set()
+            for server in servers:
+                server.stop()
+    pipe = by_transport["pipelined"]
+    blk = by_transport["blocking"]
+    pipe["speedup_vs_blocking"] = (pipe["tokens_per_sec"]
+                                   / blk["tokens_per_sec"])
+    if "snapshot_p99_ms" in pipe and "snapshot_p99_ms" in blk:
+        pipe["snapshot_p99_vs_blocking"] = (pipe["snapshot_p99_ms"]
+                                            / blk["snapshot_p99_ms"])
+    print(f"wire     pipelined vs blocking  "
+          f"{pipe['speedup_vs_blocking']:.2f}x tok/s, snapshot p99 "
+          f"{pipe.get('snapshot_p99_vs_blocking', float('nan')):.2f}x",
+          flush=True)
 
     # ---- point 2: disaggregation over the wire (PageTransfer bytes)
     meter0 = wire.wire_meter()["wire_bytes_sent"]
@@ -1290,14 +1348,28 @@ def run_wire_sweep(model, params, args, rng):
                 router.transfer_bytes // max(1,
                                              router.transfers_routed),
             "wire_bytes_sent": wire_sent,
+            # payload bytes as a fraction of EVERYTHING that hit the
+            # socket (transfers + every verb header + token events):
+            # the zero-copy scatter-gather claim is wire ~ payload,
+            # so this should sit near 1.0 — recorded, not asserted
+            # (tiny bench models inflate the verb-header share)
+            "wire_payload_frac":
+                router.transfer_bytes / max(1, wire_sent),
             "token_exact": True,
         }
+        if router.transfer_handoff_s:
+            point["handoff_p50_ms"] = \
+                _percentile(router.transfer_handoff_s, 50) * 1e3
+            point["handoff_p95_ms"] = \
+                _percentile(router.transfer_handoff_s, 95) * 1e3
         assert wire_sent >= router.transfer_bytes
         print(f"wire     prefill->decode  "
               f"{point['tokens_per_sec']:9.1f} tok/s  "
               f"{point['transfer_bytes_per_request']} KV B/req over "
-              f"{router.transfers_routed} transfers (token-exact)",
-              flush=True)
+              f"{router.transfers_routed} transfers  payload/wire="
+              f"{point['wire_payload_frac']:.3f}  handoff p95="
+              f"{point.get('handoff_p95_ms', 0):6.2f} ms "
+              "(token-exact)", flush=True)
         results.append(point)
         model_bytes_per_request = point["transfer_bytes_per_request"]
     finally:
@@ -1339,8 +1411,15 @@ def run_wire_sweep(model, params, args, rng):
             "model_dtype_bytes_per_request": model_bytes_per_request,
             "transfer_bytes_ratio": bpr / model_bytes_per_request,
             "wire_bytes_sent": wire_sent,
+            "wire_payload_frac":
+                router.transfer_bytes / max(1, wire_sent),
             "token_exact_vs_int8_engine": True,
         }
+        if router.transfer_handoff_s:
+            point["handoff_p50_ms"] = \
+                _percentile(router.transfer_handoff_s, 50) * 1e3
+            point["handoff_p95_ms"] = \
+                _percentile(router.transfer_handoff_s, 95) * 1e3
         assert wire_sent >= router.transfer_bytes
         # the halving claim: int8 lanes + f32 scales vs model-dtype
         # blocks over the SAME prompt set — (Dh+4)/(itemsize*Dh),

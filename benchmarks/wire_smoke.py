@@ -18,12 +18,15 @@ router in THIS process:
    drains cleanly: both children exit 0 on their own;
 2. **SIGKILL → redelivery** — a both/both pair with WALs serves the
    same request set; mid-run the busiest replica's PROCESS is killed
-   -9 (no drain, no goodbye frame). The router reaps it on the named
-   ``WireDead``, reads its WAL from the router-known path (``hello``
-   published it; same host = shared filesystem), redelivers the
-   unfinished requests to the peer under ORIGINAL uids — every
-   stream still byte-exact, and the fleet ``tokens_generated`` merge
-   dedups the replayed prefix to the unique token count.
+   -9 (no drain, no goodbye frame) WITH a pipelined frame in flight
+   (a ``step`` submitted, not yet completed — graftlink's hard
+   case). The orphaned completion handle fails NAMED (``WireDead``),
+   never hangs and never leaks; the router reaps the victim, reads
+   its WAL from the router-known path (``hello`` published it; same
+   host = shared filesystem), redelivers the unfinished requests to
+   the peer under ORIGINAL uids — every stream still byte-exact, and
+   the fleet ``tokens_generated`` merge dedups the replayed prefix
+   to the unique token count.
 
 Exit code 0 and one ``graftwire smoke OK`` line = the wire transport
 stack is deployable. Run: ``python benchmarks/wire_smoke.py``
@@ -219,11 +222,33 @@ def run_smoke(verbose: bool = True) -> dict:
         victim = max(replicas, key=lambda r: r.in_flight)
         assert victim.in_flight > 0
         victim_proc = by_pid[victim.engine.pid]
+        # graftlink: kill with a PIPELINED frame in flight — a step
+        # submitted but not completed. The completion handle must
+        # fail NAMED (WireDead), never hang and never leak, and the
+        # WAL must still redeliver token-exact afterwards.
+        from pytorch_multiprocessing_distributed_tpu.runtime.wire \
+            import WireDead
+        handle = victim.step_submit()
+        assert handle is not None, (
+            "pipelined submit surface missing: RemoteReplica should "
+            "default to a pipelined client")
         os.kill(victim_proc.pid, signal.SIGKILL)
         victim_proc.wait()
         out["killed"] = True
+        try:
+            victim.step_complete(handle)
+            raise AssertionError(
+                "completing a frame submitted to a SIGKILLed replica "
+                "did not fail")
+        except WireDead as e:
+            out["handle_failed_named"] = f"WireDead: {e}"[:120]
+        lane = victim._client._lanes.get("eng")
+        assert lane is None or not lane._pending, (
+            "pipelined completion handle leaked past the kill")
         note(f"kill: SIGKILLed replica {victim.rid} "
-             f"(pid {victim_proc.pid}, {victim.in_flight} in flight)")
+             f"(pid {victim_proc.pid}, {victim.in_flight} in flight, "
+             "1 pipelined frame submitted-uncompleted -> failed "
+             "named, not leaked)")
         deadline = time.perf_counter() + 120.0
         while router.in_flight:
             assert time.perf_counter() < deadline, (

@@ -167,6 +167,15 @@ RULES: Dict[str, str] = {
              "update / torn-read class that only surfaces under "
              "load — guard every access with ONE shared lock, or "
              "confine the attribute to a single thread",
+    "GL122": "copy-on-send in a wire path (``.tobytes()``, "
+             "``b''.join(...)``, or ``bytes(buf)`` inside a scope "
+             "that also calls ``.sendall``/``.sendmsg``): the frame "
+             "was about to be handed to the kernel, and this call "
+             "duplicated the payload in Python first — at KV-block "
+             "size that is a second multi-MB copy per RPC on the "
+             "PageTransfer hot path (graftlink's discipline: the "
+             "header prefix plus raw numpy memoryview segments ride "
+             "a scatter-gather sendmsg; nothing is assembled)",
 }
 
 # wrappers that COMPILE (jit family) — GL105/106/107/108 anchor on these
@@ -1555,6 +1564,82 @@ def _check_spawn_reap(file: _File, out: List[Finding]):
             "subprocess.run, which self-reaps"))
 
 
+_SEND_ATTRS = {"sendall", "sendmsg"}
+
+
+def _check_copy_on_send(file: _File, out: List[Finding]):
+    """GL122 — copy-on-send in wire paths: the throughput class
+    graftlink exists to kill. Inside any scope (function chain or
+    module top level) that also calls ``.sendall``/``.sendmsg``, an
+    assembly copy of the outgoing payload is flagged:
+
+    - ``arr.tobytes()`` — a full copy of an array that could ride as
+      a zero-copy ``memoryview`` segment of a scatter-gather send;
+    - ``b"".join(...)`` (any bytes-literal ``.join``) — frame
+      assembly by concatenation;
+    - ``bytes(buf)`` with a non-constant argument — materializing a
+      buffer that ``sendmsg`` would take as-is.
+
+    A scope with no send call is never flagged: builders like
+    ``pack_frame`` legitimately assemble (tests, faults, fallbacks
+    consume the assembled representation); the copy only costs when
+    it sits on the send path itself.
+    """
+    send_fns: Set[int] = set()
+    module_send = [False]
+    copies: List[Tuple[ast.Call, Tuple[int, ...], str]] = []
+
+    def _classify(call: ast.Call, fns: Tuple[int, ...]) -> None:
+        func = call.func
+        if isinstance(func, ast.Attribute):
+            if func.attr in _SEND_ATTRS:
+                send_fns.update(fns)
+                if not fns:
+                    module_send[0] = True
+                return
+            if func.attr == "tobytes" and not call.args:
+                copies.append((call, fns,
+                               ".tobytes() copies the whole array"))
+                return
+            if (func.attr == "join"
+                    and isinstance(func.value, ast.Constant)
+                    and isinstance(func.value.value,
+                                   (bytes, bytearray))):
+                copies.append((call, fns,
+                               "b''.join assembles the frame by "
+                               "concatenation"))
+                return
+        elif (isinstance(func, ast.Name) and func.id == "bytes"
+                and len(call.args) == 1 and not call.keywords
+                and not isinstance(call.args[0], ast.Constant)):
+            copies.append((call, fns,
+                           "bytes(...) materializes the buffer"))
+
+    def _visit(node: ast.AST, fns: Tuple[int, ...]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fns = fns + (id(node),)
+        if isinstance(node, ast.Call):
+            _classify(node, fns)
+        for child in ast.iter_child_nodes(node):
+            _visit(child, fns)
+
+    _visit(file.tree, ())
+    for call, fns, label in copies:
+        on_send_path = (any(f in send_fns for f in fns)
+                        or (not fns and module_send[0]))
+        if not on_send_path:
+            continue
+        out.append(Finding(
+            file.path, call.lineno, call.col_offset, "GL122",
+            f"copy-on-send in a wire path ({label}) in a scope that "
+            "also sends — the payload is duplicated in Python right "
+            "before the kernel takes it, a second multi-MB copy per "
+            "RPC at KV-block size; hand the header prefix plus raw "
+            "memoryview segments to a scatter-gather sendmsg "
+            "instead (the graftlink discipline: nothing on the send "
+            "path is assembled)"))
+
+
 def _check_jit_in_loop(file: _File, out: List[Finding]):
     """GL105: jax.jit(...) lexically inside a for/while body."""
     loops: List[ast.AST] = [n for n in ast.walk(file.tree)
@@ -1688,6 +1773,7 @@ def analyze_files(paths: Sequence[str],
         _check_signal_discard(f, findings)
         _check_blocking_socket(f, findings)
         _check_spawn_reap(f, findings)
+        _check_copy_on_send(f, findings)
         _check_unsynced_timing(f, findings)
         for fn in f.funcs:
             if fn.jit_scoped:

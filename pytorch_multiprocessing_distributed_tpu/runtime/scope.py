@@ -33,6 +33,12 @@ Pieces:
   (Chrome-trace "X" complete event, duration measured here on the
   host); ``emit_span`` records a span RETROACTIVELY from a duration
   the caller already measured (the trainer's data-wait meter).
+  Attribution convention for transports (graftlink): ``wire.rpc``
+  spans carry the stream id (``sid``), lane name, and the lane's
+  queue depth at submit, and the router's ``route.splice`` instants
+  carry per-transfer ``handoff_s``/``resident``/``nbytes`` — a slow
+  disaggregated handoff is attributable to queueing vs transfer from
+  the trace alone.
 - Exporters: :func:`to_chrome_trace` / :func:`write_chrome_trace`
   (Perfetto/``chrome://tracing``-loadable JSON, sits next to the XLA
   trace from ``utils.profiler.trace``), :func:`write_jsonl` /

@@ -21,6 +21,27 @@ the framing:
   (arbitrary code execution on connect — a wire format, like a WAL,
   must be data).
 
+- **Zero-copy (graftlink).** :func:`send_frame` writes the header
+  prefix and the raw numpy segments with scatter-gather
+  ``socket.sendmsg`` — no assembled-frame concatenation copy on the
+  dominant KV-block payload (GL122 lints the copy-on-send shapes
+  statically). :func:`recv_frame` reads payload segments with
+  ``recv_into`` straight into preallocated buffers — optionally from a
+  :class:`BufferPool` keyed by (shape, dtype), so the PageTransfer hot
+  path stops paying an allocation per segment.
+
+- **Pipelining (graftlink).** Frames carry a client-chosen stream id
+  (``"_sid"``, echoed on the response). A pipelined
+  :class:`WireClient` exposes :meth:`WireClient.call_async` — submit
+  frame N+1 while the peer is still processing frame N — returning a
+  :class:`Completion` handle, and splits verbs across per-connection
+  LANES ("obs" for snapshot/health/metrics probes, "eng" for engine
+  verbs) so a long ``step``/``admit_prefilled`` no longer
+  head-of-line blocks a snapshot scrape. The server keeps
+  handler-level serialization per lane — the wire adds transport
+  concurrency only, never engine concurrency the in-process seam
+  never had.
+
 - **Deadlines.** Every socket this module touches has a timeout
   (:func:`_ensure_timeout` arms a default on sockets the caller left
   unbounded — the same guarantee GL117 lints for statically), and
@@ -44,11 +65,15 @@ the framing:
   fire at the syscall boundaries (send faults can CORRUPT the frame —
   the receiver detects it via the magic/JSON sanity checks and drops
   the connection, exercising the reconnect path). Each site has a
-  matrix scenario in ``tests/test_graftfault.py``.
+  matrix scenario in ``tests/test_graftfault.py``. With a fault plan
+  armed, :func:`send_frame` falls back to the assembled-frame path so
+  corrupt faults keep their flip-one-byte-of-the-whole-frame
+  semantics.
 
 - **Observability.** Each logical call runs under a ``wire.rpc``
   graftscope span carrying verb + static byte counts (header-declared
-  sizes — never a device read), and the module-level
+  sizes — never a device read) plus, on graftlink, the stream id and
+  the lane queue depth at submit; the module-level
   ``wire_bytes_sent`` / ``wire_bytes_recv`` / ``wire_rpcs`` meter
   (:func:`wire_meter`) gives benches and CLIs the transport totals.
 
@@ -63,19 +88,23 @@ import socket
 import struct
 import threading
 import time
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+import weakref
+from collections import deque
+from typing import (Callable, Deque, Dict, List, Optional, Sequence,
+                    Tuple)
 
 import numpy as np
 
 from . import scope as graftscope
-from .faults import (FaultTimeout, GraftFaultError, maybe_fault,
-                     register_site, retry_with_backoff,
+from .faults import (FaultTimeout, GraftFaultError, active_plan,
+                     maybe_fault, register_site, retry_with_backoff,
                      run_with_timeout)
 
 __all__ = [
     "WireError", "WireDead", "pack_frame", "send_frame", "recv_frame",
-    "WireClient", "WireServer", "wire_meter", "reset_wire_meter",
-    "DEFAULT_IO_TIMEOUT_S",
+    "BufferPool", "Completion", "WireClient", "WireServer",
+    "wire_meter", "reset_wire_meter", "DEFAULT_IO_TIMEOUT_S",
+    "OBS_VERBS",
 ]
 
 MAGIC = b"GWR1"
@@ -84,6 +113,15 @@ _HEAD = struct.Struct(">I")
 # desynced or corrupted stream, not a legitimate frame
 _HEADER_MAX = 16 * 1024 * 1024
 DEFAULT_IO_TIMEOUT_S = 30.0
+# scatter-gather send is POSIX; the assembled-frame path stays as the
+# portable fallback (and as the fault-injection path — see send_frame)
+_HAS_SENDMSG = hasattr(socket.socket, "sendmsg")
+
+# observation-plane verbs ride their own client lane so a long engine
+# verb (step/admit_prefilled) cannot head-of-line block a health probe
+# or a metrics scrape; every other verb shares the "eng" lane
+OBS_VERBS = frozenset({"hello", "ping", "snapshot", "health",
+                       "metrics"})
 
 _SITE_CONNECT = register_site(
     "wire.connect",
@@ -102,7 +140,8 @@ _SITE_RECV = register_site(
 
 class WireError(GraftFaultError):
     """The byte stream is not a valid graftwire frame (bad magic,
-    oversized or unparseable header, truncated payload): the
+    oversized or unparseable header, truncated payload, a response
+    stream id that does not match the oldest in-flight request): the
     connection is desynced or corrupted and is dropped — framing
     errors are never silently resynced."""
 
@@ -166,70 +205,132 @@ def _dtype_from_name(name: str) -> np.dtype:
         return np.dtype(getattr(ml_dtypes, name))
 
 
-def pack_frame(header: Dict, arrays: Sequence[np.ndarray] = ()) -> bytes:
-    """Serialize one frame: JSON header (its ``"_arrays"`` field is
-    overwritten with the payload segment descriptors) + raw array
-    bytes. Arrays are sent at their C-contiguous numpy layout."""
-    bufs: List[bytes] = []
+def _segments(arrays: Sequence[np.ndarray]
+              ) -> Tuple[List[Dict], List[memoryview]]:
+    """Payload descriptors + zero-copy byte views, one per array.
+    The uint8 flat view works for extension dtypes (bfloat16) where
+    ``memoryview(arr)`` itself would choke on the format code."""
     descs: List[Dict] = []
+    segs: List[memoryview] = []
     for arr in arrays:
         arr = np.ascontiguousarray(arr)
-        data = arr.tobytes()
         descs.append({"shape": list(arr.shape),
                       "dtype": _dtype_name(arr.dtype),
-                      "nbytes": len(data)})
-        bufs.append(data)
+                      "nbytes": int(arr.nbytes)})
+        segs.append(memoryview(arr.reshape(-1).view(np.uint8)))
+    return descs, segs
+
+
+def _frame_prefix(header: Dict, descs: Sequence[Dict]) -> bytes:
     head = dict(header)
     if descs:
-        head["_arrays"] = descs
+        head["_arrays"] = list(descs)
     payload = json.dumps(head, sort_keys=True).encode("utf-8")
     if len(payload) > _HEADER_MAX:
         raise WireError(
             f"frame header is {len(payload)} bytes (> "
             f"{_HEADER_MAX}); bulk data belongs in payload segments, "
             "not the JSON header")
-    return b"".join([MAGIC, _HEAD.pack(len(payload)), payload] + bufs)
+    return b"".join([MAGIC, _HEAD.pack(len(payload)), payload])
+
+
+def pack_frame(header: Dict, arrays: Sequence[np.ndarray] = ()) -> bytes:
+    """Serialize one frame to a single contiguous bytestring: JSON
+    header (its ``"_arrays"`` field is overwritten with the payload
+    segment descriptors) + raw array bytes at their C-contiguous numpy
+    layout. This is the ASSEMBLED representation — send paths use
+    scatter-gather :func:`send_frame` instead and only fall back here
+    (fault injection, no ``sendmsg``); tests and corrupt-fault plans
+    want the whole frame as one buffer."""
+    descs, segs = _segments(arrays)
+    prefix = _frame_prefix(header, descs)
+    return b"".join([prefix, *(bytes(seg) for seg in segs)])
+
+
+def _sendmsg_all(sock: socket.socket,
+                 bufs: List[memoryview]) -> None:
+    """Scatter-gather sendall: advance the buffer list past partial
+    writes until every segment is on the wire — no concatenation
+    copy of header + payload segments."""
+    _ensure_timeout(sock)
+    while bufs:
+        sent = sock.sendmsg(bufs)
+        if sent <= 0:
+            raise ConnectionError("peer closed mid-frame (sendmsg)")
+        while bufs and sent >= len(bufs[0]):
+            sent -= len(bufs[0])
+            bufs.pop(0)
+        if sent:
+            bufs[0] = bufs[0][sent:]
 
 
 def send_frame(sock: socket.socket, header: Dict,
                arrays: Sequence[np.ndarray] = ()) -> int:
-    """Frame and send; returns bytes written. The ``wire.send`` fault
-    site fires on the assembled frame (corrupt faults flip one byte —
-    the receiver's sanity checks catch it)."""
-    frame = pack_frame(header, arrays)
-    frame = maybe_fault(_SITE_SEND, frame)
+    """Frame and send; returns bytes written.
+
+    Fast path: scatter-gather ``sendmsg`` of the header prefix plus
+    raw numpy segment views — zero payload copies. With a fault plan
+    armed (or no ``sendmsg`` on this platform) the frame is assembled
+    via :func:`pack_frame` so the ``wire.send`` fault site keeps its
+    contract: corrupt faults flip one byte of the WHOLE assembled
+    frame and the receiver's sanity checks catch it."""
+    descs, segs = _segments(arrays)
+    prefix = _frame_prefix(header, descs)
     _ensure_timeout(sock)
-    sock.sendall(frame)
-    _note_bytes(sent=len(frame))
-    return len(frame)
+    # per-socket capability check: test fakes and socket wrappers may
+    # not implement sendmsg even where the platform socket does
+    if (active_plan() is not None or not _HAS_SENDMSG
+            or getattr(sock, "sendmsg", None) is None):
+        frame = pack_frame(header, arrays)
+        frame = maybe_fault(_SITE_SEND, frame)
+        sock.sendall(frame)
+        _note_bytes(sent=len(frame))
+        return len(frame)
+    total = len(prefix) + sum(len(seg) for seg in segs)
+    _sendmsg_all(sock, [memoryview(prefix), *segs])
+    _note_bytes(sent=total)
+    return total
+
+
+def _recv_exact_into(sock: socket.socket, view: memoryview) -> None:
+    """Fill ``view`` completely from the socket (``recv_into`` — no
+    chunk-list join copy)."""
+    _ensure_timeout(sock)
+    n = len(view)
+    got = 0
+    while got < n:
+        k = sock.recv_into(view[got:])
+        if not k:
+            raise ConnectionError(
+                f"peer closed mid-frame ({got}/{n} bytes)")
+        got += k
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    _ensure_timeout(sock)
-    chunks: List[bytes] = []
-    got = 0
-    while got < n:
-        chunk = sock.recv(n - got)
-        if not chunk:
-            raise ConnectionError(
-                f"peer closed mid-frame ({got}/{n} bytes)")
-        chunks.append(chunk)
-        got += len(chunk)
-    return b"".join(chunks)
+    buf = bytearray(n)
+    _recv_exact_into(sock, memoryview(buf))
+    return bytes(buf)
 
 
-def recv_frame(sock: socket.socket, *, idle_ok: bool = False
+def recv_frame(sock: socket.socket, *, idle_ok: bool = False,
+               pool: Optional["BufferPool"] = None
                ) -> Optional[Tuple[Dict, List[np.ndarray]]]:
     """Receive one frame: ``(header, arrays)``.
 
-    ``idle_ok=True`` (server accept loops): a timeout BEFORE any byte
-    arrives returns None (an idle poll, not an error) and a clean EOF
-    before any byte raises ``ConnectionResetError`` (peer hung up
-    between frames — the loop's break signal). A timeout or EOF
-    MID-frame is always an error: the stream is desynced and the
-    connection must drop. The ``wire.recv`` fault site fires only once
-    a frame has begun arriving, so idle polls never consume
-    fault-plan hits."""
+    ``idle_ok=True`` (server accept loops, lane receivers): a timeout
+    BEFORE any byte arrives returns None (an idle poll, not an error)
+    and a clean EOF before any byte raises ``ConnectionResetError``
+    (peer hung up between frames — the loop's break signal). A timeout
+    or EOF MID-frame is always an error: the stream is desynced and
+    the connection must drop. The ``wire.recv`` fault site fires only
+    once a frame has begun arriving, so idle polls never consume
+    fault-plan hits.
+
+    ``pool``: payload segments land in buffers loaned from a
+    :class:`BufferPool` (keyed by shape+dtype) instead of fresh
+    ``np.empty`` allocations — the PageTransfer hot path hands the
+    same block shapes back every transfer. Either way segments are
+    read with ``recv_into`` directly into the destination buffer."""
     _ensure_timeout(sock)
     try:
         first = sock.recv(1)
@@ -277,17 +378,291 @@ def recv_frame(sock: socket.socket, *, idle_ok: bool = False
                 f"payload descriptor claims {nbytes} bytes for "
                 f"shape {shape} {dtype.name} ({want} bytes); "
                 "desynced or corrupted stream")
-        data = _recv_exact(sock, nbytes)
+        arr = (pool.take(shape, dtype) if pool is not None
+               else np.empty(shape, dtype=dtype))
+        _recv_exact_into(
+            sock, memoryview(arr.reshape(-1).view(np.uint8)))
         total += nbytes
-        arrays.append(np.frombuffer(data, dtype=dtype).reshape(shape))
+        arrays.append(arr)
     _note_bytes(recv=total)
     return header, arrays
 
 
+# ------------------------------------------------------------ buffer pool
+
+class BufferPool:
+    """Reusable receive buffers keyed by (shape, dtype) — the
+    PageTransfer hot path receives the same block shapes every
+    transfer, so ``recv_into`` can land in a recycled buffer instead
+    of a fresh allocation per segment.
+
+    Safety: the pool only re-accepts arrays it LOANED (tracked by
+    object identity via weakref) — a foreign array handed to
+    :meth:`give` is a silent no-op. That makes the give-back contract
+    safe by construction against the jax-CPU zero-copy hazard: an
+    array that was aliased into a device buffer (``jnp.asarray`` on
+    CPU can alias the numpy buffer) is only ever given back by the
+    one call site that provably finished its last read (the remote
+    admit, AFTER the wire send completed) — and anything else that
+    reaches ``give`` is simply not re-pooled."""
+
+    def __init__(self, max_per_key: int = 4):
+        self._mu = threading.Lock()
+        self._max_per_key = int(max_per_key)
+        self._free: Dict[Tuple[tuple, str], List[np.ndarray]] = {}
+        self._loaned: Dict[int, weakref.ref] = {}
+        self.hits = 0
+        self.misses = 0
+
+    def _key(self, shape, dtype) -> Tuple[tuple, str]:
+        return tuple(int(d) for d in shape), np.dtype(dtype).name
+
+    def take(self, shape, dtype) -> np.ndarray:
+        """A writable C-contiguous buffer of the given shape+dtype —
+        recycled when one is free, freshly allocated otherwise."""
+        key = self._key(shape, dtype)
+        with self._mu:
+            stack = self._free.get(key)
+            if stack:
+                arr = stack.pop()
+                self.hits += 1
+            else:
+                arr = None
+                self.misses += 1
+        if arr is None:
+            arr = np.empty(key[0], dtype=np.dtype(key[1]))
+        with self._mu:
+            if len(self._loaned) > 4096:
+                self._loaned = {i: r for i, r in self._loaned.items()
+                                if r() is not None}
+            self._loaned[id(arr)] = weakref.ref(arr)
+        return arr
+
+    def give(self, arr) -> bool:
+        """Return a loaned buffer for reuse. Only arrays this pool
+        handed out are re-pooled (identity-checked); anything else —
+        including a buffer whose loan record was already consumed — is
+        a no-op returning False."""
+        if not isinstance(arr, np.ndarray):
+            return False
+        with self._mu:
+            ref = self._loaned.pop(id(arr), None)
+            if ref is None or ref() is not arr:
+                return False
+            if not arr.flags["C_CONTIGUOUS"] or arr.base is not None:
+                return False
+            stack = self._free.setdefault(
+                self._key(arr.shape, arr.dtype), [])
+            if len(stack) < self._max_per_key:
+                stack.append(arr)
+                return True
+        return False
+
+    def stats(self) -> Dict[str, int]:
+        with self._mu:
+            free = sum(len(v) for v in self._free.values())
+            return {"hits": self.hits, "misses": self.misses,
+                    "free": free, "loaned": len(self._loaned)}
+
+
 # ---------------------------------------------------------------- client
 
+class Completion:
+    """A pipelined RPC in flight: the handle :meth:`WireClient.
+    call_async` returns. ``result(timeout)`` blocks for the response
+    (raising the transport/framing error that poisoned the lane, or
+    ``FaultTimeout`` on expiry); :meth:`WireClient.complete` wraps it
+    with the full blocking-call error contract (WireDead conversion,
+    span, per-RPC timing)."""
+
+    __slots__ = ("verb", "sid", "nbytes_out", "_lane", "_qd", "_ev",
+                 "_resp", "_arrays", "_err", "_t0")
+
+    def __init__(self, verb: str, sid: int, lane: "_Lane",
+                 nbytes_out: int):
+        self.verb = verb
+        self.sid = sid
+        self.nbytes_out = nbytes_out
+        self._lane = lane
+        self._qd = 0
+        self._ev = threading.Event()
+        self._resp: Optional[Dict] = None
+        self._arrays: Optional[List[np.ndarray]] = None
+        self._err: Optional[BaseException] = None
+        self._t0 = time.perf_counter()
+
+    @property
+    def qd(self) -> int:
+        """Lane queue depth at submit (frames already in flight)."""
+        return self._qd
+
+    def done(self) -> bool:
+        return self._ev.is_set()
+
+    def _complete(self, resp: Dict, arrays: List[np.ndarray]) -> None:
+        self._resp, self._arrays = resp, arrays
+        self._ev.set()
+
+    def _fail(self, err: BaseException) -> None:
+        if not self._ev.is_set():
+            self._err = err
+            self._ev.set()
+
+    def result(self, timeout: Optional[float] = None
+               ) -> Tuple[Dict, List[np.ndarray]]:
+        if not self._ev.wait(timeout):
+            raise FaultTimeout(
+                f"wire.rpc {self.verb!r} (sid {self.sid}) completion "
+                f"did not arrive within {timeout}s — the replica "
+                "server is wedged or the network path is gone; the "
+                "caller treats this replica as lost")
+        if self._err is not None:
+            raise self._err
+        assert self._resp is not None
+        return self._resp, self._arrays or []
+
+
+class _Lane:
+    """One multiplexed connection of a pipelined :class:`WireClient`.
+
+    ``submit`` appends a :class:`Completion` to the FIFO and sends the
+    frame without waiting; a daemon receiver thread matches responses
+    to completions by echoed stream id IN ORDER (the server answers
+    each connection's frames sequentially, so FIFO + sid equality is
+    the full check). Any transport or framing failure — including a
+    response sid that is not the oldest in-flight sid — poisons the
+    WHOLE lane: every pending completion fails NAMED and the socket
+    drops. A half-read stream is never resynced, and a completion
+    handle is never leaked silently."""
+
+    def __init__(self, client: "WireClient", name: str):
+        self._client = client
+        self.name = name
+        self._mu = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+        self._gen = 0  # bumps on every poison: stale receivers exit
+        self._pending: Deque[Completion] = deque()
+
+    def depth(self) -> int:
+        with self._mu:
+            return len(self._pending)
+
+    # ---- submit side ----------------------------------------------
+    def submit(self, header: Dict, arrays: Sequence[np.ndarray],
+               comp: Completion) -> None:
+        failed: Sequence[Completion] = ()
+        err: Optional[BaseException] = None
+        with self._mu:
+            comp._qd = len(self._pending)
+            self._pending.append(comp)
+            try:
+                if self._sock is None:
+                    # connecting is always safe to retry: nothing has
+                    # been sent on this lane's new stream yet
+                    self._sock = retry_with_backoff(
+                        self._client._connect,
+                        attempts=self._client._retries,
+                        base_delay_s=self._client._backoff_s,
+                        sleep=self._client._sleep)
+                    t = threading.Thread(  # graftlint: disable=GL120 Thread() only SPAWNS the receiver; its blocking recv runs on that thread, never under this lock
+                        target=self._recv_loop,
+                        args=(self._sock, self._gen), daemon=True,
+                        name=f"pmdt-wire-lane-{self.name}")
+                    t.start()
+                send_frame(self._sock, header, arrays)  # graftlint: disable=GL120 the lane lock IS the frame serializer: interleaved submits would corrupt the stream for every pending call
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except BaseException as e:
+                err = e
+                failed = self._poison_locked()
+        for c in failed:
+            c._fail(err)
+
+    def _poison_locked(self) -> Sequence[Completion]:
+        # every caller holds self._mu (the _locked suffix contract);
+        # the analyzer cannot see a caller's lock through the call
+        pending, self._pending = self._pending, deque()  # graftlint: disable=GL121 caller holds self._mu (_locked contract)
+        sock, self._sock = self._sock, None  # graftlint: disable=GL121 caller holds self._mu (_locked contract)
+        self._gen += 1  # graftlint: disable=GL121 caller holds self._mu (_locked contract)
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        return pending
+
+    # ---- receive side ---------------------------------------------
+    def _recv_loop(self, sock: socket.socket, gen: int) -> None:
+        pool = self._client.recv_pool
+        while True:
+            try:
+                got = recv_frame(sock, idle_ok=True, pool=pool)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except BaseException as e:
+                self._poison(sock, gen, e)
+                return
+            if got is None:  # idle poll
+                with self._mu:
+                    if self._gen != gen or self._sock is not sock:
+                        return  # superseded; a poison swept pending
+                continue
+            header, arrays = got
+            sid = header.pop("_sid", None)
+            comp: Optional[Completion] = None
+            failed: Sequence[Completion] = ()
+            err: Optional[BaseException] = None
+            with self._mu:
+                if self._gen != gen or self._sock is not sock:
+                    return
+                if self._pending and sid == self._pending[0].sid:
+                    comp = self._pending.popleft()
+                else:
+                    want = (self._pending[0].sid if self._pending
+                            else None)
+                    err = WireError(
+                        f"stale stream id {sid!r} on lane "
+                        f"{self.name!r} (oldest in-flight: {want!r}); "
+                        "desynced stream — dropping the connection")
+                    failed = self._poison_locked()
+            if comp is None:
+                for c in failed:
+                    c._fail(err)
+                return
+            _note_bytes(rpcs=1)
+            comp._complete(header, arrays)
+
+    def _poison(self, sock: socket.socket, gen: int,
+                err: BaseException) -> None:
+        with self._mu:
+            if self._gen != gen or self._sock is not sock:
+                # a newer stream owns the lane; just drop OUR socket
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                return
+            failed = self._poison_locked()
+        for c in failed:
+            c._fail(err)
+
+    # ---- lifecycle ------------------------------------------------
+    def drop(self, err: Optional[BaseException] = None) -> None:
+        """Kill the lane NOW: close the socket, fail every pending
+        completion named. The recovery for any state where the stream
+        position is unknown (an abandoned deadline, client close)."""
+        if err is None:
+            err = WireError(
+                f"lane {self.name!r} dropped with responses "
+                "outstanding; stream position unknown")
+        with self._mu:
+            failed = self._poison_locked()
+        for c in failed:
+            c._fail(err)
+
+
 class WireClient:
-    """One connection to a :class:`WireServer`, speaking
+    """One client endpoint of a :class:`WireServer`, speaking
     request/response frames.
 
     Args:
@@ -300,12 +675,19 @@ class WireClient:
         IDEMPOTENT verbs (transport failures only; typed application
         errors never retry).
       idempotent: the verb set eligible for transport retries.
+      pipelined: graftlink mode — per-verb-class lanes ("obs"/"eng"),
+        stream-id-tagged frames, :meth:`call_async` available, and
+        :meth:`call` overlaps submission with the peer's processing
+        of earlier frames. Default False: one blocking in-flight
+        call at a time, byte-compatible with the pipelined mode.
+      recv_pool: optional :class:`BufferPool` response payload
+        segments land in (the PageTransfer hot path).
 
-    Connection is LAZY (first call connects), one in-flight call at a
-    time (the router drives replicas sequentially; a lock makes
-    cross-thread misuse safe rather than silently interleaving
-    frames). Every per-call duration lands in ``rpc_s`` (bounded) —
-    the bench's per-RPC overhead sample set."""
+    Connection is LAZY (first call connects). In blocking mode one
+    in-flight call at a time (the router drives replicas
+    sequentially; a lock makes cross-thread misuse safe rather than
+    silently interleaving frames). Every per-call duration lands in
+    ``rpc_s`` (bounded) — the bench's per-RPC overhead sample set."""
 
     IDEMPOTENT = frozenset({
         "hello", "ping", "snapshot", "health", "metrics",
@@ -318,6 +700,8 @@ class WireClient:
                  call_deadline_s: Optional[float] = 60.0,
                  retries: int = 3, backoff_s: float = 0.05,
                  idempotent: Optional[frozenset] = None,
+                 pipelined: bool = False,
+                 recv_pool: Optional[BufferPool] = None,
                  sleep: Callable[[float], None] = time.sleep):
         host, _, port = address.rpartition(":")
         if not host or not port.isdigit():
@@ -332,8 +716,15 @@ class WireClient:
         self._sleep = sleep
         self._idempotent = (self.IDEMPOTENT if idempotent is None
                             else idempotent)
+        self.pipelined = bool(pipelined)
+        self.recv_pool = recv_pool
         self._sock: Optional[socket.socket] = None
-        self._mu = threading.Lock()
+        self._mu = threading.Lock()  # blocking-exchange lock
+        self._lanes: Dict[str, _Lane] = {}
+        self._lanes_mu = threading.Lock()
+        self._sid = 0
+        self._sid_mu = threading.Lock()
+        self._stats_mu = threading.Lock()
         self.rpc_s: List[float] = []  # per-call wall seconds (bounded)
 
     # ---- connection lifecycle -----------------------------------------
@@ -373,8 +764,32 @@ class WireClient:
     def close(self) -> None:
         with self._mu:
             self._drop()
+        with self._lanes_mu:
+            lanes = list(self._lanes.values())
+            self._lanes.clear()
+        for lane in lanes:
+            lane.drop(WireError("client closed"))
 
-    # ---- the call -----------------------------------------------------
+    # ---- stream ids / lanes -------------------------------------------
+    def _new_sid(self) -> int:
+        with self._sid_mu:
+            self._sid += 1
+            return self._sid
+
+    def _lane_for(self, verb: str) -> _Lane:
+        name = "obs" if verb in OBS_VERBS else "eng"
+        with self._lanes_mu:
+            lane = self._lanes.get(name)
+            if lane is None:
+                lane = self._lanes[name] = _Lane(self, name)
+            return lane
+
+    def _record_rpc(self, t0: float) -> None:
+        with self._stats_mu:
+            if len(self.rpc_s) < 200_000:
+                self.rpc_s.append(time.perf_counter() - t0)
+
+    # ---- the blocking call --------------------------------------------
     def _exchange(self, header: Dict, arrays: Sequence[np.ndarray],
                   io_timeout_s: Optional[float]
                   ) -> Tuple[Dict, List[np.ndarray]]:
@@ -383,7 +798,15 @@ class WireClient:
             sock.settimeout(io_timeout_s)
         try:
             send_frame(sock, header, arrays)
-            got = recv_frame(sock)
+            got = recv_frame(sock, pool=self.recv_pool)
+            assert got is not None  # idle_ok=False never returns None
+            rsid = got[0].pop("_sid", None)
+            want = header.get("_sid")
+            if rsid is not None and rsid != want:
+                raise WireError(
+                    f"stale stream id {rsid!r} (expected {want!r}) on "
+                    "a blocking exchange; desynced stream — dropping "
+                    "the connection")
         except BaseException:
             # mid-exchange failure leaves the stream position unknown:
             # this socket can never be trusted with another frame
@@ -394,7 +817,6 @@ class WireClient:
         finally:
             if io_timeout_s is not None and self._sock is not None:
                 self._sock.settimeout(self.io_timeout_s)
-        assert got is not None  # idle_ok=False never returns None
         return got
 
     def call(self, verb: str, *, arrays: Sequence[np.ndarray] = (),
@@ -409,10 +831,17 @@ class WireClient:
         :class:`WireDead` after the idempotent-verb retry policy has
         run its course. ``deadline_s=-1`` means "use the client
         default"; ``None`` disables the whole-call watchdog (socket
-        timeouts still bound every individual op)."""
+        timeouts still bound every individual op).
+
+        On a pipelined client this is ``call_async`` + ``complete``
+        under one span — the same error contract, but other threads'
+        submissions on the same lane overlap with the wait."""
         if deadline_s == -1.0:
             deadline_s = self.call_deadline_s
-        header = {"verb": verb}
+        if self.pipelined:
+            return self._call_pipelined(verb, arrays, deadline_s,
+                                        fields)
+        header = {"verb": verb, "_sid": self._new_sid()}
         header.update(fields)
         nbytes_out = sum(int(np.asarray(a).nbytes) for a in arrays)
 
@@ -436,6 +865,7 @@ class WireClient:
         t0 = time.perf_counter()
         with self._mu, graftscope.span(
                 "wire.rpc", cat="wire", verb=verb,
+                sid=header["_sid"], qd=0,
                 nbytes_out=nbytes_out) as sp:
             try:
                 # WireError counts as a transport failure here: a
@@ -458,19 +888,120 @@ class WireClient:
                 else:
                     resp, arrs = once()  # graftlint: disable=GL120 single-in-flight RPC: the lock IS the frame serializer
             except (OSError, FaultTimeout, WireError) as e:
-                raise WireDead(
-                    f"wire: {verb!r} to {self.address} failed "
-                    f"({type(e).__name__}: {e}) — treating the "
-                    "replica as lost"
-                    + ("" if verb in self._idempotent else
-                       "; the verb is not idempotent, so the failure "
-                       "is commit-ambiguous and redelivery (not a "
-                       "retry) is the exactly-once recovery")) from e
+                raise self._dead(verb, e) from e
             nbytes_in = sum(int(a.nbytes) for a in arrs)
             sp.note(nbytes_in=nbytes_in)
         _note_bytes(rpcs=1)
-        if len(self.rpc_s) < 200_000:
-            self.rpc_s.append(time.perf_counter() - t0)
+        self._record_rpc(t0)
+        return resp, arrs
+
+    def _dead(self, verb: str, e: BaseException) -> WireDead:
+        return WireDead(
+            f"wire: {verb!r} to {self.address} failed "
+            f"({type(e).__name__}: {e}) — treating the "
+            "replica as lost"
+            + ("" if verb in self._idempotent else
+               "; the verb is not idempotent, so the failure "
+               "is commit-ambiguous and redelivery (not a "
+               "retry) is the exactly-once recovery"))
+
+    # ---- the pipelined call -------------------------------------------
+    def call_async(self, verb: str, *,
+                   arrays: Sequence[np.ndarray] = (),
+                   **fields) -> Completion:
+        """Submit one RPC without waiting: the frame goes out on the
+        verb's lane NOW (while the peer may still be processing
+        earlier frames) and the returned :class:`Completion` resolves
+        when the response arrives. Finish it with
+        :meth:`complete` (full error contract) or ``result()`` (raw).
+        A submit-side failure comes back as an already-failed handle,
+        never an exception here — the completion IS the result
+        channel."""
+        if not self.pipelined:
+            raise ValueError(
+                "call_async requires a pipelined WireClient "
+                "(pipelined=True)")
+        sid = self._new_sid()
+        header = {"verb": verb, "_sid": sid}
+        header.update(fields)
+        nbytes_out = sum(int(np.asarray(a).nbytes) for a in arrays)
+        lane = self._lane_for(verb)
+        comp = Completion(verb, sid, lane, nbytes_out)
+        lane.submit(header, arrays, comp)
+        graftscope.emit("wire.submit", cat="wire", verb=verb,
+                        sid=sid, qd=comp.qd, lane=lane.name,
+                        nbytes_out=nbytes_out)
+        return comp
+
+    def _finish(self, comp: Completion,
+                deadline_s: Optional[float]
+                ) -> Tuple[Dict, List[np.ndarray]]:
+        try:
+            resp, arrs = comp.result(deadline_s)
+        except FaultTimeout:
+            # responses behind this one are undeliverable in order;
+            # the lane's stream position is unknown — kill it (every
+            # other pending completion fails NAMED, not leaked)
+            comp._lane.drop(WireError(
+                f"deadline abandoned lane {comp._lane.name!r} "
+                f"mid-stream (sid {comp.sid} never completed); "
+                "dropping the connection"))
+            raise
+        return resp, arrs
+
+    def complete(self, comp: Completion, *,
+                 deadline_s: Optional[float] = -1.0
+                 ) -> Tuple[Dict, List[np.ndarray]]:
+        """Wait for a :meth:`call_async` handle with the blocking-call
+        error contract: transport/framing failures and deadline expiry
+        convert to :class:`WireDead` (no resubmission — a consumed
+        submission is commit-ambiguous by definition), and the RPC's
+        wall time (submit → complete) lands in ``rpc_s``."""
+        if deadline_s == -1.0:
+            deadline_s = self.call_deadline_s
+        with graftscope.span(
+                "wire.rpc", cat="wire", verb=comp.verb, sid=comp.sid,
+                qd=comp.qd, lane=comp._lane.name,
+                nbytes_out=comp.nbytes_out) as sp:
+            try:
+                resp, arrs = self._finish(comp, deadline_s)
+            except (OSError, FaultTimeout, WireError) as e:
+                raise self._dead(comp.verb, e) from e
+            sp.note(nbytes_in=sum(int(a.nbytes) for a in arrs))
+        self._record_rpc(comp._t0)
+        return resp, arrs
+
+    def _call_pipelined(self, verb: str,
+                        arrays: Sequence[np.ndarray],
+                        deadline_s: Optional[float],
+                        fields: Dict
+                        ) -> Tuple[Dict, List[np.ndarray]]:
+        t0 = time.perf_counter()
+
+        def once() -> Tuple[Dict, List[np.ndarray]]:
+            comp = self.call_async(verb, arrays=arrays, **fields)
+            sp.note(sid=comp.sid, qd=comp.qd)
+            return self._finish(comp, deadline_s)
+
+        with graftscope.span(
+                "wire.rpc", cat="wire", verb=verb,
+                nbytes_out=sum(int(np.asarray(a).nbytes)
+                               for a in arrays)) as sp:
+            try:
+                if verb in self._idempotent:
+                    # a fresh submit per attempt: the failed lane was
+                    # poisoned, so the retry reconnects from scratch
+                    resp, arrs = retry_with_backoff(  # graftlint: disable=GL120 completion wait, not socket I/O: the lane serializes frames internally
+                        once, attempts=self._retries,
+                        base_delay_s=self._backoff_s,
+                        retry_on=(OSError, FaultTimeout, WireError),
+                        sleep=self._sleep)
+                else:
+                    resp, arrs = once()
+            except (OSError, FaultTimeout, WireError) as e:
+                raise self._dead(verb, e) from e
+            sp.note(nbytes_in=sum(int(a.nbytes) for a in arrs))
+        self._record_rpc(t0)
         return resp, arrs
 
 
@@ -486,21 +1017,39 @@ class WireServer:
     arrays)``. Handler exceptions become typed ``ok=False`` responses
     (``etype`` + ``msg``) — the client side rehydrates them; the
     connection survives application errors and drops only on framing/
-    transport errors. ``decorate(resp)`` (optional) runs under the
-    handler lock on every response — the replica server uses it to
-    piggyback a live stats/health snapshot so the remote handle's
-    mirror refreshes with every exchange, at zero extra RPCs."""
+    transport errors. ``decorate(resp, verb)`` (optional) runs under
+    the handler's lock on every response — the replica server uses it
+    to piggyback a live stats/health snapshot so the remote handle's
+    mirror refreshes with every exchange, at zero extra RPCs.
+
+    ``lanes`` maps verb -> named lane: verbs sharing a lane serialize
+    against each other under that lane's lock INSTEAD of the default
+    handler lock, so e.g. snapshot/health/metrics probes answer while
+    a long engine verb holds the main lock. Only safe for handlers
+    that never touch the engine (the replica server serves those
+    verbs from a stats cache) — the default lock stays the engine's
+    serializer.
+
+    Request frames carry a client stream id (``"_sid"``) which is
+    echoed on the response — the pipelined client's completion
+    matching. Responses per connection go out in request order (each
+    connection is served by one sequential loop), so FIFO matching is
+    exact."""
 
     def __init__(self, handlers: Dict[str, Callable], *,
                  host: str = "127.0.0.1", port: int = 0,
                  accept_timeout_s: float = 0.2,
                  io_timeout_s: float = DEFAULT_IO_TIMEOUT_S,
-                 decorate: Optional[Callable[[Dict], None]] = None,
+                 decorate: Optional[Callable[[Dict, str], None]] = None,
+                 lanes: Optional[Dict[str, str]] = None,
                  name: str = "wire"):
         self._handlers = dict(handlers)
         self._decorate = decorate
         self._io_timeout_s = float(io_timeout_s)
         self._mu = threading.Lock()       # serializes verb handlers
+        self._verb_lane = dict(lanes or {})
+        self._lane_mu = {lane: threading.Lock()
+                         for lane in set(self._verb_lane.values())}
         # the connection LIST has its own lock: kill_connections()
         # must abort sockets NOW even while a long handler (a drain)
         # holds the handler lock — process death does not queue
@@ -615,9 +1164,14 @@ class WireServer:
                 if conn in self._conns:
                     self._conns.remove(conn)
 
+    def _lock_for(self, verb) -> threading.Lock:
+        lane = self._verb_lane.get(verb)
+        return self._mu if lane is None else self._lane_mu[lane]
+
     def _dispatch(self, header: Dict, arrays: List[np.ndarray]
                   ) -> Tuple[Dict, Sequence[np.ndarray]]:
         verb = header.pop("verb", None)
+        sid = header.pop("_sid", None)
         handler = self._handlers.get(verb)
         resp: Dict
         resp_arrays: Sequence[np.ndarray] = ()
@@ -626,7 +1180,7 @@ class WireServer:
                     "msg": f"unknown verb {verb!r} (server speaks: "
                            f"{sorted(self._handlers)})"}
         else:
-            with self._mu:
+            with self._lock_for(verb):
                 try:
                     out = handler(header, arrays)
                     if isinstance(out, tuple):
@@ -647,11 +1201,13 @@ class WireServer:
                             "msg": str(e)}
                 if self._decorate is not None:
                     try:
-                        self._decorate(resp)
+                        self._decorate(resp, verb)
                     except (KeyboardInterrupt, SystemExit):
                         raise
                     except BaseException as e:
                         graftscope.emit("wire.serve_error", cat="wire",
                                         verb=verb, where="decorate",
                                         error=type(e).__name__)
+        if sid is not None:
+            resp["_sid"] = sid
         return resp, resp_arrays

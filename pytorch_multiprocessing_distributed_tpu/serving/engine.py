@@ -2856,6 +2856,36 @@ class ServingEngine:
         v_block, v_scale = quantize_kv_np(v_block)
         return tok0, k_block, v_block, k_scale, v_scale
 
+    def prefill_detached_resident(self, request: Request,
+                                  chunk: Optional[int] = None):
+        """graftlink's device-resident transfer export: the
+        :meth:`prefill_detached_wire` tuple shape with the blocks
+        left as DEVICE arrays — no host bounce. A same-process decode
+        engine splices them via a device-to-device put
+        (:meth:`admit_prefilled`'s ``_pref_sharded`` resharding IS the
+        transfer collective — audited under graftcheck's
+        ``serving_transfer_insert_*`` programs); a remote target's
+        proxy lacks this method, so :meth:`~.replica.ServingReplica
+        .prefill_step` automatically falls back to the host/wire path
+        (the cross-mesh/CPU fallback, byte-identical by pin).
+
+        graftquant engines quantize ON DEVICE (``_quant_pref_jit`` —
+        the same program a local splice of a model-dtype block runs),
+        so the exported int8 data + f32 scale sidecars match the host
+        ``quantize_kv_np`` twin bit-for-bit."""
+        tok0, k_pref, v_pref = self.prefill_detached(request,
+                                                     chunk=chunk)
+        if not self._kv_quant:
+            return tok0, k_pref, v_pref, None, None
+
+        def quant_once():
+            with expected_transfer("device-resident transfer "
+                                   "quantization (detached prefill)"):
+                return self._quant_pref_jit(k_pref, v_pref)
+
+        qk, qv = self._attempted(quant_once)
+        return tok0, qk.data, qv.data, qk.scale, qv.scale
+
     def admit_prefilled(self, request: Request, tok0: int, k_pref,
                         v_pref, k_scale=None, v_scale=None
                         ) -> List[Tuple[Request, int, bool]]:
@@ -3214,6 +3244,68 @@ def audit_programs():
 
         out.append({"name": "serving_decode_spec_draft_w32_h4_k4",
                     "min_devices": 1, "build": build_spec_dm})
+
+        # ---- graftlink: the transfer-splice ladder ----
+        # The device-resident PageTransfer path ends in exactly these
+        # programs: a detached prefill block (receiver-placed via
+        # jax.device_put) splices into the decode pool through
+        # ``_insert_jit`` — dense overwrite, paged receiver-chosen
+        # scatter at write_ids, and the int8 pre-quantized pair.
+        # Committing their fingerprints + costs makes the DMA path's
+        # budget auditable like every decode rung: the splice must
+        # move ZERO collective bytes (single-shard dynamic-update /
+        # page scatter — the device put IS the transfer; any
+        # collective appearing here means the splice started paying
+        # communication for what placement already did).
+        def pref_sds(eng, width):
+            pool = eng.pool
+            cache = pool.k_pages if eng._paged else pool.k_caches
+            if eng._paged:
+                # pages [L, P, H, ps, Dh] -> standalone prefill
+                # cache [L, 1, W, H, Dh] (scale [L, 1, W, H])
+                def leaf(c):
+                    return jax.ShapeDtypeStruct(
+                        (c.shape[0], 1, width, c.shape[2])
+                        + c.shape[4:], c.dtype)
+            else:
+                # cache [L, S, s_max, H, Dh] -> [L, 1, W, H, Dh]
+                def leaf(c):
+                    return jax.ShapeDtypeStruct(
+                        (c.shape[0], 1, width) + c.shape[3:],
+                        c.dtype)
+            return jax.tree.map(leaf, cache)
+
+        def insert_args(eng, width):
+            pool = eng.pool
+            scalar = jax.ShapeDtypeStruct((), jnp.int32)
+            pref = pref_sds(eng, width)
+            caches = ((jax.tree.map(sds, pool.k_pages),
+                       jax.tree.map(sds, pool.v_pages))
+                      if eng._paged else
+                      (jax.tree.map(sds, pool.k_caches),
+                       jax.tree.map(sds, pool.v_caches)))
+            mid = (sds(pool.positions), sds(pool.last_tokens),
+                   sds(pool.active), sds(pool.budgets),
+                   sds(pool.eos_ids), pref, pref)
+            if eng._paged:
+                n_w = -(-width // pool.page_size)
+                mid = mid + (jax.ShapeDtypeStruct((n_w,), jnp.int32),)
+            # slot, length, tok0, budget, eos
+            return caches + mid + (scalar,) * 5
+
+        for xname, xeng in (
+                ("serving_transfer_insert_w32", engine),
+                ("serving_transfer_insert_paged_w32", paged),
+                ("serving_transfer_insert_quant_w32",
+                 quant_ladder[0][1])):
+            def build_xfer(e=xeng):
+                return {
+                    "fn": e._insert_jit,
+                    "args": insert_args(e, 32),
+                    "expect_collectives": {},
+                }
+            out.append({"name": xname, "min_devices": 1,
+                        "build": build_xfer})
         return out
 
     return specs()

@@ -55,6 +55,7 @@ from __future__ import annotations
 
 import os
 import sys
+import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -62,7 +63,8 @@ import numpy as np
 
 from ..runtime import heal
 from ..runtime import scope as graftscope
-from ..runtime.wire import (DEFAULT_IO_TIMEOUT_S, WireClient, WireDead,
+from ..runtime.wire import (DEFAULT_IO_TIMEOUT_S, OBS_VERBS,
+                            BufferPool, WireClient, WireDead,
                             WireServer)
 from .replica import ROLES, ServingReplica
 from .scheduler import (DONE, FAILED, QUEUED, RUNNING, QueueFull,
@@ -70,6 +72,16 @@ from .scheduler import (DONE, FAILED, QUEUED, RUNNING, QueueFull,
 
 __all__ = ["ReplicaServer", "RemoteReplica", "RemoteFatalError",
            "RemoteRequestError", "fleet_from_directory"]
+
+# the PageTransfer hot path's receive buffers: every RemoteReplica
+# client in this process lands prefill blocks in recycled buffers
+# keyed by (shape, dtype). Buffers are given back ONLY after the
+# decode-side wire send completed (see _RemoteEngine.admit_prefilled)
+# — the one point where the block's last read provably happened — and
+# the pool's identity check makes any other give a no-op, so a block
+# that went to a LOCAL engine (and may be aliased into a device
+# buffer on CPU) is never recycled.
+_TRANSFER_POOL = BufferPool()
 
 
 class RemoteFatalError(WireDead):
@@ -165,6 +177,13 @@ class ReplicaServer:
         self._withdrawn: Dict[object, Request] = {}
         self._last_rpc = time.perf_counter()
         self._last_publish = time.perf_counter()
+        # graftlink: observation verbs answer on their OWN server lane
+        # from this cached stats snapshot — refreshed under the engine
+        # lock by every engine-verb response — so a snapshot/health/
+        # metrics probe never waits behind a long step and never
+        # touches the (non-thread-safe) engine off the engine lock
+        self._stats_mu = threading.Lock()
+        self._stats_cache: Dict = {}
         handlers = {
             "hello": self._h_hello,
             "ping": lambda h, a: {},
@@ -185,11 +204,13 @@ class ReplicaServer:
             "journal_known": self._h_journal_known,
             "journal_handoff": self._h_journal_handoff,
         }
-        self._server = WireServer(handlers, host=host, port=port,
-                                  io_timeout_s=io_timeout_s,
-                                  decorate=self._decorate,
-                                  name=f"replica-{rid}")
+        self._server = WireServer(
+            handlers, host=host, port=port,
+            io_timeout_s=io_timeout_s, decorate=self._decorate,
+            lanes={v: "obs" for v in OBS_VERBS if v in handlers},
+            name=f"replica-{rid}")
         self.address = self._server.address
+        self._stats_cache = self._live()  # valid before any RPC
 
     # ---- lifecycle ----------------------------------------------------
     def start(self) -> "ReplicaServer":
@@ -285,9 +306,22 @@ class ReplicaServer:
             run_uid=self.run_uid)
 
     # ---- the live piggyback -------------------------------------------
-    def _decorate(self, resp: Dict) -> None:
-        self._last_rpc = time.perf_counter()
-        resp["live"] = self._live()
+    def _decorate(self, resp: Dict, verb: str) -> None:
+        now = time.perf_counter()
+        with self._stats_mu:
+            self._last_rpc = now
+        if verb in OBS_VERBS:
+            # obs lane: serve the cached snapshot — never the engine.
+            # A failed-request record re-delivered from the cache is
+            # idempotent client-side (_apply_live pops the mirror
+            # once), so the cache needs no per-conn bookkeeping
+            with self._stats_mu:
+                resp["live"] = self._stats_cache
+            return
+        live = self._live()  # under the engine lane's lock (default)
+        with self._stats_mu:
+            self._stats_cache = live
+        resp["live"] = live
 
     def _live(self) -> Dict:
         engine = self.engine
@@ -419,17 +453,24 @@ class ReplicaServer:
         return {"uids": [r.uid for r in redelivered],
                 "events": _events_wire(events)}
 
+    # obs-lane verbs (graftlink): answered from the stats cache while
+    # a long engine verb holds the engine lock — these handlers must
+    # never touch the engine (it is not thread-safe off its lock)
     def _h_snapshot(self, header: Dict, arrays) -> Dict:
-        return {"snapshot": self._live()}
+        with self._stats_mu:
+            return {"snapshot": self._stats_cache}
 
     def _h_health(self, header: Dict, arrays) -> Dict:
-        out = dict(self.engine.health.snapshot())
+        with self._stats_mu:
+            out = dict(self._stats_cache.get("health") or {})
         out["rid"] = self.rid
         out["role"] = self.role
         return {"health": out}
 
     def _h_metrics(self, header: Dict, arrays) -> Dict:
-        return {"metrics": self.engine.metrics.snapshot()}
+        with self._stats_mu:
+            return {"metrics": dict(self._stats_cache.get("metrics")
+                                    or {})}
 
     def _h_journal_unfinished(self, header: Dict, arrays) -> Dict:
         journal = self.engine.journal
@@ -681,12 +722,15 @@ class _RemoteEngine:
         except WireDead as e:
             self.health.mark_wire_dead(str(e).split("—")[0].strip())
             raise
+        self._finish_header(header)
+        return header, arrs
+
+    def _finish_header(self, header: Dict) -> None:
         live = header.get("live")
         if live:
             self._apply_live(live)
         if not header.get("ok", True):
             raise self._rehydrate(header)
-        return header, arrs
 
     def _control(self, verb: str, **fields) -> None:
         """Best-effort drain-control RPC: a replica whose transport is
@@ -775,6 +819,26 @@ class _RemoteEngine:
         header, _ = self._rpc("step")
         return self._events(header.get("events", ()))
 
+    def step_async(self):
+        """graftlink fan-out: submit this replica's ``step`` on the
+        wire WITHOUT waiting (the router submits every replica's
+        frame, then collects — replica N+1's step rides the wire
+        while replica N's is still executing). Returns a completion
+        handle for :meth:`step_complete`, or None on a blocking
+        client (the caller falls back to the synchronous step)."""
+        if not getattr(self._client, "pipelined", False):
+            return None
+        return self._client.call_async("step")
+
+    def step_complete(self, comp) -> List[Tuple[Request, int, bool]]:
+        try:
+            header, _ = self._client.complete(comp)
+        except WireDead as e:
+            self.health.mark_wire_dead(str(e).split("—")[0].strip())
+            raise
+        self._finish_header(header)
+        return self._events(header.get("events", ()))
+
     def begin_drain(self, reason: str = "drain") -> None:
         self.health._local(heal.DRAINING, reason)
         self._control("begin_drain", reason=reason)
@@ -821,6 +885,14 @@ class _RemoteEngine:
             "admit_prefilled", req=_req_wire(request), tok0=int(tok0),
             arrays=arrays)
         self._requests[request.uid] = request
+        # the blocks' last read in this process was the wire send that
+        # just completed: hand buffers the transfer pool LOANED back
+        # for the next prefill receive (identity-checked — a foreign
+        # or device-converted array is a no-op)
+        pool = self._client.recv_pool
+        if pool is not None:
+            for arr in arrays:
+                pool.give(arr)
         return self._events(header.get("events", ()))
 
     def prefill_detached(self, request: Request,
@@ -882,13 +954,22 @@ class RemoteReplica(ServingReplica):
         bootstraps pass the roster key).
       journal_path: override the ``hello``-reported WAL path for the
         SIGKILL disk fallback (cross-host shared-storage mounts).
+
+    graftlink is the DEFAULT transport: the client is pipelined
+    (obs/eng lanes, stream-id frames, ``call_async`` available) and
+    receives prefill blocks into the process-wide transfer
+    :class:`~..runtime.wire.BufferPool`. Pass ``pipelined=False`` for
+    the blocking wire — byte-identical streams either way (pinned in
+    ``tests/test_graftlink.py``).
     """
 
     def __init__(self, address: str, *, rid: Optional[str] = None,
                  journal_path: Optional[str] = None,
                  client: Optional[WireClient] = None, **client_kw):
-        client = (WireClient(address, **client_kw) if client is None
-                  else client)
+        if client is None:
+            client_kw.setdefault("pipelined", True)
+            client_kw.setdefault("recv_pool", _TRANSFER_POOL)
+            client = WireClient(address, **client_kw)
         hello, _ = client.call("hello")
         engine = _RemoteEngine(client, hello)
         path = journal_path or hello.get("journal_path")
@@ -903,6 +984,16 @@ class RemoteReplica(ServingReplica):
 
     def close(self) -> None:
         self._client.close()
+
+    def scrape(self) -> Dict:
+        """A LIVE snapshot RPC (not the mirror): rides the
+        observation lane, so it answers while a long engine verb —
+        a heavy ``step``, an ``admit_prefilled`` splice — is still
+        holding the server's engine lock. The head-of-line pin and
+        the ``--sweep wire`` snapshot-p99 point measure exactly this
+        call."""
+        header, _ = self._client.call("snapshot")
+        return dict(header.get("snapshot") or {})
 
     def __repr__(self) -> str:
         return (f"RemoteReplica(rid={self.rid!r}, role={self.role!r}, "

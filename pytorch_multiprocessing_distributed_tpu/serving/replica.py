@@ -59,6 +59,8 @@ import time
 from collections import deque
 from typing import Deque, Dict, List, Optional, Tuple
 
+import numpy as np
+
 from ..runtime import scope as graftscope
 from ..runtime.faults import (DeadlineExceeded, FaultInjected,
                               GraftFaultError)
@@ -85,10 +87,17 @@ class PageTransfer:
     per-token-per-head ``k_scale``/``v_scale`` sidecars — so the wire
     (or host copy) moves ~half the bytes and the receiver splices
     them bit-identical, no requantization. Scales are ``None`` on a
-    model-dtype transfer (the historical payload, unchanged)."""
+    model-dtype transfer (the historical payload, unchanged).
+
+    graftlink: on a local (same-process) engine the blocks stay
+    DEVICE-RESIDENT — :attr:`resident` is True and the splice at the
+    receiver is a device-to-device put into its freshly chosen
+    write_ids, no host bounce. A remote decode target converts to
+    host exactly once, at its wire send. The host-numpy form stays
+    the cross-mesh/CPU fallback and the wire representation."""
 
     __slots__ = ("request", "tok0", "k_block", "v_block", "k_scale",
-                 "v_scale", "src_rid", "src_tag")
+                 "v_scale", "src_rid", "src_tag", "born")
 
     def __init__(self, request: Request, tok0: int, k_block, v_block,
                  k_scale=None, v_scale=None,
@@ -107,6 +116,16 @@ class PageTransfer:
         # weights mid-stream — the router only places a tagged
         # transfer on a same-tag decode replica
         self.src_tag = src_tag
+        # handoff clock: stamped at export so the router can attribute
+        # prefill->decode handoff latency (route.splice) off the TTFT
+        # critical path
+        self.born = time.perf_counter()
+
+    @property
+    def resident(self) -> bool:
+        """True when the blocks are still device arrays (graftlink's
+        same-process fast path); False for the host-numpy wire form."""
+        return not isinstance(self.k_block, np.ndarray)
 
     @property
     def nbytes(self) -> int:
@@ -378,6 +397,30 @@ class ServingReplica:
             return []
         return self.engine.step()
 
+    def step_submit(self):
+        """Phase 1 of a pipelined fleet step (graftlink): submit this
+        replica's ``step`` without waiting for the result. Returns an
+        opaque handle for :meth:`step_complete`, or None when the
+        engine has no async surface (in-process engines, blocking
+        clients) — the router then falls back to the synchronous
+        :meth:`step` in the collect phase. Per-stream token streams
+        are admission/batch-composition invariant (repo-pinned), so
+        overlapping replica steps cannot change any stream."""
+        if not self.decode_capable or self.dead:
+            return None
+        submit = getattr(self.engine, "step_async", None)
+        if submit is None:
+            return None
+        return submit()
+
+    def step_complete(self, handle
+                      ) -> List[Tuple[Request, int, bool]]:
+        """Phase 2: collect the events of a :meth:`step_submit`
+        handle (None = run the synchronous step now)."""
+        if handle is None:
+            return self.step()
+        return self.engine.step_complete(handle)
+
     def prefill_step(self) -> Optional[PageTransfer]:
         """Run ONE queued prompt through detached prefill and export
         the block to host (prefill role; one prompt per router step —
@@ -390,14 +433,22 @@ class ServingReplica:
             return None
         request = self._prefill_queue.popleft()
         t0 = time.perf_counter()
+        # graftlink path selection is automatic: a real (same-process)
+        # engine exports DEVICE-RESIDENT blocks and the receiver's
+        # splice is a device-to-device put; a remote engine proxy has
+        # no resident surface and takes the host/wire fallback — the
+        # cross-mesh/CPU path, byte-identical by pin
+        resident_fn = getattr(self.engine, "prefill_detached_resident",
+                              None)
         try:
-            # the host round-trip: device blocks -> numpy (the seam a
-            # device-to-device path would replace). On a graftquant
-            # engine the blocks arrive already int8 + scale sidecars —
-            # half the bytes leave this replica
-            (tok0, k_block, v_block, k_scale,
-             v_scale) = self.engine.prefill_detached_wire(
-                 request, chunk=self.engine._prefill_chunk)
+            if resident_fn is not None:
+                (tok0, k_block, v_block, k_scale,
+                 v_scale) = resident_fn(
+                     request, chunk=self.engine._prefill_chunk)
+            else:
+                (tok0, k_block, v_block, k_scale,
+                 v_scale) = self.engine.prefill_detached_wire(
+                     request, chunk=self.engine._prefill_chunk)
         except (KeyboardInterrupt, SystemExit):
             raise
         except BaseException as e:
@@ -425,5 +476,6 @@ class ServingReplica:
                                 src_tag=self.model_tag)
         graftscope.emit("route.transfer", cat="serving",
                         req=request.uid, src=self.rid,
-                        nbytes=transfer.nbytes)
+                        nbytes=transfer.nbytes,
+                        resident=transfer.resident)
         return transfer

@@ -274,6 +274,10 @@ class Router:
         self.steals = 0
         self.transfers_routed = 0
         self.transfer_bytes = 0  # host-round-trip KV block payload
+        # graftlink attribution: prefill-finish → decode-splice wall
+        # time per placed transfer (the handoff the pipelined wire
+        # takes off the TTFT critical path) — bench-join material
+        self.transfer_handoff_s: List[float] = []
         # version-orphaned transfers recovered by re-prefill (rollout:
         # the last same-tag decode replica left while the block was
         # queued — the block drops, the request re-routes fresh)
@@ -615,6 +619,15 @@ class Router:
                 self._note_directory(transfer.request, replica)
                 self.transfers_routed += 1
                 self.transfer_bytes += transfer.nbytes
+                handoff_s = time.perf_counter() - transfer.born
+                if len(self.transfer_handoff_s) < 200_000:
+                    self.transfer_handoff_s.append(handoff_s)
+                graftscope.emit("route.splice", cat="serving",
+                                req=transfer.request.uid,
+                                rid=replica.rid,
+                                handoff_s=handoff_s,
+                                resident=transfer.resident,
+                                nbytes=transfer.nbytes)
                 events.extend(evs)
                 placed = True
                 break
@@ -796,11 +809,36 @@ class Router:
             if transfer is not None:
                 self._transfers.append(transfer)
         self._place_transfers(events)
-        for replica in self._decode_replicas():
-            if replica.engine.health.dead:
+        # graftlink: two-phase decode fan-out. Submit every replica's
+        # step first (a pipelined remote puts the frame on the wire
+        # and returns a completion handle; in-process and blocking
+        # replicas return None and step in the collect phase), then
+        # collect in replica order. Exact because per-stream tokens
+        # are invariant under admission timing and batch composition
+        # (the per-slot decode-independence pin) — overlapping N
+        # remote steps changes wall time, never token streams.
+        handles: Dict[str, object] = {}
+        decode = [r for r in self._decode_replicas()
+                  if not r.engine.health.dead]
+        for replica in decode:
+            try:
+                handles[replica.rid] = replica.step_submit()
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except BaseException as e:
+                # submit-side fatal (wire dead on send): same absorb
+                # as a fatal step — the collect phase must not run
+                graftscope.emit("route.replica_fatal", cat="fault",
+                                rid=replica.rid,
+                                error=type(e).__name__)
+                self._reap(replica, events)
+                handles[replica.rid] = False  # sentinel: reaped
+        for replica in decode:
+            handle = handles.get(replica.rid)
+            if handle is False or replica.engine.health.dead:
                 continue
             try:
-                events.extend(replica.step())
+                events.extend(replica.step_complete(handle))
             except (KeyboardInterrupt, SystemExit):
                 raise
             except BaseException as e:

@@ -1,0 +1,32 @@
+"""GL122 near-miss negatives: assembly with no send in scope (the
+builder shape — tests, faults and fallbacks consume the assembled
+representation), and sends that ride zero-copy memoryview segments."""
+
+
+def pack_frame(magic, header, segments):
+    # builder: assembles, never sends — the fault path and tests
+    # consume this representation; the copy is the product here
+    return b"".join([magic, header, *segments])
+
+
+def snapshot_bytes(arr):
+    # serialization far from any socket: a checkpoint writer's copy
+    return arr.tobytes()
+
+
+def send_scatter_gather(sock, prefix, segments):
+    # the graftlink discipline: header prefix + raw memoryview
+    # segments, nothing assembled
+    sock.sendmsg([memoryview(prefix), *segments])
+
+
+def send_prebuilt(sock, frame):
+    # the assembled frame arrived from a builder scope: this scope
+    # only sends
+    sock.sendall(frame)
+
+
+def prealloc_sized(sock, n):
+    # bytes(constant) preallocates, it does not copy a payload
+    pad = bytes(16)
+    sock.sendall(pad)

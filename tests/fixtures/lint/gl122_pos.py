@@ -1,0 +1,26 @@
+"""GL122 positives: copy-on-send in wire paths — every scope here
+also sends, so each assembly call duplicates the payload in Python
+right before the kernel takes it (the second multi-MB copy per RPC
+graftlink exists to kill)."""
+
+
+def send_assembled(sock, header, payload):
+    frame = header + payload.tobytes()          # <- GL122
+    sock.sendall(frame)
+
+
+def send_joined(sock, magic, header, body):
+    frame = b"".join([magic, header, body])     # <- GL122
+    sock.sendall(frame)
+
+
+def send_materialized(sock, prefix, seg):
+    sock.sendmsg([prefix, bytes(seg)])          # <- GL122
+
+
+def send_via_helper(sock, arr):
+    def put(buf):
+        sock.sendall(buf)
+    # the copy sits inside the sending function's chain: flagged even
+    # though the literal .sendall rides in a closure
+    put(arr.tobytes())                          # <- GL122

@@ -287,6 +287,12 @@ def test_serving_ladder_fingerprints_cover_decode_programs():
                  "serving_decode_quantref_w32_h4",
                  "serving_decode_quant_paged_w32_h4",
                  "serving_decode_quantref_paged_w32_h4"}
+    # graftlink: the transfer-splice ladder — admit_prefilled's
+    # insert programs (dense/paged/quant), budgeted at ZERO
+    # collectives (the device put IS the transfer)
+    expected |= {"serving_transfer_insert_w32",
+                 "serving_transfer_insert_paged_w32",
+                 "serving_transfer_insert_quant_w32"}
     assert names == expected
     committed = graftcheck.load_fingerprints(
         graftcheck.default_fingerprints_path())

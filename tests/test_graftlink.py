@@ -1,0 +1,488 @@
+"""graftlink: the pipelined zero-copy wire + device-resident
+PageTransfer (ISSUE 19 acceptance).
+
+The headline pins:
+- a pipelined 2-replica socket fleet streams byte-identical to the
+  BLOCKING wire and to the in-process fleet — dense, and the hard
+  matrix point (paged KV + chunked prefill + H=4 split fleet + int8
+  quantized transfers);
+- the device-resident transfer export is bit-identical to the
+  host-bounce wire payload (CPU-mesh pin: same int8 data, same f32
+  scale sidecars, same first token);
+- the multiplexed framer fails LOUDLY: out-of-order stream ids, a
+  stale sid on a reused connection, truncation mid-stream, oversized
+  segment claims — every case a named ``WireError``/``WireDead`` with
+  the lane's connection dropped and every pending completion failed
+  NAMED (never a silent resync, never a raw numpy exception, never a
+  leaked handle);
+- verb lanes kill head-of-line blocking: a snapshot scrape answers
+  while a long engine verb still holds the server's handler lock;
+- the ``recv_frame`` reuse pool serves repeated shapes without fresh
+  allocation, bit-identical to the no-pool path, and never re-admits
+  a foreign buffer (the jax-CPU zero-copy aliasing hazard).
+
+All host-side: graftcheck pins the jitted programs (the transfer
+splice ladder is committed as ``serving_transfer_insert_*``).
+"""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pytorch_multiprocessing_distributed_tpu import models
+from pytorch_multiprocessing_distributed_tpu.runtime import wire
+from pytorch_multiprocessing_distributed_tpu.runtime.wire import (
+    BufferPool, WireClient, WireDead, WireError, WireServer,
+    recv_frame, send_frame)
+from pytorch_multiprocessing_distributed_tpu.serving import (
+    RemoteReplica, ReplicaServer, Router, ServingEngine,
+    ServingReplica, init_params)
+from pytorch_multiprocessing_distributed_tpu.serving.scheduler import (
+    Request)
+
+pytestmark = pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+
+
+def _tiny(**kw):
+    return models.GPT(vocab_size=61, max_seq_len=64, hidden_size=32,
+                      num_layers=2, num_heads=2, mlp_dim=64,
+                      attn_impl="xla", **kw)
+
+
+@pytest.fixture(scope="module")
+def served():
+    model = _tiny()
+    params = init_params(model, 1)
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(0, model.vocab_size, (n,)).tolist()
+               for n in (3, 7, 12, 5, 9, 6)]
+    return model, params, prompts
+
+
+def _engine(model, params, **kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("s_max", 32)
+    kw.setdefault("min_bucket", 8)
+    kw.setdefault("retry_backoff_s", 0.0)
+    return ServingEngine(model, params, **kw)
+
+
+def _socket_fleet(served, *, pipelined, roles=None, **ekw):
+    model, params, prompts = served
+    roles = roles or ["both", "both"]
+    servers = [ReplicaServer(_engine(model, params, **ekw),
+                             rid=f"r{i}", role=role).start()
+               for i, role in enumerate(roles)]
+    replicas = [RemoteReplica(s.address, backoff_s=0.0,
+                              pipelined=pipelined) for s in servers]
+    return Router(replicas), servers, replicas
+
+
+def _stop_all(servers):
+    for s in servers:
+        s.stop()
+
+
+def _serve_streams(router, prompts):
+    out = router.serve([(p, 6) for p in prompts])
+    return [list(r.tokens) for r in out]
+
+
+# ------------------------------------------- identity: the tentpole pin
+
+def test_pipelined_matrix_dense(served):
+    """Dense 2-replica fleets — pipelined wire, blocking wire, and the
+    single-engine baseline — all stream byte-identical; the pipelined
+    client really ran on lanes (not a silent blocking fallback)."""
+    model, params, prompts = served
+    ref = _serve_streams_single(model, params, prompts)
+    for pipelined in (True, False):
+        router, servers, replicas = _socket_fleet(
+            served, pipelined=pipelined)
+        try:
+            assert replicas[0]._client.pipelined is pipelined
+            got = _serve_streams(router, prompts)
+            assert got == ref, (
+                f"pipelined={pipelined} fleet diverged from baseline")
+            if pipelined:
+                lanes = replicas[0]._client._lanes
+                assert "eng" in lanes, "no eng lane: never pipelined"
+        finally:
+            _stop_all(servers)
+
+
+def _serve_streams_single(model, params, prompts, **cfg):
+    engine = _engine(model, params, **cfg)
+    return [list(r.tokens)
+            for r in engine.serve([(p, 6) for p in prompts])]
+
+
+@pytest.mark.slow  # 7 paged int8 engine builds — the 870s budget;
+# fast tier keeps the dense pipelined/blocking matrix, the resident
+# bit-identity pin, and graftwire's model-dtype split fleet
+def test_split_fleet_int8_paged_matrix(served):
+    """THE hard matrix point: prefill/decode split fleet with paged KV
+    + chunked prefill + H=4 + int8 quantized transfers, byte-identical
+    across the in-process fleet (device-resident transfers), the
+    pipelined socket fleet and the blocking socket fleet — and the
+    router attributed every handoff."""
+    model, params, prompts = served
+    cfg = dict(kv_layout="paged", page_size=8, prefill_chunk=4,
+               decode_horizon=4, kv_dtype="int8")
+    ref = _serve_streams_single(model, params, prompts, **cfg)
+
+    # in-process split fleet: prefill_step takes the RESIDENT path
+    # (the engine exports prefill_detached_resident — no host bounce)
+    pf = ServingReplica("pf", _engine(model, params, **cfg),
+                        role="prefill")
+    de = ServingReplica("de", _engine(model, params, **cfg),
+                        role="decode")
+    router = Router([pf, de])
+    assert _serve_streams(router, prompts) == ref, \
+        "in-process resident split fleet diverged"
+    assert router.transfers_routed == len(prompts)
+    assert len(router.transfer_handoff_s) == router.transfers_routed
+    assert all(h >= 0.0 for h in router.transfer_handoff_s)
+
+    for pipelined in (True, False):
+        router, servers, _ = _socket_fleet(
+            served, pipelined=pipelined,
+            roles=["prefill", "decode"], **cfg)
+        try:
+            assert _serve_streams(router, prompts) == ref, (
+                f"pipelined={pipelined} int8 split fleet diverged")
+            assert router.transfers_routed == len(prompts)
+        finally:
+            _stop_all(servers)
+
+
+def test_resident_transfer_bit_identical_to_host_bounce(served):
+    """The CPU-mesh exactness pin: the device-resident export and the
+    host-bounce wire payload are the SAME bytes — int8 data, f32
+    scale sidecars, first token (the device ``_quant_pref_jit`` and
+    the host ``quantize_kv_np`` twin are bit-equal by construction,
+    re-pinned here at the transfer seam)."""
+    model, params, prompts = served
+    engine = _engine(model, params, kv_dtype="int8")
+    r_res = Request(prompts[0], 6, uid="res")
+    r_wire = Request(prompts[0], 6, uid="wire")
+    tok0_r, kd, vd, ks, vs = engine.prefill_detached_resident(r_res)
+    tok0_w, kw_, vw, ksw, vsw = engine.prefill_detached_wire(r_wire)
+    assert int(tok0_r) == int(tok0_w)
+    assert isinstance(kw_, np.ndarray)  # the host-bounce payload
+    np.testing.assert_array_equal(np.asarray(kd), kw_)
+    np.testing.assert_array_equal(np.asarray(vd), vw)
+    np.testing.assert_array_equal(np.asarray(ks), ksw)
+    np.testing.assert_array_equal(np.asarray(vs), vsw)
+
+
+# ------------------------------------------------ the multiplexed framer
+
+def _rogue_server(conn_fn):
+    """A localhost listener whose ONE accepted connection is handed to
+    ``conn_fn`` on a thread — the adversarial peer for framer fuzz."""
+    listener = socket.create_server(("127.0.0.1", 0))
+    listener.settimeout(5.0)
+    host, port = listener.getsockname()
+
+    def run():
+        try:
+            conn, _ = listener.accept()
+        except OSError:
+            return
+        conn.settimeout(5.0)
+        try:
+            conn_fn(conn)
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return listener, f"{host}:{port}"
+
+
+def _pipelined_client(address):
+    return WireClient(address, pipelined=True, backoff_s=0.0,
+                      retries=1, call_deadline_s=5.0)
+
+
+def test_out_of_order_sids_poison_the_lane():
+    """Responses delivered out of order = a desynced stream: BOTH
+    pending completions fail with the stale-sid ``WireError`` named
+    in the ``WireDead``, and the lane's connection drops — never a
+    silent resync, never a leaked handle."""
+    def reorder(conn):
+        first = recv_frame(conn)
+        second = recv_frame(conn)
+        # answer the SECOND submit first: its sid is not the oldest
+        # in-flight, so the client must poison the whole lane
+        send_frame(conn, {"ok": True, "_sid": second[0]["_sid"]})
+        send_frame(conn, {"ok": True, "_sid": first[0]["_sid"]})
+
+    listener, address = _rogue_server(reorder)
+    client = _pipelined_client(address)
+    try:
+        c1 = client.call_async("mutate")
+        c2 = client.call_async("mutate")
+        with pytest.raises(WireDead, match="stale stream id"):
+            client.complete(c1)
+        with pytest.raises(WireDead):
+            client.complete(c2)
+        lane = client._lanes["eng"]
+        assert lane._sock is None, "poisoned lane kept its socket"
+        assert not lane._pending, "completion handle leaked"
+    finally:
+        client.close()
+        listener.close()
+
+
+def test_truncation_mid_stream_fails_named():
+    """The peer dies halfway through a response payload: the pending
+    completion fails NAMED (transport error wrapped in ``WireDead``),
+    not a hang and not a raw numpy exception."""
+    def truncate(conn):
+        got = recv_frame(conn)
+        frame = wire.pack_frame(
+            {"ok": True, "_sid": got[0]["_sid"],
+             "_arrays": [{"shape": [64], "dtype": "float32",
+                          "nbytes": 256}]})
+        conn.sendall(frame[:20])  # half the header, then hang up
+
+    listener, address = _rogue_server(truncate)
+    client = _pipelined_client(address)
+    try:
+        comp = client.call_async("mutate")
+        with pytest.raises(WireDead, match="mutate"):
+            client.complete(comp)
+        assert not client._lanes["eng"]._pending
+    finally:
+        client.close()
+        listener.close()
+
+
+def test_oversized_segment_claim_fails_named():
+    """A response descriptor whose nbytes contradicts shape x dtype is
+    a typed ``WireError`` inside the lane (surfaced as ``WireDead``),
+    never a raw reshape ValueError."""
+    import json as _json
+
+    def oversize(conn):
+        got = recv_frame(conn)
+        head = _json.dumps(
+            {"ok": True, "_sid": got[0]["_sid"],
+             "_arrays": [{"shape": [4, 4], "dtype": "float32",
+                          "nbytes": 1 << 20}]}).encode()
+        conn.sendall(wire.MAGIC + len(head).to_bytes(4, "big") + head
+                     + b"\x00" * (1 << 20))
+
+    listener, address = _rogue_server(oversize)
+    client = _pipelined_client(address)
+    try:
+        comp = client.call_async("mutate")
+        with pytest.raises(WireDead, match="descriptor"):
+            client.complete(comp)
+    finally:
+        client.close()
+        listener.close()
+
+
+def test_stale_sid_on_blocking_exchange_drops_connection():
+    """Connection-reuse desync on the BLOCKING path: a response whose
+    echoed sid does not match the request is refused named and the
+    socket drops (a non-idempotent verb -> commit-ambiguous
+    ``WireDead``)."""
+    def wrong_sid(conn):
+        got = recv_frame(conn)
+        send_frame(conn, {"ok": True,
+                          "_sid": got[0]["_sid"] + 1000})
+
+    listener, address = _rogue_server(wrong_sid)
+    client = WireClient(address, backoff_s=0.0, retries=1,
+                        call_deadline_s=5.0)
+    try:
+        with pytest.raises(WireDead, match="stale stream id"):
+            client.call("mutate")
+        assert client._sock is None, "desynced socket kept alive"
+    finally:
+        client.close()
+        listener.close()
+
+
+def test_submit_after_server_gone_fails_via_completion():
+    """A submit-side transport failure never raises out of
+    ``call_async``: the handle comes back already failed, and
+    ``complete`` names the death."""
+    listener, address = _rogue_server(lambda conn: None)
+    listener.close()  # nothing listens
+    client = _pipelined_client(address)
+    try:
+        comp = client.call_async("mutate")
+        assert comp.done()
+        with pytest.raises(WireDead, match="mutate"):
+            client.complete(comp)
+    finally:
+        client.close()
+
+
+# -------------------------------------------------- head-of-line: lanes
+
+def test_obs_lane_answers_while_eng_verb_holds_the_lock():
+    """The HOL pin: a snapshot scrape completes while a long engine
+    verb is STILL inside its handler (the obs lane has its own server
+    lock and its own client connection)."""
+    entered = threading.Event()
+    release = threading.Event()
+
+    def slow_step(header, arrays):
+        entered.set()
+        assert release.wait(10.0)
+        return {"stepped": True}
+
+    def snapshot(header, arrays):
+        return {"snapshot": {"alive": True}}
+
+    server = WireServer({"step": slow_step, "snapshot": snapshot},
+                        lanes={"snapshot": "obs"}).start()
+    client = _pipelined_client(server.address)
+    try:
+        comp = client.call_async("step")
+        assert entered.wait(5.0)
+        t0 = time.perf_counter()
+        resp, _ = client.call("snapshot")
+        scrape_s = time.perf_counter() - t0
+        assert resp["snapshot"]["alive"]
+        assert not comp.done(), "step finished early: HOL not probed"
+        assert scrape_s < 2.0, (
+            f"snapshot waited {scrape_s:.2f}s behind the eng verb")
+        release.set()
+        resp, _ = client.complete(comp)
+        assert resp["stepped"]
+        assert set(client._lanes) == {"eng", "obs"}
+    finally:
+        release.set()
+        client.close()
+        server.stop()
+
+
+def test_remote_scrape_rides_the_obs_lane(served):
+    """RemoteReplica.scrape() is a LIVE stats read over the obs lane —
+    the full server-side structure (pressure gauges + health + metrics
+    + failure records), answered from the stats cache without an
+    engine verb."""
+    model, params, _ = served
+    server = ReplicaServer(_engine(model, params), rid="S").start()
+    try:
+        remote = RemoteReplica(server.address, backoff_s=0.0)
+        live = remote.scrape()
+        for key in ("in_flight", "queue_depth", "free_slots",
+                    "health", "metrics", "failed"):
+            assert key in live, f"scrape missing {key!r}"
+        assert live["in_flight"] == 0 and live["failed"] == []
+        assert "requests_failed" in live["metrics"]
+        # the snapshot verb is an obs verb: it must ride the obs lane,
+        # never the engine lane (the HOL point of the whole exercise)
+        assert "obs" in remote._client._lanes
+        assert "eng" not in remote._client._lanes, \
+            "scrape touched an engine-lane verb"
+    finally:
+        server.stop()
+
+
+# --------------------------------------------------- recv reuse pool
+
+def test_buffer_pool_reuses_and_is_bit_identical():
+    """The PageTransfer hot-path fix: repeated same-shape receives hit
+    the pool instead of allocating, payload bytes identical to the
+    no-pool path; a foreign array is never re-admitted (the aliasing
+    hazard guard) and neither is a view."""
+    pool = BufferPool()
+    payloads = [np.arange(48, dtype=np.float32).reshape(3, 16) * i
+                for i in range(1, 4)]
+    for use_pool in (pool, None):
+        a, b = socket.socketpair()
+        try:
+            a.settimeout(5.0)
+            b.settimeout(5.0)
+            for p in payloads:
+                send_frame(a, {"verb": "kv"}, [p])
+                _, arrs = recv_frame(b, pool=use_pool)
+                # bit-identity pin, checked BEFORE give-back (the
+                # pool recycles the buffer on the next receive):
+                # pooled and fresh-allocation receives both equal
+                # the source payload
+                np.testing.assert_array_equal(arrs[0], p)
+                if use_pool:
+                    pool.give(arrs[0])
+        finally:
+            a.close()
+            b.close()
+    assert pool.hits >= 1, "same-shape receives never hit the pool"
+    assert pool.misses >= 1
+    # identity discipline: foreign arrays and views bounce
+    assert pool.give(np.zeros((3, 16), np.float32)) is False
+    loan = pool.take((3, 16), np.float32)
+    assert pool.give(loan[1:]) is False  # a view, not the loan
+    assert pool.give(loan) is True
+
+
+def test_pool_stats_shape():
+    pool = BufferPool()
+    arr = pool.take((2, 2), np.int8)
+    stats = pool.stats()
+    assert stats["misses"] == 1 and stats["loaned"] == 1
+    pool.give(arr)
+    assert pool.stats()["free"] == 1
+
+
+# ------------------------------------------------- two-phase router step
+
+def test_in_process_replica_step_submit_is_inline():
+    """An in-process replica has no wire to pipeline: step_submit
+    returns None and step_complete(None) IS step() — the router's
+    two-phase fan-out degrades to the sequential loop exactly."""
+    model = _tiny()
+    params = init_params(model, 1)
+    replica = ServingReplica("L", _engine(model, params))
+    assert replica.step_submit() is None
+    assert replica.step_complete(None) == []
+
+
+def test_remote_step_async_overlaps(served):
+    """The pipelined remote submits step N+1 while the peer processes
+    it: step_submit returns a live Completion and step_complete
+    resolves it with the same events shape step() returns."""
+    model, params, prompts = served
+    server = ReplicaServer(_engine(model, params), rid="P").start()
+    try:
+        remote = RemoteReplica(server.address, backoff_s=0.0)
+        remote.engine.enqueue(Request(prompts[0], 3, uid="a0"))
+        events = []
+        while remote.in_flight:
+            handle = remote.step_submit()
+            assert handle is not None, "pipelined remote fell inline"
+            events.extend(remote.step_complete(handle))
+        assert [e[0].uid for e in events if e[2]] == ["a0"]
+        toks = [t for r, t, _ in events]
+        # blocking path agrees token-for-token
+        server2 = ReplicaServer(_engine(model, params),
+                                rid="B").start()
+        try:
+            blocking = RemoteReplica(server2.address, backoff_s=0.0,
+                                     pipelined=False)
+            assert blocking.step_submit() is None  # no async surface
+            blocking.engine.enqueue(Request(prompts[0], 3, uid="a0"))
+            events2 = []
+            while blocking.in_flight:
+                events2.extend(blocking.step())
+            assert [t for r, t, _ in events2] == toks
+        finally:
+            server2.stop()
+    finally:
+        server.stop()

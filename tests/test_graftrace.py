@@ -46,6 +46,18 @@ from pytorch_multiprocessing_distributed_tpu.runtime.wire import (
 WIRE_REL = "pytorch_multiprocessing_distributed_tpu/runtime/wire.py"
 
 
+def _wire_lock_line(snippet):
+    """Line number of the unique line containing ``snippet`` in
+    wire.py — lock-site pins resolved from source so they survive
+    unrelated wire.py growth (the lock's LABEL is its construction
+    site)."""
+    with open(wire.__file__, "r", encoding="utf-8") as fh:
+        hits = [i for i, line in enumerate(fh.read().splitlines(), 1)
+                if snippet in line]
+    assert len(hits) == 1, (snippet, hits)
+    return hits[0]
+
+
 # ------------------------------------------------------ harness basics
 
 class _Counter:
@@ -265,9 +277,10 @@ def test_kill_connections_never_waits_on_the_verb_lock():
     assert kill_events and max(kill_events) < drain_release
     # and kill's lock traffic is ONLY the connection lock (wire.py
     # _conns_mu site), never the verb lock
+    conns_mu = _wire_lock_line("self._conns_mu = threading.Lock()")
     kill_locks = {t[2] for t in trace
                   if t[0] == "kill" and t[1] in ("acquire", "release")}
-    assert kill_locks == {f"{WIRE_REL}:507"}, kill_locks
+    assert kill_locks == {f"{WIRE_REL}:{conns_mu}"}, kill_locks
 
 
 def test_journal_close_between_append_and_fsync(tmp_path):
@@ -395,8 +408,9 @@ def test_realized_lock_graph_is_subgraph_of_static_model(tmp_path):
     model. A lock the static pass can't see fails here BY NAME."""
     model = static_lock_model()
     assert model.decls, "static model found no locks — resolver broke"
+    meter_mu = _wire_lock_line("_METER_MU = threading.Lock()")
     with S.observed(enroll=[(wire, "_METER_MU",
-                             (WIRE_REL, 120))]) as obs:
+                             (WIRE_REL, meter_mu))]) as obs:
 
         def echo(header, arrays):
             return {"y": header.get("x")}, arrays
@@ -426,8 +440,11 @@ def test_realized_lock_graph_is_subgraph_of_static_model(tmp_path):
     assert problems == [], "\n".join(problems)
     # the client->meter nesting REALIZED and matched the model's one
     # cross-lock edge — the audit exercised a real edge, not silence
-    assert ((WIRE_REL, 336), (WIRE_REL, 120)) in obs.edges
-    assert (WIRE_REL, 503) in obs.sites  # server verb lock was live
+    client_mu = _wire_lock_line("# blocking-exchange lock")
+    verb_mu = _wire_lock_line("# serializes verb handlers")
+    assert ((WIRE_REL, client_mu),
+            (WIRE_REL, meter_mu)) in obs.edges
+    assert (WIRE_REL, verb_mu) in obs.sites  # server verb lock live
 
 
 def test_audit_names_an_invisible_lock():

@@ -598,3 +598,6 @@ def test_wire_smoke_end_to_end():
     assert out["killed"]
     assert out["redelivered"] >= 1
     assert out["streams_ok"]
+    # graftlink: the frame submitted-uncompleted at kill time failed
+    # NAMED — the handle was never leaked
+    assert out["handle_failed_named"].startswith("WireDead")
