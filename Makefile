@@ -5,7 +5,7 @@ PYTEST_ENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cp
 
 .PHONY: test test-fast lint check check-update chaos soak scope meter \
         fleet spec zero route wire scale quant dryrun bench bench-cpu \
-        store trace clean
+        store trace life clean
 
 # graftlint: AST-only jit-hygiene gate (no jax import, milliseconds).
 # Exit 1 on any non-baselined finding; the tier-1 suite and
@@ -152,6 +152,17 @@ wire:
 # tests/test_graftscale.py).
 scale:
 	$(PYTEST_ENV) python benchmarks/scale_smoke.py
+
+# graftlife: the resource-lifecycle gate — the GL123-125 static pass
+# over the package (part of `make lint`, split out here) plus the
+# churny ownership-ledger soak: an autoscaled fleet under deadlines,
+# withdraws, work stealing and one injected replica death must
+# drain to an EMPTY ledger for every resource class (slots, pages,
+# buffers, journal admits, transfers, sockets, threads, files), and
+# every realized acquire site must be one the static model admits.
+life:
+	python -m pytorch_multiprocessing_distributed_tpu.analysis.lint
+	$(PYTEST_ENV) python benchmarks/life_smoke.py
 
 # full suite on the virtual 8-device CPU mesh (incl. slow e2e CLI runs)
 test:

@@ -1,0 +1,170 @@
+"""graftlife smoke: a churny fleet soak under the armed ownership
+ledger — every resource class audits EMPTY at the end.
+
+The ``make life`` target drives a deliberately messy serving run:
+
+1. **churn** — a journaled replica behind the router plus a
+   :class:`FleetAutoscaler` that JOINS replicas under a burst and
+   LEAVES them on the idle plateau; requests submitted with a mix of
+   plentiful and already-hopeless deadlines (deadline evictions),
+   two mid-run ``ServingEngine.withdraw`` calls (client
+   abandonment), and the backlog imbalance that triggers work
+   stealing;
+2. **death** — one injected engine-fatal
+   (``serving.decode_dispatch``, the existing graftfault site) kills
+   a replica mid-stream: its WAL redelivers to a peer, its slots and
+   pages hard-reclaim at the reap, its WAL's file handle closes;
+3. **the audit** — after ``Router.drain`` the
+   :class:`~pytorch_multiprocessing_distributed_tpu.runtime.life.
+   OwnershipLedger` must be EMPTY for every kind (slots, pages,
+   buffers, journal admissions, transfers, sockets, threads, files)
+   and every realized acquire site must be one the static model
+   (``analysis/lifecycle.py``) admits. Any leak is a named finding
+   with holder/site/age — and a failed smoke.
+
+Exit code 0 and one ``graftlife smoke OK`` line = drained means
+empty, audited. Run: ``python benchmarks/life_smoke.py``
+(CPU-runnable; tiny model, seconds).
+"""
+
+import argparse
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def run_smoke(verbose: bool = True) -> dict:
+    import numpy as np
+
+    from pytorch_multiprocessing_distributed_tpu import models
+    from pytorch_multiprocessing_distributed_tpu.runtime import (
+        faults, heal, life)
+    from pytorch_multiprocessing_distributed_tpu.serving import (
+        EngineReplicaSpawner, FleetAutoscaler, FleetSaturated,
+        Router, ServingEngine, ServingReplica, init_params)
+
+    def note(msg):
+        if verbose:
+            print(msg, flush=True)
+
+    model = models.GPT(vocab_size=61, max_seq_len=64, hidden_size=32,
+                       num_layers=2, num_heads=2, mlp_dim=64,
+                       attn_impl="xla")
+    params = init_params(model, 1)
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(0, model.vocab_size, (n,)).tolist()
+               for n in (3, 7, 12, 5, 9, 6, 4, 8)]
+
+    def mk_engine(tag="r0", journal=None):
+        return ServingEngine(model, params, max_slots=2, s_max=32,
+                             min_bucket=8, retry_backoff_s=0.0,
+                             kv_layout="paged", page_size=8,
+                             journal=journal)
+
+    tmp = tempfile.mkdtemp(prefix="graftlife_smoke_")
+    summary = {}
+    with life.armed() as led:
+        journal = heal.RequestJournal(
+            os.path.join(tmp, "wal0.jsonl"))
+        router = Router([ServingReplica(
+            "r0", mk_engine(journal=journal), journal=journal)],
+            max_pending=4)
+        scaler = FleetAutoscaler(
+            router, EngineReplicaSpawner(
+                lambda tag, journal: mk_engine(tag)),
+            min_replicas=1, max_replicas=3, up_after=2, down_after=6,
+            cooldown=3, sleep=lambda s: None)
+
+        note("phase 1: burst churn (joins, deadlines, withdraws, "
+             "steals)")
+        uid = 0
+        withdrawn = []
+        for tick in range(30):
+            for _ in range(2):
+                try:
+                    deadline = 1e-4 if uid % 7 == 3 else None
+                    router.submit(
+                        list(prompts[uid % len(prompts)]), 6,
+                        uid=f"u{uid}", deadline_s=deadline)
+                    uid += 1
+                except FleetSaturated:
+                    pass
+            router.step()
+            scaler.tick()
+            if tick == 12:
+                # client abandonment: withdraw two PLACED requests
+                # wherever they sit (running, pending, or queued)
+                for cand, rid in list(router._assigned.items()):
+                    if len(withdrawn) >= 2:
+                        break
+                    rec = router.records().get(cand)
+                    if rec is None or rec.state in ("done", "failed"):
+                        continue
+                    rep = next(r for r in router.replicas
+                               if r.rid == rid)
+                    if rep.engine.withdraw(cand):
+                        withdrawn.append(cand)
+        assert scaler.scale_ups >= 1, "burst never grew the fleet"
+        assert len(withdrawn) == 2, "withdraw found no live target"
+
+        note("phase 2: one injected replica death mid-stream")
+        plan = faults.FaultPlan(seed=3, rules=[faults.FaultRule(
+            "serving.decode_dispatch", "fatal", times=1)])
+        faults.arm(plan)
+        try:
+            steps = 0
+            while (router.in_flight or router.pending_depth) \
+                    and steps < 5000:
+                router.step()
+                scaler.tick()
+                steps += 1
+        finally:
+            faults.disarm()
+        assert router.requests_redelivered >= 1, (
+            "the injected death never redelivered")
+        for _ in range(60):  # idle plateau: scale back down (leaves)
+            router.step()
+            scaler.tick()
+        assert len(router.replicas) == 1, "idle fleet must shrink"
+
+        note("phase 3: drain + the audit")
+        router.drain(None)
+        recs = router.records()
+        states = {}
+        for r in recs.values():
+            states[r.state] = states.get(r.state, 0) + 1
+        findings = led.audit_drained("life smoke drain")
+        assert findings == [], "\n".join(findings)
+        site_findings = led.audit_sites()
+        assert site_findings == [], "\n".join(site_findings)
+        counts = led.counts()
+        assert not any(counts.values()), counts
+        summary = {
+            "submitted": uid,
+            "states": states,
+            "withdrawn": len(withdrawn),
+            "deaths": sum(r.reaped for r in router.replicas),
+            "redelivered": router.requests_redelivered,
+            "scale_ups": scaler.scale_ups,
+            "acquired": dict(led.acquired),
+            "released": dict(led.released),
+            "leaked": counts,
+        }
+    note(f"summary: {summary}")
+    note("graftlife smoke OK")
+    return summary
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--quiet", action="store_true")
+    args = parser.parse_args()
+    run_smoke(verbose=not args.quiet)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

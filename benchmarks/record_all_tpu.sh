@@ -29,6 +29,9 @@ python benchmarks/fleet_smoke.py
 note "fleet serving smoke (graftroute wiring sane before capture)"
 python benchmarks/route_smoke.py
 
+note "ownership-ledger smoke (graftlife: drained means empty, audited)"
+python benchmarks/life_smoke.py
+
 note "baselines (all configs, slope estimator)"
 python benchmarks/record_baselines.py
 

@@ -169,7 +169,7 @@ def run_point(model, params, prompts, new_tokens, slots, offered_rps,
     from pytorch_multiprocessing_distributed_tpu.runtime import (
         hbm as hbm_ledger)
     from pytorch_multiprocessing_distributed_tpu.runtime import (
-        faults, fleet)
+        faults, fleet, life)
     from pytorch_multiprocessing_distributed_tpu.runtime import (
         scope as graftscope)
     from pytorch_multiprocessing_distributed_tpu.serving import (
@@ -187,6 +187,10 @@ def run_point(model, params, prompts, new_tokens, slots, offered_rps,
     # prefill/drain spans feed the point's goodput fraction.
     ledger = hbm_ledger.arm(hbm_ledger.HbmLedger())
     point_scope = graftscope.arm(graftscope.Scope(keep=True))
+    # graftlife: a fresh ownership ledger per point — the leaked_*
+    # numbers below must all be 0 (a bench point that strands slots
+    # or pages is measuring a leak, not throughput)
+    life_led = life.arm(life.OwnershipLedger())
     try:
         engine = ServingEngine(model, params, max_slots=slots,
                                s_max=s_max, **engine_kwargs)
@@ -229,6 +233,7 @@ def run_point(model, params, prompts, new_tokens, slots, offered_rps,
             faults.disarm()
         hbm_ledger.disarm()
         graftscope.disarm()
+        life.disarm()
     wall = time.perf_counter() - t_start
     # graftfleet: goodput over the point's own timeline (engine
     # prefill + drain spans vs the point's wall); collective skew only
@@ -273,6 +278,10 @@ def run_point(model, params, prompts, new_tokens, slots, offered_rps,
     return {
         "hbm_resident_bytes": ledger.total_bytes,
         "hbm_per_slot_bytes": engine.pool.per_slot_bytes,
+        # graftlife: the drained point must hold NOTHING (0s, pinned)
+        "leaked_slots": life_led.live("slot"),
+        "leaked_pages": life_led.live("page"),
+        "leaked_threads": life_led.live("thread"),
         "decode_flops_per_dispatch": decode_flops,
         "mfu": mfu,
         # graftfleet: wall-time accounting + cross-rank attribution

@@ -1,0 +1,874 @@
+"""graftlife: resource-lifecycle static analysis — the ownership model
+behind GL123/GL124/GL125.
+
+The reference trainer's resource story is implicit (one process per
+GPU, everything freed at exit); this stack instead holds long-lived
+pools — KV slots and pages, wire receive buffers, sockets, threads,
+WAL entries, PageTransfers — whose acquire/release protocols were
+enforced only by review. This module makes the OWNERSHIP discipline
+machine-checked the same way :mod:`.rules` checks jit hygiene and
+:mod:`.concurrency` checks lock order: pure ``ast``, no jax import,
+milliseconds over the package.
+
+The pass builds a package-wide **resource model**:
+
+- **acquire sites** — expressions that grant ownership of a pooled or
+  OS resource, classified by resource kind:
+
+  ========  ====================================================
+  kind      recognized acquire shapes
+  ========  ====================================================
+  slot      ``<pool>.acquire()`` on a pool-named receiver
+  page      ``<pool>.alloc_pages(...)``
+  buffer    ``<pool>.take(...)`` on a pool-named receiver
+  socket    ``socket.socket`` / ``socket.create_connection`` /
+            ``socket.create_server`` / ``<listener>.accept()``
+  thread    ``threading.Thread(...)`` (non-daemon only — a
+            ``daemon=True`` thread is self-owning by design)
+  file      ``open(...)`` bound to a name (``with open()`` is
+            already a context manager and needs no tracking)
+  transfer  ``PageTransfer(...)`` construction (the wire handoff
+            object — it exists to be moved, so in practice every
+            one is immediately transferred)
+  ========  ====================================================
+
+- **release sites** — ``.release(x)`` / ``.decref(x)`` / ``.give(x)``
+  / ``.free(x)`` with the resource as an argument, or ``x.close()`` /
+  ``x.join()`` / ``x.release()`` on the resource itself;
+
+- **transfer edges** — the dispositions that END local
+  responsibility without a release, so moved resources are never
+  false leaks: *return-to-caller* (the name anywhere in a ``return``
+  expression), *store-into-owner-object* (``obj.attr = x``,
+  ``d[k] = x``, ``container.append(x)``), and *consuming call* (the
+  bare name passed as an argument to any call that is not a known
+  pure reader — constructors like ``_PagedPrep(...)`` and wire
+  handoffs like ``bind_slot(slot, ids)`` take ownership).
+
+Three rules run over per-function walks of the model:
+
+- **GL123** — an acquire with an escaping path that skips release:
+  an early ``return`` / ``raise`` / fall-off-end with the resource
+  still owned, an acquire-per-loop-iteration never disposed inside
+  the iteration, or a risky call (one that can raise) between the
+  acquire and its first disposition with no ``try/finally`` or
+  releasing ``except`` protecting it. The WireError lane-poison
+  class: a pool buffer taken, then a recv that raises mid-frame,
+  and the give-back never runs.
+- **GL124** — double-release: a release of a resource EVERY path
+  has already released (a ``finally`` that duplicates the body's
+  release, a straight-line repeat, a release after both branches
+  released). Release-after-consuming-call deliberately does NOT
+  fire — ``use(x)`` then ``finally: pool.release(x)`` is the
+  canonical protection idiom and a call argument is too weak a
+  signal for an ownership move.
+- **GL125** — ownership ambiguity: a pooled resource (slot / page /
+  buffer) stored into ``self.<attr>`` from two or more methods while
+  NO method of the class ever releases through that attribute —
+  nobody owns the free, so everybody leaks.
+
+Known limits (deliberate, same policy as every :mod:`.rules` pass):
+ownership through aliases (``y = x`` ends tracking), containers
+(``self._held[k]`` contents are not re-tracked at the pop), and
+callables passed by reference (``retry_with_backoff(self._connect)``)
+is invisible; ``incref``/``decref`` BALANCE is not counted (refcount
+arithmetic is runtime behavior); a resource acquired in one function
+and released in another is vetted only through the transfer edge that
+moved it. The runtime twin closes the gap from the other side:
+:mod:`..runtime.life`'s :class:`~..runtime.life.OwnershipLedger`
+records realized acquires/releases under the tier-1 drain matrix,
+``audit_drained()`` fails loudly on any holder that survives a
+drain, and ``audit_sites()`` requires every realized package acquire
+site to be one this model admits — an invisible acquire is a named
+finding, never silence.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .rules import (Finding, _File, _Func, _dotted, _modkey_for,
+                    _resolve_local)
+
+__all__ = ["LifecycleModel", "check_lifecycle",
+           "static_lifecycle_model", "RESOURCE_KINDS"]
+
+RESOURCE_KINDS = ("slot", "page", "buffer", "socket", "thread",
+                  "file", "transfer", "journal")
+
+# pooled kinds: GL125's "pooled resource stored into a shared
+# structure" scope (an OS handle has a kernel-side owner; a pool
+# grant has only the discipline this pass checks)
+_POOLED = {"slot", "page", "buffer"}
+
+_POOLISH = re.compile(r"pool|slots|bufs|buffers", re.IGNORECASE)
+_LOCKISH = re.compile(r"(?:^|_)(?:mu|mutex|lock|mtx|cv|cond)$")
+_LISTENISH = re.compile(r"listen|sock|srv|server", re.IGNORECASE)
+
+# verbs that release a resource PASSED AS AN ARGUMENT
+_RELEASE_ARG = {"release", "decref", "give", "free", "recycle",
+                "put_back"}
+# verbs that release THE RECEIVER itself
+_RELEASE_SELF = {"close", "join", "release"}
+# container mutators that take ownership of their argument
+_CONSUMERS = {"append", "extend", "add", "insert", "appendleft",
+              "put", "push"}
+# pure readers: never consume ownership, never risky
+_SAFE_BUILTINS = {
+    "len", "int", "float", "str", "bool", "bytes", "list", "dict",
+    "tuple", "set", "frozenset", "sorted", "reversed", "min", "max",
+    "sum", "abs", "range", "enumerate", "zip", "isinstance",
+    "issubclass", "getattr", "hasattr", "repr", "id", "print",
+    "format", "type", "round", "divmod", "memoryview", "iter",
+    "next", "any", "all", "map", "filter", "vars", "hash",
+}
+_SAFE_DOTTED = {
+    "np.asarray", "numpy.asarray", "np.prod", "numpy.prod",
+    "time.perf_counter", "time.monotonic", "time.time",
+    "os.path.basename", "os.path.join", "weakref.ref",
+    "life.active_ledger",
+}
+# the ownership ledger's own instrumentation (runtime/life.py): it
+# OBSERVES acquire/release, it never owns — `led.acquire(...)` inside
+# a pool method must not read as a risky gap for the very grant it is
+# recording
+_LEDGERISH = {"led", "ledger"}
+# observability / bookkeeping method names: reading, not consuming
+_SAFE_ATTR = re.compile(
+    r"^(emit|emit_span|span|note|record|observe|mark|log|debug|info"
+    r"|warning|warn|error|exception|get|items|keys|values|stats"
+    r"|snapshot|is_alive|is_set|format|encode|decode|copy|count"
+    r"|index|startswith|endswith|settimeout|setsockopt|split"
+    r"|rpartition|partition|strip|lower|upper)")
+
+
+# ------------------------------------------------------- classification
+
+def _recv_name(expr: ast.AST) -> str:
+    """The receiver's last path element: ``self.pool`` -> ``pool``."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    return ""
+
+
+def _recv_root_is(expr: ast.AST, name: str) -> bool:
+    """True when the receiver chain of ``expr`` starts at ``name``
+    (``x.close()``, ``x.sock.send()``)."""
+    node = expr
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return isinstance(node, ast.Name) and node.id == name
+
+
+def _thread_is_daemon(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+            return bool(kw.value.value)
+    return False
+
+
+def _acquire_kind(call: ast.Call, file: _File) -> Optional[str]:
+    """Resource kind when ``call`` is a recognized acquire site."""
+    d = _dotted(call.func, file) or ""
+    if d == "socket.create_connection" or d.endswith(
+            ".socket.create_connection"):
+        return "socket"
+    if d in ("socket.socket", "socket.create_server") or d.endswith(
+            (".socket.socket", ".socket.create_server")):
+        return "socket"
+    if d == "threading.Thread" or d.endswith(".threading.Thread"):
+        return None if _thread_is_daemon(call) else "thread"
+    if d == "open":
+        return "file"
+    if d == "PageTransfer" or d.endswith(".PageTransfer"):
+        return "transfer"
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        recv = _recv_name(f.value)
+        if f.attr == "alloc_pages":
+            return "page"
+        if (f.attr == "acquire" and _POOLISH.search(recv)
+                and not _LOCKISH.search(recv)):
+            return "slot"
+        if f.attr == "take" and _POOLISH.search(recv):
+            return "buffer"
+        if f.attr == "accept" and _LISTENISH.search(recv):
+            return "socket"
+    return None
+
+
+_EXC_NAME = re.compile(
+    r"^[A-Z]\w*(Error|Exception|Full|Timeout|Interrupt|Exit|Injected"
+    r"|Exceeded|Warning)$")
+
+
+def _is_safe_call(call: ast.Call, file: _File) -> bool:
+    f = call.func
+    if isinstance(f, ast.Name):
+        # exception construction reads its args; the Raise walk owns
+        # the leak verdict for the unwind itself
+        return f.id in _SAFE_BUILTINS or bool(_EXC_NAME.match(f.id))
+    d = _dotted(f, file) or ""
+    if d in _SAFE_DOTTED or d.split(".", 1)[-1] in _SAFE_DOTTED:
+        return True
+    # import-resolved origins keep the full module path
+    # (`pkg.runtime.life.active_ledger`): match the known-safe tail
+    if any(d.endswith("." + safe) for safe in _SAFE_DOTTED):
+        return True
+    if isinstance(f, ast.Attribute):
+        if any(_recv_root_is(f.value, n) for n in _LEDGERISH):
+            return True
+        return bool(_SAFE_ATTR.match(f.attr))
+    return False
+
+
+def _bare_names(expr: ast.AST) -> Set[str]:
+    """Bare ``Name`` loads DIRECTLY in ``expr``: the expression
+    itself, or elements of a tuple/list/set/dict-values one level
+    down. ``memoryview(x.view())`` deliberately does NOT surface
+    ``x`` — a derived view is usage, not an ownership move."""
+    out: Set[str] = set()
+    stack = [expr]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.Name):
+            out.add(node.id)
+        elif isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            stack.extend(node.elts)
+        elif isinstance(node, ast.Dict):
+            stack.extend(v for v in node.values if v is not None)
+        elif isinstance(node, ast.Starred):
+            stack.append(node.value)
+    return out
+
+
+def _all_names(expr: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(expr) if isinstance(n, ast.Name)}
+
+
+def _iter_calls(node: ast.AST, through_defs: bool = False):
+    """Every Call lexically in ``node``, pruning def/class bodies
+    BELOW the root (a nested function runs where it's called, not
+    where it's written). The root itself is always entered, so
+    passing a FunctionDef walks that function's own body. With
+    ``through_defs`` nothing is pruned (whole-module harvests)."""
+    if isinstance(node, ast.Call):
+        yield node
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        if not through_defs and isinstance(
+                n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                    ast.ClassDef)):
+            continue
+        if isinstance(n, ast.Call):
+            yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+# ------------------------------------------------------------ the model
+
+# binding states
+_LIVE = "live"
+_RELEASED = "released"
+_MOVED = "moved"
+
+
+@dataclass
+class _Binding:
+    name: str
+    kind: str
+    line: int
+    states: Set[str] = field(default_factory=lambda: {_LIVE})
+    release_line: int = 0
+    reported: bool = False
+
+
+@dataclass
+class _StoreSite:
+    cls: str
+    attr: str
+    kind: str
+    method: str
+    path: str
+    line: int
+
+
+@dataclass
+class _Ctx:
+    files: Sequence[_File]
+    index: Dict[Tuple[Tuple[str, ...], str], _Func]
+    findings: List[Finding] = field(default_factory=list)
+    seen: Set[Tuple[str, int, str, str]] = field(default_factory=set)
+    # GL125: (path, cls, attr) -> [store sites]
+    stores: Dict[Tuple[str, str, str], List[_StoreSite]] = \
+        field(default_factory=dict)
+    # (path, cls) -> attrs with release evidence somewhere in the class
+    released_attrs: Dict[Tuple[str, str], Set[str]] = \
+        field(default_factory=dict)
+    # model export: kind -> {(path, line)}
+    acquire_sites: Dict[str, Set[Tuple[str, int]]] = \
+        field(default_factory=dict)
+    release_sites: Dict[str, Set[Tuple[str, int]]] = \
+        field(default_factory=dict)
+
+
+def _class_of(fn: _Func) -> str:
+    top = fn
+    while top.parent is not None:
+        top = top.parent
+    return top.qual.rsplit(".", 1)[0] if "." in top.qual else ""
+
+
+def _emit(ctx: _Ctx, path: str, line: int, rule: str, key: str,
+          msg: str) -> None:
+    k = (path, line, rule, key)
+    if k in ctx.seen:
+        return
+    ctx.seen.add(k)
+    ctx.findings.append(Finding(path, line, 0, rule, msg))
+
+
+# ------------------------------------------------- class-level indexing
+
+def _index_class_releases(fn: _Func, ctx: _Ctx) -> None:
+    """Release EVIDENCE through ``self.<attr>`` anywhere in a class:
+    ``pool.release(self._held.pop(k))``, ``self._sock.close()``,
+    ``for t in self._threads: t.join()`` all mark their attr as
+    owned-released — GL125 only fires when NO such owner exists."""
+    cls = _class_of(fn)
+    if not cls:
+        return
+    key = (fn.file.path, cls)
+    owned = ctx.released_attrs.setdefault(key, set())
+
+    def note_self_attrs(expr: ast.AST) -> None:
+        for n in ast.walk(expr):
+            if (isinstance(n, ast.Attribute)
+                    and isinstance(n.value, ast.Name)
+                    and n.value.id == "self"):
+                owned.add(n.attr)
+
+    for call in _iter_calls(fn.node):
+        f = call.func
+        if not isinstance(f, ast.Attribute):
+            continue
+        if f.attr in _RELEASE_ARG:
+            for a in list(call.args) + [k.value for k in call.keywords]:
+                note_self_attrs(a)
+        if f.attr in _RELEASE_SELF:
+            note_self_attrs(f.value)
+    # iteration-release: `for x in self._threads: x.join()` — the
+    # loop target carries the attr's contents
+    for node in ast.walk(fn.node):
+        if not isinstance(node, (ast.For, ast.AsyncFor)):
+            continue
+        # any self.X the iterable mentions (`self._held`,
+        # `list(self._held.values())`, `self._held.items()`) feeds
+        # the loop target — a release of the target releases X
+        src_attrs = {
+            n.attr for n in ast.walk(node.iter)
+            if (isinstance(n, ast.Attribute)
+                and isinstance(n.value, ast.Name)
+                and n.value.id == "self")}
+        src_attrs.discard("pool")
+        targets = {
+            t.id for t in ast.walk(node.target)
+            if isinstance(t, ast.Name)}
+        if not src_attrs or not targets:
+            continue
+        for call in _iter_calls(node):
+            f = call.func
+            if not isinstance(f, ast.Attribute):
+                continue
+            if f.attr in _RELEASE_SELF and any(
+                    _recv_root_is(f.value, t) for t in targets):
+                owned.update(src_attrs)
+            if f.attr in _RELEASE_ARG and any(
+                    targets & _bare_names(a) for a in call.args):
+                owned.update(src_attrs)
+
+
+# --------------------------------------------------- per-function walk
+
+def _scan_function(fn: _Func, ctx: _Ctx) -> None:
+    file = fn.file
+    cls = _class_of(fn)
+    method = fn.name
+
+    def leak(b: _Binding, line: int, why: str) -> None:
+        if b.reported:
+            return
+        b.reported = True
+        _emit(ctx, file.path, b.line, "GL123", b.name,
+              f"`{b.name}` ({b.kind}) acquired here {why} — the "
+              "resource escapes without release, transfer, or "
+              "try/finally protection; a leaked "
+              f"{b.kind} is capacity another request never gets "
+              "back (release it, move ownership explicitly, or "
+              "guard the gap with try/finally)"
+              + (f" [escape at line {line}]" if line != b.line
+                 else ""))
+
+    def double(b: _Binding, line: int) -> None:
+        _emit(ctx, file.path, line, "GL124", b.name,
+              f"release of `{b.name}` ({b.kind}) which every path "
+              f"already released (at line {b.release_line}) — a "
+              "double-release corrupts the pool free list (or frees "
+              "another holder's grant under it) with no named error "
+              "at the true culprit; release exactly once, on exactly "
+              "one path")
+
+    def _self_attr_of(target: ast.AST) -> Optional[str]:
+        """``self.X`` or ``self.X[k]`` store targets -> ``X``."""
+        t = target
+        if isinstance(t, ast.Subscript):
+            t = t.value
+        if (isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"):
+            return t.attr
+        return None
+
+    def note_store(b: _Binding, target: ast.AST, line: int) -> None:
+        attr = _self_attr_of(target)
+        if cls and b.kind in _POOLED and attr is not None:
+            ctx.stores.setdefault(
+                (file.path, cls, attr), []).append(
+                _StoreSite(cls, attr, b.kind, method,
+                           file.path, line))
+
+    def process_calls(st: ast.AST, binds: Dict[str, _Binding],
+                      fin: Set[str], exc: Set[str]) -> None:
+        """Releases, consuming transfers and risky-gap checks for
+        every call in one statement."""
+        calls = list(_iter_calls(st))
+        disposed_here: Set[str] = set()
+        for call in calls:
+            f = call.func
+            attr = f.attr if isinstance(f, ast.Attribute) else None
+            argnames: Set[str] = set()
+            for a in list(call.args) + [k.value for k in call.keywords]:
+                argnames |= _bare_names(a)
+            if attr in _RELEASE_ARG:
+                for name in sorted(argnames & set(binds)):
+                    b = binds[name]
+                    if b.states == {_RELEASED}:
+                        double(b, call.lineno)
+                    b.states = {_RELEASED}
+                    b.release_line = call.lineno
+                    disposed_here.add(name)
+                continue
+            if attr in _RELEASE_SELF and isinstance(f, ast.Attribute):
+                root = f.value
+                if isinstance(root, ast.Name) and root.id in binds:
+                    b = binds[root.id]
+                    if b.states == {_RELEASED}:
+                        double(b, call.lineno)
+                    b.states = {_RELEASED}
+                    b.release_line = call.lineno
+                    disposed_here.add(root.id)
+                    continue
+            if _is_safe_call(call, file):
+                continue
+            consuming = (attr in _CONSUMERS
+                         or not isinstance(f, ast.Attribute)
+                         or not _SAFE_ATTR.match(attr or ""))
+            if consuming:
+                for name in sorted(argnames & set(binds)):
+                    b = binds[name]
+                    if _LIVE in b.states:
+                        b.states = {_MOVED}
+                        disposed_here.add(name)
+        # risky-gap: any remaining call that could raise while an
+        # earlier acquire is still undisposed and unprotected.
+        # Pool-protocol calls — another acquire, a release/handoff of
+        # a SIBLING resource — are the resource discipline itself,
+        # not the risky work it protects against; counting them would
+        # demand try/finally around every multi-resource function
+        for call in calls:
+            if _is_safe_call(call, file):
+                continue
+            attr = (call.func.attr
+                    if isinstance(call.func, ast.Attribute) else None)
+            if attr in _RELEASE_ARG or attr in _RELEASE_SELF \
+                    or attr in _CONSUMERS:
+                continue
+            if _acquire_kind(call, file) is not None:
+                continue
+            argnames = set()
+            for a in list(call.args) + [k.value for k in call.keywords]:
+                argnames |= _bare_names(a)
+            for name, b in sorted(binds.items()):
+                if name in disposed_here or name in argnames:
+                    continue
+                if _LIVE not in b.states or b.reported:
+                    continue
+                if name in fin or name in exc:
+                    continue
+                if b.line == getattr(st, "lineno", b.line):
+                    continue  # acquired by this very statement
+                if isinstance(call.func, ast.Attribute) and \
+                        _recv_root_is(call.func.value, name):
+                    continue  # using the resource is not an escape
+                leak(b, call.lineno,
+                     "with a call that can raise before any release "
+                     f"or handoff (`{ast.unparse(call.func)}` at "
+                     f"line {call.lineno})")
+
+    def dispose_names(expr: ast.AST, binds: Dict[str, _Binding],
+                      target: Optional[ast.AST] = None,
+                      line: int = 0) -> None:
+        for name in sorted(_bare_names(expr) & set(binds)):
+            b = binds[name]
+            if _LIVE in b.states:
+                b.states = {_MOVED}
+                if target is not None:
+                    note_store(b, target, line)
+
+    def acquire_target(st: ast.Assign) -> Optional[str]:
+        if len(st.targets) != 1:
+            return None
+        t = st.targets[0]
+        if isinstance(t, ast.Name):
+            return t.id
+        if (isinstance(t, ast.Tuple) and t.elts
+                and isinstance(t.elts[0], ast.Name)):
+            return t.elts[0].id
+        return None
+
+    def find_acquire(expr: ast.AST) -> Optional[Tuple[str, int]]:
+        for call in _iter_calls(expr):
+            kind = _acquire_kind(call, file)
+            if kind is not None:
+                return kind, call.lineno
+        return None
+
+    def scan_disposals(stmts: Sequence[ast.stmt]) -> Set[str]:
+        """Names a finally/except block releases or moves — the
+        protection pre-scan."""
+        out: Set[str] = set()
+        for st in stmts:
+            for call in _iter_calls(st):
+                f = call.func
+                if isinstance(f, ast.Attribute) and (
+                        f.attr in _RELEASE_SELF
+                        and isinstance(f.value, ast.Name)):
+                    out.add(f.value.id)
+                if _is_safe_call(call, file):
+                    continue
+                # release verbs, consumers, AND any non-reader call
+                # taking the bare name (an `except` that hands the
+                # resource to an abort/cleanup helper protects it)
+                for a in list(call.args) + [
+                        k.value for k in call.keywords]:
+                    out |= _bare_names(a)
+        return out
+
+    def copy_binds(binds: Dict[str, _Binding]) -> Dict[str, _Binding]:
+        return {k: _Binding(b.name, b.kind, b.line, set(b.states),
+                            b.release_line, b.reported)
+                for k, b in binds.items()}
+
+    def merge(into: Dict[str, _Binding],
+              branches: List[Dict[str, _Binding]]) -> None:
+        into.clear()
+        names: Set[str] = set()
+        for br in branches:
+            names |= set(br)
+        for name in names:
+            present = [br[name] for br in branches if name in br]
+            b0 = present[0]
+            merged = _Binding(b0.name, b0.kind, b0.line, set(),
+                              b0.release_line,
+                              any(b.reported for b in present))
+            for b in present:
+                merged.states |= b.states
+                merged.release_line = max(merged.release_line,
+                                          b.release_line)
+            into[name] = merged
+
+    def walk(stmts: Sequence[ast.stmt], binds: Dict[str, _Binding],
+             fin: Set[str], exc: Set[str]) -> bool:
+        """Returns True when every path through ``stmts``
+        terminated (return/raise/break/continue)."""
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue
+            if isinstance(st, ast.Return):
+                if st.value is not None:
+                    process_calls(st, binds, fin, exc)
+                    dispose_names(st.value, binds)
+                for name, b in sorted(binds.items()):
+                    if (_LIVE in b.states and not b.reported
+                            and name not in fin):
+                        leak(b, st.lineno,
+                             "but this return path skips its "
+                             f"release (return at line {st.lineno})")
+                return True
+            if isinstance(st, ast.Raise):
+                process_calls(st, binds, fin, exc)
+                for name, b in sorted(binds.items()):
+                    if (_LIVE in b.states and not b.reported
+                            and name not in fin and name not in exc):
+                        leak(b, st.lineno,
+                             "but this raise unwinds past it "
+                             f"(raise at line {st.lineno})")
+                return True
+            if isinstance(st, (ast.Break, ast.Continue)):
+                return True
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                for item in st.items:
+                    process_calls(item.context_expr, binds, fin, exc)
+                if walk(st.body, binds, fin, exc):
+                    return True
+                continue
+            if isinstance(st, ast.Assign):
+                process_calls(st, binds, fin, exc)
+                acq = find_acquire(st.value)
+                tgt = acquire_target(st)
+                if acq is not None and tgt is not None:
+                    kind, line = acq
+                    binds[tgt] = _Binding(tgt, kind, line)
+                    continue
+                if acq is not None and len(st.targets) == 1 and \
+                        isinstance(st.targets[0],
+                                   (ast.Attribute, ast.Subscript)):
+                    # self.attr = acquire() / self.attr[k] = acquire():
+                    # stored straight into an owner object — a GL125
+                    # store site when pooled
+                    kind, line = acq
+                    attr = _self_attr_of(st.targets[0])
+                    if cls and kind in _POOLED and attr is not None:
+                        ctx.stores.setdefault(
+                            (file.path, cls, attr), []).append(
+                            _StoreSite(cls, attr, kind, method,
+                                       file.path, line))
+                    continue
+                for t in st.targets:
+                    if isinstance(t, (ast.Attribute, ast.Subscript)):
+                        dispose_names(st.value, binds, target=t,
+                                      line=st.lineno)
+                    elif isinstance(t, ast.Name) and isinstance(
+                            st.value, ast.Name):
+                        # alias: `y = x` moves responsibility to y
+                        dispose_names(st.value, binds)
+                    elif isinstance(t, ast.Name) and t.id in binds \
+                            and _LIVE in binds[t.id].states:
+                        # overwrite of a live binding: tracking ends
+                        # (aliasing makes a leak verdict unsound)
+                        del binds[t.id]
+                continue
+            if isinstance(st, (ast.If,)):
+                process_calls(st.test, binds, fin, exc)
+                b1 = copy_binds(binds)
+                t1 = walk(st.body, b1, fin, exc)
+                b2 = copy_binds(binds)
+                t2 = walk(st.orelse, b2, fin, exc)
+                live = [b for b, t in ((b1, t1), (b2, t2)) if not t]
+                if not live:
+                    return True
+                merge(binds, live)
+                continue
+            if isinstance(st, (ast.For, ast.AsyncFor, ast.While)):
+                if isinstance(st, ast.While):
+                    process_calls(st.test, binds, fin, exc)
+                else:
+                    process_calls(st.iter, binds, fin, exc)
+                before = set(binds)
+                body = copy_binds(binds)
+                walk(st.body, body, fin, exc)
+                for name in sorted(set(body) - before):
+                    b = body[name]
+                    if _LIVE in b.states and not b.reported:
+                        leak(b, b.line,
+                             "inside this loop body and not released "
+                             "or handed off before the iteration "
+                             "ends — every later iteration leaks the "
+                             "previous grant")
+                for name in before & set(body):
+                    binds[name] = body[name]
+                walk(st.orelse, binds, fin, exc)
+                continue
+            if isinstance(st, ast.Try):
+                fin2 = fin | scan_disposals(st.finalbody)
+                exc2 = exc | set().union(*(
+                    [scan_disposals(h.body) for h in st.handlers]
+                    or [set()]))
+                pre = copy_binds(binds)
+                t_body = walk(st.body, binds, fin2, exc2)
+                if not t_body:
+                    t_body = walk(st.orelse, binds, fin2, exc2)
+                exits: List[Dict[str, _Binding]] = \
+                    [] if t_body else [binds]
+                for h in st.handlers:
+                    hb = copy_binds(pre)
+                    if not walk(h.body, hb, fin, exc):
+                        exits.append(hb)
+                if not exits:
+                    # every path terminated before finally; walk the
+                    # finalbody for its own findings, then stop
+                    walk(st.finalbody, copy_binds(pre), fin, exc)
+                    return True
+                merged = {}
+                merge(merged, exits)
+                binds.clear()
+                binds.update(merged)
+                if walk(st.finalbody, binds, fin, exc):
+                    return True
+                continue
+            if isinstance(st, (ast.Expr, ast.AugAssign, ast.AnnAssign,
+                               ast.Assert, ast.Delete)):
+                process_calls(st, binds, fin, exc)
+                continue
+            process_calls(st, binds, fin, exc)
+        return False
+
+    binds: Dict[str, _Binding] = {}
+    terminated = walk(fn.node.body, binds, set(), set())
+    if not terminated:
+        for name, b in sorted(binds.items()):
+            if _LIVE in b.states and not b.reported:
+                leak(b, b.line,
+                     "and still owned when the function falls off "
+                     "its end — no release, no transfer, no owner")
+
+
+# --------------------------------------------------------------- GL125
+
+def _shared_owner_ambiguity(ctx: _Ctx) -> None:
+    for key in sorted(ctx.stores):
+        path, cls, attr = key
+        sites = ctx.stores[key]
+        methods = sorted({s.method for s in sites})
+        if len(methods) < 2:
+            continue
+        if attr in ctx.released_attrs.get((path, cls), set()):
+            continue
+        anchor = min(sites, key=lambda s: s.line)
+        kinds = sorted({s.kind for s in sites})
+        _emit(ctx, path, anchor.line, "GL125", f"{cls}.{attr}",
+              f"pooled {'/'.join(kinds)} resources are stored into "
+              f"`self.{attr}` from {len(methods)} call paths "
+              f"(`{'`, `'.join(methods)}`) but no method of `{cls}` "
+              f"ever releases through `self.{attr}` — ownership is "
+              "ambiguous, so every path assumes another is the owner "
+              "and nobody frees; give the attribute ONE releasing "
+              "owner (a close()/drain() that empties it) or release "
+              "before storing")
+
+
+# ------------------------------------------------------------ top level
+
+def check_lifecycle(files: Sequence[_File], index,
+                    findings: List[Finding]) -> None:
+    """The GL123/GL124/GL125 pass :func:`..rules.analyze_files` runs
+    after the concurrency rules (same file set, same index)."""
+    ctx = _Ctx(files=files, index=index)
+    for file in files:
+        for fn in file.funcs:
+            if fn.parent is None:
+                _index_class_releases(fn, ctx)
+    for file in files:
+        for fn in file.funcs:
+            _scan_function(fn, ctx)
+    _shared_owner_ambiguity(ctx)
+    findings.extend(ctx.findings)
+
+
+def _harvest_sites(ctx: _Ctx, base: str) -> None:
+    for file in ctx.files:
+        rel = os.path.relpath(file.path, base)
+        for call in _iter_calls(file.tree, through_defs=True):
+            kind = _acquire_kind(call, file)
+            if kind is None:
+                # the MODEL admits daemon threads too: the leak walk
+                # exempts them (the process won't hang on one), but
+                # the runtime ledger liveness-audits every spawn, so
+                # the site must be one the model knows
+                d = _dotted(call.func, file) or ""
+                if d == "threading.Thread" or d.endswith(
+                        ".threading.Thread"):
+                    kind = "thread"
+            if kind is not None:
+                ctx.acquire_sites.setdefault(kind, set()).add(
+                    (rel, call.lineno))
+            f = call.func
+            if isinstance(f, ast.Attribute) and f.attr == "record_admit":
+                # WAL admission is an acquire in the ledger's eyes
+                # (held until a terminal record); the leak walk leaves
+                # it to graftheal's own redelivery machinery
+                ctx.acquire_sites.setdefault("journal", set()).add(
+                    (rel, call.lineno))
+            if isinstance(f, ast.Attribute) and (
+                    f.attr in _RELEASE_ARG or f.attr in _RELEASE_SELF):
+                ctx.release_sites.setdefault("any", set()).add(
+                    (rel, call.lineno))
+
+
+@dataclass
+class LifecycleModel:
+    """The static resource model the runtime ledger audits against.
+
+    ``acquire_sites`` maps each resource kind to the package call
+    sites (relpath, line) the static pass recognizes as acquires —
+    the key :mod:`..runtime.life`'s holder attribution uses.
+    ``release_sites`` is the union of recognized release sites. The
+    realized acquire sites recorded by an armed
+    :class:`~..runtime.life.OwnershipLedger` from package frames must
+    be a subset of ``acquire_sites`` (``audit_sites``) — an acquire
+    the static pass can't see is a named finding, never silence."""
+    acquire_sites: Dict[str, Set[Tuple[str, int]]]
+    release_sites: Dict[str, Set[Tuple[str, int]]]
+
+    def admits(self, kind: str, site: Tuple[str, int]) -> bool:
+        if site in self.acquire_sites.get(kind, ()):
+            return True
+        # kinds blur at shared plumbing (a socket accept attributed
+        # to a wire-server line the model filed under another kind):
+        # any-kind admission still proves the SITE is modeled
+        return any(site in sites
+                   for sites in self.acquire_sites.values())
+
+    def all_sites(self) -> Set[Tuple[str, int]]:
+        out: Set[Tuple[str, int]] = set()
+        for sites in self.acquire_sites.values():
+            out |= sites
+        return out
+
+
+def static_lifecycle_model(paths: Optional[Sequence[str]] = None,
+                           package_parent: Optional[str] = None
+                           ) -> LifecycleModel:
+    """Build the package resource model standalone (no findings) —
+    the export :mod:`..runtime.life` cross-checks realized acquire
+    sites against. Paths default to the whole package."""
+    from .lint import discover, package_root
+    from .rules import _collect_file, _fill_owners
+
+    base = package_parent or os.path.dirname(package_root())
+    files: List[_File] = []
+    for path in discover(list(paths) if paths else [package_root()]):
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                src = fh.read()
+            f = _collect_file(path, src, _modkey_for(path, base))
+        except SyntaxError:
+            continue
+        _fill_owners(f)
+        files.append(f)
+    index: Dict[Tuple[Tuple[str, ...], str], _Func] = {}
+    for f in files:
+        for name, fn in f.by_name.items():
+            index.setdefault((f.modkey, name), fn)
+    ctx = _Ctx(files=files, index=index)
+    _harvest_sites(ctx, base)
+    return LifecycleModel(acquire_sites=dict(ctx.acquire_sites),
+                          release_sites=dict(ctx.release_sites))

@@ -176,6 +176,27 @@ RULES: Dict[str, str] = {
              "PageTransfer hot path (graftlink's discipline: the "
              "header prefix plus raw numpy memoryview segments ride "
              "a scatter-gather sendmsg; nothing is assembled)",
+    "GL123": "resource acquired with an escaping path that skips its "
+             "release (pool grant / socket / thread / file / "
+             "PageTransfer still owned at an early return, an "
+             "unwinding raise, a risky call with no try/finally, or "
+             "a loop iteration end): the leaked grant is capacity "
+             "another request never gets back — release it, move "
+             "ownership explicitly (return / store-into-owner / "
+             "consuming call), or guard the gap",
+    "GL124": "double-release: a release of a resource that EVERY "
+             "path already released (straight-line repeat, a finally "
+             "duplicating the body's release, a release after both "
+             "branches released): the pool free list corrupts (or "
+             "another holder's live grant is freed under it) with no "
+             "named error at the true culprit — release exactly "
+             "once, on exactly one path",
+    "GL125": "ownership ambiguity: a pooled resource (slot/page/"
+             "buffer) stored into the same self.<attr> from two or "
+             "more call paths while NO method of the class releases "
+             "through that attribute — every path assumes another "
+             "is the owner and nobody frees; give the attribute one "
+             "releasing owner or release before storing",
 }
 
 # wrappers that COMPILE (jit family) — GL105/106/107/108 anchor on these
@@ -1787,6 +1808,10 @@ def analyze_files(paths: Sequence[str],
     # file set and index (imported here to avoid a module cycle)
     from .concurrency import check_concurrency
     check_concurrency(files, index, findings)
+    # graftlife: the GL123/GL124/GL125 resource-lifecycle pass —
+    # same file set and index, same late import
+    from .lifecycle import check_lifecycle
+    check_lifecycle(files, index, findings)
 
     findings.sort(key=lambda x: (x.path, x.line, x.rule))
     return findings

@@ -90,6 +90,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from . import life
 from . import scope as graftscope
 from .faults import (GraftFaultError, PeerLostError, maybe_fault,
                      register_site, retry_with_backoff)
@@ -760,6 +761,10 @@ class RequestJournal:
         if os.path.exists(path):
             self._replay_file()
         self._fh = open(path, "a", encoding="utf-8")
+        led = life.active_ledger()
+        if led is not None:
+            led.acquire("file", id(self._fh), obj=self._fh,
+                        holder=path, depth=1)
         # self-heal a torn tail BEFORE the first append: a crash
         # mid-append leaves the last line without its newline, and
         # appending straight after it would merge the next record
@@ -888,6 +893,10 @@ class RequestJournal:
                            "prompt": entry.prompt,
                            "max_new_tokens": entry.max_new_tokens,
                            "eos_id": entry.eos_id}])
+        led = life.active_ledger()
+        if led is not None:
+            led.acquire("journal", (id(self), request.uid),
+                        holder=request.uid)
         self._sync_durable()
 
     def note_events(self, events) -> None:
@@ -898,6 +907,7 @@ class RequestJournal:
         and a silent mismatch would double-deliver different bytes."""
         ops: List[Dict] = []
         fresh: Dict[object, List[int]] = {}
+        settled: List[object] = []
         with self._mu:
             for request, token, finished in events:
                 entry = self._entries.get(request.uid)
@@ -918,6 +928,8 @@ class RequestJournal:
                     entry.tokens.append(int(token))
                     fresh.setdefault(request.uid, []).append(int(token))
                 if finished:
+                    if not entry.done:
+                        settled.append(request.uid)
                     entry.done = True
                     entry.state = request.state
                     entry.reason = request.finish_reason
@@ -929,6 +941,10 @@ class RequestJournal:
                                 "state": request.state,
                                 "reason": request.finish_reason})
             self._append(ops)
+        led = life.active_ledger()
+        if led is not None:
+            for uid in settled:
+                led.release("journal", (id(self), uid))
         if ops:
             self._sync_durable()
 
@@ -949,6 +965,9 @@ class RequestJournal:
             self._append([{"op": "done", "uid": request.uid,
                            "state": entry.state,
                            "reason": entry.reason}])
+        led = life.active_ledger()
+        if led is not None:
+            led.release("journal", (id(self), request.uid))
         self._sync_durable()
 
     def record_failed(self, request) -> None:
@@ -964,6 +983,9 @@ class RequestJournal:
             self._append([{"op": "done", "uid": request.uid,
                            "state": request.state,
                            "reason": request.finish_reason}])
+        led = life.active_ledger()
+        if led is not None:
+            led.release("journal", (id(self), request.uid))
         self._sync_durable()
 
     def close(self, compact: bool = True) -> None:

@@ -95,6 +95,7 @@ from typing import (Callable, Deque, Dict, List, Optional, Sequence,
 
 import numpy as np
 
+from . import life
 from . import scope as graftscope
 from .faults import (FaultTimeout, GraftFaultError, active_plan,
                      maybe_fault, register_site, retry_with_backoff,
@@ -292,6 +293,25 @@ def send_frame(sock: socket.socket, header: Dict,
     return total
 
 
+def _hard_close(sock: socket.socket) -> None:
+    """``shutdown(SHUT_RDWR)`` then ``close``: a bare ``close()``
+    does NOT wake a sibling thread blocked in ``recv`` on the same
+    socket — it parks until the io timeout (30s by default), which
+    the graftlife drain audit names as a leaked thread. ``shutdown``
+    aborts the blocked recv immediately, so teardown latency is a
+    scheduler tick, not ``DEFAULT_IO_TIMEOUT_S``."""
+    shut = getattr(sock, "shutdown", None)  # test doubles may lack it
+    if shut is not None:
+        try:
+            shut(socket.SHUT_RDWR)
+        except OSError:
+            pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
 def _recv_exact_into(sock: socket.socket, view: memoryview) -> None:
     """Fill ``view`` completely from the socket (``recv_into`` — no
     chunk-list join copy)."""
@@ -364,26 +384,37 @@ def recv_frame(sock: socket.socket, *, idle_ok: bool = False,
             f"{type(header).__name__}")
     arrays: List[np.ndarray] = []
     total = len(head) + hlen
-    for desc in header.pop("_arrays", ()):
-        nbytes = int(desc["nbytes"])
-        dtype = _dtype_from_name(desc["dtype"])
-        shape = [int(d) for d in desc["shape"]]
-        want = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
-        if nbytes != want:
-            # a descriptor whose byte count contradicts its own
-            # shape x dtype is corruption — named, typed, and the
-            # connection drops; never a raw reshape ValueError that
-            # bypasses the framing-error handling
-            raise WireError(
-                f"payload descriptor claims {nbytes} bytes for "
-                f"shape {shape} {dtype.name} ({want} bytes); "
-                "desynced or corrupted stream")
-        arr = (pool.take(shape, dtype) if pool is not None
-               else np.empty(shape, dtype=dtype))
-        _recv_exact_into(
-            sock, memoryview(arr.reshape(-1).view(np.uint8)))
-        total += nbytes
-        arrays.append(arr)
+    try:
+        for desc in header.pop("_arrays", ()):
+            nbytes = int(desc["nbytes"])
+            dtype = _dtype_from_name(desc["dtype"])
+            shape = [int(d) for d in desc["shape"]]
+            want = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+            if nbytes != want:
+                # a descriptor whose byte count contradicts its own
+                # shape x dtype is corruption — named, typed, and the
+                # connection drops; never a raw reshape ValueError
+                # that bypasses the framing-error handling
+                raise WireError(
+                    f"payload descriptor claims {nbytes} bytes for "
+                    f"shape {shape} {dtype.name} ({want} bytes); "
+                    "desynced or corrupted stream")
+            arr = (pool.take(shape, dtype) if pool is not None
+                   else np.empty(shape, dtype=dtype))
+            arrays.append(arr)
+            _recv_exact_into(
+                sock, memoryview(arr.reshape(-1).view(np.uint8)))
+            total += nbytes
+    except BaseException:
+        # mid-frame failure (peer died, injected fault, corrupt
+        # descriptor): the frame dies but its loans must not — every
+        # buffer taken for this frame goes back to the pool before
+        # the error poisons the lane, or the pool bleeds one buffer
+        # set per dropped connection
+        if pool is not None:
+            for arr in arrays:
+                pool.give(arr)
+        raise
     _note_bytes(recv=total)
     return header, arrays
 
@@ -436,6 +467,9 @@ class BufferPool:
                 self._loaned = {i: r for i, r in self._loaned.items()
                                 if r() is not None}
             self._loaned[id(arr)] = weakref.ref(arr)
+        led = life.active_ledger()
+        if led is not None:
+            led.acquire("buffer", id(arr), obj=arr)
         return arr
 
     def give(self, arr) -> bool:
@@ -445,18 +479,25 @@ class BufferPool:
         a no-op returning False."""
         if not isinstance(arr, np.ndarray):
             return False
+        pooled = False
         with self._mu:
             ref = self._loaned.pop(id(arr), None)
             if ref is None or ref() is not arr:
                 return False
-            if not arr.flags["C_CONTIGUOUS"] or arr.base is not None:
-                return False
-            stack = self._free.setdefault(
-                self._key(arr.shape, arr.dtype), [])
-            if len(stack) < self._max_per_key:
-                stack.append(arr)
-                return True
-        return False
+            # the loan record is consumed from here down: whether the
+            # buffer is re-pooled or merely dropped, its OWNERSHIP has
+            # returned to the pool — the ledger hold ends either way
+            ok = (arr.flags["C_CONTIGUOUS"] and arr.base is None)
+            if ok:
+                stack = self._free.setdefault(
+                    self._key(arr.shape, arr.dtype), [])
+                if len(stack) < self._max_per_key:
+                    stack.append(arr)
+                    pooled = True
+        led = life.active_ledger()
+        if led is not None:
+            led.release("buffer", id(arr))
+        return pooled
 
     def stats(self) -> Dict[str, int]:
         with self._mu:
@@ -568,6 +609,10 @@ class _Lane:
                         target=self._recv_loop,
                         args=(self._sock, self._gen), daemon=True,
                         name=f"pmdt-wire-lane-{self.name}")
+                    led = life.active_ledger()
+                    if led is not None:
+                        led.acquire("thread", id(t), obj=t,
+                                    holder=t.name, depth=1)
                     t.start()
                 send_frame(self._sock, header, arrays)  # graftlint: disable=GL120 the lane lock IS the frame serializer: interleaved submits would corrupt the stream for every pending call
             except (KeyboardInterrupt, SystemExit):
@@ -585,10 +630,7 @@ class _Lane:
         sock, self._sock = self._sock, None  # graftlint: disable=GL121 caller holds self._mu (_locked contract)
         self._gen += 1  # graftlint: disable=GL121 caller holds self._mu (_locked contract)
         if sock is not None:
-            try:
-                sock.close()
-            except OSError:
-                pass
+            _hard_close(sock)  # wake the lane's blocked receiver NOW
         return pending
 
     # ---- receive side ---------------------------------------------
@@ -733,6 +775,10 @@ class WireClient:
         sock = socket.create_connection((self._host, self._port),
                                         timeout=self.io_timeout_s)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        led = life.active_ledger()
+        if led is not None:
+            led.acquire("socket", id(sock), obj=sock,
+                        holder=f"{self._host}:{self._port}", depth=1)
         return sock
 
     def _ensure(self) -> socket.socket:
@@ -749,17 +795,11 @@ class WireClient:
             # an abandoned deadline worker waking up late: the
             # connection IT used is already replaced — close the stale
             # one, never the replacement a concurrent retry opened
-            try:
-                only.close()
-            except OSError:
-                pass
+            _hard_close(only)
             return
         sock, self._sock = self._sock, None
         if sock is not None:
-            try:
-                sock.close()
-            except OSError:
-                pass
+            _hard_close(sock)
 
     def close(self) -> None:
         with self._mu:
@@ -1057,6 +1097,11 @@ class WireServer:
         self._stop = threading.Event()
         self._listener = socket.create_server((host, port))
         self._listener.settimeout(accept_timeout_s)
+        led = life.active_ledger()
+        if led is not None:
+            led.acquire("socket", id(self._listener),
+                        obj=self._listener, holder=f"{name}-listener",
+                        depth=1)
         self.host = host
         self.port = self._listener.getsockname()[1]
         self.address = f"{host}:{self.port}"
@@ -1065,6 +1110,10 @@ class WireServer:
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True,
             name=f"pmdt-{name}-accept")
+        if led is not None:
+            led.acquire("thread", id(self._accept_thread),
+                        obj=self._accept_thread,
+                        holder=self._accept_thread.name, depth=1)
 
     def start(self) -> "WireServer":
         self._accept_thread.start()
@@ -1104,10 +1153,7 @@ class WireServer:
         with self._conns_mu:
             conns, self._conns = self._conns, []
         for conn in conns:
-            try:
-                conn.close()
-            except OSError:
-                pass
+            _hard_close(conn)  # a blocked handler recv wakes NOW
 
     # ---- loops --------------------------------------------------------
     def _accept_loop(self) -> None:
@@ -1119,6 +1165,10 @@ class WireServer:
             except OSError:
                 break  # listener closed
             conn.settimeout(self._io_timeout_s)
+            led = life.active_ledger()
+            if led is not None:
+                led.acquire("socket", id(conn), obj=conn,
+                            holder="accepted-conn", depth=1)
             try:
                 conn.setsockopt(socket.IPPROTO_TCP,
                                 socket.TCP_NODELAY, 1)
@@ -1129,6 +1179,9 @@ class WireServer:
             t = threading.Thread(target=self._serve_conn, args=(conn,),
                                  daemon=True,
                                  name="pmdt-wire-conn")
+            if led is not None:
+                led.acquire("thread", id(t), obj=t, holder=t.name,
+                            depth=1)
             # prune finished handlers: a long-lived server whose
             # clients reconnect must not accrete dead Thread objects.
             # Under _conns_mu — stop() snapshots this list from

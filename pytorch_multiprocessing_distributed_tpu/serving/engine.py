@@ -121,6 +121,7 @@ from ..ops.kv_quant import (KV_DTYPES, QuantizedKV, dequantize_kv,
                             quantize_kv_np)
 from ..runtime import hbm
 from ..runtime import heal
+from ..runtime import life
 from ..runtime import scope as graftscope
 from ..runtime.faults import (DeadlineExceeded, FaultInjected,
                               FaultTimeout, GraftFaultError,
@@ -133,8 +134,9 @@ from ..utils.metrics import ServingMetrics
 from .kv_pages import PagePool, PagePoolExhausted, PrefixCache
 from .kv_slots import SlotPool
 from .scheduler import (DONE, FAILED, RUNNING, FIFOScheduler,
-                        PrefillPlan, QueueFull, Request, bucket_length,
-                        pick_draft_k, pick_horizon)
+                        PrefillPlan, QueueFull, Request,
+                        RequestWithdrawn, bucket_length, pick_draft_k,
+                        pick_horizon)
 from .spec import NgramDrafter
 
 __all__ = ["ServingEngine", "Request"]
@@ -1714,6 +1716,9 @@ class ServingEngine:
             events.append((request, token, True))
             return None
         slot = self.pool.acquire()
+        led = life.active_ledger()
+        if led is not None:
+            led.tag("slot", (id(self.pool), slot), request.uid)
         request.slot = slot
         self._running[slot] = request
         events.append((request, token, False))
@@ -2981,19 +2986,26 @@ class ServingEngine:
                         queue_wait_s=(request.admit_time
                                       - request.submit_time))
         events: List[Tuple[Request, int, bool]] = []
-        slot = self._first_token(request, int(tok0), events)
+        try:
+            slot = self._first_token(request, int(tok0), events)
+        except BaseException:
+            # the fresh pages in prep have no owner until _insert
+            # binds them — an engine fault inside the first token
+            # (slot grant, decode, injected fault) must not leak them
+            self._abort_prep(prep)
+            raise
         if slot is None:  # finished at its (transferred) first token
             self._abort_prep(prep)
         else:
-            if k_scale is not None:
-                k_dev = self._pref_sharded(QuantizedKV(
-                    jnp.asarray(k_pref), jnp.asarray(k_scale)))
-                v_dev = self._pref_sharded(QuantizedKV(
-                    jnp.asarray(v_pref), jnp.asarray(v_scale)))
-            else:
-                k_dev = self._pref_sharded(jnp.asarray(k_pref))
-                v_dev = self._pref_sharded(jnp.asarray(v_pref))
             try:
+                if k_scale is not None:
+                    k_dev = self._pref_sharded(QuantizedKV(
+                        jnp.asarray(k_pref), jnp.asarray(k_scale)))
+                    v_dev = self._pref_sharded(QuantizedKV(
+                        jnp.asarray(v_pref), jnp.asarray(v_scale)))
+                else:
+                    k_dev = self._pref_sharded(jnp.asarray(k_pref))
+                    v_dev = self._pref_sharded(jnp.asarray(v_pref))
                 self._insert(request, slot, k_dev, v_dev, length,
                              jnp.int32(int(tok0)), prep=prep)
             except Exception as e:
@@ -3002,6 +3014,39 @@ class ServingEngine:
         if self.journal is not None and events:
             self.journal.note_events(events)
         return events
+
+    def withdraw(self, uid) -> bool:
+        """Abandon one request NOW, wherever it is — QUEUED,
+        mid-chunked-prefill, or RUNNING (ROADMAP item 4: an
+        abandoned request otherwise decodes to its full token budget,
+        burning slot-steps nobody will read). Eviction rides the
+        existing quarantine machinery: a running request's slot has
+        its device gates scrubbed and its pages decref'd back to the
+        pool (ledger-verified reclaim), the WAL records the request
+        terminal (a restart never redelivers it), and every OTHER
+        slot's token stream is untouched — pinned token-exact in
+        tests/test_graftlife.py. The request leaves FAILED with
+        reason ``"withdraw"`` and :class:`~.scheduler.
+        RequestWithdrawn` on ``.error``: accounted, never silently
+        dropped. Returns True when ``uid`` was found. The fleet-level
+        cancellation verb is a thin wire wrapper over this."""
+        err = RequestWithdrawn(
+            f"request {uid} withdrawn by its client")
+        for slot, request in list(self._running.items()):
+            if request.uid == uid:
+                self._quarantine(request, err, reason="withdraw",
+                                 slot=slot)
+                return True
+        pend = self._pending
+        if pend is not None and pend.request.uid == uid:
+            self._drop_pending()
+            self._quarantine(pend.request, err, reason="withdraw")
+            return True
+        request = self.scheduler.withdraw_uid(uid)
+        if request is not None:
+            self._quarantine(request, err, reason="withdraw")
+            return True
+        return False
 
     def withdraw_queued(self, max_n: int = 1) -> List[Request]:
         """graftroute work stealing: hand up to ``max_n`` QUEUED
@@ -3021,6 +3066,22 @@ class ServingEngine:
                             req=request.uid)
             out.append(request)
         return out
+
+    def hard_reclaim(self) -> None:
+        """Release every device resource this engine holds WITHOUT
+        touching request state: the in-process analogue of the OS
+        reclaiming a SIGKILLed serving process. The router calls it
+        at the reap — the dead engine's requests are redelivered
+        from its journal under their original uids, so only the
+        residency (slots, pages, chunked-prefill prep buffers) must
+        go; marking the ``Request`` records here would corrupt the
+        redelivery path that now owns them. Idempotent."""
+        if self._pending is not None:
+            self._drop_pending()
+        for slot in list(self._running):
+            self._scrub_slot(slot)
+            del self._running[slot]
+            self.pool.release(slot)
 
     def serve(self, requests: Iterable[Tuple[Sequence[int], int]]
               ) -> List[Request]:

@@ -54,7 +54,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.kv_quant import KV_DTYPES, QuantizedKV
-from ..runtime import hbm
+from ..runtime import hbm, life
 
 
 class PagePoolExhausted(RuntimeError):
@@ -295,6 +295,10 @@ class PagePool:
             self._refs[p] = 1
         if hbm.active_ledger() is not None:
             self._note_pages_ledger()
+        led = life.active_ledger()
+        if led is not None:
+            for p in ids:
+                led.acquire("page", (id(self), p))
         return ids
 
     def incref(self, ids: Sequence[int]) -> None:
@@ -309,6 +313,7 @@ class PagePool:
         """Drop one reference per page; a page at zero returns to the
         free list (sorted — deterministic reuse)."""
         freed = False
+        led = life.active_ledger()
         for p in ids:
             if p == 0:
                 continue
@@ -318,6 +323,8 @@ class PagePool:
             if self._refs[p] == 0:
                 self._free.append(p)
                 freed = True
+                if led is not None:
+                    led.release("page", (id(self), p))
         if freed:
             self._free.sort()
             if hbm.active_ledger() is not None:
@@ -377,7 +384,11 @@ class PagePool:
         if not self._free_slots:
             raise RuntimeError("no free slots (acquire() without "
                                "checking free_slots)")
-        return self._free_slots.pop(0)
+        slot = self._free_slots.pop(0)
+        led = life.active_ledger()
+        if led is not None:
+            led.acquire("slot", (id(self), slot))
+        return slot
 
     def release(self, slot: int) -> None:
         """Return ``slot`` to the free list AND drop its page
@@ -393,6 +404,9 @@ class PagePool:
         self._free_slots.append(slot)
         self._free_slots.sort()
         self._active_host[slot] = False
+        led = life.active_ledger()
+        if led is not None:
+            led.release("slot", (id(self), slot))
 
     # ---- host position mirror (decode-window tracking) -----------------
     def note_insert(self, slot: int, position: int) -> None:

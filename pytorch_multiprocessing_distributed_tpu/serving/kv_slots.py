@@ -54,7 +54,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.kv_quant import KV_DTYPES, QuantizedKV
-from ..runtime import hbm
+from ..runtime import hbm, life
 
 
 class SlotPool:
@@ -226,7 +226,11 @@ class SlotPool:
         if not self._free:
             raise RuntimeError("no free slots (acquire() without "
                                "checking free_slots)")
-        return self._free.pop(0)
+        slot = self._free.pop(0)
+        led = life.active_ledger()
+        if led is not None:
+            led.acquire("slot", (id(self), slot))
+        return slot
 
     def release(self, slot: int) -> None:
         """Return ``slot`` to the free list. The device-side active
@@ -243,6 +247,9 @@ class SlotPool:
         self._free.append(slot)
         self._free.sort()
         self._active_host[slot] = False
+        led = life.active_ledger()
+        if led is not None:
+            led.release("slot", (id(self), slot))
 
     # ---- host position mirror (decode-window tracking) -----------------
     def note_insert(self, slot: int, position: int) -> None:

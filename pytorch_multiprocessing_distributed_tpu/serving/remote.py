@@ -710,6 +710,14 @@ class _RemoteEngine:
         self._in_flight = 0
         self._apply_live(hello.get("live"))
 
+    @property
+    def recv_pool(self):
+        """The wire client's :class:`~..runtime.wire.BufferPool` —
+        the lender of every host block this proxy's prefills return,
+        and therefore the pool a dropped
+        :class:`~.replica.PageTransfer` must give back to."""
+        return self._client.recv_pool
+
     # ---- transport ----------------------------------------------------
     def _rpc(self, verb: str, *, arrays: Sequence[np.ndarray] = (),
              deadline_s: Optional[float] = -1.0,
@@ -888,7 +896,11 @@ class _RemoteEngine:
         # the blocks' last read in this process was the wire send that
         # just completed: hand buffers the transfer pool LOANED back
         # for the next prefill receive (identity-checked — a foreign
-        # or device-converted array is a no-op)
+        # or device-converted array is a no-op). ONLY the success path
+        # gives back here — on QueueFull / replica-fatal the router
+        # retries this transfer with these very buffers, so ownership
+        # ends either at a successful splice or at the router's drop
+        # sites (PageTransfer.release)
         pool = self._client.recv_pool
         if pool is not None:
             for arr in arrays:

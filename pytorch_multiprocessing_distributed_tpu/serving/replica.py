@@ -61,6 +61,7 @@ from typing import Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..runtime import life
 from ..runtime import scope as graftscope
 from ..runtime.faults import (DeadlineExceeded, FaultInjected,
                               GraftFaultError)
@@ -97,12 +98,12 @@ class PageTransfer:
     the cross-mesh/CPU fallback and the wire representation."""
 
     __slots__ = ("request", "tok0", "k_block", "v_block", "k_scale",
-                 "v_scale", "src_rid", "src_tag", "born")
+                 "v_scale", "src_rid", "src_tag", "born", "pool")
 
     def __init__(self, request: Request, tok0: int, k_block, v_block,
                  k_scale=None, v_scale=None,
                  src_rid: Optional[str] = None,
-                 src_tag: Optional[str] = None):
+                 src_tag: Optional[str] = None, pool=None):
         self.request = request
         self.tok0 = int(tok0)
         self.k_block = k_block
@@ -116,10 +117,18 @@ class PageTransfer:
         # weights mid-stream — the router only places a tagged
         # transfer on a same-tag decode replica
         self.src_tag = src_tag
+        # the BufferPool that LOANED the host blocks (the prefill
+        # proxy's recv_pool), or None for device-resident / unpooled
+        # blocks — the owner :meth:`release` gives back to when the
+        # router DROPS this transfer instead of splicing it
+        self.pool = pool
         # handoff clock: stamped at export so the router can attribute
         # prefill->decode handoff latency (route.splice) off the TTFT
         # critical path
         self.born = time.perf_counter()
+        led = life.active_ledger()
+        if led is not None:
+            led.acquire("transfer", id(self), holder=request.uid)
 
     @property
     def resident(self) -> bool:
@@ -136,6 +145,45 @@ class PageTransfer:
         if self.k_scale is not None:
             n += int(self.k_scale.nbytes) + int(self.v_scale.nbytes)
         return n
+
+    def release(self) -> None:
+        """End this transfer's ownership of its blocks WITHOUT a
+        splice — the router's drop sites (permanent request error,
+        version-orphaned withdraw, drain) call this so a dropped
+        transfer hands its pool-loaned buffers back instead of
+        leaking one buffer set per drop. Idempotent (the pool's
+        give is identity-checked and single-shot) and a no-op for
+        device-resident or unpooled blocks. A SPLICED transfer must
+        use :meth:`consumed` instead: after the proxy's give-back the
+        pool may have re-loaned these very array objects to a new
+        frame, and a second give here would return a buffer a live
+        tenant is still writing."""
+        led = life.active_ledger()
+        if led is not None:
+            led.release("transfer", id(self))
+        pool, self.pool = self.pool, None
+        if pool is None:
+            return
+        for arr in (self.k_block, self.v_block,
+                    self.k_scale, self.v_scale):
+            if isinstance(arr, np.ndarray):
+                pool.give(arr)
+        self.k_block = self.v_block = None
+        self.k_scale = self.v_scale = None
+
+    def consumed(self) -> None:
+        """Mark a SUCCESSFULLY SPLICED transfer finished: ownership of
+        the blocks moved into the decode engine's cache (and the
+        pooled host loans were given back by the one call site that
+        provably finished reading them — the remote admit, after the
+        wire send). Ends the ledger hold without touching the pool:
+        see :meth:`release` for why a give here would corrupt it."""
+        led = life.active_ledger()
+        if led is not None:
+            led.release("transfer", id(self))
+        self.pool = None
+        self.k_block = self.v_block = None
+        self.k_scale = self.v_scale = None
 
 
 class ServingReplica:
@@ -473,7 +521,10 @@ class ServingReplica:
         transfer = PageTransfer(request, tok0, k_block, v_block,
                                 k_scale=k_scale, v_scale=v_scale,
                                 src_rid=self.rid,
-                                src_tag=self.model_tag)
+                                src_tag=self.model_tag,
+                                pool=(None if resident_fn is not None
+                                      else getattr(self.engine,
+                                                   "recv_pool", None)))
         graftscope.emit("route.transfer", cat="serving",
                         req=request.uid, src=self.rid,
                         nbytes=transfer.nbytes,

@@ -594,6 +594,7 @@ class Router:
                                     req=transfer.request.uid,
                                     error="ValueError",
                                     where="fleet_splice")
+                    transfer.release()  # dropped: loans go back
                     placed = True
                     break
                 except (KeyboardInterrupt, SystemExit):
@@ -629,6 +630,9 @@ class Router:
                                 resident=transfer.resident,
                                 nbytes=transfer.nbytes)
                 events.extend(evs)
+                transfer.consumed()  # spliced: ownership moved into
+                # the decode cache (NOT release() — the pool may have
+                # re-loaned these arrays already; see PageTransfer)
                 placed = True
                 break
             if not placed:
@@ -648,6 +652,7 @@ class Router:
                     # reached the client yet.
                     self.transfers_withdrawn += 1
                     self._assigned.pop(transfer.request.uid, None)
+                    transfer.release()  # block dropped: loans go back
                     graftscope.emit("route.transfer_withdrawn",
                                     cat="serving",
                                     req=transfer.request.uid,
@@ -677,6 +682,21 @@ class Router:
         # forever for readers that pass no ttl_s. replica_directory
         # never returns a reaped rid (test-pinned).
         self._unpublish(replica)
+        # the OS reclaims a SIGKILLed process's memory; the in-process
+        # analogue must be explicit — free the dead engine's slots,
+        # pages and prep buffers (hbm gauges and the ownership ledger
+        # both account them) without touching request state, which the
+        # redelivery below now owns. Best-effort: a REMOTE dead engine
+        # is unreachable and its real process teardown already freed
+        # everything.
+        reclaim = getattr(replica.engine, "hard_reclaim", None)
+        if reclaim is not None:
+            try:
+                reclaim()
+            except Exception as e:
+                graftscope.emit("route.reap_reclaim_failed",
+                                cat="fault", rid=replica.rid,
+                                error=type(e).__name__)
         # un-prefilled intake: no tokens yet, a plain re-route is exact
         for request in replica.withdraw_prefill():
             if not self._dispatch_request(request):
@@ -738,9 +758,39 @@ class Router:
             self.redelivery_replayed_tokens += replayed
             self.redelivery_replayed_decode_tokens += max(
                 0, replayed - 1)
+            if replica.journal is not None:
+                # ownership moved: record the handoff on the dead
+                # replica's WAL too, so a restart over it never
+                # re-runs a uid the peer now owns. Best-effort — a
+                # real SIGKILL never reaches this line for that
+                # journal, and a failing disk just leaves today's
+                # crash shape (the peer's own WAL is authoritative
+                # either way: Router.recover dedups cross-WAL).
+                try:
+                    replica.journal.record_handoff(
+                        entry, to=peer.rid)
+                except Exception as e:
+                    graftscope.emit("route.reap_handoff_failed",
+                                    cat="fault", rid=replica.rid,
+                                    req=entry.uid,
+                                    error=type(e).__name__)
         graftscope.emit("route.redelivered", cat="fault",
                         rid=replica.rid, requests=len(entries),
                         replayed_tokens=self.redelivery_replayed_tokens)
+        # nothing writes the dead WAL after the reap: close it
+        # (compacted — handed-off uids drop, router-held uids stay
+        # unfinished for their own delivery path). Releases the open
+        # file handle the drain audit would otherwise name leaked.
+        # Best-effort like the handoffs: a remote journal proxy has
+        # no local handle to close.
+        close = getattr(replica.journal, "close", None)
+        if close is not None:
+            try:
+                close()
+            except Exception as e:
+                graftscope.emit("route.reap_wal_close_failed",
+                                cat="fault", rid=replica.rid,
+                                error=type(e).__name__)
 
     def _steal(self) -> None:
         """Cross-replica work stealing: a READY replica with an empty
@@ -975,6 +1025,8 @@ class Router:
                 "the end of the fleet drain (admission closed before "
                 "it placed): failed named, resubmit to another fleet")
             request.finish_time = time.perf_counter()
+        for transfer in self._transfers:
+            transfer.release()  # dropped at drain: loans go back
         self._pending.clear()
         self._transfers.clear()
         return events

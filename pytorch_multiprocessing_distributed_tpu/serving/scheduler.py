@@ -180,6 +180,15 @@ class QueueFull(RuntimeError):
     ``ServingMetrics.requests_shed``."""
 
 
+class RequestWithdrawn(RuntimeError):
+    """The error recorded on a request evicted by
+    :meth:`~.engine.ServingEngine.withdraw` — the client abandoned it
+    (disconnect, user cancel), so the engine reclaims its slot and
+    pages NOW instead of decoding to the token budget for nobody
+    (ROADMAP item 4). The request leaves FAILED with reason
+    ``"withdraw"``: accounted, never silently dropped."""
+
+
 # request lifecycle states
 QUEUED = "queued"
 RUNNING = "running"
@@ -344,6 +353,16 @@ class FIFOScheduler:
         the queue is empty. The request's lifecycle record (uid,
         ``submit_time``, hence its TTFT clock) travels with it."""
         return self._queue.pop() if self._queue else None
+
+    def withdraw_uid(self, uid) -> Optional[Request]:
+        """Remove and return the QUEUED request carrying ``uid`` (the
+        engine's withdraw verb — same in-place removal as ``expire``),
+        or None when no queued request has it."""
+        for request in self._queue:
+            if request.uid == uid:
+                self._queue.remove(request)
+                return request
+        return None
 
     def requeue_tail(self, request: Request) -> None:
         """Put a withdrawn request back at the TAIL (a theft the

@@ -1,0 +1,88 @@
+"""GL123 near-miss negatives: the same acquire shapes with every
+escape properly covered — try/finally over the risky gap, a
+releasing ``except`` before the re-raise (the recv_frame fix shape),
+ownership ENDING at a transfer edge (return-to-caller,
+store-into-owner, consuming call), a context manager, and a daemon
+thread (self-owning by design). All silent."""
+import socket
+import threading
+
+
+def guarded_gap(pool, sock, shape, dtype):
+    arr = pool.take(shape, dtype)
+    try:
+        recv_into(sock, memoryview(arr))
+    finally:
+        pool.give(arr)
+
+
+def releasing_handler(pool, sock, shape, dtype):
+    # the recv_frame fix: give the loan back, THEN poison the lane
+    arr = pool.take(shape, dtype)
+    try:
+        recv_into(sock, memoryview(arr))
+    except BaseException:
+        pool.give(arr)
+        raise
+    return arr
+
+
+def moved_to_caller(pool):
+    slot = pool.acquire()
+    return slot
+
+
+def stored_into_owner(state, pool, uid):
+    slot = pool.acquire()
+    state.running[uid] = slot
+    bookkeeping()
+
+
+def consumed_by_handoff(pool, out):
+    arr = pool.take((4,), "float32")
+    out.append(arr)
+    bookkeeping()
+
+
+def context_managed(path):
+    with open(path) as fh:
+        return fh.readline()
+
+
+def released_before_return(pool, ready):
+    slot = pool.acquire()
+    if not ready:
+        pool.release(slot)
+        return None
+    return slot
+
+
+def daemon_owns_itself(fn):
+    t = threading.Thread(target=fn, daemon=True)
+    t.start()
+
+
+def joined_worker(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join()
+
+
+def probe_and_close(host, greeting):
+    sock = socket.create_connection((host, 80), timeout=1.0)
+    if not greeting:
+        sock.close()
+        raise ConnectionError("bad hello")
+    return sock
+
+
+def recv_into(sock, view):
+    raise ConnectionError("peer died mid-frame")
+
+
+def bookkeeping():
+    pass
+
+
+def expected():
+    return "hello"

@@ -1,0 +1,67 @@
+"""GL123 positives: acquires with an escaping path that skips the
+release — early return, unwinding raise, a risky call in the
+acquire→release gap with no try protection, an acquire-per-iteration
+never disposed inside the loop, and a fall-off-the-end leak. Each
+finding anchors at the ACQUIRE line (the resource that leaks), with
+the escape site named in the message."""
+import socket
+import threading
+
+
+def early_return(pool, ready):
+    slot = pool.acquire()                           # <- GL123
+    if not ready:
+        return None
+    pool.release(slot)
+    return slot
+
+
+def raise_unwinds(pool, n):
+    pages = pool.alloc_pages(n)                     # <- GL123
+    if n > 4:
+        raise ValueError("too many")
+    pool.decref(pages)
+
+
+def risky_gap(pool, sock, shape, dtype):
+    # the WireError lane-poison shape recv_frame shipped with: buffer
+    # taken, recv raises mid-frame, give-back never runs (the recv
+    # sees a derived view, not the owning name — usage, not a move)
+    arr = pool.take(shape, dtype)                   # <- GL123
+    recv_into(sock, memoryview(arr))
+    pool.give(arr)
+
+
+def leak_per_iteration(pool, items):
+    for item in items:
+        slot = pool.acquire()                       # <- GL123
+        stage(item)
+
+
+def falls_off_the_end(path):
+    fh = open(path)                                 # <- GL123
+    header = fh.readline()
+
+
+def connect_probe(host, greeting):
+    sock = socket.create_connection((host, 80), timeout=1.0)  # <- GL123
+    if greeting != expected():
+        raise ConnectionError("bad hello")
+    return sock
+
+
+def worker_never_joined(fn):
+    t = threading.Thread(target=fn)                 # <- GL123
+    t.start()
+
+
+def recv_into(sock, view):
+    raise ConnectionError("peer died mid-frame")
+
+
+def stage(item):
+    pass
+
+
+def expected():
+    return "hello"

@@ -1,0 +1,37 @@
+"""GL124 near-miss negatives: single releases that LOOK repeated —
+a release on only ONE branch before the common release (some path
+still owns it), the canonical use-then-finally-release idiom (a call
+argument is usage, not a definite ownership move), release-then-
+re-acquire into the same name, and two releases of two DIFFERENT
+resources. All silent."""
+
+
+def one_branch_then_common(pool, fast):
+    pages = pool.alloc_pages(2)
+    if fast:
+        pool.decref(pages)
+        return None
+    pool.decref(pages)
+    return True
+
+
+def use_then_finally(pool, work):
+    slot = pool.acquire()
+    try:
+        work(slot)
+    finally:
+        pool.release(slot)
+
+
+def reacquired_same_name(pool):
+    slot = pool.acquire()
+    pool.release(slot)
+    slot = pool.acquire()
+    pool.release(slot)
+
+
+def two_resources(pool):
+    a = pool.acquire()
+    b = pool.acquire()
+    pool.release(a)
+    pool.release(b)

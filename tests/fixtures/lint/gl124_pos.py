@@ -1,0 +1,38 @@
+"""GL124 positives: releases of a resource EVERY path already
+released — a straight-line repeat, a ``finally`` duplicating the
+body's release, and a post-branch release after BOTH branches
+released. Each finding anchors at the REDUNDANT release site."""
+
+
+def straight_line_repeat(pool):
+    slot = pool.acquire()
+    pool.release(slot)
+    pool.release(slot)                              # <- GL124
+
+
+def finally_duplicates_body(pool, shape, dtype):
+    arr = pool.take(shape, dtype)
+    try:
+        checksum(memoryview(arr))
+        pool.give(arr)
+    finally:
+        pool.give(arr)                              # <- GL124
+
+
+def both_branches_then_again(pool, fast):
+    pages = pool.alloc_pages(2)
+    if fast:
+        pool.decref(pages)
+    else:
+        pool.decref(pages)
+    pool.decref(pages)                              # <- GL124
+
+
+def close_twice(path):
+    fh = open(path)
+    fh.close()
+    fh.close()                                      # <- GL124
+
+
+def checksum(view):
+    return sum(view)

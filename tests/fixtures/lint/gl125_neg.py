@@ -1,0 +1,50 @@
+"""GL125 near-miss negatives: the same two-path store shape WITH a
+release owner — one class drains through the attribute on close
+(release evidence through ``self._held``), one releases each popped
+grant, and one stores from a single path only. All silent."""
+
+
+class DrainedTable:
+    def __init__(self, pool):
+        self.pool = pool
+        self._held = {}
+
+    def admit(self, uid):
+        slot = self.pool.acquire()
+        self._held[uid] = slot
+
+    def steal(self, uid):
+        slot = self.pool.acquire()
+        self._held[uid] = slot
+
+    def close(self):
+        for slot in list(self._held.values()):
+            self.pool.release(slot)
+        self._held.clear()
+
+
+class PoppingTable:
+    def __init__(self, pool):
+        self.pool = pool
+        self._held = {}
+
+    def admit(self, uid):
+        slot = self.pool.acquire()
+        self._held[uid] = slot
+
+    def requeue(self, uid):
+        slot = self.pool.acquire()
+        self._held[uid] = slot
+
+    def evict(self, uid):
+        self.pool.release(self._held.pop(uid))
+
+
+class SinglePath:
+    def __init__(self, pool):
+        self.pool = pool
+        self._held = {}
+
+    def admit(self, uid):
+        slot = self.pool.acquire()
+        self._held[uid] = slot

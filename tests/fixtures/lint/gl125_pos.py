@@ -1,0 +1,22 @@
+"""GL125 positive: ownership ambiguity — pooled grants stored into
+the same ``self`` attribute from TWO call paths while no method of
+the class ever releases through that attribute. Admission stores,
+steal stores, and nobody owns the free: every path assumes another
+is the owner. Anchors at the lexically-first store site."""
+
+
+class AmbiguousTable:
+    def __init__(self, pool):
+        self.pool = pool
+        self._held = {}
+
+    def admit(self, uid):
+        slot = self.pool.acquire()
+        self._held[uid] = slot                      # <- GL125
+
+    def steal(self, uid):
+        slot = self.pool.acquire()
+        self._held[uid] = slot
+
+    def holders(self):
+        return list(self._held)

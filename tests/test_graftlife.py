@@ -1,0 +1,430 @@
+"""graftlife: the ownership ledger + drain audits (ISSUE 20).
+
+The headline pins:
+- drained means EMPTY, audited: after ``drain()``/``stop()``/
+  ``close()`` on every fleet topology — in-process fleet, socket
+  fleet, disagg split (dense and int8 paged), autoscale scale-down,
+  SIGKILL-redelivery — ``audit_drained()`` returns NO findings, and
+  every realized acquire site is one the static model admits
+  (``audit_sites``);
+- ``ServingEngine.withdraw(uid)`` reclaims a RUNNING request's slot
+  and pages NOW (ledger-verified), and every unaffected slot's token
+  stream is byte-identical to the no-withdraw run;
+- the armed ledger is pure host bookkeeping: 0 compiles, 0 transfers,
+  0 host syncs added to a warmed serving path (sentinel-pinned);
+- the pre-fix ``recv_frame`` leak shape keeps firing GL123 forever
+  (the must-keep-firing canary for the true leak this PR fixed).
+
+Heavy topology points are slow-marked; the fast subset stays tier-1.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from pytorch_multiprocessing_distributed_tpu import models
+from pytorch_multiprocessing_distributed_tpu.analysis.lifecycle import (
+    static_lifecycle_model)
+from pytorch_multiprocessing_distributed_tpu.analysis.rules import (
+    analyze_files)
+from pytorch_multiprocessing_distributed_tpu.analysis.sentinels import (
+    guard_transfers, recompile_budget)
+from pytorch_multiprocessing_distributed_tpu.runtime import (
+    faults, heal, life)
+from pytorch_multiprocessing_distributed_tpu.serving import (
+    RemoteReplica, ReplicaServer, Router, ServingEngine,
+    ServingReplica, init_params)
+from pytorch_multiprocessing_distributed_tpu.serving.scheduler import (
+    RequestWithdrawn)
+
+pytestmark = pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+
+PAGED = dict(kv_layout="paged", page_size=8, prefill_chunk=4,
+             decode_horizon=4)
+
+
+def _tiny(**kw):
+    return models.GPT(vocab_size=61, max_seq_len=64, hidden_size=32,
+                      num_layers=2, num_heads=2, mlp_dim=64,
+                      attn_impl="xla", **kw)
+
+
+@pytest.fixture(scope="module")
+def served():
+    model = _tiny()
+    params = init_params(model, 1)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, model.vocab_size, (n,)).tolist()
+               for n in (3, 7, 12, 5, 9, 6)]
+    return model, params, prompts
+
+
+def _engine(model, params, **kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("s_max", 32)
+    kw.setdefault("min_bucket", 8)
+    kw.setdefault("retry_backoff_s", 0.0)
+    return ServingEngine(model, params, **kw)
+
+
+def _assert_settled(led, scope, timeout_s=10.0):
+    """Audit green, with a liveness grace window: stopped servers'
+    handler/lane threads take a few scheduler ticks to exit, and the
+    liveness prune needs them actually dead."""
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if not any(led.counts().values()):
+            break
+        time.sleep(0.02)
+    audit = led.audit_drained(scope)
+    assert audit == [], "\n".join(audit)
+    assert not any(led.counts().values()), led.counts()
+    sites = led.audit_sites()
+    assert sites == [], "\n".join(sites)
+
+
+# ------------------------------------------------- the ledger itself
+
+def test_armed_restores_and_disarmed_is_free():
+    assert life.active_ledger() is None
+    with life.armed() as led:
+        assert life.active_ledger() is led
+        inner = life.OwnershipLedger()
+        with life.armed(inner):
+            assert life.active_ledger() is inner
+        assert life.active_ledger() is led
+    assert life.active_ledger() is None
+
+
+def test_leak_is_named_and_release_empties():
+    led = life.OwnershipLedger()
+    led.acquire("slot", ("p", 3), holder="u7", depth=1)
+    findings = led.audit_drained("unit drain")
+    assert len(findings) == 1
+    f = findings[0]
+    assert "GRAFTLIFE-AUDIT" in f and "leaked slot" in f
+    assert "holder='u7'" in f and "after unit drain" in f
+    assert "test_graftlife.py" in f  # the acquire site, named
+    led.release("slot", ("p", 3))
+    assert led.audit_drained() == []
+    assert led.acquired["slot"] == 1 and led.released["slot"] == 1
+
+
+def test_double_acquire_is_an_anomaly_unmatched_release_is_not():
+    led = life.OwnershipLedger()
+    led.acquire("page", ("p", 0))
+    led.acquire("page", ("p", 0))  # same key, no release between
+    led.release("page", ("p", 0))
+    out = led.audit_drained()
+    assert len(out) == 1 and "double-acquire" in out[0]
+    # a release the armed window never saw acquired: counted, silent
+    led2 = life.OwnershipLedger()
+    led2.release("slot", ("p", 1))
+    assert led2.unmatched_releases["slot"] == 1
+    assert led2.audit_drained() == []
+
+
+def test_liveness_kinds_prune_dead_objects(tmp_path):
+    import socket as socketmod
+    import threading
+    led = life.OwnershipLedger()
+    a, b = socketmod.socketpair()
+    led.acquire("socket", id(a), obj=a, holder="pair")
+    t = threading.Thread(target=lambda: None)
+    t.start()
+    led.acquire("thread", id(t), obj=t, holder=t.name)
+    fh = open(tmp_path / "f.txt", "w")
+    led.acquire("file", id(fh), obj=fh, holder="f.txt")
+    # all still live: three named findings
+    t.join()
+    assert len(led.audit_drained()) == 2  # the thread died: pruned
+    a.close()
+    fh.close()
+    assert led.audit_drained() == []
+    b.close()
+
+
+def test_tag_attributes_a_holder_after_the_fact():
+    led = life.OwnershipLedger()
+    led.acquire("slot", ("p", 0))
+    led.tag("slot", ("p", 0), "u42")
+    f = led.audit_drained()[0]
+    assert "holder='u42'" in f
+
+
+# ------------------------------------------- the static model bridge
+
+def test_static_model_knows_every_instrumented_kind():
+    model = static_lifecycle_model()
+    for kind in ("slot", "page", "buffer", "socket", "thread",
+                 "file", "journal", "transfer"):
+        assert model.acquire_sites.get(kind), f"no {kind} sites"
+    slot_files = {rel for rel, _ in model.acquire_sites["slot"]}
+    assert any(rel.endswith("serving/engine.py") for rel in slot_files)
+    assert model.all_sites()
+
+
+def test_canary_prefix_recv_frame_leak_keeps_firing(tmp_path):
+    """The pre-fix ``recv_frame`` shape — buffer taken, recv raises
+    mid-frame, give-back unreachable — must fire GL123 at the acquire
+    line FOREVER. If this test fails, the analyzer lost the exact
+    finding that caught the real leak this PR fixed in
+    ``runtime/wire.py``; do not weaken the rule."""
+    src = (
+        "def recv_frame_prefix(pool, sock, shape, dtype):\n"
+        "    arr = pool.take(shape, dtype)\n"
+        "    recv_into(sock, memoryview(arr))\n"
+        "    pool.give(arr)\n"
+        "\n"
+        "\n"
+        "def recv_into(sock, view):\n"
+        "    raise ConnectionError('peer died mid-frame')\n"
+    )
+    p = tmp_path / "prefix_recv.py"
+    p.write_text(src)
+    got = [(f.rule, f.line) for f in analyze_files([str(p)])]
+    assert ("GL123", 2) in got, got
+
+
+# ------------------------------------------- drain matrix: fast tier
+
+@pytest.mark.parametrize("cfg", [{}, PAGED],
+                         ids=["dense", "paged"])
+def test_single_engine_drain_audit_green(served, cfg):
+    model, params, prompts = served
+    with life.armed() as led:
+        engine = _engine(model, params, **cfg)
+        done = engine.serve([(p, 6) for p in prompts])
+        assert all(r.state == "done" for r in done)
+        _assert_settled(led, "engine serve+drain")
+        assert led.acquired["slot"] > 0  # armed, really recording
+
+
+def test_inprocess_fleet_drain_audit_green(served, tmp_path):
+    """2 journaled replicas behind the router: serve, drain — every
+    ledger empty, WALs compacted AND their file handles closed."""
+    model, params, prompts = served
+    with life.armed() as led:
+        reps = []
+        for i in range(2):
+            journal = heal.RequestJournal(
+                str(tmp_path / f"wal{i}.jsonl"))
+            reps.append(ServingReplica(
+                f"r{i}", _engine(model, params, journal=journal),
+                journal=journal))
+        router = Router(reps)
+        out = router.serve([(p, 6) for p in prompts])
+        assert all(r.state == "done" for r in out)
+        router.drain(None)
+        assert router.healthz()["state_name"] == "DEAD"
+        _assert_settled(led, "fleet drain")
+        assert led.acquired["journal"] >= len(prompts)
+        assert led.acquired["file"] == 2
+
+
+def test_sigkill_redelivery_drain_audit_green(served, tmp_path):
+    """The hard point: kill one replica mid-stream (injected engine-
+    fatal), redeliver from its WAL — then EVERYTHING still drains
+    empty: the dead engine's slots/pages hard-reclaimed at the reap,
+    its WAL's admits handoff-settled and its file handle closed."""
+    model, params, prompts = served
+    with life.armed() as led:
+        def mkrep(i):
+            journal = heal.RequestJournal(
+                str(tmp_path / f"wal{i}.jsonl"))
+            engine = _engine(model, params, journal=journal,
+                             dispatch_retries=1)
+            return ServingReplica(f"r{i}", engine, journal=journal)
+
+        router = Router([mkrep(0), mkrep(1)])
+        for i, p in enumerate(prompts):
+            router.submit(p, 6, uid=f"u{i}")
+        for _ in range(3):
+            router.step()
+        plan = faults.FaultPlan(seed=1, rules=[faults.FaultRule(
+            "serving.decode_dispatch", "fatal", times=1)])
+        faults.arm(plan)
+        try:
+            while router.in_flight:
+                router.step()
+        finally:
+            faults.disarm()
+        assert sum(r.reaped for r in router.replicas) == 1
+        assert router.requests_redelivered >= 1
+        recs = router.records()
+        assert all(recs[f"u{i}"].state == "done"
+                   for i in range(len(prompts)))
+        router.drain(None)
+        _assert_settled(led, "SIGKILL redelivery + drain")
+
+
+# --------------------------------------- withdraw (ROADMAP item 4)
+
+def test_withdraw_running_reclaims_and_leaves_peers_token_exact(
+        served):
+    """Withdraw a RUNNING request: its slot and pages come back NOW
+    (ledger-verified), it leaves FAILED/"withdraw" with
+    RequestWithdrawn on .error, and the co-resident slot's stream is
+    byte-identical to the no-withdraw run."""
+    model, params, prompts = served
+    ref_engine = _engine(model, params, **PAGED)
+    ref = ref_engine.serve([(p, 6) for p in prompts[:2]])
+    ref_tokens = list(ref[1].tokens)
+
+    with life.armed() as led:
+        engine = _engine(model, params, **PAGED)
+        r0 = engine.submit(prompts[0], 6, uid="u0")
+        r1 = engine.submit(prompts[1], 6, uid="u1")
+        for _ in range(50):
+            if len(engine._running) >= 2:
+                break
+            engine.step()
+        assert led.live("slot") == 2
+        pages_before = led.live("page")
+        assert engine.withdraw("u0") is True
+        assert led.live("slot") == 1, "slot not reclaimed"
+        assert led.live("page") < pages_before, "pages not reclaimed"
+        assert engine.withdraw("nope") is False
+        engine.drain()
+        _assert_settled(led, "withdraw + drain")
+    assert r0.state == "failed"
+    assert r0.finish_reason == "withdraw"
+    assert isinstance(r0.error, RequestWithdrawn)
+    assert r1.state == "done"
+    assert list(r1.tokens) == ref_tokens, (
+        "withdraw perturbed an unaffected slot's stream")
+
+
+def test_withdraw_queued_never_runs(served):
+    model, params, prompts = served
+    engine = _engine(model, params)  # max_slots=2
+    engine.submit(prompts[0], 4, uid="u0")
+    engine.submit(prompts[1], 4, uid="u1")
+    queued = engine.submit(prompts[2], 4, uid="u2")
+    assert engine.withdraw("u2") is True
+    done = engine.drain()
+    assert queued.state == "failed"
+    assert queued.finish_reason == "withdraw"
+    assert queued.tokens == []  # never decoded a single token
+    assert {r.uid for r, _, fin in done if fin} == {"u0", "u1"}
+
+
+# ----------------------------------------- the zero-cost sentinels
+
+def test_armed_ledger_adds_no_compiles_no_transfers(served):
+    """Arming the ledger over a warmed engine: 0 new decode programs,
+    0 unexpected transfers, byte-identical streams — the ledger is
+    host bookkeeping only."""
+    model, params, prompts = served
+    engine = _engine(model, params)
+    first = engine.serve([(p, 4) for p in prompts])  # warm, disarmed
+    with life.armed() as led:
+        with guard_transfers():
+            with recompile_budget(engine._decode, 0,
+                                  label="armed-ledger steady state"):
+                again = engine.serve([(p, 4) for p in prompts])
+        _assert_settled(led, "armed steady-state serve")
+        assert led.acquired["slot"] >= len(prompts)
+    assert [list(r.tokens) for r in again] == \
+        [list(r.tokens) for r in first]
+
+
+# ------------------------------------------- drain matrix: slow tier
+
+@pytest.mark.slow
+def test_socket_fleet_stop_audit_green(served):
+    """Dense pipelined socket fleet: serve, close the clients, stop
+    the servers — sockets, lane/handler threads, wire buffers, slots
+    all settle to zero."""
+    model, params, prompts = served
+    with life.armed() as led:
+        servers = [ReplicaServer(_engine(model, params), rid=f"r{i}",
+                                 role="both").start()
+                   for i in range(2)]
+        replicas = [RemoteReplica(s.address, backoff_s=0.0,
+                                  pipelined=True) for s in servers]
+        router = Router(replicas)
+        try:
+            out = router.serve([(p, 6) for p in prompts])
+            assert all(r.state == "done" for r in out)
+        finally:
+            for r in replicas:
+                r.close()
+            for s in servers:
+                s.stop()
+        _assert_settled(led, "socket fleet stop")
+        assert led.acquired["socket"] > 0
+        assert led.acquired["thread"] > 0
+
+
+@pytest.mark.slow
+def test_disagg_int8_socket_fleet_audit_green(served):
+    """The hardest wire shape: prefill/decode split over sockets with
+    int8 paged KV — every PageTransfer ends at a splice (consumed) or
+    a drop (released), every wire buffer returns to its pool."""
+    model, params, prompts = served
+    cfg = dict(PAGED, kv_dtype="int8")
+    with life.armed() as led:
+        servers = [
+            ReplicaServer(_engine(model, params, **cfg), rid="pf",
+                          role="prefill").start(),
+            ReplicaServer(_engine(model, params, **cfg), rid="dc",
+                          role="decode").start()]
+        replicas = [RemoteReplica(s.address, backoff_s=0.0,
+                                  pipelined=True) for s in servers]
+        router = Router(replicas)
+        try:
+            out = router.serve([(p, 6) for p in prompts])
+            assert all(r.state == "done" for r in out)
+        finally:
+            for r in replicas:
+                r.close()
+            for s in servers:
+                s.stop()
+        _assert_settled(led, "disagg int8 fleet stop")
+        assert led.acquired["transfer"] >= len(prompts)
+        assert led.acquired["buffer"] > 0
+
+
+@pytest.mark.slow
+def test_autoscale_scale_down_audit_green(served):
+    """Burst grows the fleet, idleness drains it back to min — every
+    retired replica's resources settle; the final drain is empty."""
+    from pytorch_multiprocessing_distributed_tpu.serving import (
+        EngineReplicaSpawner, FleetAutoscaler, FleetSaturated)
+    model, params, prompts = served
+    with life.armed() as led:
+        router = Router([ServingReplica(
+            "r0", _engine(model, params))], max_pending=4)
+        scaler = FleetAutoscaler(
+            router, EngineReplicaSpawner(
+                lambda tag, journal: _engine(model, params)),
+            min_replicas=1, max_replicas=3, up_after=2, down_after=6,
+            cooldown=3, sleep=lambda s: None)
+        uid = 0
+        for _ in range(25):
+            for _ in range(2):
+                try:
+                    router.submit(list(prompts[uid % len(prompts)]),
+                                  6, uid=f"u{uid}")
+                    uid += 1
+                except FleetSaturated:
+                    pass
+            router.step()
+            scaler.tick()
+        steps = 0
+        while (router.in_flight or router.pending_depth) \
+                and steps < 3000:
+            router.step()
+            scaler.tick()
+            steps += 1
+        for _ in range(60):  # idle plateau: scale back down
+            router.step()
+            scaler.tick()
+        assert scaler.scale_ups >= 1
+        assert len(router.replicas) == 1
+        router.drain(None)
+        _assert_settled(led, "autoscale scale-down + drain")
